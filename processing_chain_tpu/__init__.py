@@ -1,0 +1,23 @@
+"""processing_chain_tpu — TPU-native video degradation processing chain.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the
+AVHD-AS / P.NATS Phase 2 processing chain (reference: pnats2avhd/processing-chain):
+YAML-defined test databases of SRC videos and HRC degradation conditions are
+encoded into segments, metadata (.qchanges/.vfi/.afi/.buff), lossless AVPVS
+renders, and context-processed CPVS outputs — with the pixel-domain hot path
+(decode-fed rescale, spinner/stall compositing, concat, SI/TI + PSNR/SSIM
+feature extraction) executed as batched kernels on TPU.
+
+Layout:
+    config/    domain model + YAML contract (reference: lib/test_config.py)
+    models/    the four artifact pipelines as typed op graphs
+               (segments / metadata / avpvs / cpvs)
+    ops/       device kernel library (resize, SI/TI, overlay, metrics, pixfmt)
+    parallel/  mesh + sharding strategies, host fan-out, halo exchange
+    io/        host media boundary (native libav demux/decode/encode/mux)
+    native/    C++ sources for the media boundary
+    stages/    p00–p04 drivers + CLI (reference: p0*_*.py)
+    utils/     logging, runner, version, aux tools
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+from .log import get_logger, setup_custom_logger
+from .runner import ChainError, ParallelRunner, run_task, shell
+
+__all__ = [
+    "get_logger",
+    "setup_custom_logger",
+    "ChainError",
+    "ParallelRunner",
+    "run_task",
+    "shell",
+]

@@ -1,0 +1,47 @@
+"""Central logger for the chain.
+
+Parity target: reference lib/log.py:26-67 — a single process-wide logger named
+"main" with ANSI-colored level names on stderr and DEBUG enabled by -v.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_COLORS = {
+    logging.DEBUG: "\033[36m",     # cyan
+    logging.INFO: "\033[32m",      # green
+    logging.WARNING: "\033[33m",   # yellow
+    logging.ERROR: "\033[31m",     # red
+    logging.CRITICAL: "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            record.levelname = f"{color}{record.levelname}{_RESET}"
+        return super().format(record)
+
+
+def setup_custom_logger(name: str = "main", verbose: bool = False) -> logging.Logger:
+    """Create (or reconfigure) the chain-wide logger."""
+    logger = logging.getLogger(name)
+    logger.setLevel(logging.DEBUG if verbose else logging.INFO)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            _ColorFormatter("%(asctime)s [%(levelname)s] %(message)s", "%H:%M:%S")
+        )
+        logger.addHandler(handler)
+    else:
+        logger.handlers[0].setLevel(logging.DEBUG)
+    logger.propagate = False
+    return logger
+
+
+def get_logger() -> logging.Logger:
+    return logging.getLogger("main")

@@ -1,0 +1,122 @@
+"""Host-side parallel task execution.
+
+Parity target: reference lib/cmd_utils.py:42-148. The reference's entire
+parallelism engine is a multiprocessing.Pool over ffmpeg shell command
+strings (`ParallelRunner`). Here the unit of work is an in-process Python
+callable (usually a thin driver around native libav calls or a jitted device
+function), so we use a thread pool: the native decode/encode paths release
+the GIL and device dispatch is async.
+
+Deliberate fixes over the reference (SURVEY.md quirks list — do-not-copy):
+  * tasks are kept in an *ordered* dedup'd list, not a set
+    (cmd_utils.py:73-79 dedups via set => nondeterministic order);
+  * results/exceptions are recorded per task (cmd_utils.py:88-91 has dead
+    code after `return` and never stores stdout/stderr);
+  * fail-fast cancels not-yet-started tasks but reports the first error with
+    its task label.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from .log import get_logger
+
+logger_ = get_logger
+
+
+@dataclass
+class Task:
+    """A schedulable unit of host work."""
+
+    fn: Callable[..., Any]
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+    label: str = ""
+
+    def key(self) -> str:
+        return self.label or repr((self.fn, self.args))
+
+
+class ChainError(RuntimeError):
+    """Raised when any task in a fail-fast batch fails."""
+
+
+class ParallelRunner:
+    """Ordered, dedup'd, fail-fast parallel executor for host tasks."""
+
+    def __init__(self, max_parallel: int = 4, name: str = "runner") -> None:
+        self.max_parallel = max(1, int(max_parallel))
+        self.name = name
+        self._tasks: list[Task] = []
+        self._seen: set[str] = set()
+        self.results: dict[str, Any] = {}
+
+    def add(self, fn: Callable[..., Any], *args: Any, label: str = "", **kwargs: Any) -> None:
+        task = Task(fn, args, kwargs, label)
+        key = task.key()
+        if key in self._seen:
+            logger_().debug("%s: duplicate task skipped: %s", self.name, key)
+            return
+        self._seen.add(key)
+        self._tasks.append(task)
+
+    def __len__(self) -> int:
+        return len(self._tasks)
+
+    def run(self) -> dict[str, Any]:
+        """Run all tasks; raise ChainError on first failure (fail-fast,
+        reference cmd_utils.py:97-99 aborts the whole run on any nonzero
+        exit). Returns {task key: result}."""
+        if not self._tasks:
+            return {}
+        log = logger_()
+        log.debug("%s: running %d tasks, %d-wide", self.name, len(self._tasks), self.max_parallel)
+        with ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
+            futures = {pool.submit(t.fn, *t.args, **t.kwargs): t for t in self._tasks}
+            done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
+            first_err: BaseException | None = None
+            err_task: Task | None = None
+            for fut in done:
+                task = futures[fut]
+                exc = fut.exception()
+                if exc is not None and first_err is None:
+                    first_err, err_task = exc, task
+                elif exc is None:
+                    self.results[task.key()] = fut.result()
+            if first_err is not None:
+                for fut in not_done:
+                    fut.cancel()
+                raise ChainError(
+                    f"{self.name}: task '{err_task.key()}' failed: {first_err!r}"
+                ) from first_err
+        self._tasks.clear()
+        self._seen.clear()
+        return self.results
+
+
+def run_task(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+    """Serial single-task helper (reference run_command, cmd_utils.py:132-148):
+    executes and converts failure into ChainError."""
+    try:
+        return fn(*args, **kwargs)
+    except Exception as exc:  # noqa: BLE001 - fail-fast boundary
+        raise ChainError(f"task {getattr(fn, '__name__', fn)!r} failed: {exc!r}") from exc
+
+
+def shell(cmd: Sequence[str] | str, check: bool = True) -> subprocess.CompletedProcess:
+    """Minimal subprocess helper (reference shell_call, cmd_utils.py:42-57).
+
+    Only used at the edges (e.g. `git describe` for versioning); media work
+    never goes through a shell in this framework.
+    """
+    return subprocess.run(
+        cmd,
+        shell=isinstance(cmd, str),
+        check=check,
+        capture_output=True,
+        text=True,
+    )

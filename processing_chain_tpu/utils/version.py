@@ -1,0 +1,47 @@
+"""Chain version reporting.
+
+Parity target: reference lib/check_requirements.py:34-56 — version string is
+`git describe` when available, else the package version; requirement checking
+is a scaffold that logs but never fails (reference sets fail=False always).
+Here the runtime requirements are importable modules + the native media
+library, so the check is real.
+"""
+
+from __future__ import annotations
+
+from . import log
+from .runner import shell
+
+
+def get_processing_chain_version() -> str:
+    try:
+        result = shell(["git", "describe", "--always", "--dirty"], check=False)
+        if result.returncode == 0 and result.stdout.strip():
+            return result.stdout.strip()
+    except OSError:
+        pass
+    from .. import __version__
+
+    return __version__
+
+
+def check_requirements(need_device: bool = False) -> bool:
+    """Verify the runtime environment. Returns True when usable."""
+    logger = log.get_logger()
+    ok = True
+    try:
+        import jax
+
+        if need_device:
+            jax.devices()
+    except Exception as exc:  # pragma: no cover - environment-specific
+        logger.error("jax unavailable: %r", exc)
+        ok = False
+    try:
+        from ..io import medialib
+
+        medialib.ensure_loaded()
+    except Exception as exc:
+        logger.warning("native media library unavailable: %r", exc)
+    logger.info("processing chain version: %s", get_processing_chain_version())
+    return ok

@@ -1,0 +1,28 @@
+import pytest
+
+from processing_chain_tpu.utils import ChainError, ParallelRunner, run_task
+
+
+def test_runner_ordered_dedup_and_results():
+    r = ParallelRunner(max_parallel=4)
+    out = []
+    for i in [1, 2, 2, 3]:
+        r.add(lambda x=i: out.append(x) or x * 10, label=f"t{i}")
+    assert len(r) == 3  # dedup by label, order preserved
+    results = r.run()
+    assert results == {"t1": 10, "t2": 20, "t3": 30}
+
+
+def test_runner_fail_fast():
+    r = ParallelRunner(max_parallel=2)
+    def boom():
+        raise ValueError("nope")
+    r.add(boom, label="bad")
+    with pytest.raises(ChainError, match="bad"):
+        r.run()
+
+
+def test_run_task_wraps_errors():
+    with pytest.raises(ChainError):
+        run_task(lambda: 1 / 0)
+    assert run_task(lambda: 42) == 42
