@@ -37,8 +37,6 @@ def setup_custom_logger(name: str = "main", verbose: bool = False) -> logging.Lo
             _ColorFormatter("%(asctime)s [%(levelname)s] %(message)s", "%H:%M:%S")
         )
         logger.addHandler(handler)
-    else:
-        logger.handlers[0].setLevel(logging.DEBUG)
     logger.propagate = False
     return logger
 

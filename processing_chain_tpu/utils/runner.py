@@ -38,7 +38,7 @@ class Task:
     label: str = ""
 
     def key(self) -> str:
-        return self.label or repr((self.fn, self.args))
+        return self.label or repr((self.fn, self.args, sorted(self.kwargs.items())))
 
 
 class ChainError(RuntimeError):
@@ -71,8 +71,9 @@ class ParallelRunner:
         """Run all tasks; raise ChainError on first failure (fail-fast,
         reference cmd_utils.py:97-99 aborts the whole run on any nonzero
         exit). Returns {task key: result}."""
+        self.results = {}
         if not self._tasks:
-            return {}
+            return self.results
         log = logger_()
         log.debug("%s: running %d tasks, %d-wide", self.name, len(self._tasks), self.max_parallel)
         with ThreadPoolExecutor(max_workers=self.max_parallel) as pool:

@@ -10,12 +10,21 @@ library, so the check is real.
 from __future__ import annotations
 
 from . import log
-from .runner import shell
 
 
 def get_processing_chain_version() -> str:
+    import os
+    import subprocess
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     try:
-        result = shell(["git", "describe", "--always", "--dirty"], check=False)
+        result = subprocess.run(
+            ["git", "describe", "--always", "--dirty"],
+            cwd=pkg_root,
+            capture_output=True,
+            text=True,
+            check=False,
+        )
         if result.returncode == 0 and result.stdout.strip():
             return result.stdout.strip()
     except OSError:

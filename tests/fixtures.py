@@ -1,0 +1,145 @@
+"""Shared fixture factories: synthetic mini databases in tmp dirs.
+
+Mirrors the role of the reference's external example-databases corpus
+(reference test/build_and_test.sh:1-15, README.md:87-92) without shipping
+media: SRC probing is satisfied by StaticProber, and tests that need real
+pixels generate tiny synthetic SRCs through the io layer.
+"""
+
+from __future__ import annotations
+
+import os
+import textwrap
+
+from processing_chain_tpu.config import StaticProber
+
+SRC_INFO_1080 = {
+    "width": 1920,
+    "height": 1080,
+    "pix_fmt": "yuv420p",
+    "r_frame_rate": "24/1",
+    "video_duration": 10.0,
+    "video_codec": "ffv1",
+}
+
+
+def write_short_db(tmp_path, db_id: str = "P2SXM00", src_info: dict | None = None):
+    """Create a short-test database folder + YAML; returns (yaml_path, prober)."""
+    db_dir = tmp_path / db_id
+    db_dir.mkdir(parents=True, exist_ok=True)
+    (db_dir / "srcVid").mkdir(exist_ok=True)
+    yaml_path = db_dir / f"{db_id}.yaml"
+    yaml_path.write_text(textwrap.dedent(f"""\
+        databaseId: {db_id}
+        syntaxVersion: 6
+        type: short
+        qualityLevelList:
+          Q0:
+            index: 0
+            videoCodec: h264
+            videoBitrate: 500
+            width: 960
+            height: 540
+            fps: 24
+          Q1:
+            index: 1
+            videoCodec: h264
+            videoBitrate: 2000
+            width: 1920
+            height: 1080
+            fps: 24
+        codingList:
+          VC01:
+            type: video
+            encoder: libx264
+            passes: 2
+            iFrameInterval: 2
+        srcList:
+          SRC000: SRC000.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            eventList:
+              - [Q0, 8]
+          HRC001:
+            videoCodingId: VC01
+            eventList:
+              - [Q1, 8]
+        pvsList:
+          - {db_id}_SRC000_HRC000
+          - {db_id}_SRC000_HRC001
+        postProcessingList:
+          - type: pc
+            displayWidth: 1920
+            displayHeight: 1080
+            codingWidth: 1920
+            codingHeight: 1080
+    """))
+    src_file = db_dir / "srcVid" / "SRC000.avi"
+    src_file.write_bytes(b"")  # placeholder; probing is via StaticProber
+    prober = StaticProber({"SRC000.avi": src_info or SRC_INFO_1080})
+    return str(yaml_path), prober
+
+
+def write_long_db(tmp_path, db_id: str = "P2LTR00", src_duration: float = 12.0):
+    """Long-test database with a stall and last-segment truncation."""
+    db_dir = tmp_path / db_id
+    db_dir.mkdir(parents=True, exist_ok=True)
+    (db_dir / "srcVid").mkdir(exist_ok=True)
+    yaml_path = db_dir / f"{db_id}.yaml"
+    yaml_path.write_text(textwrap.dedent(f"""\
+        databaseId: {db_id}
+        syntaxVersion: 6
+        type: long
+        segmentDuration: 5
+        qualityLevelList:
+          Q0:
+            index: 0
+            videoCodec: h264
+            videoBitrate: 500
+            width: 960
+            height: 540
+            fps: 24
+            audioCodec: aac
+            audioBitrate: 128
+          Q1:
+            index: 1
+            videoCodec: h264
+            videoBitrate: 2000
+            width: 1920
+            height: 1080
+            fps: 24
+            audioCodec: aac
+            audioBitrate: 128
+        codingList:
+          VC01:
+            type: video
+            encoder: libx264
+            passes: 1
+            iFrameInterval: 2
+          AC01:
+            type: audio
+            encoder: aac
+        srcList:
+          SRC001: SRC001.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            audioCodingId: AC01
+            eventList:
+              - [Q0, 10]
+              - [stall, 2.5]
+              - [Q1, 5]
+        pvsList:
+          - {db_id}_SRC001_HRC000
+        postProcessingList:
+          - type: pc
+            displayWidth: 1920
+            displayHeight: 1080
+            codingWidth: 1920
+            codingHeight: 1080
+    """))
+    (db_dir / "srcVid" / "SRC001.avi").write_bytes(b"")
+    info = dict(SRC_INFO_1080, video_duration=src_duration)
+    prober = StaticProber({"SRC001.avi": info})
+    return str(yaml_path), prober

@@ -1,0 +1,227 @@
+"""Tests for the config domain model against reference semantics
+(reference lib/test_config.py; see SURVEY.md §3.2)."""
+
+import os
+
+import pytest
+
+from processing_chain_tpu.config import ConfigError, StaticProber, TestConfig
+from tests.fixtures import SRC_INFO_1080, write_long_db, write_short_db
+
+
+def test_short_db_parses(tmp_path):
+    yaml_path, prober = write_short_db(tmp_path)
+    tc = TestConfig(yaml_path, prober=prober)
+    assert tc.is_short() and not tc.is_long()
+    assert tc.database_id == "P2SXM00"
+    assert set(tc.pvses) == {"P2SXM00_SRC000_HRC000", "P2SXM00_SRC000_HRC001"}
+    # one segment per PVS; distinct quality levels → 2 segments total
+    assert len(tc.segments) == 2
+    seg = sorted(tc.segments)[0]
+    assert seg.filename == "P2SXM00_SRC000_Q0_VC01_0000_0-8.mp4"
+    assert seg.target_pix_fmt == "yuv420p"
+    assert seg.start_time == 0 and seg.duration == 8
+
+
+def test_segment_dedup_across_pvses(tmp_path):
+    """Two PVSes sharing SRC×QL×coding×time must share one segment
+    (reference Segment.__hash__ :583-590)."""
+    yaml_path, prober = write_short_db(tmp_path)
+    tc = TestConfig(yaml_path, prober=prober)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["hrcList"]["HRC002"] = {"videoCodingId": "VC01", "eventList": [["Q0", 8]]}
+    data["pvsList"].append("P2SXM00_SRC000_HRC002")
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    tc = TestConfig(yaml_path, prober=prober)
+    assert len(tc.pvses) == 3
+    assert len(tc.segments) == 2  # HRC002 reuses HRC000's segment
+
+
+def test_long_db_planner_truncation_and_stall(tmp_path):
+    yaml_path, prober = write_long_db(tmp_path, src_duration=12.0)
+    tc = TestConfig(yaml_path, prober=prober)
+    pvs = tc.pvses["P2LTR00_SRC001_HRC000"]
+    # events: Q0 x10s (2 segments of 5), stall 2.5 (no segment),
+    # Q1 x5s but SRC only 12s → truncated to 2s
+    assert [(s.start_time, s.duration) for s in pvs.segments] == [
+        (0, 5), (5, 5), (10, 2.0),
+    ]
+    assert pvs.segments[2].filename.endswith("_0002_10-12.mp4")
+    assert pvs.has_buffering() and not pvs.has_framefreeze()
+    assert pvs.get_buff_events_media_time() == [[10, 2.5]]
+    assert pvs.get_buff_events_wallclock_time() == [[10, 2.5]]
+    assert pvs.hrc.get_long_hrc_duration() == 17.5
+
+
+def test_buff_events_wallclock_vs_media(tmp_path):
+    """Wallclock time includes prior stall durations, media time does not
+    (reference :312-350)."""
+    yaml_path, prober = write_long_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["hrcList"]["HRC000"]["eventList"] = [
+        ["Q0", 5], ["stall", 2.0], ["Q0", 5], ["stall", 1.5], ["Q1", 5]
+    ]
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    tc = TestConfig(yaml_path, prober=prober)
+    hrc = tc.hrcs["HRC000"]
+    assert hrc.get_buff_events_media_time() == [[5, 2.0], [10, 1.5]]
+    assert hrc.get_buff_events_wallclock_time() == [[5, 2.0], [12, 1.5]]
+
+
+def test_freeze_events_sorted_durations(tmp_path):
+    yaml_path, prober = write_long_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["hrcList"]["HRC000"]["eventList"] = [
+        ["Q0", 5], ["freeze", 3.0], ["Q0", 5], ["freeze", 1.5],
+    ]
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    tc = TestConfig(yaml_path, prober=prober)
+    hrc = tc.hrcs["HRC000"]
+    assert hrc.has_framefreeze()
+    # freeze mode: sorted bare durations, converted to float
+    assert hrc.get_buff_events_media_time() == [1.5, 3.0]
+
+
+def test_event_divisibility_error(tmp_path):
+    yaml_path, prober = write_long_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["hrcList"]["HRC000"]["eventList"] = [["Q0", 7]]  # not divisible by 5
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    with pytest.raises(ConfigError, match="does not match"):
+        TestConfig(yaml_path, prober=prober)
+
+
+def test_short_db_multi_segment_rejected(tmp_path):
+    yaml_path, prober = write_short_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    # 16s event with first-event-derived segment duration 8 → 2 segments
+    data["hrcList"]["HRC000"]["eventList"] = [["Q0", 16]]
+    data["hrcList"]["HRC000"]["segmentDuration"] = 8
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    with pytest.raises(ConfigError, match="one segment"):
+        TestConfig(yaml_path, prober=prober)
+
+
+def test_upscale_guard(tmp_path):
+    """SRC narrower than max HRC width is rejected (reference Pvs :59-65)."""
+    small_src = dict(SRC_INFO_1080, width=960, height=540)
+    yaml_path, _ = write_short_db(tmp_path)
+    prober = StaticProber({"SRC000.avi": small_src})
+    with pytest.raises(ConfigError, match="upscaled"):
+        TestConfig(yaml_path, prober=prober)
+
+
+def test_pix_fmt_harmonization(tmp_path):
+    for src_fmt, expected in [
+        ("yuv444p", "yuv422p"),
+        ("yuv422p", "yuv422p"),
+        ("rgb24", "yuv422p"),
+        ("yuv420p", "yuv420p"),
+        ("yuv420p10le", "yuv420p10le"),
+        ("yuv444p10le", "yuv422p10le"),
+    ]:
+        yaml_path, _ = write_short_db(tmp_path / src_fmt)
+        prober = StaticProber({"SRC000.avi": dict(SRC_INFO_1080, pix_fmt=src_fmt)})
+        tc = TestConfig(yaml_path, prober=prober)
+        seg = next(iter(tc.segments))
+        assert seg.target_pix_fmt == expected, src_fmt
+
+
+def test_filters(tmp_path):
+    yaml_path, prober = write_short_db(tmp_path)
+    tc = TestConfig(yaml_path, prober=prober, filter_hrcs="HRC000")
+    assert set(tc.pvses) == {"P2SXM00_SRC000_HRC000"}
+    tc = TestConfig(yaml_path, prober=prober, filter_pvses="P2SXM00_SRC000_HRC001")
+    assert set(tc.pvses) == {"P2SXM00_SRC000_HRC001"}
+
+
+def test_bad_ids_rejected(tmp_path):
+    yaml_path, prober = write_short_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["qualityLevelList"]["X0"] = data["qualityLevelList"].pop("Q0")
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    with pytest.raises(ConfigError, match="syntax"):
+        TestConfig(yaml_path, prober=prober)
+
+
+def test_syntax_version_gate(tmp_path):
+    yaml_path, prober = write_short_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["syntaxVersion"] = 5
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    with pytest.raises(ConfigError, match="syntaxVersion"):
+        TestConfig(yaml_path, prober=prober)
+
+
+def test_complexity_ladder(tmp_path):
+    """'low/high' bitrate pairs select by complexity class (reference
+    :426-445, :1250-1257)."""
+    yaml_path, prober = write_short_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["qualityLevelList"]["Q0"]["videoBitrate"] = "400/800"
+    data["qualityLevelList"]["Q1"]["videoBitrate"] = "1500/3000"
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    cdir = tmp_path / "complexityAnalysis"
+    cdir.mkdir()
+    (cdir / "complexity_classification.csv").write_text(
+        "file,complexity,complexity_class\nSRC000.avi,5.0,3\n"
+    )
+    tc = TestConfig(yaml_path, prober=prober, complexity_csv_dir=str(cdir))
+    assert tc.is_complex()
+    rates = sorted(s.target_video_bitrate for s in tc.segments)
+    assert rates == [800.0, 3000.0]  # class 3 > 1 → high rung
+
+    # class 0 → low rung
+    (cdir / "complexity_classification.csv").write_text(
+        "file,complexity,complexity_class\nSRC000.avi,1.0,0\n"
+    )
+    tc = TestConfig(yaml_path, prober=prober, complexity_csv_dir=str(cdir))
+    rates = sorted(s.target_video_bitrate for s in tc.segments)
+    assert rates == [400.0, 1500.0]
+
+
+def test_cpvs_paths_and_formats(tmp_path):
+    yaml_path, prober = write_short_db(tmp_path)
+    tc = TestConfig(yaml_path, prober=prober)
+    pvs = tc.pvses["P2SXM00_SRC000_HRC000"]
+    assert pvs.get_cpvs_file_path("pc").endswith("P2SXM00_SRC000_HRC000_PC.avi")
+    assert pvs.get_cpvs_file_path("mobile").endswith("P2SXM00_SRC000_HRC000_MO.mp4")
+    assert pvs.get_cpvs_file_path("pc", rawvideo=True).endswith("_PC.mkv")
+    assert pvs.get_vcodec_and_pix_fmt_for_cpvs() == ("rawvideo", "uyvy422")
+    assert pvs.get_avpvs_file_path().endswith("P2SXM00_SRC000_HRC000.avi")
+
+
+def test_database_layout_created(tmp_path):
+    yaml_path, prober = write_short_db(tmp_path)
+    TestConfig(yaml_path, prober=prober)
+    db_dir = os.path.dirname(yaml_path)
+    for sub in [
+        "videoSegments", "avpvs", "cpvs", "logs", "buffEventFiles",
+        "qualityChangeEventFiles", "videoFrameInformation",
+        "audioFrameInformation", "sideInformation",
+    ]:
+        assert os.path.isdir(os.path.join(db_dir, sub)), sub
