@@ -403,6 +403,13 @@ class TestConfig:
                 if event.duration == "src_duration":
                     n_segments = 1
                 else:
+                    if pvs.hrc.segment_duration == "src_duration":
+                        raise ConfigError(
+                            f"HRC {pvs.hrc.hrc_id} mixes a numeric event "
+                            f"duration ({event.duration}) with src_duration "
+                            "segmenting; use src_duration for all events or "
+                            "set an explicit segmentDuration"
+                        )
                     if event.duration % pvs.hrc.segment_duration != 0:
                         raise ConfigError(
                             f"event duration {event.duration} does not match "

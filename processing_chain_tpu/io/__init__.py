@@ -1,0 +1,13 @@
+from . import framesizes, medialib, probe
+from .medialib import MediaError
+from .video import Frame, VideoReader, VideoWriter
+
+__all__ = [
+    "framesizes",
+    "medialib",
+    "probe",
+    "MediaError",
+    "Frame",
+    "VideoReader",
+    "VideoWriter",
+]

@@ -1,0 +1,369 @@
+"""ctypes bindings for the native media boundary (native/media.cpp).
+
+Auto-builds libpcmedia.so from source on first use if missing (the native
+analog of the reference's Docker-built ffmpeg, Dockerfile:1-56).
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libpcmedia.so")
+
+_lock = threading.Lock()
+_lib: Optional[ct.CDLL] = None
+
+# swscale flag constants (libswscale/swscale.h)
+SWS_FAST_BILINEAR = 1
+SWS_BILINEAR = 2
+SWS_BICUBIC = 4
+SWS_POINT = 0x10
+SWS_AREA = 0x20
+SWS_BICUBLIN = 0x40
+SWS_SINC = 0x100
+SWS_LANCZOS = 0x200
+SWS_SPLINE = 0x400
+SWS_ACCURATE_RND = 0x40000
+SWS_BITEXACT = 0x80000
+SWS_FULL_CHR_H_INT = 0x2000
+SWS_FULL_CHR_H_INP = 0x4000
+
+
+class MPStreamInfo(ct.Structure):
+    _fields_ = [
+        ("stream_index", ct.c_int32),
+        ("codec_type", ct.c_int32),
+        ("codec_name", ct.c_char * 32),
+        ("width", ct.c_int32),
+        ("height", ct.c_int32),
+        ("pix_fmt", ct.c_char * 32),
+        ("fps_num", ct.c_int32),
+        ("fps_den", ct.c_int32),
+        ("avg_fps_num", ct.c_int32),
+        ("avg_fps_den", ct.c_int32),
+        ("tb_num", ct.c_int32),
+        ("tb_den", ct.c_int32),
+        ("duration", ct.c_double),
+        ("nb_frames", ct.c_int64),
+        ("bit_rate", ct.c_int64),
+        ("sample_rate", ct.c_int32),
+        ("channels", ct.c_int32),
+        ("sample_fmt", ct.c_char * 32),
+    ]
+
+
+class MPFormatInfo(ct.Structure):
+    _fields_ = [
+        ("format_name", ct.c_char * 64),
+        ("duration", ct.c_double),
+        ("bit_rate", ct.c_int64),
+        ("file_size", ct.c_int64),
+        ("nb_streams", ct.c_int32),
+    ]
+
+
+class MPVideoDesc(ct.Structure):
+    _fields_ = [
+        ("width", ct.c_int32),
+        ("height", ct.c_int32),
+        ("pix_fmt", ct.c_char * 32),
+        ("fps_num", ct.c_int32),
+        ("fps_den", ct.c_int32),
+        ("duration", ct.c_double),
+        ("planes", ct.c_int32),
+        ("plane_w", ct.c_int32 * 4),
+        ("plane_h", ct.c_int32 * 4),
+        ("bytes_per_sample", ct.c_int32),
+    ]
+
+
+class MediaError(RuntimeError):
+    pass
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+
+
+def ensure_loaded() -> ct.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            _build()
+        lib = ct.CDLL(_SO_PATH)
+
+        u8p = ct.POINTER(ct.c_uint8)
+        i16p = ct.POINTER(ct.c_int16)
+        lib.mp_probe.restype = ct.c_int
+        lib.mp_probe.argtypes = [
+            ct.c_char_p, ct.POINTER(MPFormatInfo), ct.POINTER(MPStreamInfo),
+            ct.c_int, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_scan_packets.restype = ct.c_long
+        lib.mp_scan_packets.argtypes = [
+            ct.c_char_p, ct.c_int, ct.POINTER(ct.c_int64),
+            ct.POINTER(ct.c_double), ct.POINTER(ct.c_double),
+            ct.POINTER(ct.c_double), ct.POINTER(ct.c_int8), ct.c_long,
+            ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_decoder_open.restype = ct.c_void_p
+        lib.mp_decoder_open.argtypes = [
+            ct.c_char_p, ct.c_double, ct.c_double, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_decoder_desc.restype = ct.c_int
+        lib.mp_decoder_desc.argtypes = [ct.c_void_p, ct.POINTER(MPVideoDesc)]
+        lib.mp_decoder_next.restype = ct.c_int
+        lib.mp_decoder_next.argtypes = [
+            ct.c_void_p, u8p, u8p, u8p, u8p, ct.POINTER(ct.c_double),
+            ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_decoder_close.restype = None
+        lib.mp_decoder_close.argtypes = [ct.c_void_p]
+        lib.mp_decode_audio_s16.restype = ct.c_long
+        lib.mp_decode_audio_s16.argtypes = [
+            ct.c_char_p, ct.c_double, ct.c_double, i16p, ct.c_long,
+            ct.POINTER(ct.c_int32), ct.POINTER(ct.c_int32), ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_encoder_open.restype = ct.c_void_p
+        lib.mp_encoder_open.argtypes = [
+            ct.c_char_p, ct.c_char_p, ct.c_int, ct.c_int, ct.c_char_p,
+            ct.c_int, ct.c_int, ct.c_int64, ct.c_int64, ct.c_int64, ct.c_int64,
+            ct.c_int, ct.c_int, ct.c_int, ct.c_char_p, ct.c_int, ct.c_char_p,
+            ct.c_char_p, ct.c_int, ct.c_int, ct.c_int64, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_encoder_write_video.restype = ct.c_int
+        lib.mp_encoder_write_video.argtypes = [
+            ct.c_void_p, u8p, u8p, u8p, u8p, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_encoder_write_audio.restype = ct.c_int
+        lib.mp_encoder_write_audio.argtypes = [
+            ct.c_void_p, i16p, ct.c_long, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_encoder_close.restype = ct.c_int
+        lib.mp_encoder_close.argtypes = [ct.c_void_p, ct.c_char_p, ct.c_int]
+        lib.mp_sws_scale_plane.restype = ct.c_int
+        lib.mp_sws_scale_plane.argtypes = [
+            u8p, ct.c_int, ct.c_int, u8p, ct.c_int, ct.c_int, ct.c_int,
+            ct.c_double, ct.c_double, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_sws_scale_yuv.restype = ct.c_int
+        lib.mp_sws_scale_yuv.argtypes = [
+            u8p, u8p, u8p, ct.c_int, ct.c_int, ct.c_char_p,
+            u8p, u8p, u8p, ct.c_int, ct.c_int, ct.c_char_p,
+            ct.c_int, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_extract_annexb.restype = ct.c_int
+        lib.mp_extract_annexb.argtypes = [
+            ct.c_char_p, ct.c_char_p, ct.c_char_p, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_extract_ivf.restype = ct.c_int
+        lib.mp_extract_ivf.argtypes = [
+            ct.c_char_p, ct.c_char_p, ct.c_char_p, ct.c_int,
+        ]
+        lib.mp_version.restype = ct.c_char_p
+        _lib = lib
+        return lib
+
+
+def _err_buf() -> ct.Array:
+    return ct.create_string_buffer(512)
+
+
+def _np_u8p(arr: np.ndarray):
+    """Raw byte pointer to a contiguous array (any dtype — the native side
+    addresses planes in bytes)."""
+    if arr is None:
+        return None
+    assert arr.flags["C_CONTIGUOUS"]
+    return arr.ctypes.data_as(ct.POINTER(ct.c_uint8))
+
+
+def version() -> str:
+    lib = ensure_loaded()
+    return lib.mp_version().decode()
+
+
+def probe(path: str) -> dict:
+    """Container + stream info (the ffprobe -show_streams/-show_format
+    replacement)."""
+    lib = ensure_loaded()
+    fmt = MPFormatInfo()
+    cap = 64
+    streams = (MPStreamInfo * cap)()
+    err = _err_buf()
+    n = lib.mp_probe(path.encode(), ct.byref(fmt), streams, cap, err, 512)
+    if n < 0:
+        raise MediaError(f"probe({path}): {err.value.decode()}")
+    if fmt.nb_streams > cap:
+        cap = int(fmt.nb_streams)
+        streams = (MPStreamInfo * cap)()
+        n = lib.mp_probe(path.encode(), ct.byref(fmt), streams, cap, err, 512)
+        if n < 0:
+            raise MediaError(f"probe({path}): {err.value.decode()}")
+    out_streams = []
+    for i in range(n):
+        s = streams[i]
+        d = {
+            "index": s.stream_index,
+            "codec_type": "video" if s.codec_type == 0 else "audio",
+            "codec_name": s.codec_name.decode(),
+            "duration": s.duration,
+            "nb_frames": s.nb_frames,
+            "bit_rate": s.bit_rate,
+            "time_base": (s.tb_num, s.tb_den),
+        }
+        if s.codec_type == 0:
+            d.update(
+                width=s.width,
+                height=s.height,
+                pix_fmt=s.pix_fmt.decode(),
+                r_frame_rate=f"{s.fps_num}/{s.fps_den}",
+                avg_frame_rate=f"{s.avg_fps_num}/{s.avg_fps_den}",
+            )
+        else:
+            d.update(
+                sample_rate=s.sample_rate,
+                channels=s.channels,
+                sample_fmt=s.sample_fmt.decode(),
+            )
+        out_streams.append(d)
+    return {
+        "format": {
+            "format_name": fmt.format_name.decode(),
+            "duration": fmt.duration,
+            "bit_rate": fmt.bit_rate,
+            "size": fmt.file_size,
+            "nb_streams": fmt.nb_streams,
+        },
+        "streams": out_streams,
+    }
+
+
+def scan_packets(path: str, codec_type: str = "video") -> dict:
+    """Per-packet size/pts/dts/duration/keyflag arrays (the ffprobe
+    -show_packets replacement; reference lib/ffmpeg.py:636-769)."""
+    lib = ensure_loaded()
+    ctype = 0 if codec_type == "video" else 1
+    cap = 1 << 16
+    while True:
+        sizes = np.zeros(cap, np.int64)
+        pts = np.zeros(cap, np.float64)
+        dts = np.zeros(cap, np.float64)
+        dur = np.zeros(cap, np.float64)
+        key = np.zeros(cap, np.int8)
+        err = _err_buf()
+        n = lib.mp_scan_packets(
+            path.encode(), ctype,
+            sizes.ctypes.data_as(ct.POINTER(ct.c_int64)),
+            pts.ctypes.data_as(ct.POINTER(ct.c_double)),
+            dts.ctypes.data_as(ct.POINTER(ct.c_double)),
+            dur.ctypes.data_as(ct.POINTER(ct.c_double)),
+            key.ctypes.data_as(ct.POINTER(ct.c_int8)),
+            cap, err, 512,
+        )
+        if n < 0:
+            raise MediaError(f"scan_packets({path}): {err.value.decode()}")
+        if n <= cap:
+            return {
+                "size": sizes[:n].copy(),
+                "pts_time": pts[:n].copy(),
+                "dts_time": dts[:n].copy(),
+                "duration_time": dur[:n].copy(),
+                "key": key[:n].copy(),
+            }
+        cap = int(n) + 1024
+
+
+def sws_scale_plane(
+    src: np.ndarray, dw: int, dh: int, flags: int = SWS_LANCZOS,
+    param0: float = 0.0, param1: float = 0.0,
+) -> np.ndarray:
+    """Scale a single 8-bit plane through libswscale — the golden oracle the
+    TPU resize kernels are tested against."""
+    lib = ensure_loaded()
+    assert src.dtype == np.uint8 and src.ndim == 2
+    src = np.ascontiguousarray(src)
+    dst = np.zeros((dh, dw), np.uint8)
+    err = _err_buf()
+    ret = lib.mp_sws_scale_plane(
+        _np_u8p(src), src.shape[1], src.shape[0], _np_u8p(dst), dw, dh,
+        flags, param0, param1, err, 512,
+    )
+    if ret < 0:
+        raise MediaError(f"sws_scale_plane: {err.value.decode()}")
+    return dst
+
+
+def sws_scale_yuv(
+    planes: tuple, sw: int, sh: int, src_fmt: str,
+    dw: int, dh: int, dst_fmt: str, flags: int = SWS_LANCZOS,
+) -> tuple:
+    """Full planar-YUV rescale via swscale (reference `scale=` filter)."""
+    lib = ensure_loaded()
+    sy, su, sv = (np.ascontiguousarray(p) if p is not None else None for p in planes)
+    sub_w = 2 if "420" in dst_fmt or "422" in dst_fmt else 1
+    sub_h = 2 if "420" in dst_fmt else 1
+    dst_dtype = np.uint16 if "10" in dst_fmt and dst_fmt != "yuv410p" else np.uint8
+    dy = np.zeros((dh, dw), dst_dtype)
+    du = np.zeros((dh // sub_h, dw // sub_w), dst_dtype)
+    dv = np.zeros_like(du)
+    err = _err_buf()
+    ret = lib.mp_sws_scale_yuv(
+        _np_u8p(sy), _np_u8p(su), _np_u8p(sv), sw, sh, src_fmt.encode(),
+        _np_u8p(dy), _np_u8p(du), _np_u8p(dv), dw, dh, dst_fmt.encode(),
+        flags, err, 512,
+    )
+    if ret < 0:
+        raise MediaError(f"sws_scale_yuv: {err.value.decode()}")
+    return dy, du, dv
+
+
+def extract_annexb(path: str, bsf_name: str, out_path: str) -> None:
+    lib = ensure_loaded()
+    err = _err_buf()
+    if lib.mp_extract_annexb(path.encode(), bsf_name.encode(), out_path.encode(), err, 512) < 0:
+        raise MediaError(f"extract_annexb({path}): {err.value.decode()}")
+
+
+def extract_ivf(path: str, out_path: str) -> None:
+    lib = ensure_loaded()
+    err = _err_buf()
+    if lib.mp_extract_ivf(path.encode(), out_path.encode(), err, 512) < 0:
+        raise MediaError(f"extract_ivf({path}): {err.value.decode()}")
+
+
+def decode_audio_s16(path: str, start: float = 0.0, duration: float = 0.0):
+    """Decode best audio stream to (samples[n, channels] int16, sample_rate)."""
+    lib = ensure_loaded()
+    err = _err_buf()
+    rate = ct.c_int32()
+    chans = ct.c_int32()
+    n = lib.mp_decode_audio_s16(
+        path.encode(), start, duration, None, 0, ct.byref(rate),
+        ct.byref(chans), err, 512,
+    )
+    if n < 0:
+        raise MediaError(f"decode_audio({path}): {err.value.decode()}")
+    buf = np.zeros((int(n), max(1, chans.value)), np.int16)
+    n2 = lib.mp_decode_audio_s16(
+        path.encode(), start, duration,
+        buf.ctypes.data_as(ct.POINTER(ct.c_int16)), n,
+        ct.byref(rate), ct.byref(chans), err, 512,
+    )
+    if n2 < 0:
+        raise MediaError(f"decode_audio({path}): {err.value.decode()}")
+    return buf[: int(n2)], rate.value

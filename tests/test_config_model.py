@@ -225,3 +225,17 @@ def test_database_layout_created(tmp_path):
         "audioFrameInformation", "sideInformation",
     ]:
         assert os.path.isdir(os.path.join(db_dir, sub)), sub
+
+
+def test_mixed_src_duration_rejected(tmp_path):
+    """Numeric event durations cannot mix with src_duration segmenting;
+    must raise ConfigError, not TypeError."""
+    yaml_path, prober = write_long_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["hrcList"]["HRC000"]["eventList"] = [["Q0", 10], ["Q1", "src_duration"]]
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    with pytest.raises(ConfigError, match="src_duration"):
+        TestConfig(yaml_path, prober=prober)

@@ -1,0 +1,189 @@
+"""Tests for the native media I/O boundary.
+
+These generate tiny synthetic videos through the encoder, then exercise
+probe / decode / packet-scan / frame-size paths against them — the in-repo
+replacement for the reference's external example-databases fixtures.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu.io import (
+    MediaError,
+    VideoReader,
+    VideoWriter,
+    framesizes,
+    medialib,
+    probe,
+)
+
+
+def synth_frames(n=24, w=192, h=108, seed=0):
+    """Deterministic moving-gradient test frames (yuv420p planes)."""
+    rng = np.random.default_rng(seed)
+    ys, us, vs = [], [], []
+    base = rng.integers(0, 255, size=(h, w), dtype=np.uint8)
+    xx = np.arange(w, dtype=np.uint8)[None, :]
+    for t in range(n):
+        y = (base // 2 + xx * 2 + t * 3).astype(np.uint8)
+        u = np.full((h // 2, w // 2), 128 - t, np.uint8)
+        v = np.full((h // 2, w // 2), 120 + t, np.uint8)
+        ys.append(y)
+        us.append(u)
+        vs.append(v)
+    return ys, us, vs
+
+
+def write_test_video(path, codec="libx264", n=24, w=192, h=108, fps=(24, 1),
+                     audio=False, **kw):
+    ys, us, vs = synth_frames(n, w, h)
+    kw.setdefault("opts", "crf=28:preset=ultrafast" if codec == "libx264" else "")
+    aud = dict(audio_codec="flac", sample_rate=48000, channels=2) if audio else {}
+    with VideoWriter(path, codec, w, h, "yuv420p", fps, **aud, **kw) as wr:
+        if audio:
+            t = np.arange(int(48000 * n / fps[0]))
+            tone = (np.sin(2 * np.pi * 440 * t / 48000) * 8000).astype(np.int16)
+            wr.write_audio(np.stack([tone, tone], axis=1))
+        for y, u, v in zip(ys, us, vs):
+            wr.write(y, u, v)
+    return ys, us, vs
+
+
+def test_version_loads():
+    assert "lavc 59" in medialib.version()
+
+
+def test_ffv1_lossless_roundtrip(tmp_path):
+    path = str(tmp_path / "t.avi")
+    ys, us, vs = write_test_video(path, codec="ffv1", opts="")
+    with VideoReader(path) as r:
+        assert (r.width, r.height) == (192, 108)
+        assert r.pix_fmt == "yuv420p"
+        planes, pts = r.read_all()
+    assert planes[0].shape == (24, 108, 192)
+    np.testing.assert_array_equal(planes[0], np.stack(ys))
+    np.testing.assert_array_equal(planes[1], np.stack(us))
+    np.testing.assert_array_equal(planes[2], np.stack(vs))
+    assert pts[0] == 0.0 and len(pts) == 24
+
+
+def test_x264_encode_probe(tmp_path):
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx264", gop=12, bframes=2)
+    info = medialib.probe(path)
+    v = [s for s in info["streams"] if s["codec_type"] == "video"][0]
+    assert v["codec_name"] == "h264"
+    assert (v["width"], v["height"]) == (192, 108)
+    assert v["r_frame_rate"] == "24/1"
+    assert abs(v["duration"] - 1.0) < 0.1
+    seg = probe.get_segment_info(path, target_video_bitrate=500)
+    assert seg["video_codec"] == "h264"
+    assert seg["video_width"] == 192
+    assert seg["video_target_bitrate"] == 500
+    assert seg["file_size"] > 0
+    assert seg["video_bitrate"] > 0
+
+
+def test_trim_decode(tmp_path):
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx264", gop=6, n=48)
+    with VideoReader(path, start=1.0, duration=0.5) as r:
+        planes, pts = r.read_all()
+    assert len(pts) == 12  # 0.5 s at 24 fps
+    assert abs(pts[0] - 1.0) < 1e-6
+
+
+def test_packet_scan_and_vfi(tmp_path):
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx264", gop=12, n=24)
+    pk = medialib.scan_packets(path, "video")
+    assert len(pk["size"]) == 24
+    assert pk["key"][0] == 1 and pk["key"].sum() == 2  # keyframe each 12
+    vfi = probe.get_video_frame_info(path, "seg.mp4")
+    assert list(vfi.columns) == ["segment", "index", "frame_type", "dts", "size", "duration"]
+    assert len(vfi) == 24
+    assert vfi["frame_type"].iloc[0] == "I"
+    assert (vfi["size"] > 0).all()
+    assert np.isfinite(vfi["duration"]).all()
+
+
+def test_audio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.avi")
+    write_test_video(path, codec="ffv1", opts="", audio=True)
+    info = medialib.probe(path)
+    a = [s for s in info["streams"] if s["codec_type"] == "audio"][0]
+    assert a["codec_name"] == "flac"
+    assert a["sample_rate"] == 48000 and a["channels"] == 2
+    samples, rate = medialib.decode_audio_s16(path)
+    assert rate == 48000
+    assert samples.shape[1] == 2
+    assert abs(samples.shape[0] - 48000) < 2048
+    # FLAC is lossless: the tone should survive exactly after trimming edges
+    afi = probe.get_audio_frame_info(path)
+    assert len(afi) > 0
+
+
+def test_framesize_h264_exact(tmp_path):
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx264", gop=12, n=24)
+    sizes = framesizes.get_framesize_h264(path)
+    assert len(sizes) == 24
+    pk = medialib.scan_packets(path, "video")
+    # Annex-B slice sizes track container packet sizes up to start-code vs
+    # length-prefix accounting (±small constant); the first frame also
+    # excludes SPS/PPS/SEI bytes, matching reference semantics (non-slice
+    # NALs are not attributed to any frame, get_framesize.py:144-201)
+    diffs = np.abs(np.array(sizes) - pk["size"])
+    assert np.all(diffs[1:] < 16)
+    assert diffs[0] < 1500
+
+
+def test_framesize_h265_exact(tmp_path):
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx265", n=24, gop=12,
+                     opts="crf=30:preset=ultrafast:x265-params=log-level=error")
+    sizes = framesizes.get_framesize_h265(path)
+    assert len(sizes) == 24
+    assert all(s > 0 for s in sizes)
+
+
+def test_framesize_vp9(tmp_path):
+    path = str(tmp_path / "t.webm")
+    write_test_video(path, codec="libvpx-vp9", n=24, gop=12,
+                     bitrate_kbps=200, opts="speed=8:row-mt=1")
+    sizes = framesizes.get_framesize_vp9(path)
+    assert len(sizes) >= 24  # superframes may add non-displayed frames
+    pk = medialib.scan_packets(path, "video")
+    assert sum(sizes) == int(pk["size"].sum())
+
+
+def test_sws_scale_plane():
+    # band-limited (smooth) image: upscale then downscale approximates identity
+    xx, yy = np.meshgrid(np.arange(192), np.arange(108))
+    src = ((np.sin(xx / 20) + np.cos(yy / 15)) * 60 + 128).astype(np.uint8)
+    up = medialib.sws_scale_plane(src, 384, 216, medialib.SWS_LANCZOS)
+    assert up.shape == (216, 384)
+    down = medialib.sws_scale_plane(up, 192, 108, medialib.SWS_BICUBIC)
+    assert np.mean(np.abs(down.astype(int) - src.astype(int))) < 1.0
+
+
+def test_two_pass_encoding(tmp_path):
+    path1 = str(tmp_path / "p1.mp4")
+    path2 = str(tmp_path / "p2.mp4")
+    stats = str(tmp_path / "stats.log")
+    write_test_video(path1, codec="libx264", bitrate_kbps=300, gop=12,
+                     pass_num=1, stats_path=stats, opts="preset=ultrafast")
+    assert os.path.getsize(stats) > 0
+    write_test_video(path2, codec="libx264", bitrate_kbps=300, gop=12,
+                     pass_num=2, stats_path=stats, opts="preset=ultrafast")
+    seg = probe.get_segment_info(path2)
+    assert seg["video_codec"] == "h264"
+
+
+def test_missing_file_error():
+    with pytest.raises(MediaError, match="open"):
+        VideoReader("/nonexistent/nope.mp4")
+    with pytest.raises(MediaError):
+        medialib.probe("/nonexistent/nope.mp4")
