@@ -324,6 +324,15 @@ class TestConfig:
             event_list.append(Event(event_type, quality_level, event_duration))
             quality_level_list.append(quality_level)
 
+        if hrc_segment_duration == "src_duration" and any(
+            e.event_type == "quality_level" and e.duration != "src_duration"
+            for e in event_list
+        ):
+            raise ConfigError(
+                f"HRC {hrc_id} mixes numeric event durations with "
+                "src_duration segmenting; use src_duration for all events or "
+                "set an explicit segmentDuration"
+            )
         hrc = Hrc(
             hrc_id, self, hrc_type, video_coding, audio_coding, event_list,
             hrc_segment_duration,
