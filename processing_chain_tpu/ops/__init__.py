@@ -1,0 +1,3 @@
+from . import fps, metrics, overlay, pad, pixfmt, resize, siti
+
+__all__ = ["fps", "metrics", "overlay", "pad", "pixfmt", "resize", "siti"]

@@ -1,0 +1,88 @@
+"""Frame-rate conversion as gather index plans.
+
+Parity targets: the reference's fps spec grammar (lib/ffmpeg.py:321-396 —
+number, fraction, "original", "auto", "50/60", "24/25/30") and its
+hand-built `select=` drop tables for each supported ratio
+(lib/ffmpeg.py:806-832). Where the reference emits an ffmpeg select
+expression evaluated per frame, we emit the equivalent index array once on
+host; on device the conversion is a single gather over the frame axis.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Optional
+
+import numpy as np
+
+from ..config.errors import ConfigError
+
+#: the reference's select tables, keyed by int(100 * dst/src) — each entry is
+#: the set of source-frame phases kept per cycle (cycle_len, kept_phases)
+#: (lib/ffmpeg.py:806-832). E.g. 60→24 keeps frames 0 and 3 of every 5.
+_SELECT_TABLES: dict[float, tuple[int, tuple[int, ...]]] = {
+    50.0: (2, (0,)),                    # mod(n+1,2): keeps even n
+    40.0: (5, (0, 3)),                  # 60->24
+    33.0: (3, (0,)),                    # 60->20, 24->8
+    25.0: (4, (0,)),                    # 60->15, 24->6
+    80.0: (5, (0, 1, 2, 3)),            # 30->24: mod(n+1,5) keeps n%5 != 4
+    30.0: (10, (0, 3, 7)),              # 50->15
+    60.0: (5, (0, 2, 3)),               # 25->15
+    62.5: (8, (0, 2, 3, 5, 6)),         # 24->15
+}
+
+
+def resolve_fps_spec(fps_spec, src_fps: float) -> Optional[float]:
+    """The reference's fps grammar (lib/ffmpeg.py:321-396). Returns the
+    target fps, or None for keep-as-is."""
+    if fps_spec in ("original", "auto"):
+        return None
+    if fps_spec == "24/25/30":
+        if src_fps in (24, 25, 30):
+            return None
+        if src_fps == 50:
+            return 25.0
+        if src_fps in (60, 120):
+            return 30.0
+        raise ConfigError(f"unsupported SRC frame rate {src_fps} for 24/25/30")
+    if fps_spec == "50/60":
+        if src_fps in (50, 60):
+            return None
+        if src_fps < 50:
+            raise ConfigError(f"fps requested as 50/60 but SRC has only {src_fps}")
+        if src_fps == 120:
+            return 60.0
+        raise ConfigError(f"unsupported SRC frame rate {src_fps} for 50/60")
+    if "/" in str(fps_spec):
+        return src_fps * float(Fraction(str(fps_spec)))
+    return float(int(fps_spec))
+
+
+def select_indices(n_frames: int, src_fps: float, dst_fps: float) -> np.ndarray:
+    """Indices of source frames to keep for src_fps → dst_fps, using the
+    reference's drop tables; raises ConfigError for unsupported ratios
+    exactly like the reference (lib/ffmpeg.py:827-829)."""
+    if dst_fps == src_fps:
+        return np.arange(n_frames)
+    perc = 100.0 * dst_fps / src_fps
+    key = perc if perc in _SELECT_TABLES else float(int(perc))
+    if key not in _SELECT_TABLES:
+        raise ConfigError(
+            f"Frame rate conversion from {src_fps} to {dst_fps} is not supported"
+        )
+    cycle, phases = _SELECT_TABLES[key]
+    n = np.arange(n_frames)
+    mask = np.isin(n % cycle, phases)
+    return n[mask]
+
+
+def fps_resample_indices(n_frames: int, src_fps: float, dst_fps: float) -> np.ndarray:
+    """General ffmpeg `fps=` filter semantics (used where the reference
+    applies a bare fps filter, e.g. AVPVS -z/-f60 paths): output frame k at
+    time k/dst_fps duplicates/drops to the last source frame with
+    pts <= k/dst_fps (+ half-tick rounding)."""
+    duration = n_frames / src_fps
+    n_out = int(round(duration * dst_fps))
+    t_out = np.arange(n_out) / dst_fps
+    idx = np.floor(t_out * src_fps + 0.5).astype(np.int64)
+    return np.clip(idx, 0, n_frames - 1)

@@ -1,0 +1,82 @@
+"""Full-reference per-frame quality metrics on device: PSNR and SSIM.
+
+The reference builds libvmaf into its ffmpeg (Dockerfile:38-43,
+install_ffmpeg.sh:61) though chain code never invokes it; BASELINE config 4
+calls for per-frame PSNR/SSIM feature extraction vs SRC as part of the long
+test. vmapped over the frame axis; inputs are luma (or any single plane).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def psnr_frame(ref: jnp.ndarray, deg: jnp.ndarray, peak: float = 255.0) -> jnp.ndarray:
+    """PSNR of one [H, W] plane pair, dB (inf-free: clamped to 100 dB for
+    identical frames, ffmpeg's psnr filter convention caps similarly)."""
+    r = ref.astype(jnp.float32)
+    d = deg.astype(jnp.float32)
+    mse = jnp.mean((r - d) ** 2)
+    psnr = 10.0 * jnp.log10((peak * peak) / jnp.maximum(mse, 1e-10))
+    return jnp.minimum(psnr, 100.0)
+
+
+@jax.jit
+def psnr_frames(ref: jnp.ndarray, deg: jnp.ndarray) -> jnp.ndarray:
+    """Per-frame PSNR for [T, H, W] pairs."""
+    return jax.vmap(psnr_frame)(ref, deg)
+
+
+def _gaussian_kernel(size: int = 11, sigma: float = 1.5) -> jnp.ndarray:
+    x = jnp.arange(size, dtype=jnp.float32) - (size - 1) / 2.0
+    g = jnp.exp(-(x * x) / (2.0 * sigma * sigma))
+    return g / jnp.sum(g)
+
+
+def _filter2_sep(img: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Separable valid-mode gaussian filter of [H, W]."""
+    size = k.shape[0]
+    h, w = img.shape
+    out = jnp.zeros((h - size + 1, w), img.dtype)
+    for i in range(size):
+        out = out + k[i] * img[i : h - size + 1 + i, :]
+    out2 = jnp.zeros((out.shape[0], w - size + 1), img.dtype)
+    for i in range(size):
+        out2 = out2 + k[i] * out[:, i : w - size + 1 + i]
+    return out2
+
+
+def ssim_frame(
+    ref: jnp.ndarray,
+    deg: jnp.ndarray,
+    peak: float = 255.0,
+    k1: float = 0.01,
+    k2: float = 0.03,
+) -> jnp.ndarray:
+    """Mean SSIM of one [H, W] plane pair (Wang et al. 2004: 11x11 gaussian
+    window sigma 1.5, valid borders)."""
+    r = ref.astype(jnp.float32)
+    d = deg.astype(jnp.float32)
+    kern = _gaussian_kernel()
+    c1 = (k1 * peak) ** 2
+    c2 = (k2 * peak) ** 2
+    mu_r = _filter2_sep(r, kern)
+    mu_d = _filter2_sep(d, kern)
+    mu_rr = mu_r * mu_r
+    mu_dd = mu_d * mu_d
+    mu_rd = mu_r * mu_d
+    var_r = _filter2_sep(r * r, kern) - mu_rr
+    var_d = _filter2_sep(d * d, kern) - mu_dd
+    cov = _filter2_sep(r * d, kern) - mu_rd
+    num = (2.0 * mu_rd + c1) * (2.0 * cov + c2)
+    den = (mu_rr + mu_dd + c1) * (var_r + var_d + c2)
+    return jnp.mean(num / den)
+
+
+@jax.jit
+def ssim_frames(ref: jnp.ndarray, deg: jnp.ndarray) -> jnp.ndarray:
+    """Per-frame SSIM for [T, H, W] pairs."""
+    return jax.vmap(ssim_frame)(ref, deg)

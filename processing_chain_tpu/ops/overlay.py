@@ -1,0 +1,221 @@
+"""Stalling / freeze rendering: the device-side replacement for `bufferer`.
+
+The reference shells out to the external `bufferer` CLI to re-render a PVS
+with stalling (reference p03_generateAvPvs.py:216-260, invocation contract
+`bufferer -i in -o out -b [[t,d],…] --force-framerate --black-frame -v ffv1
+-a pcm_s16le -x pixfmt (-s spinner.png | -e --skipping)`). Here the same
+behavior is a host-side timeline plan plus a device-side gather + alpha
+blend:
+
+  * stall mode: at each buffer event [media_t, dur], insert round(dur*fps)
+    frames showing a black frame (--black-frame) or the last played frame,
+    composited with a rotating spinner; output length grows.
+  * skipping mode (frame freeze): the frame at the event start repeats for
+    the event duration while content underneath is skipped; output length
+    is unchanged and no spinner is drawn.
+
+Behavioral-spec note: upstream bufferer's exact spinner angular rate is not
+documented; we rotate one revolution per second (`spinner_rps`,
+configurable), with precomputed rotations at `n_rotations` phases.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Host: timeline planning
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StallPlan:
+    """Device-executable stalling timeline.
+
+    src_idx[k]    source frame shown at output frame k (int32)
+    stall_mask[k] 1 where frame k is an inserted stall frame
+    black_mask[k] 1 where the background is a black frame
+    phase[k]      spinner rotation phase index (into the rotation bank)
+    """
+
+    src_idx: np.ndarray
+    stall_mask: np.ndarray
+    black_mask: np.ndarray
+    phase: np.ndarray
+
+    @property
+    def n_out(self) -> int:
+        return len(self.src_idx)
+
+
+def plan_stalling(
+    n_frames: int,
+    fps: float,
+    buff_events: list,
+    skipping: bool = False,
+    black_frame: bool = True,
+    spinner_rps: float = 1.0,
+    n_rotations: int = 64,
+) -> StallPlan:
+    """Expand buffer events into a per-output-frame plan.
+
+    buff_events: [[media_time_s, duration_s], ...] for stalls, or a bare
+    list of durations for freezes in skipping mode (the .buff freeze format,
+    reference test_config.py:318-322) — bare durations freeze back-to-back
+    from t=0 since the freeze format carries no positions.
+    """
+    if skipping:
+        # normalize bare durations to [[t, d]] back-to-back
+        events = []
+        t_cursor = 0.0
+        for ev in buff_events:
+            if isinstance(ev, (list, tuple)):
+                events.append((float(ev[0]), float(ev[1])))
+            else:
+                events.append((t_cursor, float(ev)))
+                t_cursor += float(ev)
+        src_idx = np.arange(n_frames, dtype=np.int32)
+        stall = np.zeros(n_frames, np.int8)
+        for t, d in events:
+            start = int(round(t * fps))
+            end = min(n_frames, int(round((t + d) * fps)))
+            if start >= n_frames:
+                continue
+            src_idx[start:end] = src_idx[start]
+            stall[start:end] = 1
+        return StallPlan(
+            src_idx=src_idx,
+            stall_mask=stall,
+            black_mask=np.zeros(n_frames, np.int8),
+            phase=np.zeros(n_frames, np.int32),
+        )
+
+    events = sorted((float(e[0]), float(e[1])) for e in buff_events)
+    src_idx: list[int] = []
+    stall: list[int] = []
+    black: list[int] = []
+    phase: list[int] = []
+    spin_count = 0
+    next_src = 0
+    for t, d in events:
+        event_frame = min(n_frames, int(round(t * fps)))
+        while next_src < event_frame:
+            src_idx.append(next_src)
+            stall.append(0)
+            black.append(0)
+            phase.append(0)
+            next_src += 1
+        n_stall = int(round(d * fps))
+        for _ in range(n_stall):
+            # background: black frame or the last played frame
+            src_idx.append(max(0, next_src - 1))
+            stall.append(1)
+            black.append(1 if black_frame else 0)
+            phase.append(
+                int(spin_count * spinner_rps * n_rotations / fps) % n_rotations
+            )
+            spin_count += 1
+    while next_src < n_frames:
+        src_idx.append(next_src)
+        stall.append(0)
+        black.append(0)
+        phase.append(0)
+        next_src += 1
+    return StallPlan(
+        src_idx=np.asarray(src_idx, np.int32),
+        stall_mask=np.asarray(stall, np.int8),
+        black_mask=np.asarray(black, np.int8),
+        phase=np.asarray(phase, np.int32),
+    )
+
+
+def prepare_spinner(
+    spinner_rgba: np.ndarray, n_rotations: int = 64
+) -> tuple[np.ndarray, np.ndarray]:
+    """Precompute the rotation bank for a spinner image.
+
+    spinner_rgba: [H, W, 4] uint8 (e.g. the reference's
+    util/spinner-128-white.png). Returns (yuv [R, 3, H, W] float32 in 0-255,
+    alpha [R, H, W] float32 in 0-1), rotated counterclockwise per phase.
+    """
+    import scipy.ndimage as ndi
+
+    r, g, b = (spinner_rgba[..., c].astype(np.float32) for c in range(3))
+    a = spinner_rgba[..., 3].astype(np.float32) / 255.0
+    # BT.601 limited-range YUV (matches ffmpeg overlay of RGBA onto yuv420p)
+    y = 0.257 * r + 0.504 * g + 0.098 * b + 16.0
+    u = -0.148 * r - 0.291 * g + 0.439 * b + 128.0
+    v = 0.439 * r - 0.368 * g - 0.071 * b + 128.0
+    yuvs, alphas = [], []
+    for k in range(n_rotations):
+        angle = -360.0 * k / n_rotations  # clockwise spin
+        rot = lambda img, cval: ndi.rotate(
+            img, angle, reshape=False, order=1, mode="constant", cval=cval
+        )
+        ak = np.clip(rot(a, 0.0), 0.0, 1.0)
+        yuvs.append(np.stack([rot(y, 16.0), rot(u, 128.0), rot(v, 128.0)]))
+        alphas.append(ak)
+    return np.stack(yuvs), np.stack(alphas)
+
+
+# ---------------------------------------------------------------------------
+# Device: gather + composite
+# ---------------------------------------------------------------------------
+
+
+def _blend_plane(
+    bg: jnp.ndarray, fg: jnp.ndarray, alpha: jnp.ndarray, y0: int, x0: int
+) -> jnp.ndarray:
+    """Alpha-composite fg (with alpha) onto bg at (y0, x0)."""
+    h, w = fg.shape[-2], fg.shape[-1]
+    region = jax.lax.dynamic_slice_in_dim(
+        jax.lax.dynamic_slice_in_dim(bg, y0, h, axis=-2), x0, w, axis=-1
+    )
+    blended = region * (1.0 - alpha) + fg * alpha
+    return jax.lax.dynamic_update_slice(
+        bg, blended.astype(bg.dtype), (y0, x0)
+    )
+
+
+def render_stalled_plane(
+    frames: jnp.ndarray,
+    plan: StallPlan,
+    spinner: Optional[jnp.ndarray] = None,
+    spinner_alpha: Optional[jnp.ndarray] = None,
+    black_value: float = 16.0,
+) -> jnp.ndarray:
+    """Apply a StallPlan to one plane tensor [T, H, W] (float32 0-255).
+
+    spinner: [R, h, w] rotation bank for THIS plane (chroma callers pass the
+    subsampled bank), spinner_alpha likewise [R, h, w]. Returns [T_out, H, W].
+    """
+    t_out = plan.n_out
+    h, w = frames.shape[-2], frames.shape[-1]
+    gathered = jnp.take(frames, jnp.asarray(plan.src_idx), axis=0)
+    stall = jnp.asarray(plan.stall_mask, jnp.float32)[:, None, None]
+    black = jnp.asarray(plan.black_mask, jnp.float32)[:, None, None]
+    out = gathered * (1.0 - black) + black_value * black
+    if spinner is not None:
+        # phases are modulo the actual rotation-bank size, so a plan built
+        # with a different n_rotations still indexes in range
+        phases = jnp.asarray(plan.phase) % spinner.shape[0]
+        sp = jnp.take(jnp.asarray(spinner), phases, axis=0)
+        sa = jnp.take(jnp.asarray(spinner_alpha), phases, axis=0)
+        sa = sa * stall  # only composite on stall frames
+        y0 = (h - spinner.shape[-2]) // 2
+        x0 = (w - spinner.shape[-1]) // 2
+        blend = jax.vmap(_blend_plane, in_axes=(0, 0, 0, None, None))
+        out = blend(out, sp, sa, y0, x0)
+    return out
+
+
+def downsample_alpha(alpha: np.ndarray) -> np.ndarray:
+    """[R, H, W] alpha → chroma-grid alpha [R, H/2, W/2] (2x2 mean)."""
+    return alpha.reshape(alpha.shape[0], alpha.shape[1] // 2, 2,
+                         alpha.shape[2] // 2, 2).mean(axis=(2, 4))

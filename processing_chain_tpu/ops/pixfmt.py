@@ -1,0 +1,83 @@
+"""Pixel-format conversions on device.
+
+Covers the chain's format plumbing (reference lib/test_config.py:447-480
+harmonization targets and lib/ffmpeg.py CPVS maps): planar 420/422/444
+chroma resampling, 8↔10-bit depth conversion, and UYVY422 packing for the
+PC-context CPVS (reference Pvs.get_vcodec_and_pix_fmt_for_cpvs,
+test_config.py:188-227). All functions take/return jnp arrays and are
+jit/vmap friendly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .resize import resize_plane
+
+
+def chroma_to_444(u: jnp.ndarray, v: jnp.ndarray, luma_h: int, luma_w: int,
+                  kernel: str = "bilinear") -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Upsample subsampled chroma planes to the luma grid."""
+    return (
+        resize_plane(u, luma_h, luma_w, kernel),
+        resize_plane(v, luma_h, luma_w, kernel),
+    )
+
+
+def chroma_420_to_422(u: jnp.ndarray, v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """yuv420p → yuv422p: double the chroma height (vertical bilinear)."""
+    h, w = u.shape[-2], u.shape[-1]
+    return (
+        resize_plane(u, h * 2, w, "bilinear"),
+        resize_plane(v, h * 2, w, "bilinear"),
+    )
+
+
+def chroma_422_to_420(u: jnp.ndarray, v: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """yuv422p → yuv420p: halve the chroma height."""
+    h, w = u.shape[-2], u.shape[-1]
+    return (
+        resize_plane(u, h // 2, w, "bilinear"),
+        resize_plane(v, h // 2, w, "bilinear"),
+    )
+
+
+def depth_8_to_10(plane: jnp.ndarray) -> jnp.ndarray:
+    """uint8 → 10-bit in uint16 (left shift, ffmpeg's scale semantics)."""
+    return (plane.astype(jnp.uint16) << 2)
+
+
+def depth_10_to_8(plane: jnp.ndarray) -> jnp.ndarray:
+    """10-bit uint16 → uint8 with round-half-up."""
+    p = plane.astype(jnp.int32)
+    return jnp.clip((p + 2) >> 2, 0, 255).astype(jnp.uint8)
+
+
+def pack_uyvy422(y: jnp.ndarray, u: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """Planar yuv422 (u/v at half width) → packed UYVY bytes [H, W*2]
+    (the rawvideo CPVS layout for the PC context)."""
+    h, w = y.shape[-2], y.shape[-1]
+    out = jnp.zeros(y.shape[:-2] + (h, w * 2), jnp.uint8)
+    out = out.at[..., 0::4].set(u)
+    out = out.at[..., 2::4].set(v)
+    out = out.at[..., 1::2].set(y)
+    return out
+
+
+def planes_to_float(planes: tuple, ten_bit: bool = False) -> tuple:
+    """Native-depth planes → float32 in [0, 255] (10-bit scaled to 8-bit
+    range so kernels are depth-agnostic)."""
+    scale = 1.0 / 4.0 if ten_bit else 1.0
+    return tuple(p.astype(jnp.float32) * scale for p in planes)
+
+
+def float_to_planes(planes: tuple, ten_bit: bool = False) -> tuple:
+    """float32 [0,255] range → uint8 or 10-bit uint16 with round-half-up."""
+    if ten_bit:
+        return tuple(
+            jnp.clip(jnp.floor(p * 4.0 + 0.5), 0, 1023).astype(jnp.uint16)
+            for p in planes
+        )
+    return tuple(
+        jnp.clip(jnp.floor(p + 0.5), 0, 255).astype(jnp.uint8) for p in planes
+    )

@@ -1,0 +1,181 @@
+"""Separable polyphase resampling on device — the chain's hottest op.
+
+TPU-native replacement for the reference's swscale `scale=W:H:flags=bicubic`
+/ `flags=lanczos` filters (reference lib/ffmpeg.py:948, :1037, :1196).
+Filter construction mirrors libswscale's: align-centers source mapping,
+BC-spline bicubic with the swscale default (B=0, C=0.6), Lanczos-3, support
+widening + renormalization for downscale. The tap plan (indices + weights)
+is precomputed on host per (src, dst, kernel) and cached; the device side is
+K fused multiply-adds over gathered rows/columns — bandwidth-bound, VPU
+friendly, vmappable over frames and planes.
+
+Golden-tested against libswscale output (io.medialib.sws_scale_plane).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Host-side filter construction
+# ---------------------------------------------------------------------------
+
+
+def _bicubic_kernel(d: np.ndarray, b: float = 0.0, c: float = 0.6) -> np.ndarray:
+    """Mitchell-Netravali BC-spline; swscale's bicubic uses B=0, C=0.6 by
+    default (libswscale/utils.c initFilter)."""
+    d = np.abs(d)
+    d2, d3 = d * d, d * d * d
+    p0 = (6.0 - 2.0 * b) / 6.0
+    p2 = (-18.0 + 12.0 * b + 6.0 * c) / 6.0
+    p3 = (12.0 - 9.0 * b - 6.0 * c) / 6.0
+    q0 = (8.0 * b + 24.0 * c) / 6.0
+    q1 = (-12.0 * b - 48.0 * c) / 6.0
+    q2 = (6.0 * b + 30.0 * c) / 6.0
+    q3 = (-b - 6.0 * c) / 6.0
+    return np.where(
+        d < 1.0,
+        p0 + p2 * d2 + p3 * d3,
+        np.where(d < 2.0, q0 + q1 * d + q2 * d2 + q3 * d3, 0.0),
+    )
+
+
+def _lanczos_kernel(d: np.ndarray, a: int = 3) -> np.ndarray:
+    d = np.abs(d)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        out = np.sinc(d) * np.sinc(d / a)
+    return np.where(d < a, np.where(d == 0, 1.0, out), 0.0)
+
+
+_KERNELS = {
+    "bicubic": (_bicubic_kernel, 2.0),
+    "lanczos": (_lanczos_kernel, 3.0),
+    "bilinear": (lambda d: np.maximum(0.0, 1.0 - np.abs(d)), 1.0),
+}
+
+
+@functools.lru_cache(maxsize=256)
+def make_plan(
+    src_size: int, dst_size: int, kernel: str = "lanczos", quantize: bool = True
+) -> tuple[np.ndarray, np.ndarray]:
+    """Tap plan for one axis: (indices [dst, K] int32, weights [dst, K] f32).
+
+    Align-centers mapping: src_pos(i) = (i + 0.5) * src/dst - 0.5. For
+    downscales the kernel support widens by the scale ratio and weights are
+    renormalized (swscale's filter stretching). With quantize=True weights
+    are rounded to swscale's 14-bit fixed-point grid, which is what makes
+    8-bit outputs land on the same integers as libswscale.
+    """
+    if kernel not in _KERNELS:
+        raise ValueError(f"unknown resize kernel {kernel!r}")
+    fn, support = _KERNELS[kernel]
+    ratio = src_size / dst_size
+    fscale = max(1.0, ratio)
+    radius = support * fscale
+    ntaps = max(2, int(math.ceil(radius * 2)))
+    # even tap counts keep the window symmetric around the center
+    if ntaps % 2:
+        ntaps += 1
+
+    i = np.arange(dst_size, dtype=np.float64)
+    center = (i + 0.5) * ratio - 0.5
+    left = np.floor(center).astype(np.int64) - ntaps // 2 + 1
+    k = np.arange(ntaps, dtype=np.int64)
+    idx = left[:, None] + k[None, :]                   # [dst, K]
+    dist = (center[:, None] - idx) / fscale
+    w = fn(dist)
+    wsum = w.sum(axis=1, keepdims=True)
+    w = w / np.where(wsum == 0, 1.0, wsum)
+    if quantize:
+        # swscale stores coefficients as int16 with 1<<14 == 1.0 and
+        # redistributes the rounding remainder so each row sums to 1<<14
+        one = 1 << 14
+        wq = np.floor(w * one + 0.5).astype(np.int64)
+        err = one - wq.sum(axis=1)
+        # add the remainder to the largest tap (swscale puts it on the
+        # center tap; largest == center for our symmetric windows)
+        main = np.argmax(wq, axis=1)
+        wq[np.arange(dst_size), main] += err
+        w = wq.astype(np.float64) / one
+    # clamp taps to the valid range; out-of-range taps replicate the edge
+    # (swscale clips filterPos and folds edge weights)
+    idx = np.clip(idx, 0, src_size - 1)
+    return idx.astype(np.int32), w.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Device-side resampling
+# ---------------------------------------------------------------------------
+
+
+def _apply_axis(x: jnp.ndarray, idx: jnp.ndarray, w: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Weighted gather along one axis: out[..., i, ...] = Σ_k w[i,k] ·
+    x[..., idx[i,k], ...]. K is static → unrolled into K fused FMAs."""
+    ntaps = idx.shape[1]
+    out = None
+    for k in range(ntaps):
+        sl = jnp.take(x, idx[:, k], axis=axis)
+        wk = w[:, k]
+        shape = [1] * x.ndim
+        shape[axis] = wk.shape[0]
+        term = sl * wk.reshape(shape)
+        out = term if out is None else out + term
+    return out
+
+
+def resize_plane(
+    x: jnp.ndarray,
+    dst_h: int,
+    dst_w: int,
+    kernel: str = "lanczos",
+    quantize_output: bool = True,
+) -> jnp.ndarray:
+    """Resize [..., H, W] planes to [..., dst_h, dst_w].
+
+    Input uint8/uint16 or float; output uint8 quantized with swscale's
+    round-half-up when quantize_output and input was integer, else float32.
+    """
+    src_h, src_w = x.shape[-2], x.shape[-1]
+    integer_in = jnp.issubdtype(x.dtype, jnp.integer)
+    xf = x.astype(jnp.float32)
+    if (src_h, src_w) != (dst_h, dst_w):
+        idx_v, w_v = make_plan(src_h, dst_h, kernel)
+        idx_h, w_h = make_plan(src_w, dst_w, kernel)
+        xf = _apply_axis(xf, jnp.asarray(idx_v), jnp.asarray(w_v), x.ndim - 2)
+        xf = _apply_axis(xf, jnp.asarray(idx_h), jnp.asarray(w_h), x.ndim - 1)
+    if integer_in and quantize_output:
+        maxval = 255 if x.dtype == jnp.uint8 else 1023
+        out = jnp.clip(jnp.floor(xf + 0.5), 0, maxval)
+        return out.astype(x.dtype)
+    return xf
+
+
+@functools.partial(jax.jit, static_argnames=("dst_h", "dst_w", "kernel"))
+def resize_frames(
+    frames: jnp.ndarray, dst_h: int, dst_w: int, kernel: str = "lanczos"
+) -> jnp.ndarray:
+    """Batched resize of [T, H, W] (or [H, W]) planes — the jitted entry the
+    AVPVS pipeline uses per plane."""
+    return resize_plane(frames, dst_h, dst_w, kernel)
+
+
+def resize_yuv(
+    planes: tuple[jnp.ndarray, ...],
+    dst_h: int,
+    dst_w: int,
+    pix_fmt: str = "yuv420p",
+    kernel: str = "lanczos",
+) -> tuple[jnp.ndarray, ...]:
+    """Resize a planar YUV frame set: luma to (dst_h, dst_w), chroma planes
+    to the subsampled grid of `pix_fmt`."""
+    sub_w = 2 if ("420" in pix_fmt or "422" in pix_fmt) else 1
+    sub_h = 2 if "420" in pix_fmt else 1
+    out = [resize_plane(planes[0], dst_h, dst_w, kernel)]
+    for p in planes[1:3]:
+        out.append(resize_plane(p, dst_h // sub_h, dst_w // sub_w, kernel))
+    return tuple(out)

@@ -1,0 +1,86 @@
+"""SI / TI spatial-temporal complexity features on device (ITU-T P.910).
+
+SI = stddev over pixels of the Sobel gradient magnitude (border excluded);
+TI = stddev over pixels of the inter-frame luma difference.
+
+The reference chain ships a CRF-23 normalized-bitrate *proxy* for complexity
+(reference util/complexity_classification.py:50-69) rather than Sobel SI/TI;
+this module is the device-side feature extractor called for by the north
+star (BASELINE.json), and `norm_bitrate_complexity` provides the proxy's
+formula for parity with the shipped classifier.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+SOBEL_X = jnp.array([[-1.0, 0.0, 1.0], [-2.0, 0.0, 2.0], [-1.0, 0.0, 1.0]])
+SOBEL_Y = SOBEL_X.T
+
+
+def _conv3x3(img: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """3x3 valid convolution of [H, W] via shifted adds (cheaper than a
+    conv call for a fixed tiny kernel; XLA fuses the 9 FMAs)."""
+    h, w = img.shape
+    out = jnp.zeros((h - 2, w - 2), img.dtype)
+    for dy in range(3):
+        for dx in range(3):
+            out = out + k[dy, dx] * img[dy : h - 2 + dy, dx : w - 2 + dx]
+    return out
+
+
+def sobel_magnitude(y: jnp.ndarray) -> jnp.ndarray:
+    """Gradient magnitude of a [H, W] luma plane, valid region [H-2, W-2]."""
+    yf = y.astype(jnp.float32)
+    gx = _conv3x3(yf, SOBEL_X)
+    gy = _conv3x3(yf, SOBEL_Y)
+    return jnp.sqrt(gx * gx + gy * gy)
+
+
+def si_frame(y: jnp.ndarray) -> jnp.ndarray:
+    """Spatial information of one frame (population stddev, P.910)."""
+    return jnp.std(sobel_magnitude(y))
+
+
+@jax.jit
+def si_frames(y: jnp.ndarray) -> jnp.ndarray:
+    """SI per frame for [T, H, W] luma."""
+    return jax.vmap(si_frame)(y)
+
+
+@jax.jit
+def ti_frames(y: jnp.ndarray) -> jnp.ndarray:
+    """TI per frame for [T, H, W] luma: TI[0] = 0 (undefined for the first
+    frame), TI[t] = std(y[t] - y[t-1])."""
+    yf = y.astype(jnp.float32)
+    diff = yf[1:] - yf[:-1]
+    ti = jax.vmap(jnp.std)(diff)
+    return jnp.concatenate([jnp.zeros((1,), ti.dtype), ti])
+
+
+@jax.jit
+def siti(y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(SI[T], TI[T]) for a [T, H, W] luma tensor — the batched feature
+    extractor behind p02/complexity classification."""
+    return si_frames(y), ti_frames(y)
+
+
+#: reference util/complexity_classification.py:34 — "arbitrarily chosen in
+#: order to get a maximum difficulty of around 10"
+REFERENCE_BITRATE = 2.75
+
+
+def norm_bitrate_complexity(
+    size_bytes: float, framerate: float, duration: float, width: int, height: int,
+) -> tuple[float, float]:
+    """The reference's complexity proxy (util/complexity_classification.py:50-69):
+    norm_bitrate = file_size / framerate / duration / (pixels/1000);
+    complexity = 20 * log10(norm_bitrate) / REFERENCE_BITRATE.
+    Returns (norm_bitrate, complexity)."""
+    import math
+
+    norm_bitrate = size_bytes / framerate / duration / (width * height / 1000.0)
+    return norm_bitrate, 20.0 * math.log10(norm_bitrate) / REFERENCE_BITRATE

@@ -3,22 +3,37 @@
 Multi-chip TPU hardware is unavailable in CI; all sharding/mesh tests run on
 `--xla_force_host_platform_device_count=8` CPU devices, which exercises the
 same partitioning + collective code paths XLA uses on a real v5e-8.
+
+This environment registers an experimental 'axon' TPU-tunnel PJRT plugin at
+interpreter start (sitecustomize) — before this conftest runs — and
+initializing it can block on the remote tunnel. Tests must never touch it:
+we deregister the factory and force the cpu platform before any backend is
+created.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:  # private API: harmless to skip if a jax upgrade moves it
+    from jax._src import xla_bridge as _xb  # noqa: E402
+
+    getattr(_xb, "_backend_factories", {}).pop("axon", None)
+except Exception:
+    pass
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def devices8():
-    import jax
-
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]

@@ -1,0 +1,283 @@
+"""Device kernel tests (run on the CPU backend via conftest; identical XLA
+semantics to TPU modulo float association order)."""
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu.io import medialib
+from processing_chain_tpu.ops import fps, metrics, overlay, pad, pixfmt, resize, siti
+
+
+def smooth_image(h=540, w=960):
+    xx, yy = np.meshgrid(np.arange(w), np.arange(h))
+    return ((np.sin(xx / 37) + np.cos(yy / 23)) * 55 + 128).astype(np.uint8)
+
+
+# ------------------------------------------------------------------- resize
+
+@pytest.mark.parametrize("kernel,flag", [
+    ("lanczos", medialib.SWS_LANCZOS),
+    ("bicubic", medialib.SWS_BICUBIC),
+])
+@pytest.mark.parametrize("dst", [(540, 960), (1080, 1920), (135, 240)])
+def test_resize_golden_vs_swscale(kernel, flag, dst):
+    """Golden: device resample vs libswscale (reference scale filter).
+    Agreement within 1 LSB on ≥85% of pixels and MAE < 0.3 — the residual
+    is swscale's two-stage fixed-point rounding (SURVEY.md §7 hard parts)."""
+    src = smooth_image(270, 480)
+    dh, dw = dst
+    ref = medialib.sws_scale_plane(src, dw, dh, flag)
+    ours = np.asarray(resize.resize_plane(src, dh, dw, kernel))
+    diff = np.abs(ref.astype(int) - ours.astype(int))
+    assert diff.max() <= 1, f"max {diff.max()}"
+    assert diff.mean() < 0.3
+    assert (diff == 0).mean() > 0.85
+
+
+def test_resize_batched_matches_single():
+    """Batched jit vs per-frame eager: identical up to 1 LSB (XLA may fuse
+    the FMA chain differently, moving values across the .5 rounding edge)."""
+    src = np.stack([smooth_image(108, 192) + i for i in range(4)])
+    batched = np.asarray(resize.resize_frames(src, 216, 384)).astype(int)
+    single = np.stack(
+        [np.asarray(resize.resize_plane(s, 216, 384)) for s in src]
+    ).astype(int)
+    diff = np.abs(batched - single)
+    assert diff.max() <= 1
+    assert (diff != 0).mean() < 0.01
+
+
+def test_resize_identity_passthrough():
+    src = smooth_image(108, 192)
+    out = np.asarray(resize.resize_plane(src, 108, 192))
+    np.testing.assert_array_equal(out, src)
+
+
+def test_resize_yuv_chroma_grids():
+    y = smooth_image(108, 192)
+    u = smooth_image(54, 96)
+    v = smooth_image(54, 96)
+    oy, ou, ov = resize.resize_yuv((y, u, v), 216, 384, "yuv420p")
+    assert oy.shape == (216, 384)
+    assert ou.shape == (108, 192) and ov.shape == (108, 192)
+
+
+# ------------------------------------------------------------------- SI/TI
+
+def test_siti_against_numpy_reference():
+    rng = np.random.default_rng(3)
+    frames = rng.integers(0, 255, size=(6, 72, 128), dtype=np.uint8)
+    si, ti = siti.siti(frames)
+    si, ti = np.asarray(si), np.asarray(ti)
+
+    # independent numpy implementation of ITU-T P.910
+    def np_sobel_std(y):
+        from scipy.ndimage import convolve
+
+        y = y.astype(np.float64)
+        kx = np.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], float)
+        gx = convolve(y, kx)[1:-1, 1:-1]
+        gy = convolve(y, kx.T)[1:-1, 1:-1]
+        return np.std(np.sqrt(gx**2 + gy**2))
+
+    for t in range(6):
+        assert abs(si[t] - np_sobel_std(frames[t])) < 0.05
+    assert ti[0] == 0.0
+    for t in range(1, 6):
+        expect = np.std(frames[t].astype(np.float64) - frames[t - 1].astype(np.float64))
+        assert abs(ti[t] - expect) < 0.05
+
+
+def test_siti_flat_frame_zero():
+    frames = np.full((3, 64, 64), 77, np.uint8)
+    si, ti = siti.siti(frames)
+    assert np.allclose(si, 0.0) and np.allclose(ti, 0.0)
+
+
+def test_complexity_proxy_formula():
+    # reference util/complexity_classification.py:50-69 on a synthetic case
+    nb, comp = siti.norm_bitrate_complexity(
+        size_bytes=1_000_000, framerate=25.0, duration=8.0, width=1920, height=1080
+    )
+    expect_nb = 1_000_000 / 25.0 / 8.0 / (1920 * 1080 / 1000.0)
+    assert abs(nb - expect_nb) < 1e-9
+    assert abs(comp - 20 * np.log10(expect_nb) / 2.75) < 1e-9
+
+
+# ------------------------------------------------------------------ metrics
+
+def test_psnr():
+    ref = smooth_image(72, 128)
+    deg = np.clip(ref.astype(int) + 4, 0, 255).astype(np.uint8)
+    got = float(metrics.psnr_frame(ref, deg))
+    mse = np.mean((ref.astype(float) - deg.astype(float)) ** 2)
+    assert abs(got - 10 * np.log10(255**2 / mse)) < 1e-3
+    assert float(metrics.psnr_frame(ref, ref)) == 100.0
+
+
+def test_ssim_properties():
+    ref = smooth_image(72, 128)
+    assert float(metrics.ssim_frame(ref, ref)) > 0.9999
+    rng = np.random.default_rng(0)
+    noisy = np.clip(
+        ref.astype(int) + rng.normal(0, 25, ref.shape), 0, 255
+    ).astype(np.uint8)
+    mid = float(metrics.ssim_frame(ref, noisy))
+    assert 0.05 < mid < 0.95
+    inverted = (255 - ref).astype(np.uint8)
+    assert float(metrics.ssim_frame(ref, inverted)) < 0.5
+
+
+def test_metrics_batched():
+    ref = np.stack([smooth_image(72, 128)] * 3)
+    deg = ref.copy()
+    deg[1] = np.clip(deg[1].astype(int) + 10, 0, 255).astype(np.uint8)
+    p = np.asarray(metrics.psnr_frames(ref, deg))
+    s = np.asarray(metrics.ssim_frames(ref, deg))
+    assert p.shape == (3,) and s.shape == (3,)
+    assert p[0] == 100.0 and p[1] < 30.0
+    assert s[1] < s[0]
+
+
+# ---------------------------------------------------------------------- fps
+
+def test_fps_spec_grammar():
+    assert fps.resolve_fps_spec("original", 60.0) is None
+    assert fps.resolve_fps_spec("auto", 60.0) is None
+    assert fps.resolve_fps_spec("24/25/30", 60.0) == 30.0
+    assert fps.resolve_fps_spec("24/25/30", 25.0) is None
+    assert fps.resolve_fps_spec("50/60", 120.0) == 60.0
+    assert fps.resolve_fps_spec("1/2", 60.0) == 30.0
+    assert fps.resolve_fps_spec(15, 60.0) == 15.0
+    from processing_chain_tpu.config import ConfigError
+
+    with pytest.raises(ConfigError):
+        fps.resolve_fps_spec("24/25/30", 48.0)
+    with pytest.raises(ConfigError):
+        fps.resolve_fps_spec("50/60", 30.0)
+
+
+def test_select_tables_match_reference():
+    """The reference's hand-built select expressions (lib/ffmpeg.py:806-832)
+    evaluated symbolically vs our phase tables."""
+    import math
+
+    cases = {
+        (60, 30): lambda n: (n + 1) % 2 != 0,
+        (60, 24): lambda n: (n % 5 == 0) or ((n - 3) % 5 == 0),
+        (60, 20): lambda n: n % 3 == 0,
+        (60, 15): lambda n: n % 4 == 0,
+        (30, 24): lambda n: (n + 1) % 5 != 0,
+        (50, 15): lambda n: (n % 10 == 0) or ((n - 3) % 10 == 0) or ((n - 7) % 10 == 0),
+        (25, 15): lambda n: (n % 5 == 0) or ((n - 3) % 5 == 0) or ((n - 2) % 5 == 0),
+        (24, 15): lambda n: any((n - o) % 8 == 0 for o in (0, 3, 2, 5, 6)),
+    }
+    for (src, dst), expr in cases.items():
+        got = set(fps.select_indices(240, src, dst).tolist())
+        want = {n for n in range(240) if expr(n)}
+        assert got == want, f"{src}->{dst}"
+
+
+def test_fps_resample_duplication():
+    idx = fps.fps_resample_indices(24, 24.0, 60.0)
+    assert len(idx) == 60
+    assert idx[0] == 0 and idx[-1] <= 23
+    # each source frame appears at least twice upsampling 24->60
+    counts = np.bincount(idx, minlength=24)
+    assert counts.min() >= 2
+
+
+# ------------------------------------------------------------------ overlay
+
+def test_stall_plan_inserts_frames():
+    plan = overlay.plan_stalling(n_frames=48, fps=24.0, buff_events=[[1.0, 0.5]])
+    assert plan.n_out == 48 + 12
+    # first 24 frames play normally, then 12 stall frames, then resume
+    assert list(plan.src_idx[:24]) == list(range(24))
+    assert all(plan.src_idx[24:36] == 23)
+    assert all(plan.stall_mask[24:36] == 1)
+    assert list(plan.src_idx[36:]) == list(range(24, 48))
+    assert plan.stall_mask.sum() == 12
+
+
+def test_freeze_plan_keeps_length():
+    plan = overlay.plan_stalling(
+        n_frames=48, fps=24.0, buff_events=[1.0], skipping=True
+    )
+    assert plan.n_out == 48
+    # bare duration -> freeze at t=0 for 1s: frames 0..23 show frame 0
+    assert all(plan.src_idx[:24] == 0)
+    assert list(plan.src_idx[24:]) == list(range(24, 48))
+
+
+def test_render_stalled_black_and_spinner():
+    frames = np.full((10, 64, 64), 200, np.float32)
+    plan = overlay.plan_stalling(
+        10, 10.0, [[0.5, 0.3]], black_frame=True, n_rotations=4
+    )
+    spinner_rgba = np.zeros((16, 16, 4), np.uint8)
+    spinner_rgba[..., 0:3] = 255
+    spinner_rgba[4:12, 4:12, 3] = 255  # opaque center square
+    yuv, alpha = overlay.prepare_spinner(spinner_rgba, n_rotations=4)
+    out = np.asarray(
+        overlay.render_stalled_plane(
+            frames, plan, spinner=yuv[:, 0], spinner_alpha=alpha
+        )
+    )
+    assert out.shape[0] == 13
+    # stall frames are black (16) except where the spinner is composited
+    stall_frame = out[6]
+    assert stall_frame[0, 0] == 16.0
+    assert abs(stall_frame[32, 32] - 235.0) < 40  # white-ish spinner center
+    # normal frames untouched
+    assert out[0, 0, 0] == 200.0
+
+
+def test_downsample_alpha():
+    a = np.zeros((2, 8, 8), np.float32)
+    a[:, :4, :4] = 1.0
+    d = overlay.downsample_alpha(a)
+    assert d.shape == (2, 4, 4)
+    assert d[0, 0, 0] == 1.0 and d[0, 3, 3] == 0.0
+
+
+# ---------------------------------------------------------------------- pad
+
+def test_pad_center():
+    p = np.full((2, 10, 20), 99, np.float32)
+    out = np.asarray(pad.pad_center(p, 16, 32, fill=16.0))
+    assert out.shape == (2, 16, 32)
+    assert out[0, 0, 0] == 16.0
+    assert out[0, 3, 6] == 99.0
+    y, u, v = pad.pad_yuv(
+        (np.ones((10, 20)), np.ones((5, 10)), np.ones((5, 10))), 16, 32
+    )
+    assert y.shape == (16, 32) and u.shape == (8, 16)
+
+
+# ------------------------------------------------------------------- pixfmt
+
+def test_depth_roundtrip():
+    x = np.arange(256, dtype=np.uint8).reshape(16, 16)
+    ten = np.asarray(pixfmt.depth_8_to_10(x))
+    assert ten.dtype == np.uint16 and ten.max() == 1020
+    back = np.asarray(pixfmt.depth_10_to_8(ten))
+    np.testing.assert_array_equal(back, x)
+
+
+def test_pack_uyvy422():
+    y = np.arange(8, dtype=np.uint8).reshape(2, 4)
+    u = np.array([[100, 101], [102, 103]], np.uint8)
+    v = np.array([[200, 201], [202, 203]], np.uint8)
+    packed = np.asarray(pixfmt.pack_uyvy422(y, u, v))
+    assert packed.shape == (2, 8)
+    assert list(packed[0]) == [100, 0, 200, 1, 101, 2, 201, 3]
+
+
+def test_chroma_420_422_shapes():
+    u = np.full((54, 96), 128, np.uint8)
+    v = np.full((54, 96), 128, np.uint8)
+    u2, v2 = pixfmt.chroma_420_to_422(u, v)
+    assert u2.shape == (108, 96)
+    u3, v3 = pixfmt.chroma_422_to_420(u2, v2)
+    assert u3.shape == (54, 96)
