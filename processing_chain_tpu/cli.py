@@ -1,0 +1,69 @@
+"""Command-line entry points.
+
+    python -m processing_chain_tpu -c DB/DB.yaml [-str 1234] …   (p00)
+    python -m processing_chain_tpu.cli p01 -c …                  (single stage)
+
+Flag surface mirrors the reference's per-script CLIs (README.md:94-127).
+ConfigError and pipeline failures exit 1 like the reference's sys.exit(1)
+sites.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, Sequence
+
+from .config.errors import ConfigError
+from .utils import log as log_mod
+from .utils import parse_args as pa
+from .utils.runner import ChainError
+from .utils.version import check_requirements
+
+
+def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
+    script_num = {"p01": 1, "p02": 2, "p03": 3, "p04": 4}.get(stage or "")
+    name = stage or "processAll"
+    args = pa.parse_args(name, script_num, argv)
+    log_mod.setup_custom_logger("main", verbose=args.verbose)
+    if not args.skip_requirements:
+        check_requirements()
+    from .utils.device import ensure_backend
+
+    ensure_backend()
+    try:
+        if stage is None:
+            from .stages import p00_process_all
+
+            p00_process_all.run(args)
+        else:
+            from .stages import (
+                p01_generate_segments,
+                p02_generate_metadata,
+                p03_generate_avpvs,
+                p04_generate_cpvs,
+            )
+
+            mod = {
+                "p01": p01_generate_segments,
+                "p02": p02_generate_metadata,
+                "p03": p03_generate_avpvs,
+                "p04": p04_generate_cpvs,
+            }[stage]
+            mod.run(args)
+    except (ConfigError, ChainError) as exc:
+        log_mod.get_logger().error("%s", exc)
+        return 1
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    stage = None
+    if argv and argv[0] in ("p01", "p02", "p03", "p04", "p00"):
+        head = argv.pop(0)
+        stage = None if head == "p00" else head
+    return _dispatch(stage, argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

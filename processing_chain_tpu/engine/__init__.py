@@ -1,0 +1,3 @@
+from .jobs import Job, JobRunner
+
+__all__ = ["Job", "JobRunner"]

@@ -1,0 +1,110 @@
+"""Job model: memoized, provenance-logged units of pipeline work.
+
+Replaces the reference's command-string + exists-check idiom (every
+operator returns None when its output exists and --force is unset,
+reference lib/ffmpeg.py:786-788, :964-970, :1022-1028, :1067-1073,
+:1126-1132, :1271-1277) with a typed Job: the filesystem stays the
+checkpoint/resume system (SURVEY.md §5), deterministic output paths are
+the cache keys, and each job can write a provenance log capturing what
+produced the artifact (reference p01:89-92, p03:41-59).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..utils.log import get_logger
+from ..utils.runner import ParallelRunner
+from ..utils.version import get_processing_chain_version
+
+
+@dataclass
+class Job:
+    """One unit of work producing `output_path`."""
+
+    label: str
+    output_path: str
+    fn: Callable[[], Any]
+    provenance: dict = field(default_factory=dict)
+    logfile_path: Optional[str] = None
+
+    def should_run(self, force: bool) -> bool:
+        if force or not self.output_path:
+            return True
+        if os.path.isfile(self.output_path):
+            get_logger().warning(
+                "output %s already exists, will not convert. Use --force to "
+                "force overwriting.",
+                self.output_path,
+            )
+            return False
+        return True
+
+    def write_provenance(self) -> None:
+        if not self.logfile_path:
+            return
+        record = {
+            "output": os.path.basename(self.output_path),
+            "processingChain": get_processing_chain_version(),
+            "job": self.label,
+            **self.provenance,
+        }
+        os.makedirs(os.path.dirname(self.logfile_path), exist_ok=True)
+        with open(self.logfile_path, "w") as f:
+            for key, value in record.items():
+                f.write(f"{key}: {json.dumps(value) if not isinstance(value, str) else value}\n")
+
+    def run(self) -> Any:
+        result = self.fn()
+        self.write_provenance()
+        return result
+
+
+class JobRunner:
+    """Plans and executes jobs with skip-existing / force / dry-run
+    semantics and fail-fast parallel execution."""
+
+    def __init__(self, force: bool = False, dry_run: bool = False,
+                 parallelism: int = 4, name: str = "jobs") -> None:
+        self.force = force
+        self.dry_run = dry_run
+        self.parallelism = parallelism
+        self.name = name
+        self.jobs: list[Job] = []
+
+    def add(self, job: Optional[Job]) -> None:
+        if job is None:
+            return
+        if job.should_run(self.force):
+            self.jobs.append(job)
+
+    def run(self) -> dict[str, Any]:
+        log = get_logger()
+        if self.dry_run:
+            for job in self.jobs:
+                log.info("[dry-run] %s -> %s", job.label, job.output_path)
+            planned = self.jobs
+            self.jobs = []
+            return {j.label: None for j in planned}
+        runner = ParallelRunner(max_parallel=self.parallelism, name=self.name)
+        for job in self.jobs:
+            runner.add(job.run, label=job.label)
+        self.jobs = []
+        return runner.run()
+
+    def run_serial(self) -> dict[str, Any]:
+        """Run jobs one by one in order (for device-bound stages — one chip,
+        serialized device queue)."""
+        log = get_logger()
+        results = {}
+        jobs, self.jobs = self.jobs, []
+        for job in jobs:
+            if self.dry_run:
+                log.info("[dry-run] %s -> %s", job.label, job.output_path)
+                results[job.label] = None
+            else:
+                results[job.label] = job.run()
+        return results

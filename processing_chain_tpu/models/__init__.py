@@ -1,0 +1,3 @@
+from . import avpvs, cpvs, frames, metadata, segments
+
+__all__ = ["avpvs", "cpvs", "frames", "metadata", "segments"]

@@ -1,0 +1,279 @@
+"""AVPVS model — the p03 pixel-domain core (reference p03_generateAvPvs.py
++ lib/ffmpeg.py:940-1105, :1262-1289; bufferer pass p03:216-260).
+
+Short tests: decode the single segment → device rescale to the AVPVS canvas
+(bicubic, reference create_avpvs_short :940-1000) → FFV1(+FLAC) AVI.
+
+Long tests: per segment, decode → device rescale → resample onto the canvas
+frame rate (the nullsrc-overlay trick of create_avpvs_segment :1003-1055:
+exactly duration×rate frames, last frame repeated when short) → streamed
+into one FFV1 writer (the file-based tmp-segment + concat demuxer of the
+reference, :1058-1105, collapses into an in-process stream) → SRC audio
+muxed as pcm_s16le 2ch (audio_mux :1262-1289).
+
+Stalling pass (both): a StallPlan from the PVS buff events drives the
+device gather + spinner composite (ops/overlay — the bufferer
+re-implementation), with silence inserted into the audio during stalls.
+Frame-freeze HRCs use skipping mode (no spinner, length preserved).
+
+Device work is chunked over CHUNK-frame batches so arbitrarily long PVSes
+stream through bounded HBM.
+"""
+
+from __future__ import annotations
+
+import os
+from fractions import Fraction
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.domain import Pvs
+from ..engine.jobs import Job
+from ..io import medialib
+from ..io.video import VideoReader, VideoWriter
+from ..ops import fps as fps_ops
+from ..ops import overlay as ov
+from ..utils.log import get_logger
+from . import frames as fr
+
+CHUNK = 64  # frames per device batch
+
+
+def avpvs_dimensions(pvs: Pvs, post_proc_id: int = 0) -> tuple[int, int]:
+    """(width, height) of the AVPVS canvas: aspect-aware dims vs the
+    post-processing coding size, overridden upward when the encoded segment
+    is taller (reference create_avpvs_short :976-986)."""
+    pp = pvs.test_config.post_processings[post_proc_id]
+    w, h = fr.calculate_avpvs_video_dimensions(
+        pvs.src.stream_info["width"],
+        pvs.src.stream_info["height"],
+        pp.coding_width,
+        pp.coding_height,
+    )
+    ql = pvs.segments[0].quality_level
+    if ql.height > h:
+        w, h = ql.width, ql.height
+    return w, h
+
+
+def canvas_fps(pvs: Pvs, avpvs_src_fps: bool = False) -> float:
+    """AVPVS canvas frame rate: 60 by default, SRC fps with -z
+    (reference create_avpvs_segment :1030-1033, p03 flags)."""
+    return pvs.src.get_fps() if avpvs_src_fps else 60.0
+
+
+def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
+                 with_audio: bool, sample_rate: int = 48000) -> VideoWriter:
+    frac = Fraction(rate).limit_denominator(1001)
+    audio = dict(audio_codec="pcm_s16le", sample_rate=sample_rate, channels=2) if with_audio else {}
+    # FFV1 level 3 + slicecrc stream integrity (reference :1047: -level 3
+    # -coder 1 -context 1 -slicecrc 1); -threads 4 parity
+    return VideoWriter(
+        path, "ffv1", w, h, pix_fmt, (frac.numerator, frac.denominator),
+        threads=4, opts="level=3:coder=1:context=1:slicecrc=1", **audio,
+    )
+
+
+def _segment_to_canvas(seg, w: int, h: int, rate: float, pix_fmt: str):
+    """Decode one encoded segment and yield [T,H,W] uint8 plane chunks on
+    the canvas grid/rate (exactly round(duration*rate) frames)."""
+    with VideoReader(seg.file_path) as reader:
+        planes = fr.stack_planes(list(reader))
+        seg_fps = reader.fps
+    if not planes:
+        raise medialib.MediaError(f"no frames in segment {seg.file_path}")
+    n = planes[0].shape[0]
+    n_out = int(round(seg.duration * rate))
+    t_out = np.arange(n_out) / rate
+    idx = np.clip(np.floor(t_out * seg_fps + 0.5).astype(np.int64), 0, n - 1)
+    sub = fr.chroma_subsampling(pix_fmt)
+    for start in range(0, n_out, CHUNK):
+        sel = idx[start : start + CHUNK]
+        chunk = [p[sel] for p in planes]
+        scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
+        yield fr.to_uint8(scaled, ten_bit="10" in pix_fmt)
+
+
+def create_avpvs_wo_buffer(
+    pvs: Pvs,
+    overwrite: bool = False,
+    avpvs_src_fps: bool = False,
+    force_60_fps: bool = False,
+) -> Optional[Job]:
+    """The decode+rescale(+concat+audio) stage producing the pre-stalling
+    AVPVS (or the final one when the HRC has no buffering)."""
+    tc = pvs.test_config
+    out_path = (
+        pvs.get_avpvs_wo_buffer_file_path()
+        if pvs.has_buffering()
+        else pvs.get_avpvs_file_path()
+    )
+    w, h = avpvs_dimensions(pvs)
+    pix_fmt = pvs.get_pix_fmt_for_avpvs()
+
+    def run() -> str:
+        if tc.is_short():
+            # single segment, native segment frame rate unless -z/-f60
+            seg = pvs.segments[0]
+            with VideoReader(seg.file_path) as reader:
+                planes = fr.stack_planes(list(reader))
+                seg_fps = reader.fps
+            rate = pvs.src.get_fps() if avpvs_src_fps else (60.0 if force_60_fps else seg_fps)
+            n = planes[0].shape[0]
+            if rate != seg_fps:
+                idx = fps_ops.fps_resample_indices(n, seg_fps, rate)
+                planes = [p[idx] for p in planes]
+            sub = fr.chroma_subsampling(pix_fmt)
+            with _ffv1_writer(out_path, w, h, pix_fmt, rate, with_audio=False) as writer:
+                for start in range(0, planes[0].shape[0], CHUNK):
+                    chunk = [p[start : start + CHUNK] for p in planes]
+                    scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
+                    for out in zip(*(np.asarray(p) for p in fr.to_uint8(scaled, "10" in pix_fmt))):
+                        writer.write(*out)
+        else:
+            rate = canvas_fps(pvs, avpvs_src_fps)
+            total = float(sum(s.get_segment_duration() for s in pvs.segments))
+            samples, srate = medialib.decode_audio_s16(
+                pvs.src.file_path, 0.0, total
+            )
+            if samples.ndim != 2 or samples.shape[1] != 2:
+                samples = np.repeat(samples.reshape(-1, 1), 2, axis=1)
+            with _ffv1_writer(
+                out_path, w, h, pix_fmt, rate, with_audio=True, sample_rate=srate
+            ) as writer:
+                writer.write_audio(samples)
+                for seg in pvs.segments:
+                    for chunk in _segment_to_canvas(seg, w, h, rate, pix_fmt):
+                        for out in zip(*(np.asarray(p) for p in chunk)):
+                            writer.write(*out)
+        return out_path
+
+    return Job(
+        label=f"avpvs {pvs.pvs_id}",
+        output_path=out_path,
+        fn=run,
+        logfile_path=pvs.get_logfile_path(),
+        provenance={
+            "pvs": pvs.pvs_id,
+            "pipeline": {
+                "canvas": [w, h],
+                "pix_fmt": pix_fmt,
+                "segments": [s.filename for s in pvs.segments],
+                "codec": "ffv1(level3,slicecrc)",
+            },
+        },
+    )
+
+
+def load_spinner(path: str) -> np.ndarray:
+    """Load a spinner image as [H, W, 4] RGBA uint8."""
+    from PIL import Image
+
+    img = Image.open(path).convert("RGBA")
+    return np.asarray(img, dtype=np.uint8)
+
+
+def apply_stalling(
+    pvs: Pvs,
+    spinner_path: Optional[str] = None,
+    overwrite: bool = False,
+    n_rotations: int = 64,
+) -> Optional[Job]:
+    """The bufferer pass (reference p03:216-260): re-render the
+    wo_buffer AVPVS with stall insertions (spinner over black frames) or
+    frame-freeze skipping."""
+    if not pvs.has_buffering():
+        return None
+    in_path = pvs.get_avpvs_wo_buffer_file_path()
+    out_path = pvs.get_avpvs_file_path()
+    skipping = pvs.has_framefreeze()
+    events = pvs.get_buff_events_media_time()
+
+    def run() -> str:
+        with VideoReader(in_path) as reader:
+            planes = fr.stack_planes(list(reader))  # host uint8/uint16
+            rate = reader.fps
+            pix_fmt = reader.pix_fmt
+            w, hgt = reader.width, reader.height
+        n = planes[0].shape[0]
+        ten_bit = "10" in pix_fmt
+        plan = ov.plan_stalling(
+            n, rate, events, skipping=skipping, black_frame=True,
+            n_rotations=n_rotations,
+        )
+        sp_y = sp_u = sp_v = sa = sa_c = None
+        if not skipping and spinner_path:
+            bank_yuv, bank_a = ov.prepare_spinner(
+                load_spinner(spinner_path), n_rotations
+            )
+            sp_y, sp_u, sp_v = bank_yuv[:, 0], bank_yuv[:, 1], bank_yuv[:, 2]
+            sa = bank_a
+            sa_c = ov.downsample_alpha(bank_a)
+            sp_u = sp_u[:, ::2, ::2]
+            sp_v = sp_v[:, ::2, ::2]
+
+        # audio: decode, insert stall silence at wallclock positions
+        audio = None
+        srate = 48000
+        try:
+            audio, srate = medialib.decode_audio_s16(in_path)
+        except medialib.MediaError:
+            audio = None
+        if audio is not None and audio.size and not skipping:
+            pieces = []
+            cursor = 0
+            for t, d in sorted((float(e[0]), float(e[1])) for e in events):
+                cut = int(round(t * srate))
+                pieces.append(audio[cursor:cut])
+                pieces.append(np.zeros((int(round(d * srate)), audio.shape[1]), np.int16))
+                cursor = cut
+            pieces.append(audio[cursor:])
+            audio = np.concatenate([p for p in pieces if len(p)])
+
+        with _ffv1_writer(
+            out_path, w, hgt, pix_fmt, rate,
+            with_audio=audio is not None and audio.size > 0, sample_rate=srate,
+        ) as writer:
+            if audio is not None and audio.size:
+                writer.write_audio(audio)
+            # stream the output timeline in CHUNK-frame device batches so
+            # long PVSes stay within bounded HBM (input stays host uint8;
+            # each batch gathers its own source frames)
+            for start in range(0, plan.n_out, CHUNK):
+                sub = ov.StallPlan(
+                    src_idx=np.zeros(len(plan.src_idx[start : start + CHUNK]), np.int32),
+                    stall_mask=plan.stall_mask[start : start + CHUNK],
+                    black_mask=plan.black_mask[start : start + CHUNK],
+                    phase=plan.phase[start : start + CHUNK],
+                )
+                sel = plan.src_idx[start : start + CHUNK]
+                # local gather on host (indices relative to the batch)
+                y = jnp.asarray(planes[0][sel], jnp.float32)
+                u = jnp.asarray(planes[1][sel], jnp.float32)
+                v = jnp.asarray(planes[2][sel], jnp.float32)
+                sub = ov.StallPlan(
+                    src_idx=np.arange(len(sel), dtype=np.int32),
+                    stall_mask=sub.stall_mask,
+                    black_mask=sub.black_mask,
+                    phase=sub.phase,
+                )
+                oy = ov.render_stalled_plane(y, sub, sp_y, sa, black_value=16.0)
+                ou = ov.render_stalled_plane(u, sub, sp_u, sa_c, black_value=128.0)
+                ovv = ov.render_stalled_plane(v, sub, sp_v, sa_c, black_value=128.0)
+                outs = fr.to_uint8([oy, ou, ovv], ten_bit)
+                for i in range(outs[0].shape[0]):
+                    writer.write(*(np.asarray(p[i]) for p in outs))
+        return out_path
+
+    return Job(
+        label=f"stalling {pvs.pvs_id}",
+        output_path=out_path,
+        fn=run,
+        provenance={
+            "pvs": pvs.pvs_id,
+            "mode": "skipping" if skipping else "spinner-stall",
+            "events": events,
+        },
+    )

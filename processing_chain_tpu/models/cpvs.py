@@ -1,0 +1,242 @@
+"""CPVS model — the p04 stage (reference p04_generateCpvs.py +
+lib/ffmpeg.py create_cpvs :1149-1247, create_preview :1250-1259).
+
+PC context: AVPVS → display frame rate → centered pad to the display
+canvas when the AVPVS is shorter → rawvideo/UYVY422 AVI (8-bit) or
+v210/yuv422p10le (10-bit); audio none (short) or pcm_s16le 2ch trimmed to
+the HRC duration (long). Mobile/tablet: x264 CRF mp4 (high profile,
+faststart) with scale/pad to the display dims; AAC 512k for long tests.
+Long tests get RMS loudness normalization to -23 dBFS (the reference's
+ffmpeg-normalize step, lib/ffmpeg.py:1233-1245) applied in-process.
+"""
+
+from __future__ import annotations
+
+import os
+from fractions import Fraction
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.domain import PostProcessing, Pvs
+from ..engine.jobs import Job
+from ..io import medialib
+from ..io.video import VideoReader, VideoWriter
+from ..ops import fps as fps_ops
+from ..ops import pad as pad_ops
+from ..ops import pixfmt as pf
+from ..utils.log import get_logger
+from . import frames as fr
+from .avpvs import avpvs_dimensions
+
+CHUNK = 64
+
+
+def normalize_rms(samples: np.ndarray, target_dbfs: float = -23.0) -> np.ndarray:
+    """RMS loudness normalization (ffmpeg-normalize `-nt rms` equivalent)."""
+    if samples.size == 0:
+        return samples
+    x = samples.astype(np.float64) / 32768.0
+    rms = np.sqrt(np.mean(x * x))
+    if rms <= 0:
+        return samples
+    gain = 10.0 ** ((target_dbfs - 20.0 * np.log10(rms)) / 20.0)
+    return np.clip(x * gain * 32768.0, -32768, 32767).astype(np.int16)
+
+
+def _read_avpvs(pvs: Pvs):
+    path = pvs.get_avpvs_file_path()
+    with VideoReader(path) as r:
+        planes = fr.stack_planes(list(r))
+        return planes, r.fps, r.pix_fmt, r.width, r.height
+
+
+def _audio_for_long(pvs: Pvs, normalize: bool):
+    try:
+        samples, rate = medialib.decode_audio_s16(pvs.get_avpvs_file_path())
+    except medialib.MediaError:
+        return None, 48000
+    total = pvs.hrc.get_long_hrc_duration()
+    samples = samples[: int(round(total * rate))]
+    if normalize:
+        samples = normalize_rms(samples)
+    return samples, rate
+
+
+def create_cpvs(
+    pvs: Pvs,
+    post_processing: PostProcessing,
+    rawvideo: bool = False,
+    overwrite: bool = False,
+    nonraw_crf: int = 17,
+    mobile_vprofile: str = "high",
+    mobile_preset: str = "fast",
+) -> Optional[Job]:
+    tc = pvs.test_config
+    pp = post_processing
+    out_path = pvs.get_cpvs_file_path(context=pp.processing_type, rawvideo=rawvideo)
+    is_pc = pp.processing_type in ("pc", "hd-pc-home", "uhd-pc-home")
+
+    def run() -> str:
+        planes, rate, pix_fmt, w, h = _read_avpvs(pvs)
+        n = planes[0].shape[0]
+        # display frame rate resample (reference fps=displayFrameRate filter)
+        if rate != pp.display_frame_rate:
+            idx = fps_ops.fps_resample_indices(n, rate, float(pp.display_frame_rate))
+            planes = [p[idx] for p in planes]
+        out_rate = Fraction(pp.display_frame_rate).limit_denominator(1001)
+        ten_bit = "10" in pix_fmt
+
+        audio = None
+        srate = 48000
+        if tc.is_long():
+            audio, srate = _audio_for_long(pvs, normalize=True)
+
+        if is_pc:
+            vcodec, target_pix_fmt = pvs.get_vcodec_and_pix_fmt_for_cpvs(rawvideo)
+            need_pad = h < pp.coding_height
+            dw, dh = pp.display_width, pp.display_height
+            aud = (
+                dict(audio_codec="pcm_s16le", sample_rate=srate, channels=2)
+                if (tc.is_long() and audio is not None and audio.size)
+                else {}
+            )
+            with VideoWriter(
+                out_path, vcodec, dw if need_pad else w, dh if need_pad else h,
+                target_pix_fmt, (out_rate.numerator, out_rate.denominator), **aud,
+            ) as writer:
+                if aud:
+                    writer.write_audio(audio)
+                for start in range(0, planes[0].shape[0], CHUNK):
+                    y = jnp.asarray(planes[0][start : start + CHUNK])
+                    u = jnp.asarray(planes[1][start : start + CHUNK])
+                    v = jnp.asarray(planes[2][start : start + CHUNK])
+                    if "420" in pix_fmt:
+                        # CPVS is 422-based (uyvy422 / v210): lift chroma
+                        u, v = pf.chroma_420_to_422(u, v)
+                    if need_pad:
+                        y = pad_ops.pad_center(y, dh, dw, 16.0 if not ten_bit else 64.0)
+                        u = pad_ops.pad_center(u, dh, dw // 2, 128.0 if not ten_bit else 512.0)
+                        v = pad_ops.pad_center(v, dh, dw // 2, 128.0 if not ten_bit else 512.0)
+                    if rawvideo:
+                        # raw passthrough in the AVPVS pix_fmt
+                        outs = fr.to_uint8([y, u, v], ten_bit)
+                        for i in range(outs[0].shape[0]):
+                            writer.write(*(np.asarray(p[i]) for p in outs))
+                    elif not ten_bit:
+                        # packed UYVY422 via the rawvideo encoder
+                        yq, uq, vq = fr.to_uint8([y, u, v], False)
+                        packed = pf.pack_uyvy422(
+                            jnp.asarray(yq), jnp.asarray(uq), jnp.asarray(vq)
+                        )
+                        for i in range(packed.shape[0]):
+                            writer.write(np.asarray(packed[i]))
+                    else:
+                        # v210 encoder takes planar yuv422p10le input
+                        outs = fr.to_uint8([y, u, v], True)
+                        for i in range(outs[0].shape[0]):
+                            writer.write(*(np.asarray(p[i]) for p in outs))
+        else:
+            # mobile / tablet: x264 CRF mp4, scale (+pad) to display dims;
+            # output is always 8-bit yuv420p, so 10-bit AVPVS planes are
+            # depth-converted first
+            if ten_bit:
+                planes = [
+                    np.asarray(pf.depth_10_to_8(jnp.asarray(p))) for p in planes
+                ]
+            dw, dh = pp.display_width, pp.display_height
+            aud = (
+                dict(audio_codec="aac", sample_rate=srate, channels=2,
+                     audio_bitrate_kbps=512)
+                if (tc.is_long() and audio is not None and audio.size)
+                else {}
+            )
+            opts = (
+                f"crf={nonraw_crf}:preset={mobile_preset}:"
+                f"profile={mobile_vprofile}:movflags=+faststart"
+            )
+            need_pad = (pp.display_height != pp.coding_height) or (h < pp.coding_height)
+            with VideoWriter(
+                out_path, "libx264", dw, dh, "yuv420p",
+                (out_rate.numerator, out_rate.denominator), opts=opts, **aud,
+            ) as writer:
+                if aud:
+                    writer.write_audio(audio)
+                for start in range(0, planes[0].shape[0], CHUNK):
+                    chunk = [p[start : start + CHUNK] for p in planes]
+                    if need_pad:
+                        # scale to fit coding dims, pad to display canvas
+                        cw, ch_ = pp.coding_width, pp.coding_height
+                        scaled = fr.scale_yuv_frames(chunk, ch_, cw, "bicubic", (2, 2))
+                        y, u, v = pad_ops.pad_yuv(tuple(scaled), dh, dw, "yuv420p")
+                    else:
+                        scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
+                        y, u, v = scaled
+                    outs = fr.to_uint8([y, u, v], False)
+                    for i in range(outs[0].shape[0]):
+                        writer.write(*(np.asarray(p[i]) for p in outs))
+        return out_path
+
+    return Job(
+        label=f"cpvs {pvs.pvs_id} {pp.processing_type}",
+        output_path=out_path,
+        fn=run,
+        provenance={
+            "pvs": pvs.pvs_id,
+            "context": pp.processing_type,
+            "display": [pp.display_width, pp.display_height],
+            "rawvideo": rawvideo,
+        },
+    )
+
+
+def create_preview(pvs: Pvs, overwrite: bool = False) -> Optional[Job]:
+    """ProRes + AAC preview (reference create_preview :1250-1259)."""
+    out_path = pvs.get_preview_file_path()
+
+    def run() -> str:
+        planes, rate, pix_fmt, w, h = _read_avpvs(pvs)
+        frac = Fraction(rate).limit_denominator(1001)
+        audio = None
+        srate = 48000
+        try:
+            audio, srate = medialib.decode_audio_s16(pvs.get_avpvs_file_path())
+        except medialib.MediaError:
+            audio = None
+        aud = (
+            dict(audio_codec="aac", sample_rate=srate, channels=2)
+            if audio is not None and audio.size
+            else {}
+        )
+        with VideoWriter(
+            out_path, "prores_ks", w, h, "yuv422p10le",
+            (frac.numerator, frac.denominator), **aud,
+        ) as writer:
+            if aud:
+                writer.write_audio(audio)
+            for start in range(0, planes[0].shape[0], CHUNK):
+                y = jnp.asarray(planes[0][start : start + CHUNK])
+                u = jnp.asarray(planes[1][start : start + CHUNK])
+                v = jnp.asarray(planes[2][start : start + CHUNK])
+                if "420" in pix_fmt:
+                    u, v = pf.chroma_420_to_422(u, v)
+                if "10" not in pix_fmt:
+                    y, u, v = (pf.depth_8_to_10(q.astype(jnp.uint8)) for q in fr_round(y, u, v))
+                outs = [np.asarray(q) for q in (y, u, v)]
+                for i in range(outs[0].shape[0]):
+                    writer.write(*(p[i] for p in outs))
+        return out_path
+
+    def fr_round(*planes):
+        return tuple(
+            jnp.clip(jnp.floor(p.astype(jnp.float32) + 0.5), 0, 255).astype(jnp.uint8)
+            for p in planes
+        )
+
+    return Job(
+        label=f"preview {pvs.pvs_id}",
+        output_path=out_path,
+        fn=run,
+        provenance={"pvs": pvs.pvs_id, "codec": "prores_ks"},
+    )

@@ -1,0 +1,102 @@
+"""Shared frame-pipeline helpers for the artifact models."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import resize
+
+
+def calculate_avpvs_video_dimensions(
+    src_width: int, src_height: int, postproc_w: int, postproc_h: int
+) -> tuple[int, int]:
+    """AVPVS canvas dimensions (reference lib/ffmpeg.py:33-58).
+
+    Same-size SRC → post-processing dims. Mobile-style targets narrower
+    than the SRC adapt height to the SRC aspect ratio (rounded up to even);
+    otherwise a (3-decimal) aspect-ratio mismatch keeps the SRC height.
+    The reference's `&`-for-`and` precedence slip at ffmpeg.py:45 is on the
+    do-not-copy list (SURVEY.md §7); this implements the intended check.
+    """
+    if src_width == postproc_w and src_height == postproc_h:
+        return postproc_w, postproc_h
+    src_ar = src_width / src_height
+    post_ar = postproc_w / postproc_h
+    w, h = postproc_w, postproc_h
+    if postproc_w < src_width:
+        if src_ar != post_ar:
+            h = int(postproc_w / src_ar)
+            if h % 2:
+                h += 1
+    else:
+        if int(1000 * src_ar) != int(1000 * post_ar):
+            h = src_height
+    return w, h
+
+
+def scale_to_width_keep_ar(
+    src_h: int, src_w: int, target_w: int
+) -> tuple[int, int]:
+    """ffmpeg `scale=W:-2` semantics (reference encode filter,
+    lib/ffmpeg.py:800): fixed width, proportional height rounded to the
+    nearest even number."""
+    h = int(round(target_w * src_h / src_w / 2.0)) * 2
+    return h, target_w
+
+
+def stack_planes(frames: list) -> list[np.ndarray]:
+    """[Frame, ...] → per-plane [T, H, W] arrays."""
+    if not frames:
+        return []
+    return [
+        np.stack([f.planes[p] for f in frames])
+        for p in range(len(frames[0].planes))
+    ]
+
+
+def scale_yuv_frames(
+    planes: list,
+    dst_h: int,
+    dst_w: int,
+    kernel: str = "bicubic",
+    chroma_sub: tuple[int, int] = (2, 2),
+) -> list[jnp.ndarray]:
+    """Device-resize stacked planar YUV [T, H, W] to a new luma size with
+    chroma on its subsampled grid. chroma_sub = (sub_h, sub_w)."""
+    sub_h, sub_w = chroma_sub
+    out = [resize.resize_frames(jnp.asarray(planes[0]), dst_h, dst_w, kernel)]
+    for p in planes[1:3]:
+        out.append(
+            resize.resize_frames(
+                jnp.asarray(p), dst_h // sub_h, dst_w // sub_w, kernel
+            )
+        )
+    return out
+
+
+def chroma_subsampling(pix_fmt: str) -> tuple[int, int]:
+    """(sub_h, sub_w) for a planar yuv pix_fmt."""
+    if "420" in pix_fmt:
+        return (2, 2)
+    if "422" in pix_fmt:
+        return (1, 2)
+    return (1, 1)
+
+
+def to_uint8(planes: list, ten_bit: bool = False) -> list[np.ndarray]:
+    """Device float/int planes → host numpy in the container bit depth."""
+    out = []
+    for p in planes:
+        arr = np.asarray(p)
+        if ten_bit:
+            if arr.dtype != np.uint16:
+                arr = np.clip(np.floor(arr.astype(np.float64) + 0.5), 0, 1023).astype(np.uint16)
+            out.append(arr)
+        else:
+            if arr.dtype != np.uint8:
+                arr = np.clip(np.floor(arr.astype(np.float64) + 0.5), 0, 255).astype(np.uint8)
+            out.append(arr)
+    return out

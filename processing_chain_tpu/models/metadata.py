@@ -1,0 +1,136 @@
+"""Metadata model — the p02 stage (reference p02_generateMetadata.py:33-152).
+
+Per PVS:
+  * .qchanges — one row per segment from probe.get_segment_info, with
+    video_bitrate recomputed from the exact bitstream frame sizes
+    (reference :112-116);
+  * .buff — stall/freeze events in media time, one python-repr per line
+    (reference :59-71);
+  * .vfi / .afi — per-packet frame tables with ffprobe sizes replaced by
+    the exact parsed sizes, frame-count consistency enforced
+    (reference :119-124 hard-exits on mismatch; here it raises);
+  * VP9 superframe packets merged before size replacement (reference :100-104).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from ..config.domain import Pvs
+from ..io import framesizes, probe
+from ..utils.log import get_logger
+
+
+class MetadataError(RuntimeError):
+    pass
+
+
+def _maybe_write(path: str, force: bool, write_fn) -> None:
+    log = get_logger()
+    if not force and os.path.isfile(path):
+        log.warning(
+            "file %s already exists, not overwriting. Use -f/--force to "
+            "force overwriting", path,
+        )
+        return
+    log.info("writing %s", path)
+    write_fn(path)
+
+
+def generate_pvs_metadata(pvs: Pvs, force: bool = False) -> dict:
+    """Produce all four metadata artifacts for one PVS. Returns the frames
+    tables for downstream use (device feature extraction in p03/bench)."""
+    tc = pvs.test_config
+
+    qchanges_rows = []
+    vfi_parts = []
+    afi_parts = []
+    for segment in pvs.segments:
+        if not segment.exists():
+            raise MetadataError(f"segment {segment.filename} does not exist!")
+        qchanges_rows.append(dict(segment.get_segment_info()))
+        vfi_parts.append(
+            probe.get_video_frame_info(segment.file_path, segment.filename)
+        )
+        try:
+            afi_parts.append(
+                probe.get_audio_frame_info(segment.file_path, segment.filename)
+            )
+        except Exception:
+            pass  # short tests have no audio stream
+    vfi = pd.concat(vfi_parts, ignore_index=True)
+    afi = (
+        pd.concat(afi_parts, ignore_index=True)
+        if afi_parts
+        else pd.DataFrame(columns=["segment", "index", "dts", "size", "duration"])
+    )
+
+    # exact frame sizes per segment; recompute qchanges video_bitrate.
+    # VP9 superframe packets are merged first, restricted to each VP9
+    # segment's own rows (reference :100-104 merges before size replacement)
+    vp9_segments = {
+        pvs.segments[i].filename
+        for i in range(len(pvs.segments))
+        if str(qchanges_rows[i]["video_codec"]).lower() == "vp9"
+    }
+    if vp9_segments:
+        is_vp9 = vfi["segment"].isin(vp9_segments)
+        merged = framesizes.merge_superframes(vfi[is_vp9])
+        vfi = pd.concat([vfi[~is_vp9], merged], ignore_index=True)
+        # restore the PVS's segment playout order (not lexicographic)
+        order = {s.filename: i for i, s in enumerate(pvs.segments)}
+        vfi = (
+            vfi.assign(_seg_order=vfi["segment"].map(order))
+            .sort_values(["_seg_order", "index"], kind="stable")
+            .drop(columns="_seg_order")
+            .reset_index(drop=True)
+        )
+    all_sizes: list[int] = []
+    for i, segment in enumerate(pvs.segments):
+        codec = str(qchanges_rows[i]["video_codec"]).lower()
+        seg_sizes = framesizes.get_framesizes(
+            segment.file_path, "h265" if codec == "hevc" else codec, force
+        )
+        all_sizes.extend(seg_sizes)
+        qchanges_rows[i]["video_bitrate"] = round(
+            sum(seg_sizes) / 1024 * 8 / qchanges_rows[i]["video_duration"], 2
+        )
+
+    if len(vfi) != len(all_sizes):
+        raise MetadataError(
+            f"Number of frames detected for {pvs.pvs_id} does not match: "
+            f"vfi={len(vfi)} exact={len(all_sizes)}"
+        )
+    vfi = vfi.assign(size=np.asarray(all_sizes, dtype=np.int64))
+
+    qchanges_file = os.path.join(
+        tc.get_quality_change_event_files_path(), pvs.pvs_id + ".qchanges"
+    )
+    _maybe_write(
+        qchanges_file, force,
+        lambda p: pd.DataFrame(qchanges_rows).to_csv(p, index=False),
+    )
+
+    if pvs.has_buffering():
+        buff_file = os.path.join(
+            tc.get_buff_event_files_path(), pvs.pvs_id + ".buff"
+        )
+        events = pvs.get_buff_events_media_time()
+        _maybe_write(
+            buff_file, force,
+            lambda p: open(p, "w").write("\n".join(str(b) for b in events) + "\n"),
+        )
+
+    vfi_file = os.path.join(
+        tc.get_video_frame_information_path(), pvs.pvs_id + ".vfi"
+    )
+    afi_file = os.path.join(
+        tc.get_audio_frame_information_path(), pvs.pvs_id + ".afi"
+    )
+    _maybe_write(vfi_file, force, lambda p: vfi.to_csv(p, index=False))
+    _maybe_write(afi_file, force, lambda p: afi.to_csv(p, index=False))
+
+    return {"qchanges": qchanges_rows, "vfi": vfi, "afi": afi}

@@ -1,0 +1,227 @@
+"""Segment encoding model — the p01 compute (reference encode path,
+lib/ffmpeg.py:772-937 + _get_video_encoder_command :61-318).
+
+Where the reference builds an ffmpeg command string per segment, this model
+is a typed pipeline: host decode of the SRC window → device scale
+(`scale=W:-2` bicubic) + frame-rate select (the reference's drop tables) →
+host x264/x265/libvpx/libaom encode with the same rate-control surface
+(bitrate/CRF/QP, min/max/bufsize factors, GOP from iFrameInterval × fps,
+bframes, scenecut, preset, speed/quality/cpu-used, enc_options, 2-pass)."""
+
+from __future__ import annotations
+
+import os
+from fractions import Fraction
+from typing import Optional
+
+import numpy as np
+
+from ..config.domain import Segment
+from ..engine.jobs import Job
+from ..io.video import VideoReader, VideoWriter
+from ..io import medialib
+from ..ops import fps as fps_ops
+from ..utils.log import get_logger
+from . import frames as fr
+
+#: encoder name → libav encoder + default private options
+_ENCODERS = {
+    "libx264": "libx264",
+    "h264_nvenc": "libx264",   # no NVENC on this host; transparent fallback
+    "libx265": "libx265",
+    "hevc_nvenc": "libx265",
+    "libvpx-vp9": "libvpx-vp9",
+    "libaom-av1": "libaom-av1",
+}
+
+
+def _encoder_opts(segment: Segment, current_pass: int, total_passes: int) -> str:
+    """Private-option string mirroring _get_video_encoder_command semantics
+    (reference lib/ffmpeg.py:61-318), minus what VideoWriter takes as
+    first-class arguments (bitrate/min/max/bufsize/gop/bframes)."""
+    coding = segment.video_coding
+    encoder = _ENCODERS[coding.encoder]
+    opts: list[str] = []
+
+    if coding.crf is not None:
+        opts.append(f"crf={segment.quality_level.video_crf}")
+    elif coding.qp is not None:
+        opts.append(f"qp={segment.quality_level.video_qp}")
+
+    if coding.preset and encoder in ("libx264", "libx265"):
+        opts.append(f"preset={coding.preset}")
+
+    if encoder == "libx264":
+        params = []
+        if not coding.scenecut:
+            params.append("scenecut=-1")
+        if params:
+            opts.append("x264-params=" + ":".join(params))
+    elif encoder == "libx265":
+        params = ["log-level=error"]
+        # reference quirk (do-not-copy list): x265 scenecut=0 was appended
+        # whenever scenecut was NOT False (inverted vs x264,
+        # ffmpeg.py:213-214). Intended semantics: disable on scenecut=False.
+        if not coding.scenecut:
+            params.append("scenecut=0")
+        if total_passes == 2:
+            params.append(f"pass={current_pass}")
+        opts.append("x265-params=" + ":".join(params))
+    elif encoder == "libvpx-vp9":
+        speed = coding.speed
+        # first pass runs at speed 4 (reference :100-102)
+        if total_passes == 2 and current_pass == 1:
+            speed = 4
+        opts.append(f"quality={coding.quality}")
+        opts.append(f"speed={speed}")
+        opts.append("row-mt=1")
+    elif encoder == "libaom-av1":
+        opts.append(f"cpu-used={coding.cpu_used}")
+        opts.append("usage=realtime")
+
+    if coding.enc_options:
+        # reference passes raw ffmpeg flags; accept "k=v:k=v" style here
+        opts.append(str(coding.enc_options))
+    return ":".join(o for o in opts if o)
+
+
+def plan_segment_frames(segment: Segment):
+    """Decode + filter plan: (target_h, target_w, keep_indices|None,
+    out_fps_fraction). Mirrors the reference's filter chain
+    scale=W:-2,select,fps (lib/ffmpeg.py:794-834)."""
+    src_fps = segment.src.get_fps()
+    target_fps = fps_ops.resolve_fps_spec(segment.quality_level.fps, src_fps)
+    width = segment.quality_level.width
+    src_info = segment.src.stream_info
+    target_h, target_w = fr.scale_to_width_keep_ar(
+        src_info["height"], src_info["width"], width
+    )
+    out_fps = target_fps if target_fps is not None else src_fps
+    return target_h, target_w, target_fps, out_fps
+
+
+def encode_segment(segment: Segment, overwrite: bool = False) -> Optional[Job]:
+    """Build the encode Job for a segment (None when memoized, reference
+    :782-788)."""
+    out_path = segment.file_path
+    tc = segment.test_config
+    log = get_logger()
+
+    coding = segment.video_coding
+    encoder = _ENCODERS.get(coding.encoder)
+    if encoder is None:
+        raise ValueError(f"wrong encoder: {coding.encoder}")
+    if encoder != coding.encoder:
+        log.warning(
+            "encoder %s unavailable on this host; using %s",
+            coding.encoder, encoder,
+        )
+
+    target_h, target_w, target_fps, out_fps = plan_segment_frames(segment)
+    passes = 2 if coding.passes == 2 else 1
+    bitrate = 0.0
+    if coding.crf is None and coding.qp is None:
+        bitrate = float(segment.target_video_bitrate or 0)
+
+    def run() -> str:
+        src_fps = segment.src.get_fps()
+        with VideoReader(
+            segment.src.file_path, segment.start_time, segment.duration
+        ) as reader:
+            decoded = fr.stack_planes(list(reader))
+        if not decoded:
+            raise medialib.MediaError(
+                f"no frames decoded for {segment} from {segment.src.file_path}"
+            )
+        n = decoded[0].shape[0]
+        if target_fps is not None and target_fps != src_fps:
+            keep = fps_ops.select_indices(n, src_fps, target_fps)
+            decoded = [p[keep] for p in decoded]
+        sub = fr.chroma_subsampling(segment.target_pix_fmt)
+        scaled = fr.scale_yuv_frames(decoded, target_h, target_w, "bicubic", sub)
+        ten_bit = bool(segment.uses_10_bit())
+        planes = fr.to_uint8(scaled, ten_bit)
+
+        fps_frac = Fraction(out_fps).limit_denominator(1001)
+        gop = -1
+        if coding.iframe_interval:
+            gop = int(out_fps * coding.iframe_interval)
+        bframes = coding.bframes if coding.bframes is not None else -1
+
+        audio = {}
+        if tc.is_long() and segment.audio_coding is not None:
+            samples, rate = medialib.decode_audio_s16(
+                segment.src.file_path, segment.start_time, segment.duration
+            )
+            audio = dict(
+                audio_codec="aac"
+                if segment.audio_coding.encoder in ("libfdk_aac", "aac")
+                else segment.audio_coding.encoder,
+                sample_rate=rate,
+                channels=samples.shape[1] if samples.size else 2,
+                audio_bitrate_kbps=float(segment.quality_level.audio_bitrate or 128),
+            )
+
+        stats = os.path.join(
+            tc.get_logs_path(),
+            "passlogfile_" + os.path.splitext(segment.filename)[0],
+        )
+
+        def encode_pass(pass_num: int, path: str) -> None:
+            kw = dict(
+                codec=encoder,
+                width=target_w,
+                height=target_h,
+                pix_fmt=segment.target_pix_fmt,
+                fps=(fps_frac.numerator, fps_frac.denominator),
+                bitrate_kbps=bitrate,
+                maxrate_kbps=(coding.maxrate_factor or 0) * bitrate,
+                minrate_kbps=(coding.minrate_factor or 0) * bitrate,
+                bufsize_kbps=(coding.bufsize_factor or 0) * bitrate,
+                gop=gop,
+                bframes=bframes,
+                threads=1,  # determinism (reference -threads 1, :790)
+                opts=_encoder_opts(segment, pass_num, passes),
+                pass_num=pass_num if passes == 2 else 0,
+                stats_path=stats if passes == 2 else "",
+            )
+            with VideoWriter(path, **kw, **(audio if pass_num != 1 or passes == 1 else {})) as w:
+                if audio and (pass_num != 1 or passes == 1):
+                    w.write_audio(samples)
+                for i in range(planes[0].shape[0]):
+                    w.write(*(p[i] for p in planes))
+
+        if passes == 2:
+            null_out = out_path + ".pass1.tmp" + os.path.splitext(out_path)[1]
+            encode_pass(1, null_out)
+            os.unlink(null_out)
+            encode_pass(2, out_path)
+        else:
+            encode_pass(1, out_path)
+        return out_path
+
+    job = Job(
+        label=f"encode {segment.filename}",
+        output_path=out_path,
+        fn=run,
+        logfile_path=segment.get_logfile_path(),
+        provenance={
+            "segmentFilename": segment.filename,
+            "pipeline": {
+                "decode": segment.src.filename,
+                "window": [segment.start_time, segment.duration],
+                "scale": [target_w, target_h, "bicubic"],
+                "fps": out_fps,
+                "encoder": encoder,
+                "passes": passes,
+                "rate_control": (
+                    {"crf": segment.quality_level.video_crf}
+                    if coding.crf is not None
+                    else {"qp": segment.quality_level.video_qp}
+                    if coding.qp is not None
+                    else {"bitrate_kbps": bitrate}
+                ),
+            },
+        },
+    )
+    return job

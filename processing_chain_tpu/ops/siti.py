@@ -16,8 +16,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-SOBEL_X = jnp.array([[-1.0, 0.0, 1.0], [-2.0, 0.0, 2.0], [-1.0, 0.0, 1.0]])
+# plain numpy at import time: creating device arrays on import would force
+# backend initialization for anyone importing the package
+SOBEL_X = np.array([[-1.0, 0.0, 1.0], [-2.0, 0.0, 2.0], [-1.0, 0.0, 1.0]])
 SOBEL_Y = SOBEL_X.T
 
 

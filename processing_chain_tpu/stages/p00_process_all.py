@@ -1,0 +1,39 @@
+"""Orchestrator: run stages 1-4 in order (reference p00_processAll.py:24-53).
+
+The parsed TestConfig is threaded through so later stages skip re-parsing
+(reference p00:38), and `-str "1234"` selects a stage subset.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import TestConfig
+from ..utils.log import get_logger
+from . import (
+    p01_generate_segments,
+    p02_generate_metadata,
+    p03_generate_avpvs,
+    p04_generate_cpvs,
+)
+
+_STAGES = {
+    "1": p01_generate_segments,
+    "2": p02_generate_metadata,
+    "3": p03_generate_avpvs,
+    "4": p04_generate_cpvs,
+}
+
+
+def run(cli_args) -> Optional[TestConfig]:
+    log = get_logger()
+    selection = cli_args.scripts_to_run
+    if selection == "all":
+        selection = "1234"
+    test_config = None
+    for key in "1234":
+        if key not in selection:
+            continue
+        log.info("=== stage p0%s ===", key)
+        test_config = _STAGES[key].run(cli_args, test_config=test_config)
+    return test_config

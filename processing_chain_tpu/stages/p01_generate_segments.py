@@ -1,0 +1,43 @@
+"""Stage 1: encode all required segments (reference p01_generateSegments.py:30-101)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import TestConfig
+from ..engine.jobs import JobRunner
+from ..models import segments as seg_model
+from ..utils.log import get_logger
+
+
+def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
+    log = get_logger()
+    if test_config is None:
+        test_config = TestConfig(
+            cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
+            cli_args.filter_pvs,
+        )
+
+    runner = JobRunner(
+        force=cli_args.force,
+        dry_run=cli_args.dry_run,
+        parallelism=cli_args.parallelism,
+        name="p01",
+    )
+    for segment in sorted(test_config.get_required_segments()):
+        if getattr(segment.video_coding, "is_online", False):
+            if cli_args.skip_online_services:
+                log.warning("Skipping online segment %s", segment.filename)
+                continue
+            log.warning(
+                "online encoder %s for %s is not available in this "
+                "environment; skipping (use the downloader tool)",
+                segment.video_coding.encoder, segment.filename,
+            )
+            continue
+        runner.add(seg_model.encode_segment(segment, overwrite=cli_args.force))
+    log.info("p01: %d segment encodes planned", len(runner.jobs))
+    # device work is serialized through the single chip; host decode/encode
+    # parallelism lives inside the native layer
+    runner.run_serial()
+    return test_config

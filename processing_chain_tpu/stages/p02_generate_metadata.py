@@ -1,0 +1,27 @@
+"""Stage 2: metadata artifacts (reference p02_generateMetadata.py:33-152)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import TestConfig
+from ..models import metadata as md
+from ..utils.log import get_logger
+
+
+def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
+    log = get_logger()
+    if test_config is None:
+        test_config = TestConfig(
+            cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
+            cli_args.filter_pvs,
+        )
+    for pvs_id, pvs in test_config.pvses.items():
+        if cli_args.skip_online_services and pvs.is_online():
+            log.warning("Skipping PVS %s because it is an online service", pvs)
+            continue
+        if cli_args.dry_run:
+            log.info("[dry-run] metadata for %s", pvs_id)
+            continue
+        md.generate_pvs_metadata(pvs, force=cli_args.force)
+    return test_config

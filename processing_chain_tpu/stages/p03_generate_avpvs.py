@@ -1,0 +1,61 @@
+"""Stage 3: AVPVS generation (reference p03_generateAvPvs.py:62-267)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..config import TestConfig
+from ..engine.jobs import JobRunner
+from ..models import avpvs as av
+from ..utils.log import get_logger
+
+
+def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
+    log = get_logger()
+    if test_config is None:
+        test_config = TestConfig(
+            cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
+            cli_args.filter_pvs,
+        )
+
+    runner = JobRunner(
+        force=cli_args.force, dry_run=cli_args.dry_run,
+        parallelism=cli_args.parallelism, name="p03",
+    )
+    stall_runner = JobRunner(
+        force=cli_args.force, dry_run=cli_args.dry_run,
+        parallelism=cli_args.parallelism, name="p03-stall",
+    )
+    # p00 parses without the p03-only flags; fall back to the default
+    # spinner so orchestrated runs still composite it (the reference p00
+    # re-parses per-script args, p00_processAll.py:33-34)
+    from ..utils.parse_args import _DEFAULT_SPINNER
+
+    spinner = getattr(cli_args, "spinner_path", None) or _DEFAULT_SPINNER
+    for pvs_id, pvs in test_config.pvses.items():
+        if cli_args.skip_online_services and pvs.is_online():
+            log.warning("Skipping PVS %s because it is an online service", pvs)
+            continue
+        runner.add(
+            av.create_avpvs_wo_buffer(
+                pvs,
+                overwrite=cli_args.force,
+                avpvs_src_fps=getattr(cli_args, "avpvs_src_fps", False),
+                force_60_fps=getattr(cli_args, "force_60_fps", False),
+            )
+        )
+        stall_runner.add(
+            av.apply_stalling(pvs, spinner_path=spinner, overwrite=cli_args.force)
+        )
+    runner.run_serial()
+    stall_runner.run_serial()
+
+    if cli_args.remove_intermediate:
+        for pvs in test_config.pvses.values():
+            if pvs.has_buffering():
+                tmp = pvs.get_avpvs_wo_buffer_file_path()
+                if os.path.isfile(tmp):
+                    log.debug("removing intermediate %s", tmp)
+                    os.unlink(tmp)
+    return test_config

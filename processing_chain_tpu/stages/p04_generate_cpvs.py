@@ -1,0 +1,40 @@
+"""Stage 4: CPVS generation (reference p04_generateCpvs.py:31-81)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import TestConfig
+from ..engine.jobs import JobRunner
+from ..models import cpvs as cp
+from ..utils.log import get_logger
+
+
+def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
+    log = get_logger()
+    if test_config is None:
+        test_config = TestConfig(
+            cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
+            cli_args.filter_pvs,
+        )
+    runner = JobRunner(
+        force=cli_args.force, dry_run=cli_args.dry_run,
+        parallelism=cli_args.parallelism, name="p04",
+    )
+    for pvs_id, pvs in test_config.pvses.items():
+        if cli_args.skip_online_services and pvs.is_online():
+            log.warning("Skipping PVS %s because it is an online service", pvs)
+            continue
+        for pp in test_config.post_processings:
+            runner.add(
+                cp.create_cpvs(
+                    pvs, pp,
+                    rawvideo=getattr(cli_args, "rawvideo", False),
+                    overwrite=cli_args.force,
+                    nonraw_crf=int(getattr(cli_args, "nonraw_crf", 17)),
+                )
+            )
+        if getattr(cli_args, "lightweight_preview", False):
+            runner.add(cp.create_preview(pvs, overwrite=cli_args.force))
+    runner.run_serial()
+    return test_config

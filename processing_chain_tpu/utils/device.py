@@ -1,0 +1,44 @@
+"""Accelerator backend selection with CPU fallback.
+
+The failure-detection analog of the reference's fail-fast subprocess model
+(SURVEY.md §5): the chain should degrade to the CPU backend with a warning
+when the configured accelerator backend cannot initialize (e.g. the TPU
+tunnel is down), instead of crashing every stage.
+"""
+
+from __future__ import annotations
+
+from .log import get_logger
+
+_checked = False
+
+
+def ensure_backend() -> str:
+    """Initialize the JAX backend, falling back to CPU if the configured
+    platform is unavailable. Returns the platform name in use."""
+    global _checked
+    import jax
+
+    try:
+        devs = jax.devices()
+        _checked = True
+        return devs[0].platform
+    except RuntimeError as exc:
+        get_logger().warning(
+            "accelerator backend unavailable (%s); falling back to CPU", exc
+        )
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            devs = jax.devices()
+            _checked = True
+            return devs[0].platform
+        except RuntimeError as exc2:  # pragma: no cover - no CPU either
+            raise RuntimeError(f"no usable JAX backend: {exc2}") from exc2
+
+
+def device_count() -> int:
+    import jax
+
+    if not _checked:
+        ensure_backend()
+    return jax.device_count()

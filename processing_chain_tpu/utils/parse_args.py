@@ -1,0 +1,108 @@
+"""CLI flag surface — parity with reference lib/parse_args.py:25-137.
+
+All shared flags (-c -f -v -n -p -r --filter-src/hrc/pvs -sos -str
+--skip-requirements) plus per-stage extras: p01 -g/--set-gpu-loc (device
+index here), p03 -s/--spinner-path -z/--avpvs-src-fps -f60/--force-60-fps,
+p04 -e -a -ccrf.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional, Sequence
+
+_DEFAULT_SPINNER = os.path.abspath(
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "assets",
+        "spinner-128-white.png",
+    )
+)
+
+
+def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description=name, formatter_class=argparse.ArgumentDefaultsHelpFormatter
+    )
+    parser.add_argument(
+        "-c", "--test-config", required=True,
+        help="path to test config file at the root of the database folder",
+    )
+    parser.add_argument(
+        "-f", "--force", action="store_true",
+        help="force overwrite existing output files",
+    )
+    parser.add_argument(
+        "-v", "--verbose", action="store_true", help="print more verbose output"
+    )
+    parser.add_argument(
+        "-n", "--dry-run", action="store_true",
+        help="only print planned jobs, do not run them",
+    )
+    parser.add_argument(
+        "--filter-src", help="Only create specified SRC-IDs ('|'-separated)"
+    )
+    parser.add_argument(
+        "--filter-hrc", help="Only create specified HRC-IDs ('|'-separated)"
+    )
+    parser.add_argument(
+        "--filter-pvs", help="Only create specified PVS-IDs ('|'-separated)"
+    )
+    parser.add_argument(
+        "-p", "--parallelism", default=4, type=int,
+        help="number of host workers to run in parallel",
+    )
+    parser.add_argument(
+        "-r", "--remove-intermediate", action="store_true",
+        help="remove/delete intermediate files",
+    )
+    parser.add_argument(
+        "-sos", "--skip-online-services", action="store_true",
+        help="skip videos coded by online services",
+    )
+    parser.add_argument(
+        "-str", "--scripts-to-run", default="1234",
+        help='which stages p00 shall execute (e.g. "all", "1234", "34")',
+    )
+    if script == 1:
+        parser.add_argument(
+            "-g", "--set-gpu-loc", default=-1, type=int,
+            help="accelerator device index to pin encodes to (-1 = auto)",
+        )
+    if script == 3:
+        parser.add_argument(
+            "-s", "--spinner-path", default=_DEFAULT_SPINNER,
+            help="path to the spinner image used for stalling events",
+        )
+        parser.add_argument(
+            "-z", "--avpvs-src-fps", action="store_true",
+            help="use the SRC fps for the avpvs (default: 60 fps canvas)",
+        )
+        parser.add_argument(
+            "-f60", "--force-60-fps", action="store_true",
+            help="force avpvs framerate to 60 fps",
+        )
+    if script == 4:
+        parser.add_argument(
+            "-e", "--lightweight-preview", action="store_true",
+            help="create lightweight preview files",
+        )
+        parser.add_argument(
+            "-a", "--rawvideo", action="store_true",
+            help="use rawvideo codec and MKV output for PC",
+        )
+        parser.add_argument(
+            "-ccrf", "--nonraw-crf", default=17, type=int,
+            help="CRF level for libx264 CPVS encodes",
+        )
+    parser.add_argument(
+        "--skip-requirements", action="store_true",
+        help="continue running even if requirements are not fulfilled",
+    )
+    return parser
+
+
+def parse_args(name: str, script: Optional[int] = None,
+               argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
+    return build_parser(name, script).parse_args(argv)

@@ -1,0 +1,233 @@
+"""End-to-end chain tests on synthetic databases — the equivalent of the
+reference's Docker smoke test on P2SXM00 (reference test/build_and_test.sh),
+self-contained: SRCs are generated through the io layer."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu.cli import main as cli_main
+from processing_chain_tpu.io import VideoReader, VideoWriter, medialib, probe
+
+
+def make_src(path, w=320, h=180, n=48, fps=24, audio=False):
+    aud = dict(audio_codec="flac", sample_rate=48000, channels=2) if audio else {}
+    with VideoWriter(str(path), "ffv1", w, h, "yuv420p", (fps, 1), **aud) as wr:
+        if audio:
+            t = np.arange(48000 * n // fps)
+            tone = (np.sin(2 * np.pi * 220 * t / 48000) * 6000).astype(np.int16)
+            wr.write_audio(np.stack([tone, tone], axis=1))
+        for i in range(n):
+            xx, yy = np.meshgrid(np.arange(w), np.arange(h))
+            y = ((np.sin((xx + 4 * i) / 23) + np.cos(yy / 17)) * 50 + 120).astype(np.uint8)
+            wr.write(y, np.full((h // 2, w // 2), 128, np.uint8),
+                     np.full((h // 2, w // 2), 118, np.uint8))
+
+
+def write_db(tmp_path, db_id, yaml_text, src_specs):
+    db = tmp_path / db_id
+    (db / "srcVid").mkdir(parents=True)
+    (db / f"{db_id}.yaml").write_text(yaml_text)
+    for name, kw in src_specs.items():
+        make_src(db / "srcVid" / name, **kw)
+    return str(db / f"{db_id}.yaml")
+
+
+@pytest.fixture(scope="module")
+def short_db(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("shortdb")
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2SXM90
+        syntaxVersion: 6
+        type: short
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24}
+          Q1: {index: 1, videoCodec: h264, videoCrf: 28, width: 320, height: 180, fps: 24}
+        codingList:
+          VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+          VC02: {type: video, encoder: libx264, crf: yes, iFrameInterval: 1, preset: ultrafast}
+        srcList:
+          SRC000: SRC000.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            eventList: [[Q0, 2]]
+          HRC001:
+            videoCodingId: VC02
+            eventList: [[Q1, 2]]
+          HRC002:
+            videoCodingId: VC01
+            eventList: [[Q0, 2], [stall, 0.5]]
+        pvsList:
+          - P2SXM90_SRC000_HRC000
+          - P2SXM90_SRC000_HRC001
+          - P2SXM90_SRC000_HRC002
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+    """)
+    yaml_path = write_db(tmp, "P2SXM90", yaml_text, {"SRC000.avi": dict(n=48)})
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "1234", "--skip-requirements"])
+    assert rc == 0
+    return yaml_path
+
+
+def test_p01_segments(short_db):
+    segdir = os.path.join(os.path.dirname(short_db), "videoSegments")
+    files = sorted(os.listdir(segdir))
+    assert "P2SXM90_SRC000_Q0_VC01_0000_0-2.mp4" in files
+    assert "P2SXM90_SRC000_Q1_VC02_0000_0-2.mp4" in files
+    seg = probe.get_segment_info(
+        os.path.join(segdir, "P2SXM90_SRC000_Q0_VC01_0000_0-2.mp4")
+    )
+    assert seg["video_codec"] == "h264"
+    assert seg["video_width"] == 160 and seg["video_height"] == 90
+    assert abs(seg["video_duration"] - 2.0) < 0.05
+    assert abs(seg["video_frame_rate"] - 24.0) < 0.01
+
+
+def test_p01_provenance_logs(short_db):
+    logdir = os.path.join(os.path.dirname(short_db), "logs")
+    logfile = os.path.join(logdir, "P2SXM90_SRC000_Q0_VC01_0000_0-2.log")
+    assert os.path.isfile(logfile)
+    content = open(logfile).read()
+    assert "segmentFilename" in content and "processingChain" in content
+
+
+def test_p02_metadata(short_db):
+    db = os.path.dirname(short_db)
+    import pandas as pd
+
+    qch = pd.read_csv(os.path.join(db, "qualityChangeEventFiles", "P2SXM90_SRC000_HRC000.qchanges"))
+    assert list(qch.columns[:5]) == [
+        "segment_filename", "file_size", "video_duration", "video_frame_rate",
+        "video_bitrate",
+    ]
+    assert qch["video_bitrate"].iloc[0] > 0
+
+    vfi = pd.read_csv(os.path.join(db, "videoFrameInformation", "P2SXM90_SRC000_HRC000.vfi"))
+    assert len(vfi) == 48
+    assert vfi["frame_type"].iloc[0] == "I"
+    assert (vfi["size"] > 0).all()
+
+    buff = open(os.path.join(db, "buffEventFiles", "P2SXM90_SRC000_HRC002.buff")).read()
+    assert buff.strip() == "[2, 0.5]"
+
+
+def test_p03_avpvs(short_db):
+    db = os.path.dirname(short_db)
+    av = os.path.join(db, "avpvs", "P2SXM90_SRC000_HRC000.avi")
+    assert os.path.isfile(av)
+    with VideoReader(av) as r:
+        assert (r.width, r.height) == (320, 180)
+        assert r.pix_fmt == "yuv420p"
+        planes, pts = r.read_all()
+    assert planes[0].shape[0] == 48  # 2s at 24fps
+
+
+def test_p03_stalling(short_db):
+    db = os.path.dirname(short_db)
+    stalled = os.path.join(db, "avpvs", "P2SXM90_SRC000_HRC002.avi")
+    wo_buffer = os.path.join(db, "avpvs", "P2SXM90_SRC000_HRC002_concat_wo_buffer.avi")
+    assert os.path.isfile(stalled) and os.path.isfile(wo_buffer)
+    with VideoReader(stalled) as r:
+        planes, _ = r.read_all()
+    # 48 + round(0.5*24)=12 stall frames at the end (stall at media t=2.0)
+    assert planes[0].shape[0] == 60
+    # stall frames are black with the spinner: much darker than content
+    assert planes[0][55].mean() < planes[0][10].mean()
+
+
+def test_p04_cpvs(short_db):
+    db = os.path.dirname(short_db)
+    cp = os.path.join(db, "cpvs", "P2SXM90_SRC000_HRC000_PC.avi")
+    assert os.path.isfile(cp)
+    info = medialib.probe(cp)
+    v = info["streams"][0]
+    assert v["codec_name"] == "rawvideo"
+    assert v["pix_fmt"] == "uyvy422"
+    assert (v["width"], v["height"]) == (320, 180)
+
+
+def test_memoization_skips_existing(short_db, caplog):
+    # re-run p01: everything exists, nothing should be re-encoded
+    rc = cli_main(["p01", "-c", short_db, "--skip-requirements"])
+    assert rc == 0
+
+
+def test_filters_subset(short_db):
+    rc = cli_main([
+        "p03", "-c", short_db, "--filter-pvs", "P2SXM90_SRC000_HRC000",
+        "--skip-requirements",
+    ])
+    assert rc == 0
+
+
+@pytest.fixture(scope="module")
+def long_db(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("longdb")
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2LTR00
+        syntaxVersion: 6
+        type: long
+        segmentDuration: 1
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24, audioCodec: aac, audioBitrate: 96}
+          Q1: {index: 1, videoCodec: h264, videoBitrate: 500, width: 320, height: 180, fps: 24, audioCodec: aac, audioBitrate: 96}
+        codingList:
+          VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+          AC01: {type: audio, encoder: aac}
+        srcList:
+          SRC001: SRC001.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            audioCodingId: AC01
+            eventList: [[Q0, 1], [stall, 0.5], [Q1, 1]]
+        pvsList:
+          - P2LTR00_SRC001_HRC000
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+    """)
+    yaml_path = write_db(
+        tmp, "P2LTR00", yaml_text, {"SRC001.avi": dict(n=48, audio=True)}
+    )
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "134", "--skip-requirements"])
+    assert rc == 0
+    return yaml_path
+
+
+def test_long_chain_segments_have_audio(long_db):
+    db = os.path.dirname(long_db)
+    seg = os.path.join(db, "videoSegments", "P2LTR00_SRC001_Q0_VC01_0000_0-1.mp4")
+    assert os.path.isfile(seg)
+    info = medialib.probe(seg)
+    types = {s["codec_type"] for s in info["streams"]}
+    assert types == {"video", "audio"}
+
+
+def test_long_chain_avpvs(long_db):
+    db = os.path.dirname(long_db)
+    stalled = os.path.join(db, "avpvs", "P2LTR00_SRC001_HRC000.avi")
+    assert os.path.isfile(stalled)
+    with VideoReader(stalled) as r:
+        # canvas rate 60: 2s content + 0.5s stall = 150 frames
+        planes, _ = r.read_all()
+        assert r.fps == 60.0
+    assert planes[0].shape[0] == 150
+    # audio present with stall silence inserted
+    samples, rate = medialib.decode_audio_s16(stalled)
+    assert samples.shape[0] >= int(2.4 * rate)
+    stall_zone = samples[int(1.1 * rate): int(1.4 * rate)]
+    assert np.abs(stall_zone).mean() < 50  # silence during stall
+
+
+def test_long_chain_cpvs_audio_normalized(long_db):
+    db = os.path.dirname(long_db)
+    cp = os.path.join(db, "cpvs", "P2LTR00_SRC001_HRC000_PC.avi")
+    assert os.path.isfile(cp)
+    samples, rate = medialib.decode_audio_s16(cp)
+    x = samples.astype(np.float64) / 32768.0
+    rms_db = 20 * np.log10(np.sqrt(np.mean(x * x)) + 1e-12)
+    assert -26.0 < rms_db < -20.0  # ~-23 dBFS RMS target
