@@ -1,0 +1,130 @@
+"""Benchmark: AVPVS hot path — 1080p→4K Lanczos upscale + SI/TI per frame.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value        = frames/sec/chip of the jitted device step (luma+chroma
+               Lanczos resample to 4K + Sobel SI + frame-diff TI).
+vs_baseline  = value / (8 × measured single-core CPU fps of the same
+               work done the reference's way: libswscale Lanczos scale
+               + numpy Sobel/TI). The reference publishes no numbers
+               (BASELINE.md), so the 8-core baseline is measured here:
+               its process pool runs single-threaded ffmpeg workers
+               (reference lib/cmd_utils.py:60-129, -threads 1 at
+               lib/ffmpeg.py:790), so 8 × one core is the faithful model.
+
+The TPU backend is probed in a subprocess first so a wedged tunnel cannot
+hang the bench; it falls back to CPU (and says so in the "platform" field).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+H, W = 1080, 1920
+DH, DW = 2160, 3840
+T = int(os.environ.get("BENCH_FRAMES", "8"))
+ITERS = int(os.environ.get("BENCH_ITERS", "5"))
+
+
+def _tpu_usable(timeout_s: int = 60) -> bool:
+    code = (
+        "import jax; d=jax.devices(); import jax.numpy as jnp;"
+        "x=jnp.ones((8,8)); (x@x).block_until_ready(); print(d[0].platform)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        return proc.returncode == 0 and "cpu" not in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> None:
+    if not _tpu_usable():
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            from jax._src import xla_bridge as _xb
+
+            getattr(_xb, "_backend_factories", {}).pop("axon", None)
+        except Exception:
+            pass
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "") or None)
+    except Exception:
+        pass
+    platform = jax.devices()[0].platform
+
+    from processing_chain_tpu.parallel import avpvs_siti_step
+
+    rng = np.random.default_rng(0)
+    y = jnp.asarray(rng.integers(0, 255, size=(T, H, W), dtype=np.uint8))
+    u = jnp.asarray(rng.integers(0, 255, size=(T, H // 2, W // 2), dtype=np.uint8))
+    v = jnp.asarray(rng.integers(0, 255, size=(T, H // 2, W // 2), dtype=np.uint8))
+
+    @jax.jit
+    def step(y, u, v):
+        up_y, up_u, up_v, si, ti = avpvs_siti_step(y, u, v, DH, DW)
+        return up_y, si, ti
+
+    # warmup / compile
+    out = step(y, u, v)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = step(y, u, v)
+    jax.block_until_ready(out)
+    device_fps = T * ITERS / (time.perf_counter() - t0)
+
+    # CPU single-core baseline: swscale Lanczos + numpy Sobel SI / diff TI
+    from processing_chain_tpu.io import medialib
+    from scipy.ndimage import convolve
+
+    ys = np.asarray(y[:2])
+    kx = np.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], float)
+    n_base = 2
+    t0 = time.perf_counter()
+    prev = None
+    for i in range(n_base):
+        up = medialib.sws_scale_plane(ys[i], DW, DH, medialib.SWS_LANCZOS)
+        _ = medialib.sws_scale_plane(
+            np.ascontiguousarray(ys[i][::2, ::2]), DW // 2, DH // 2,
+            medialib.SWS_LANCZOS,
+        )
+        upf = up.astype(np.float64)
+        gx = convolve(upf, kx)[1:-1, 1:-1]
+        gy = convolve(upf, kx.T)[1:-1, 1:-1]
+        _si = np.std(np.sqrt(gx * gx + gy * gy))
+        if prev is not None:
+            _ti = np.std(upf - prev)
+        prev = upf
+    cpu_core_fps = n_base / (time.perf_counter() - t0)
+    baseline_8core = 8.0 * cpu_core_fps
+
+    print(
+        json.dumps(
+            {
+                "metric": "AVPVS frames/sec/chip (1080p->4K Lanczos + SI/TI)",
+                "value": round(device_fps, 2),
+                "unit": "frames/s/chip",
+                "vs_baseline": round(device_fps / baseline_8core, 2),
+                "platform": platform,
+                "baseline_8core_fps": round(baseline_8core, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -97,7 +97,10 @@ class JobRunner:
 
     def run_serial(self) -> dict[str, Any]:
         """Run jobs one by one in order (for device-bound stages — one chip,
-        serialized device queue)."""
+        serialized device queue). Failures become ChainError so the CLI can
+        map them to a clean exit 1."""
+        from ..utils.runner import ChainError
+
         log = get_logger()
         results = {}
         jobs, self.jobs = self.jobs, []
@@ -106,5 +109,10 @@ class JobRunner:
                 log.info("[dry-run] %s -> %s", job.label, job.output_path)
                 results[job.label] = None
             else:
-                results[job.label] = job.run()
+                try:
+                    results[job.label] = job.run()
+                except Exception as exc:
+                    raise ChainError(
+                        f"{self.name}: job '{job.label}' failed: {exc!r}"
+                    ) from exc
         return results

@@ -98,7 +98,6 @@ def _segment_to_canvas(seg, w: int, h: int, rate: float, pix_fmt: str):
 
 def create_avpvs_wo_buffer(
     pvs: Pvs,
-    overwrite: bool = False,
     avpvs_src_fps: bool = False,
     force_60_fps: bool = False,
 ) -> Optional[Job]:
@@ -178,7 +177,6 @@ def load_spinner(path: str) -> np.ndarray:
 def apply_stalling(
     pvs: Pvs,
     spinner_path: Optional[str] = None,
-    overwrite: bool = False,
     n_rotations: int = 64,
 ) -> Optional[Job]:
     """The bufferer pass (reference p03:216-260): re-render the
@@ -203,16 +201,24 @@ def apply_stalling(
             n, rate, events, skipping=skipping, black_frame=True,
             n_rotations=n_rotations,
         )
+        depth_scale = 4.0 if ten_bit else 1.0
+        sub_h, sub_w = fr.chroma_subsampling(pix_fmt)
         sp_y = sp_u = sp_v = sa = sa_c = None
         if not skipping and spinner_path:
             bank_yuv, bank_a = ov.prepare_spinner(
                 load_spinner(spinner_path), n_rotations
             )
-            sp_y, sp_u, sp_v = bank_yuv[:, 0], bank_yuv[:, 1], bank_yuv[:, 2]
+            # spinner bank is on the 8-bit scale; lift for 10-bit AVPVS
+            sp_y = bank_yuv[:, 0] * depth_scale
+            # chroma bank on the AVPVS chroma grid (420: half both dims,
+            # 422: half width only)
+            sp_u = bank_yuv[:, 1][:, ::sub_h, ::sub_w] * depth_scale
+            sp_v = bank_yuv[:, 2][:, ::sub_h, ::sub_w] * depth_scale
             sa = bank_a
-            sa_c = ov.downsample_alpha(bank_a)
-            sp_u = sp_u[:, ::2, ::2]
-            sp_v = sp_v[:, ::2, ::2]
+            if (sub_h, sub_w) == (2, 2):
+                sa_c = ov.downsample_alpha(bank_a)
+            else:
+                sa_c = bank_a[:, ::sub_h, ::sub_w]
 
         # audio: decode, insert stall silence at wallclock positions
         audio = None
@@ -242,26 +248,26 @@ def apply_stalling(
             # long PVSes stay within bounded HBM (input stays host uint8;
             # each batch gathers its own source frames)
             for start in range(0, plan.n_out, CHUNK):
+                sel = plan.src_idx[start : start + CHUNK]
+                # gather source frames on host; batch-local plan indices
                 sub = ov.StallPlan(
-                    src_idx=np.zeros(len(plan.src_idx[start : start + CHUNK]), np.int32),
+                    src_idx=np.arange(len(sel), dtype=np.int32),
                     stall_mask=plan.stall_mask[start : start + CHUNK],
                     black_mask=plan.black_mask[start : start + CHUNK],
                     phase=plan.phase[start : start + CHUNK],
                 )
-                sel = plan.src_idx[start : start + CHUNK]
-                # local gather on host (indices relative to the batch)
                 y = jnp.asarray(planes[0][sel], jnp.float32)
                 u = jnp.asarray(planes[1][sel], jnp.float32)
                 v = jnp.asarray(planes[2][sel], jnp.float32)
-                sub = ov.StallPlan(
-                    src_idx=np.arange(len(sel), dtype=np.int32),
-                    stall_mask=sub.stall_mask,
-                    black_mask=sub.black_mask,
-                    phase=sub.phase,
+                oy = ov.render_stalled_plane(
+                    y, sub, sp_y, sa, black_value=16.0 * depth_scale
                 )
-                oy = ov.render_stalled_plane(y, sub, sp_y, sa, black_value=16.0)
-                ou = ov.render_stalled_plane(u, sub, sp_u, sa_c, black_value=128.0)
-                ovv = ov.render_stalled_plane(v, sub, sp_v, sa_c, black_value=128.0)
+                ou = ov.render_stalled_plane(
+                    u, sub, sp_u, sa_c, black_value=128.0 * depth_scale
+                )
+                ovv = ov.render_stalled_plane(
+                    v, sub, sp_v, sa_c, black_value=128.0 * depth_scale
+                )
                 outs = fr.to_uint8([oy, ou, ovv], ten_bit)
                 for i in range(outs[0].shape[0]):
                     writer.write(*(np.asarray(p[i]) for p in outs))

@@ -68,7 +68,6 @@ def create_cpvs(
     pvs: Pvs,
     post_processing: PostProcessing,
     rawvideo: bool = False,
-    overwrite: bool = False,
     nonraw_crf: int = 17,
     mobile_vprofile: str = "high",
     mobile_preset: str = "fast",
@@ -112,13 +111,17 @@ def create_cpvs(
                     y = jnp.asarray(planes[0][start : start + CHUNK])
                     u = jnp.asarray(planes[1][start : start + CHUNK])
                     v = jnp.asarray(planes[2][start : start + CHUNK])
-                    if "420" in pix_fmt:
-                        # CPVS is 422-based (uyvy422 / v210): lift chroma
+                    if "420" in pix_fmt and not rawvideo:
+                        # packed/uyvy and v210 outputs are 422-based: lift
+                        # chroma; rawvideo passes through the AVPVS layout
                         u, v = pf.chroma_420_to_422(u, v)
                     if need_pad:
+                        # chroma pads on its own grid: full height for 422
+                        # layouts, half height for raw 420 passthrough
+                        c_h = dh // 2 if (rawvideo and "420" in pix_fmt) else dh
                         y = pad_ops.pad_center(y, dh, dw, 16.0 if not ten_bit else 64.0)
-                        u = pad_ops.pad_center(u, dh, dw // 2, 128.0 if not ten_bit else 512.0)
-                        v = pad_ops.pad_center(v, dh, dw // 2, 128.0 if not ten_bit else 512.0)
+                        u = pad_ops.pad_center(u, c_h, dw // 2, 128.0 if not ten_bit else 512.0)
+                        v = pad_ops.pad_center(v, c_h, dw // 2, 128.0 if not ten_bit else 512.0)
                     if rawvideo:
                         # raw passthrough in the AVPVS pix_fmt
                         outs = fr.to_uint8([y, u, v], ten_bit)
@@ -166,10 +169,12 @@ def create_cpvs(
                 for start in range(0, planes[0].shape[0], CHUNK):
                     chunk = [p[start : start + CHUNK] for p in planes]
                     if need_pad:
-                        # scale to fit coding dims, pad to display canvas
-                        cw, ch_ = pp.coding_width, pp.coding_height
-                        scaled = fr.scale_yuv_frames(chunk, ch_, cw, "bicubic", (2, 2))
-                        y, u, v = pad_ops.pad_yuv(tuple(scaled), dh, dw, "yuv420p")
+                        # pad-only at native AVPVS size (letterbox), the
+                        # reference's padding branch applies no scale
+                        # (lib/ffmpeg.py:1207-1210)
+                        y, u, v = pad_ops.pad_yuv(
+                            tuple(jnp.asarray(p) for p in chunk), dh, dw, "yuv420p"
+                        )
                     else:
                         scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
                         y, u, v = scaled
@@ -191,7 +196,7 @@ def create_cpvs(
     )
 
 
-def create_preview(pvs: Pvs, overwrite: bool = False) -> Optional[Job]:
+def create_preview(pvs: Pvs) -> Optional[Job]:
     """ProRes + AAC preview (reference create_preview :1250-1259)."""
     out_path = pvs.get_preview_file_path()
 

@@ -21,6 +21,7 @@ import pandas as pd
 
 from ..config.domain import Pvs
 from ..io import framesizes, probe
+from ..io.medialib import MediaError
 from ..utils.log import get_logger
 
 
@@ -59,8 +60,9 @@ def generate_pvs_metadata(pvs: Pvs, force: bool = False) -> dict:
             afi_parts.append(
                 probe.get_audio_frame_info(segment.file_path, segment.filename)
             )
-        except Exception:
-            pass  # short tests have no audio stream
+        except MediaError as exc:
+            # short tests have no audio stream; anything else propagates
+            get_logger().debug("no audio frame info for %s: %s", segment.filename, exc)
     vfi = pd.concat(vfi_parts, ignore_index=True)
     afi = (
         pd.concat(afi_parts, ignore_index=True)

@@ -100,9 +100,9 @@ def plan_segment_frames(segment: Segment):
     return target_h, target_w, target_fps, out_fps
 
 
-def encode_segment(segment: Segment, overwrite: bool = False) -> Optional[Job]:
-    """Build the encode Job for a segment (None when memoized, reference
-    :782-788)."""
+def encode_segment(segment: Segment) -> Optional[Job]:
+    """Build the encode Job for a segment; skip/--force semantics live in
+    Job.should_run / JobRunner (engine/jobs.py)."""
     out_path = segment.file_path
     tc = segment.test_config
     log = get_logger()

@@ -1,0 +1,11 @@
+from .mesh import batch_sharding, make_mesh, scalar_sharding
+from .pipeline import avpvs_siti_step, make_batch_metrics_step, make_sharded_step
+
+__all__ = [
+    "batch_sharding",
+    "make_mesh",
+    "scalar_sharding",
+    "avpvs_siti_step",
+    "make_batch_metrics_step",
+    "make_sharded_step",
+]

@@ -1,0 +1,55 @@
+"""Multi-host distributed runtime.
+
+The reference is single-host (no MPI/NCCL/Gloo; "communication" is the
+filesystem + SFTP, SURVEY.md §2.3/§5). The TPU-native equivalent is
+`jax.distributed` + XLA collectives: within a pod slice, collectives ride
+ICI; across hosts, DCN. The host-level fan-out of the PVS list (the process
+pool analog) becomes per-process shards of the PVS batch feeding the global
+mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.log import get_logger
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from args or the standard env vars
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID). Returns
+    True when running distributed, False for single-process operation."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None:
+        return False
+    num_processes = num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get("JAX_PROCESS_ID", "0"))
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    get_logger().info(
+        "distributed: process %d/%d, %d global devices",
+        process_id, num_processes, jax.device_count(),
+    )
+    return True
+
+
+def shard_pvs_list(pvs_ids: list, process_id: int, num_processes: int) -> list:
+    """Deterministic per-host shard of the PVS work list (the multi-host
+    replacement for the reference's single-host pool fan-out)."""
+    return [p for i, p in enumerate(sorted(pvs_ids)) if i % num_processes == process_id]

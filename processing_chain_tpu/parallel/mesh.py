@@ -1,0 +1,48 @@
+"""Device mesh construction for the chain's two parallel axes.
+
+The reference's parallelism is a process pool over independent shell
+commands (reference lib/cmd_utils.py:60-129, SURVEY.md §2.3). The TPU-native
+mapping is a 2-D `jax.sharding.Mesh`:
+
+  * "pvs"  — data parallelism over the PVS batch (the `-p` flag / pool
+    fan-out analog);
+  * "time" — sequence/context parallelism over the frame-time axis (the
+    long-video segment-partitioning strategy, reference
+    test_config.py:1162-1248, mapped onto devices with halo exchange
+    instead of files — see parallel/pipeline.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    devices: Optional[Sequence] = None,
+    time_parallel: int = 1,
+) -> Mesh:
+    """Mesh over (pvs, time). time_parallel must divide the device count."""
+    import jax
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    if n % time_parallel:
+        raise ValueError(
+            f"time_parallel={time_parallel} does not divide {n} devices"
+        )
+    grid = np.array(devs).reshape(n // time_parallel, time_parallel)
+    return Mesh(grid, ("pvs", "time"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [B, T, H, W] frame tensors: PVS batch over "pvs",
+    frame time over "time", spatial dims replicated."""
+    return NamedSharding(mesh, P("pvs", "time", None, None))
+
+
+def scalar_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-frame feature outputs [B, T]."""
+    return NamedSharding(mesh, P("pvs", "time"))

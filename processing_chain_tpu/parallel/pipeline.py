@@ -1,0 +1,132 @@
+"""SPMD batch pipeline: the chain's hot path as one sharded device step.
+
+This is the execution model the north star describes (BASELINE.json): per-PVS
+pixel pipelines data-parallel over the "pvs" mesh axis, and the frame-time
+axis sharded over "time" — the device analog of the reference's long-video
+temporal partitioning (reference test_config.py:1162-1248 + p03:88-136,
+SURVEY.md §5 "long-context"). TI needs each time-shard's first frame to see
+the previous shard's last frame: a one-frame halo exchanged with
+`lax.ppermute` over the "time" axis — the ring-attention-style neighbor
+communication, riding ICI.
+
+`avpvs_siti_step` is the single-chip flagship step (also the bench body);
+`make_sharded_step` wraps it in shard_map over a (pvs, time) mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import metrics as metrics_ops
+from ..ops import resize as resize_ops
+from ..ops import siti as siti_ops
+
+
+def _si_frames(y: jnp.ndarray) -> jnp.ndarray:
+    return jax.vmap(siti_ops.si_frame)(y)
+
+
+def avpvs_siti_step(
+    y: jnp.ndarray,
+    u: jnp.ndarray,
+    v: jnp.ndarray,
+    dst_h: int,
+    dst_w: int,
+    prev_last: Optional[jnp.ndarray] = None,
+    kernel: str = "lanczos",
+):
+    """One AVPVS+features step on a [T, H, W] clip (single shard / chip):
+    Lanczos upscale of luma+chroma, SI per frame, TI per frame (using
+    prev_last as the frame before this shard when given).
+
+    Returns (up_y, up_u, up_v, si[T], ti[T]).
+    """
+    up_y = resize_ops.resize_plane(y, dst_h, dst_w, kernel)
+    up_u = resize_ops.resize_plane(u, dst_h // 2, dst_w // 2, kernel)
+    up_v = resize_ops.resize_plane(v, dst_h // 2, dst_w // 2, kernel)
+
+    yf = up_y.astype(jnp.float32)
+    si = _si_frames(yf)
+    if prev_last is None:
+        prev = jnp.concatenate([yf[:1], yf[:-1]], axis=0)
+        ti = jax.vmap(jnp.std)(yf - prev)
+        ti = ti.at[0].set(0.0)
+    else:
+        prev = jnp.concatenate([prev_last[None], yf[:-1]], axis=0)
+        ti = jax.vmap(jnp.std)(yf - prev)
+    return up_y, up_u, up_v, si, ti
+
+
+def make_sharded_step(mesh: Mesh, dst_h: int, dst_w: int, kernel: str = "lanczos"):
+    """Jit a full batched step over the (pvs, time) mesh.
+
+    In/out: y [B, T, H, W] uint8 (+ u, v at chroma res) sharded
+    P("pvs","time",None,None); returns upscaled planes and SI/TI [B, T].
+    The TI halo is exchanged between neighboring time shards with ppermute;
+    the first shard falls back to its own first frame (TI[0] = 0 globally).
+    """
+    n_time = mesh.shape["time"]
+
+    def shard_fn(y, u, v):
+        # y: [B_loc, T_loc, H, W] local block
+        def per_pvs(y1, u1, v1):
+            return avpvs_siti_step(y1, u1, v1, dst_h, dst_w, kernel=kernel)
+
+        up_y, up_u, up_v, si, _ = jax.vmap(per_pvs)(y, u, v)
+
+        # halo: previous time-shard's last upscaled luma frame
+        yf = up_y.astype(jnp.float32)
+        last = yf[:, -1]
+        perm = [(i, (i + 1) % n_time) for i in range(n_time)]
+        prev_last = lax.ppermute(last, "time", perm)
+        t_idx = lax.axis_index("time")
+        # shard 0 has no predecessor: use its own first frame (diff -> 0)
+        prev_last = jnp.where(t_idx == 0, yf[:, 0], prev_last)
+        prev = jnp.concatenate([prev_last[:, None], yf[:, :-1]], axis=1)
+        ti = jnp.std(yf - prev, axis=(2, 3))
+        return up_y, up_u, up_v, si, ti
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P("pvs", "time", None, None),
+            P("pvs", "time", None, None),
+            P("pvs", "time", None, None),
+        ),
+        out_specs=(
+            P("pvs", "time", None, None),
+            P("pvs", "time", None, None),
+            P("pvs", "time", None, None),
+            P("pvs", "time"),
+            P("pvs", "time"),
+        ),
+    )
+    return jax.jit(mapped)
+
+
+def make_batch_metrics_step(mesh: Mesh):
+    """Sharded per-frame PSNR/SSIM vs a reference batch (BASELINE config 4),
+    data-parallel over (pvs, time) — frame-local, no halo needed."""
+
+    def shard_fn(ref, deg):
+        b, t = ref.shape[0], ref.shape[1]
+        r = ref.reshape((-1,) + ref.shape[2:])
+        d = deg.reshape((-1,) + deg.shape[2:])
+        psnr = jax.vmap(metrics_ops.psnr_frame)(r, d).reshape(b, t)
+        ssim = jax.vmap(metrics_ops.ssim_frame)(r, d).reshape(b, t)
+        return psnr, ssim
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P("pvs", "time", None, None), P("pvs", "time", None, None)),
+        out_specs=(P("pvs", "time"), P("pvs", "time")),
+    )
+    return jax.jit(mapped)

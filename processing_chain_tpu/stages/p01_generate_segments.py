@@ -35,7 +35,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
                 segment.video_coding.encoder, segment.filename,
             )
             continue
-        runner.add(seg_model.encode_segment(segment, overwrite=cli_args.force))
+        runner.add(seg_model.encode_segment(segment))
     log.info("p01: %d segment encodes planned", len(runner.jobs))
     # device work is serialized through the single chip; host decode/encode
     # parallelism lives inside the native layer

@@ -40,14 +40,11 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
         runner.add(
             av.create_avpvs_wo_buffer(
                 pvs,
-                overwrite=cli_args.force,
                 avpvs_src_fps=getattr(cli_args, "avpvs_src_fps", False),
                 force_60_fps=getattr(cli_args, "force_60_fps", False),
             )
         )
-        stall_runner.add(
-            av.apply_stalling(pvs, spinner_path=spinner, overwrite=cli_args.force)
-        )
+        stall_runner.add(av.apply_stalling(pvs, spinner_path=spinner))
     runner.run_serial()
     stall_runner.run_serial()
 

@@ -30,11 +30,10 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
                 cp.create_cpvs(
                     pvs, pp,
                     rawvideo=getattr(cli_args, "rawvideo", False),
-                    overwrite=cli_args.force,
                     nonraw_crf=int(getattr(cli_args, "nonraw_crf", 17)),
                 )
             )
         if getattr(cli_args, "lightweight_preview", False):
-            runner.add(cp.create_preview(pvs, overwrite=cli_args.force))
+            runner.add(cp.create_preview(pvs))
     runner.run_serial()
     return test_config
