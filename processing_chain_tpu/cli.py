@@ -56,8 +56,35 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
     return 0
 
 
+def _dispatch_tool(argv: Sequence[str]) -> int:
+    """`tools <name> …` subcommands (reference util/ scripts)."""
+    tools = ("src-analysis", "complexity", "plots")
+    if not argv or argv[0] not in tools:
+        sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
+        return 2
+    name, rest = argv[0], list(argv[1:])
+    log_mod.setup_custom_logger("main")
+    try:
+        if name == "src-analysis":
+            from .tools import src_analysis
+
+            return src_analysis.main(rest)
+        if name == "complexity":
+            from .tools import complexity
+
+            return complexity.main(rest)
+        from .tools import plots
+
+        return plots.main(rest)
+    except (OSError, ValueError, KeyError, ChainError) as exc:
+        log_mod.get_logger().error("tools %s: %s", name, exc)
+        return 1
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "tools":
+        return _dispatch_tool(argv[1:])
     stage = None
     if argv and argv[0] in ("p01", "p02", "p03", "p04", "p00"):
         head = argv.pop(0)

@@ -1,0 +1,12 @@
+"""Standalone analysis & plotting tools (reference util/ directory).
+
+Each module doubles as a library (importable functions) and a CLI
+(`python -m processing_chain_tpu tools <name> …`):
+
+  * src_analysis — md5 + .yaml info sidecars for SRC files
+    (reference util/SRC_analysis.py)
+  * complexity — CRF-23 proxy encode → complexity classes CSV
+    (reference util/complexity_classification.py)
+  * plots — HRC timeline / bitrate-resolution design plots
+    (reference util/plot_config_{long,short}.py)
+"""

@@ -1,0 +1,223 @@
+"""SRC complexity classification via the CRF-23 proxy encode.
+
+Parity target: reference util/complexity_classification.py:18-251. Every SRC
+is proxy-encoded with x264 CRF 23 (yuv420p, no audio), its normalized
+bitrate and log-complexity computed (ops/siti.norm_bitrate_complexity), and
+SRCs are binned into classes 0-3 at the {.25, .5, .75} complexity quantiles
+of their framerate band (≤30 fps vs >30 fps). The resulting
+`complexity_classification.csv` is what flips `TestConfig.complex_bitrates`
+(config/test_config.py) and drives low/high bitrate-pair selection per
+segment.
+
+Deliberate fix over the reference: the CSV `file` column holds the *SRC*
+basename, not the `<src>_crf23.avi` proxy name the reference tool writes —
+the config layer looks complexity up by SRC filename
+(reference test_config.py:436), and the CSVs shipped with the reference are
+keyed that way too; the raw reference tool output would never match. The
+proxy artifact name is kept in a separate `proxy_file` column.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional, Sequence
+
+import pandas as pd
+
+from ..io import medialib
+from ..io.probe import get_segment_info
+from ..io.video import VideoReader, VideoWriter
+from ..ops.siti import norm_bitrate_complexity
+from ..utils.log import get_logger
+from ..utils.runner import ParallelRunner
+
+#: quantile keys used for the class thresholds
+QUANTILES = (0.25, 0.5, 0.75)
+
+
+def proxy_encode(input_file: str, output_file: str) -> str:
+    """Stream-encode `input_file` with x264 CRF 23, yuv420p, audio dropped
+    (reference encode_file, util/complexity_classification.py:134-141:
+    `ffmpeg -i IN -pix_fmt yuv420p -an -c:v libx264 -crf 23 OUT`)."""
+    with VideoReader(input_file) as reader:
+        w, h = reader.width, reader.height
+        with VideoWriter(
+            output_file,
+            codec="libx264",
+            width=w,
+            height=h,
+            pix_fmt="yuv420p",
+            fps=reader.fps_fraction,
+            opts="crf=23",
+        ) as writer:
+            native_420 = reader.pix_fmt == "yuv420p"
+            for frame in reader:
+                if native_420:
+                    writer.write(*frame.planes)
+                else:
+                    y, u, v = medialib.sws_scale_yuv(
+                        frame.planes, w, h, reader.pix_fmt, w, h, "yuv420p"
+                    )
+                    writer.write(y, u, v)
+    return output_file
+
+
+def get_difficulty(proxy_file: str, src_file: Optional[str] = None) -> dict:
+    """Complexity record for one proxy encode (reference get_difficulty,
+    util/complexity_classification.py:50-69)."""
+    info = get_segment_info(proxy_file)
+    size = float(info["file_size"])
+    duration = float(info["video_duration"])
+    framerate = float(info["video_frame_rate"])
+    width = int(info["video_width"])
+    height = int(info["video_height"])
+    norm_bitrate, complexity = norm_bitrate_complexity(
+        size, framerate, duration, width, height
+    )
+    return {
+        "file": os.path.basename(src_file or proxy_file),
+        "proxy_file": os.path.basename(proxy_file),
+        "norm_bitrate": norm_bitrate,
+        "complexity": complexity,
+        "framerate": framerate,
+        "width": width,
+        "height": height,
+        "size": int(size),
+        "duration": duration,
+    }
+
+
+def classify_complexity(complexity: float, framerate: float, quantiles: dict) -> int:
+    """Class 0-3 from the framerate band's quantiles (reference
+    classify_complexity, util/complexity_classification.py:72-88)."""
+    band = quantiles["low"] if framerate <= 30 else quantiles["high"]
+    if complexity > band[0.50]:
+        return 3 if complexity > band[0.75] else 2
+    return 1 if complexity > band[0.25] else 0
+
+
+def classify_dataframe(data: pd.DataFrame) -> pd.DataFrame:
+    """Append `complexity_class` using per-framerate-band quantiles
+    (reference main, :230-241)."""
+    quants = {
+        "low": data[data["framerate"] <= 30]["complexity"].quantile(list(QUANTILES)),
+        "high": data[data["framerate"] > 30]["complexity"].quantile(list(QUANTILES)),
+    }
+    data = data.copy()
+    data["complexity_class"] = data.apply(
+        lambda r: classify_complexity(r["complexity"], r["framerate"], quants), axis=1
+    )
+    return data
+
+
+def run(
+    inputs: Sequence[str],
+    tmp_dir: str,
+    output_file: str = "complexity_classification.csv",
+    parallelism: int = 1,
+    force: bool = False,
+    dry_run: bool = False,
+) -> Optional[pd.DataFrame]:
+    """Proxy-encode + classify all inputs; writes `<tmp_dir>/<output_file>`
+    and returns the DataFrame (None on dry run)."""
+    log = get_logger()
+    os.makedirs(tmp_dir, exist_ok=True)
+    if not output_file.endswith(".csv"):
+        raise ValueError("output file must be .csv")
+
+    input_files = []
+    for f in inputs:
+        if f.endswith(".avi"):
+            input_files.append(f)
+        else:
+            log.warning("skipping %s: not an .avi file", f)
+
+    basenames = [os.path.basename(f) for f in input_files]
+    dupes = {b for b in basenames if basenames.count(b) > 1}
+    if dupes:
+        # same basename ⇒ same proxy path AND ambiguous CSV `file` keys —
+        # the config layer looks complexity up by SRC basename, so this
+        # cannot be disambiguated; refuse instead of silently misclassifying
+        raise ValueError(
+            f"duplicate SRC basenames across inputs: {sorted(dupes)}"
+        )
+
+    runner = ParallelRunner(max_parallel=parallelism, name="complexity-encode")
+    pairs: list[tuple[str, str]] = []
+    for input_file in input_files:
+        base = os.path.splitext(os.path.basename(input_file))[0]
+        proxy = os.path.join(tmp_dir, base + "_crf23.avi")
+        pairs.append((input_file, proxy))
+        if os.path.isfile(proxy) and not force:
+            log.warning("proxy %s exists, use --force to re-encode", proxy)
+        else:
+            runner.add(proxy_encode, input_file, proxy, label=proxy)
+
+    if dry_run:
+        for input_file, proxy in pairs:
+            log.info("would encode %s -> %s", input_file, proxy)
+        return None
+
+    if len(runner):
+        log.info("encoding %d proxies, this may take a while …", len(runner))
+        runner.run()
+
+    records = [get_difficulty(proxy, src) for src, proxy in pairs]
+    if not records:
+        raise ValueError("no inputs analysed")
+
+    data = pd.DataFrame(records)[
+        [
+            "file",
+            "proxy_file",
+            "norm_bitrate",
+            "complexity",
+            "framerate",
+            "width",
+            "height",
+            "size",
+            "duration",
+        ]
+    ].sort_values("file")
+    data = classify_dataframe(data)
+
+    csv_path = os.path.join(tmp_dir, output_file)
+    data.to_csv(csv_path, index=False)
+    log.info("wrote %s (%d rows)", csv_path, len(data))
+    return data
+
+
+def build_parser(parser: Optional[argparse.ArgumentParser] = None) -> argparse.ArgumentParser:
+    p = parser or argparse.ArgumentParser(
+        "complexity", description="Classify SRC encoding complexity (CRF-23 proxy)"
+    )
+    p.add_argument("-i", "--input", required=True, nargs="+", help="input SRC files (.avi)")
+    p.add_argument("-t", "--tmp-dir", default="complexityAnalysis",
+                   help="directory for proxy encodes + the output CSV")
+    p.add_argument("-p", "--parallelism", type=int, default=1,
+                   help="number of parallel proxy encodes")
+    p.add_argument("-o", "--output-file", default="complexity_classification.csv",
+                   help="CSV output filename")
+    p.add_argument("-f", "--force", action="store_true",
+                   help="re-encode existing proxies")
+    p.add_argument("-n", "--dry-run", action="store_true",
+                   help="show what would be encoded")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    run(
+        args.input,
+        tmp_dir=args.tmp_dir,
+        output_file=args.output_file,
+        parallelism=args.parallelism,
+        force=args.force,
+        dry_run=args.dry_run,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

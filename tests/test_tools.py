@@ -1,0 +1,252 @@
+"""Tests for the util-tool layer: SRC analysis, complexity classifier,
+design plotters (reference util/ directory, SURVEY.md §2.1)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+import yaml
+
+from processing_chain_tpu.tools import complexity, plots, src_analysis
+
+from tests.test_io import write_test_video
+
+
+# ----------------------------------------------------------- src_analysis
+
+
+def test_md5_write_then_verify(tmp_path):
+    f = tmp_path / "clip.avi"
+    f.write_bytes(b"0123456789" * 1000)
+
+    r1 = src_analysis.check_or_write_md5(str(f))
+    assert r1.status == "written"
+    assert os.path.isfile(str(f) + ".md5")
+
+    r2 = src_analysis.check_or_write_md5(str(f))
+    assert r2.status == "ok"
+    assert r2.digest == r1.digest
+
+    # corrupt the file -> BAD
+    f.write_bytes(b"tampered")
+    r3 = src_analysis.check_or_write_md5(str(f))
+    assert r3.status == "BAD"
+    assert "BAD" in r3.summary()
+
+
+def test_md5_sidecar_accepts_cli_format(tmp_path):
+    f = tmp_path / "clip.avi"
+    f.write_bytes(b"data")
+    digest = src_analysis.md5sum(str(f))
+    (tmp_path / "clip.avi.md5").write_text(f"{digest}  clip.avi\n")
+    assert src_analysis.check_or_write_md5(str(f)).status == "ok"
+
+
+def test_analyse_src_writes_yaml_sidecar(tmp_path):
+    path = str(tmp_path / "src.avi")
+    write_test_video(path, codec="ffv1", n=8)
+    sidecar = src_analysis.analyse_src(path)
+    with open(sidecar) as fh:
+        data = yaml.safe_load(fh)
+    assert set(data) == {"md5sum", "get_stream_size", "get_src_info"}
+    assert data["md5sum"] == src_analysis.md5sum(path)
+    assert data["get_src_info"]["width"] == 192
+    assert data["get_stream_size"]["v"] > 0
+
+
+def test_run_skips_existing_sidecars(tmp_path):
+    path = str(tmp_path / "src.avi")
+    write_test_video(path, codec="ffv1", n=8)
+    out = src_analysis.run(
+        [str(tmp_path)], concurrency=1,
+        summary_path=str(tmp_path / "summary.txt"),
+    )
+    assert len(out["md5"]) == 1 and len(out["sidecars"]) == 1
+    # second run: sidecar exists, nothing to do without force
+    out2 = src_analysis.run([str(tmp_path)], concurrency=1, summary_path=None)
+    assert out2["md5"] == [] and out2["sidecars"] == []
+
+
+def test_collect_video_files_expands_dirs(tmp_path):
+    (tmp_path / "a.mp4").write_bytes(b"")
+    (tmp_path / "b.avi").write_bytes(b"")
+    (tmp_path / "c.txt").write_bytes(b"")
+    files = src_analysis.collect_video_files([str(tmp_path)])
+    assert [os.path.basename(f) for f in files] == ["a.mp4", "b.avi"]
+
+
+# ------------------------------------------------------------- complexity
+
+
+def test_classify_complexity_quantile_bands():
+    quants = {
+        "low": pd.Series({0.25: 1.0, 0.5: 2.0, 0.75: 3.0}),
+        "high": pd.Series({0.25: 4.0, 0.5: 5.0, 0.75: 6.0}),
+    }
+    assert complexity.classify_complexity(0.5, 24, quants) == 0
+    assert complexity.classify_complexity(1.5, 24, quants) == 1
+    assert complexity.classify_complexity(2.5, 24, quants) == 2
+    assert complexity.classify_complexity(3.5, 24, quants) == 3
+    # >30 fps band uses the high quantiles
+    assert complexity.classify_complexity(3.5, 60, quants) == 0
+
+
+def test_complexity_end_to_end(tmp_path):
+    # two synthetic SRCs: noisy (hard) vs flat (easy)
+    hard = str(tmp_path / "hard.avi")
+    write_test_video(hard, codec="ffv1", n=16)
+
+    easy = str(tmp_path / "easy.avi")
+    from processing_chain_tpu.io.video import VideoWriter
+
+    with VideoWriter(easy, "ffv1", 192, 108, "yuv420p", (24, 1)) as w:
+        y = np.full((108, 192), 128, np.uint8)
+        u = np.full((54, 96), 128, np.uint8)
+        v = np.full((54, 96), 128, np.uint8)
+        for _ in range(16):
+            w.write(y, u, v)
+
+    data = complexity.run(
+        [hard, easy, str(tmp_path / "skipped.mp4")],
+        tmp_dir=str(tmp_path / "ca"),
+        parallelism=2,
+    )
+    assert list(data["file"]) == ["easy.avi", "hard.avi"]
+    csv_path = tmp_path / "ca" / "complexity_classification.csv"
+    assert csv_path.is_file()
+    easy_row = data[data["file"] == "easy.avi"].iloc[0]
+    hard_row = data[data["file"] == "hard.avi"].iloc[0]
+    assert hard_row["complexity"] > easy_row["complexity"]
+    assert hard_row["complexity_class"] >= easy_row["complexity_class"]
+    # proxy artifacts exist and are h264
+    assert (tmp_path / "ca" / "hard_crf23.avi").is_file()
+
+
+def test_complexity_csv_feeds_test_config(tmp_path):
+    """The tool's CSV flips TestConfig.complex_bitrates and selects the
+    low/high rung of a 'low/high' bitrate pair (reference
+    test_config.py:426-445)."""
+    from processing_chain_tpu.config import TestConfig
+    from tests.fixtures import write_short_db
+
+    yaml_path, prober = write_short_db(tmp_path)
+    # patch the DB yaml to use a bitrate pair
+    text = (tmp_path / "P2SXM00" / "P2SXM00.yaml").read_text()
+    text = text.replace("videoBitrate: 500", "videoBitrate: 400/600")
+    (tmp_path / "P2SXM00" / "P2SXM00.yaml").write_text(text)
+
+    ca_dir = tmp_path / "complexityAnalysis"
+    ca_dir.mkdir()
+    pd.DataFrame(
+        [{"file": "SRC000.avi", "complexity_class": 3}]
+    ).to_csv(ca_dir / "complexity_classification.csv", index=False)
+
+    tc = TestConfig(str(yaml_path), prober=prober, complexity_csv_dir=str(ca_dir))
+    assert tc.is_complex()
+    segs = [s for s in tc.get_required_segments()
+            if s.quality_level.index == 0]
+    assert segs and all(s.target_video_bitrate == 600.0 for s in segs)
+
+
+def test_complexity_rejects_duplicate_basenames(tmp_path):
+    (tmp_path / "a").mkdir()
+    (tmp_path / "b").mkdir()
+    with pytest.raises(ValueError, match="duplicate SRC basenames"):
+        complexity.run(
+            [str(tmp_path / "a" / "clip.avi"), str(tmp_path / "b" / "clip.avi")],
+            tmp_dir=str(tmp_path / "ca"),
+        )
+
+
+def test_complexity_dry_run(tmp_path):
+    out = complexity.run(
+        ["x.avi"], tmp_dir=str(tmp_path / "ca"), dry_run=True
+    )
+    assert out is None
+    assert not (tmp_path / "ca" / "complexity_classification.csv").exists()
+
+
+# ------------------------------------------------------------------ plots
+
+
+def _design_yaml(tmp_path, event_lists):
+    data = {
+        "databaseId": "P2LTR00",
+        "syntaxVersion": 6,
+        "type": "long",
+        "segmentDuration": 5,
+        "qualityLevelList": {
+            "Q0": {"index": 0, "videoCodec": "h264", "videoBitrate": 500,
+                    "width": 960, "height": 540, "fps": 24},
+            "Q1": {"index": 1, "videoCodec": "vp9", "videoBitrate": "2000/3000",
+                    "width": 1920, "height": 1080, "fps": 24},
+        },
+        "hrcList": {
+            f"HRC{i:03d}": {"videoCodingId": "VC01", "eventList": ev}
+            for i, ev in enumerate(event_lists)
+        },
+    }
+    path = tmp_path / "design.yaml"
+    path.write_text(yaml.safe_dump(data))
+    return str(path)
+
+
+def test_design_warnings_rules():
+    # first chunk too short
+    w = plots.design_warnings("H1", [["Q0", 2], ["Q1", 20]], 22)
+    assert any("first chunk" in x for x in w)
+    # last chunk < 10 s on a long video
+    w = plots.design_warnings("H2", [["Q0", 60], ["Q1", 8]], 68)
+    assert any("last chunk" in x for x in w)
+    # stall events are not media chunks
+    w = plots.design_warnings("H3", [["stall", 2], ["Q0", 10], ["Q1", 15]], 25)
+    assert w == []
+    # chunk not divisible by segment duration
+    w = plots.design_warnings("H4", [["Q0", 7], ["Q1", 15]], 22, 5)
+    assert any("not a multiple" in x for x in w)
+    assert plots.design_warnings("H5", [["Q0", 10], ["Q1", 15]], 25, 5) == []
+
+
+def test_plot_long_writes_svg_and_warns(tmp_path):
+    cfg = _design_yaml(tmp_path, [
+        [["Q0", 10], ["stall", 2], ["Q1", 15]],
+        [["Q1", 2], ["Q0", 20]],   # first-chunk warning
+    ])
+    out = str(tmp_path / "design_long.svg")
+    warnings = plots.plot_long(cfg, out)
+    assert os.path.isfile(out)
+    assert any("first chunk" in w for w in warnings)
+    assert "<svg" in open(out).read(2000)
+
+
+def test_plot_short_scatter_and_codecwise(tmp_path):
+    cfg = _design_yaml(tmp_path, [
+        [["Q0", 10]],
+        [["Q1", 10]],
+        [["stall", 1], ["Q1", 10]],
+    ])
+    single = plots.plot_short(cfg, str(tmp_path / "short.svg"))
+    assert single == [str(tmp_path / "short.svg")]
+    assert os.path.isfile(single[0])
+
+    per_codec = plots.plot_short(cfg, codec_wise=True)
+    assert len(per_codec) == 3
+    for path in per_codec:
+        assert os.path.isfile(path)
+        os.remove(path)
+
+    # -o is honored in codec-wise mode (base path for the per-codec files)
+    out_base = str(tmp_path / "sub" / "custom.svg")
+    os.makedirs(tmp_path / "sub")
+    per_codec = plots.plot_short(cfg, out_file=out_base, codec_wise=True)
+    assert all(p.startswith(str(tmp_path / "sub" / "custom")) for p in per_codec)
+    assert all(os.path.isfile(p) for p in per_codec)
+
+
+def test_plot_default_name_uses_splitext(tmp_path):
+    cfg = _design_yaml(tmp_path, [[["Q0", 10]]])
+    yml = str(tmp_path / "design.yml")  # 4-char extension
+    os.rename(cfg, yml)
+    plots.plot_long(yml)
+    assert os.path.isfile(str(tmp_path / "design.svg"))
