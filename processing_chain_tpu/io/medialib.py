@@ -174,6 +174,10 @@ def ensure_loaded() -> ct.CDLL:
         lib.mp_extract_ivf.argtypes = [
             ct.c_char_p, ct.c_char_p, ct.c_char_p, ct.c_int,
         ]
+        lib.mp_remux.restype = ct.c_int
+        lib.mp_remux.argtypes = [
+            ct.c_char_p, ct.c_char_p, ct.c_char_p, ct.c_char_p, ct.c_int,
+        ]
         lib.mp_version.restype = ct.c_char_p
         _lib = lib
         return lib
@@ -330,6 +334,20 @@ def sws_scale_yuv(
     if ret < 0:
         raise MediaError(f"sws_scale_yuv: {err.value.decode()}")
     return dy, du, dv
+
+
+def remux(video_path: str, out_path: str, audio_path: str = "") -> None:
+    """Stream-copy remux: video stream from `video_path` (+ audio stream from
+    `audio_path`, which may equal `video_path`) into `out_path` — no
+    transcoding (reference `ffmpeg -i V [-i A] -c copy OUT`,
+    lib/downloader.py:786-871)."""
+    lib = ensure_loaded()
+    err = _err_buf()
+    ret = lib.mp_remux(
+        video_path.encode(), audio_path.encode(), out_path.encode(), err, 512
+    )
+    if ret < 0:
+        raise MediaError(f"remux {video_path} -> {out_path}: {err.value.decode()}")
 
 
 def extract_annexb(path: str, bsf_name: str, out_path: str) -> None:

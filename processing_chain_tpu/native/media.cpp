@@ -653,7 +653,9 @@ EXPORT MPEncoder* mp_encoder_open(
         }
     }
 
+    AVDictionary* opts = nullptr;
     auto fail_cleanup = [&]() {
+        av_dict_free(&opts);
         if (e->stats_file) fclose(e->stats_file);
         avcodec_free_context(&e->venc);
         if (e->aenc) avcodec_free_context(&e->aenc);
@@ -661,7 +663,6 @@ EXPORT MPEncoder* mp_encoder_open(
         avformat_free_context(e->fmt);
         delete e;
     };
-    AVDictionary* opts = nullptr;
     if (vopts && vopts[0]) {
         ret = av_dict_parse_string(&opts, vopts, "=", ":", 0);
         if (ret < 0) {
@@ -670,8 +671,10 @@ EXPORT MPEncoder* mp_encoder_open(
             return nullptr;
         }
     }
+    // entries avcodec_open2 does not consume stay in `opts` and are handed
+    // to the muxer below — so e.g. "movflags=+frag_keyframe" in the same
+    // option string reaches avformat_write_header (ffmpeg-CLI-like split)
     ret = avcodec_open2(e->venc, vc, &opts);
-    av_dict_free(&opts);
     if (ret < 0) {
         set_err(err, errlen, "video avcodec_open2: " + av_errstr(ret));
         fail_cleanup();
@@ -735,12 +738,13 @@ EXPORT MPEncoder* mp_encoder_open(
             return nullptr;
         }
     }
-    ret = avformat_write_header(e->fmt, nullptr);
+    ret = avformat_write_header(e->fmt, &opts);
     if (ret < 0) {
         set_err(err, errlen, "write_header: " + av_errstr(ret));
         fail_cleanup();
         return nullptr;
     }
+    av_dict_free(&opts);
     e->header_written = true;
     e->vframe = av_frame_alloc();
     e->vframe->format = pf;
@@ -1086,6 +1090,195 @@ EXPORT int mp_extract_ivf(const char* path, const char* out_path, char* err,
     fwrite(&nframes, 4, 1, out);
     fclose(out);
     avformat_close_input(&fmt);
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Stream-copy remux: video stream from `video_path` plus (optionally) the
+// audio stream from `audio_path` into `out_path`, no transcoding — the
+// native replacement for the reference's `ffmpeg -i V [-i A] -c copy OUT`
+// reassembly commands (reference lib/downloader.py:786-871). `audio_path`
+// may be NULL/empty; when equal to `video_path` both streams are taken from
+// the one file.
+
+// One input being merged into the output: reads ahead a single packet of
+// its wanted stream so the remux loop can always write the earliest-dts
+// packet next (proper interleaving without buffering whole streams).
+struct RemuxSource {
+    AVFormatContext* ctx = nullptr;
+    int in_idx = -1;
+    int out_idx = -1;
+    AVPacket* pkt = nullptr;
+    bool have = false;
+    bool eof = false;
+
+    // returns 0 ok / <0 error; sets have/eof
+    int advance() {
+        have = false;
+        int ret;
+        while ((ret = av_read_frame(ctx, pkt)) >= 0) {
+            if (pkt->stream_index == in_idx) {
+                have = true;
+                return 0;
+            }
+            av_packet_unref(pkt);
+        }
+        if (ret == AVERROR_EOF) {
+            eof = true;
+            return 0;
+        }
+        return ret;
+    }
+
+    double next_time() const {
+        int64_t ts = pkt->dts != AV_NOPTS_VALUE ? pkt->dts : pkt->pts;
+        if (ts == AV_NOPTS_VALUE) return 0.0;
+        return ts * av_q2d(ctx->streams[in_idx]->time_base);
+    }
+};
+
+static int remux_merge(RemuxSource* sources, int n_sources,
+                       AVFormatContext* out, char* err, int errlen) {
+    for (int i = 0; i < n_sources; i++) {
+        int ret = sources[i].advance();
+        if (ret < 0) {
+            set_err(err, errlen, "read packet: " + av_errstr(ret));
+            return -1;
+        }
+    }
+    for (;;) {
+        RemuxSource* next = nullptr;
+        for (int i = 0; i < n_sources; i++) {
+            RemuxSource& s = sources[i];
+            if (!s.have) continue;
+            if (!next || s.next_time() < next->next_time()) next = &s;
+        }
+        if (!next) break;  // all sources drained
+        AVPacket* pkt = next->pkt;
+        AVRational in_tb = next->ctx->streams[next->in_idx]->time_base;
+        pkt->stream_index = next->out_idx;
+        av_packet_rescale_ts(pkt, in_tb, out->streams[next->out_idx]->time_base);
+        pkt->pos = -1;
+        int ret = av_interleaved_write_frame(out, pkt);
+        if (ret < 0) {
+            set_err(err, errlen, "write packet: " + av_errstr(ret));
+            return -1;
+        }
+        if ((ret = next->advance()) < 0) {
+            set_err(err, errlen, "read packet: " + av_errstr(ret));
+            return -1;
+        }
+    }
+    return 0;
+}
+
+EXPORT int mp_remux(const char* video_path, const char* audio_path,
+                    const char* out_path, char* err, int errlen) {
+    AVFormatContext* vin = nullptr;
+    AVFormatContext* ain = nullptr;
+    AVFormatContext* out = nullptr;
+    int ret = avformat_open_input(&vin, video_path, nullptr, nullptr);
+    if (ret < 0) {
+        set_err(err, errlen, std::string(video_path) + ": " + av_errstr(ret));
+        return -1;
+    }
+    auto fail = [&](const std::string& msg) {
+        set_err(err, errlen, msg);
+        if (vin) avformat_close_input(&vin);
+        if (ain) avformat_close_input(&ain);
+        if (out) {
+            if (!(out->oformat->flags & AVFMT_NOFILE) && out->pb) avio_closep(&out->pb);
+            avformat_free_context(out);
+        }
+        return -1;
+    };
+    if ((ret = avformat_find_stream_info(vin, nullptr)) < 0)
+        return fail("stream info: " + av_errstr(ret));
+    int v_idx = av_find_best_stream(vin, AVMEDIA_TYPE_VIDEO, -1, -1, nullptr, 0);
+    if (v_idx < 0) return fail(std::string(video_path) + ": no video stream");
+
+    bool same_file = audio_path && *audio_path && !strcmp(audio_path, video_path);
+    int a_idx = -1;
+    if (audio_path && *audio_path) {
+        if (same_file) {
+            a_idx = av_find_best_stream(vin, AVMEDIA_TYPE_AUDIO, -1, -1, nullptr, 0);
+        } else {
+            if ((ret = avformat_open_input(&ain, audio_path, nullptr, nullptr)) < 0)
+                return fail(std::string(audio_path) + ": " + av_errstr(ret));
+            if ((ret = avformat_find_stream_info(ain, nullptr)) < 0)
+                return fail("audio stream info: " + av_errstr(ret));
+            a_idx = av_find_best_stream(ain, AVMEDIA_TYPE_AUDIO, -1, -1, nullptr, 0);
+        }
+        if (a_idx < 0) return fail(std::string(audio_path) + ": no audio stream");
+    }
+
+    if ((ret = avformat_alloc_output_context2(&out, nullptr, nullptr, out_path)) < 0)
+        return fail(std::string(out_path) + ": " + av_errstr(ret));
+    // reference reassembly commands pass `-strict -2` (lib/downloader.py:859,
+    // :868) — e.g. FLAC-in-MP4 is gated behind experimental compliance
+    out->strict_std_compliance = FF_COMPLIANCE_EXPERIMENTAL;
+
+    AVStream* vs = avformat_new_stream(out, nullptr);
+    if (!vs || avcodec_parameters_copy(vs->codecpar, vin->streams[v_idx]->codecpar) < 0)
+        return fail("copy video params failed");
+    vs->codecpar->codec_tag = 0;
+    vs->time_base = vin->streams[v_idx]->time_base;
+
+    if (a_idx >= 0) {
+        AVFormatContext* asrc = same_file ? vin : ain;
+        AVStream* as = avformat_new_stream(out, nullptr);
+        if (!as || avcodec_parameters_copy(as->codecpar, asrc->streams[a_idx]->codecpar) < 0)
+            return fail("copy audio params failed");
+        as->codecpar->codec_tag = 0;
+        as->time_base = asrc->streams[a_idx]->time_base;
+    }
+
+    if (!(out->oformat->flags & AVFMT_NOFILE) &&
+        (ret = avio_open(&out->pb, out_path, AVIO_FLAG_WRITE)) < 0)
+        return fail(std::string(out_path) + ": " + av_errstr(ret));
+    if ((ret = avformat_write_header(out, nullptr)) < 0)
+        return fail("write header: " + av_errstr(ret));
+
+    if (same_file && a_idx >= 0) {
+        // single pass over the one input, copying both streams
+        AVPacket* pkt = av_packet_alloc();
+        while ((ret = av_read_frame(vin, pkt)) >= 0) {
+            int out_idx = pkt->stream_index == v_idx ? 0
+                        : pkt->stream_index == a_idx ? 1 : -1;
+            if (out_idx < 0) {
+                av_packet_unref(pkt);
+                continue;
+            }
+            AVRational in_tb = vin->streams[pkt->stream_index]->time_base;
+            pkt->stream_index = out_idx;
+            av_packet_rescale_ts(pkt, in_tb, out->streams[out_idx]->time_base);
+            pkt->pos = -1;
+            if ((ret = av_interleaved_write_frame(out, pkt)) < 0) {
+                av_packet_free(&pkt);
+                return fail("write packet: " + av_errstr(ret));
+            }
+        }
+        av_packet_free(&pkt);
+        if (ret != AVERROR_EOF) return fail("read packet: " + av_errstr(ret));
+    } else {
+        RemuxSource sources[2];
+        int n_sources = 0;
+        AVPacket* p0 = av_packet_alloc();
+        AVPacket* p1 = av_packet_alloc();
+        sources[n_sources++] = RemuxSource{vin, v_idx, 0, p0};
+        if (a_idx >= 0) sources[n_sources++] = RemuxSource{ain, a_idx, 1, p1};
+        int ret2 = remux_merge(sources, n_sources, out, err, errlen);
+        av_packet_free(&p0);
+        av_packet_free(&p1);
+        if (ret2 < 0) return fail(err && err[0] ? err : "remux merge failed");
+    }
+
+    if ((ret = av_write_trailer(out)) < 0)
+        return fail("write trailer: " + av_errstr(ret));
+    avformat_close_input(&vin);
+    if (ain) avformat_close_input(&ain);
+    if (!(out->oformat->flags & AVFMT_NOFILE) && out->pb) avio_closep(&out->pb);
+    avformat_free_context(out);
     return 0;
 }
 

@@ -1,0 +1,8 @@
+"""Host-side online services (reference lib/downloader.py).
+
+Cloud encode/download paths never touch the TPU: they produce encoded
+segment files behind the same Segment interface p01 consumes
+(SURVEY.md §2.3 "Cloud offload").
+"""
+
+from .downloader import Downloader, select_format  # noqa: F401

@@ -5,7 +5,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..config import TestConfig
-from ..engine.jobs import JobRunner
+from ..engine.jobs import Job, JobRunner
 from ..models import segments as seg_model
 from ..utils.log import get_logger
 
@@ -24,16 +24,27 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
         parallelism=cli_args.parallelism,
         name="p01",
     )
+    downloader = None
     for segment in sorted(test_config.get_required_segments()):
         if getattr(segment.video_coding, "is_online", False):
             if cli_args.skip_online_services:
                 log.warning("Skipping online segment %s", segment.filename)
                 continue
-            log.warning(
-                "online encoder %s for %s is not available in this "
-                "environment; skipping (use the downloader tool)",
-                segment.video_coding.encoder, segment.filename,
-            )
+            if downloader is None:
+                from ..services import Downloader
+
+                downloader = Downloader(test_config.get_video_segments_path())
+            encoder = segment.video_coding.encoder.casefold()
+            seg, force = segment, cli_args.force
+            if encoder == "bitmovin":
+                fn = lambda s=seg, f=force: downloader.encode_bitmovin(s, overwrite=f)  # noqa: E731
+            else:
+                fn = lambda s=seg, f=force: downloader.init_download(s, force=f)  # noqa: E731
+            runner.add(Job(
+                label=f"online:{segment.filename}",
+                output_path=segment.file_path,
+                fn=fn,
+            ))
             continue
         runner.add(seg_model.encode_segment(segment))
     log.info("p01: %d segment encodes planned", len(runner.jobs))

@@ -1,0 +1,302 @@
+"""Tests for the online-services downloader (reference lib/downloader.py,
+SURVEY.md §2.1). Network clients are faked; reassembly runs on real fMP4
+chunks produced by slicing a real encode."""
+
+import os
+
+import pytest
+
+from processing_chain_tpu.services import downloader as dl
+
+from tests.test_io import write_test_video
+
+
+# -------------------------------------------------------- format selection
+
+
+def fmt(format_id, height, vbr=None, tbr=None, vcodec="avc1.64001f",
+        protocol="https", fps=30, width=None, note=""):
+    e = {
+        "format_id": format_id,
+        "format": f"{format_id} - {note or 'video'}",
+        "height": height,
+        "width": width or height * 16 // 9,
+        "vcodec": vcodec,
+        "protocol": protocol,
+        "fps": fps,
+    }
+    if vbr is not None:
+        e["vbr"] = vbr
+    if tbr is not None:
+        e["tbr"] = tbr
+    return e
+
+
+def test_select_nearest_resolution_under_bitrate_cap():
+    formats = [
+        fmt("a", 1080, vbr=4000),
+        fmt("b", 720, vbr=1500),
+        fmt("c", 480, vbr=800),
+    ]
+    # cap excludes 1080; 720 is nearest to 1080 among the rest
+    chosen = dl.select_format(formats, height=1080, bitrate_kbps=2000, vcodec="h264")
+    assert chosen.format_id == "b"
+    # generous cap: exact match wins
+    chosen = dl.select_format(formats, height=1080, bitrate_kbps=9000, vcodec="h264")
+    assert chosen.format_id == "a"
+
+
+def test_select_skips_audio_only_and_wrong_codec():
+    formats = [
+        fmt("aud", 0, tbr=128, note="audio only"),
+        fmt("vp9", 720, vbr=1000, vcodec="vp9"),
+        fmt("avc", 720, vbr=1000),
+    ]
+    chosen = dl.select_format(formats, height=720, bitrate_kbps=2000, vcodec="h264")
+    assert chosen.format_id == "avc"
+    chosen = dl.select_format(formats, height=720, bitrate_kbps=2000, vcodec="vp9")
+    assert chosen.format_id == "vp9"
+
+
+def test_select_uses_tbr_when_vbr_missing_and_skips_rateless():
+    formats = [
+        fmt("no-rate", 720),
+        fmt("tbr-only", 720, tbr=900),
+    ]
+    chosen = dl.select_format(formats, height=720, bitrate_kbps=1000, vcodec="h264")
+    assert chosen.format_id == "tbr-only"
+    assert dl.select_format([fmt("no-rate", 720)], 720, 1000, "h264") is None
+
+
+def test_select_prefers_requested_protocol():
+    formats = [
+        fmt("hls", 720, vbr=1000, protocol="m3u8_native"),
+        fmt("dash", 720, vbr=1000, protocol="http_dash_segments"),
+    ]
+    assert dl.select_format(formats, 720, 2000, "h264", protocol="hls").format_id == "hls"
+    assert dl.select_format(formats, 720, 2000, "h264", protocol="dash").format_id == "dash"
+    # unavailable protocol still returns a format, flagged unmatched
+    chosen = dl.select_format(
+        [fmt("hls", 720, vbr=1000, protocol="m3u8_native")], 720, 2000, "h264",
+        protocol="dash",
+    )
+    assert chosen.format_id == "hls" and not chosen.protocol_matched
+
+
+def test_select_fps_tiebreak():
+    formats = [
+        fmt("f30", 720, vbr=1000, fps=30),
+        fmt("f60", 720, vbr=1000, fps=60),
+    ]
+    # 'original' prefers highest fps
+    assert dl.select_format(formats, 720, 2000, "h264", fps="original").format_id == "f60"
+    # numeric fps prefers nearest
+    assert dl.select_format(formats, 720, 2000, "h264", fps=25).format_id == "f30"
+
+
+def test_select_tolerates_null_vbr_and_null_vcodec():
+    # yt-dlp emits explicit "vbr": null beside a valid "tbr"
+    e = fmt("x", 720, tbr=900)
+    e["vbr"] = None
+    assert dl.select_format([e], 720, 1000, "h264").format_id == "x"
+    # some extractors emit "vcodec": null — treated as unknown, not a crash
+    e2 = fmt("y", 720, vbr=500)
+    e2["vcodec"] = None
+    assert dl.select_format([e2], 720, 1000, "h264").format_id == "y"
+
+
+def test_selected_format_carries_ext():
+    e = fmt("w", 720, vbr=500, vcodec="vp9")
+    e["ext"] = "webm"
+    chosen = dl.select_format([e], 720, 1000, "vp9")
+    assert chosen.ext == "webm"
+
+
+def test_fix_codec_and_check_mode():
+    assert dl.fix_codec("libx264-h264") == "avc"
+    assert dl.fix_codec("vp9-profile0") == "vp9"
+    assert dl.check_mode("https://www.youtube.com/watch?v=x") == "youtube"
+    assert dl.check_mode("https://youtu.be/x") == "youtube"
+    assert dl.check_mode("https://vimeo.com/123") == "vimeo"
+
+
+# ----------------------------------------------------------- youtube facade
+
+
+class FakeYoutube:
+    def __init__(self, formats, ext="mp4"):
+        self.info = {"formats": formats, "ext": ext}
+        self.downloads = []
+
+    def extract_info(self, url):
+        return self.info
+
+    def download(self, url, format_id, outtmpl):
+        self.downloads.append((url, format_id))
+        path = outtmpl.replace("%(ext)s", self.info["ext"])
+        write_test_video(path, codec="libx264", n=24, fps=(3, 1))  # 8 s video
+
+
+def test_download_video_fake_roundtrip(tmp_path):
+    yt = FakeYoutube([fmt("b", 720, vbr=1500)])
+    d = dl.Downloader(str(tmp_path), youtube=yt)
+    out = d.download_video(
+        "https://youtu.be/x", 1920, 1080, "SEG001", "h264", 2000
+    )
+    assert out == str(tmp_path / "SEG001.mp4") and os.path.isfile(out)
+    assert yt.downloads == [("https://youtu.be/x", "b")]
+    # second call: file exists, no new download
+    d.download_video("https://youtu.be/x", 1920, 1080, "SEG001", "h264", 2000)
+    assert len(yt.downloads) == 1
+
+
+def test_download_video_no_match_returns_none(tmp_path):
+    yt = FakeYoutube([fmt("a", 1080, vbr=4000)])
+    d = dl.Downloader(str(tmp_path), youtube=yt)
+    out = d.download_video("https://youtu.be/x", 1920, 1080, "SEG", "h264", 100)
+    assert out is None and yt.downloads == []
+
+
+def test_download_video_rejects_bad_protocol(tmp_path):
+    d = dl.Downloader(str(tmp_path), youtube=FakeYoutube([]))
+    with pytest.raises(ValueError):
+        d.download_video("u", 1, 1, "f", "h264", 1, protocol="ftp")
+
+
+# ------------------------------------------------- chunk stores + reassembly
+
+
+def _make_chunks(seg_dir, tmp_path, audio=False, drop_index=None):
+    """Slice a real fMP4 encode into init + media chunks on disk."""
+    src = str(tmp_path / "full.mp4")
+    # gop=6 -> a keyframe (and thus a fragment) every 6 frames: 4 chunks
+    write_test_video(src, codec="libx264", n=24, audio=False, gop=6,
+                     opts="crf=28:preset=ultrafast:movflags=+frag_keyframe+empty_moov")
+    data = open(src, "rb").read()
+    # fragmented mp4: everything before the first moof is the init segment
+    first_moof = data.find(b"moof")
+    assert first_moof > 0
+    init, media = data[: first_moof - 4], data[first_moof - 4:]
+    # split media bytes at each moof box start
+    offsets = []
+    pos = media.find(b"moof")
+    while pos != -1:
+        offsets.append(pos - 4)
+        pos = media.find(b"moof", pos + 4)
+    offsets.append(len(media))
+    os.makedirs(seg_dir, exist_ok=True)
+    with open(os.path.join(seg_dir, "seg_init.mp4"), "wb") as f:
+        f.write(init)
+    n = 0
+    for i in range(len(offsets) - 1):
+        if drop_index is not None and i == drop_index:
+            continue
+        with open(os.path.join(seg_dir, f"seg_{i}.m4s"), "wb") as f:
+            f.write(media[offsets[i]: offsets[i + 1]])
+        n += 1
+    return n
+
+
+def test_generate_full_segment_from_chunks(tmp_path):
+    seg_dir = str(tmp_path / "SEG001")
+    n = _make_chunks(seg_dir, tmp_path)
+    assert n >= 1
+    d = dl.Downloader(str(tmp_path))
+    assert d.check_output_existence_level("SEG001.mp4", "h264", audio=False) == 2
+    out = d.generate_full_segment("SEG001.mp4", "h264")
+    assert out == str(tmp_path / "SEG001.mp4") and os.path.isfile(out)
+
+    from processing_chain_tpu.io import probe
+
+    from processing_chain_tpu.io import medialib
+
+    info = probe.get_segment_info(out)
+    assert info["video_codec"] == "h264"
+    assert len(medialib.scan_packets(out, "video")["size"]) == 24
+    # reassembled → level 3 now
+    assert d.check_output_existence_level("SEG001.mp4", "h264", audio=False) == 3
+
+
+def test_missing_chunk_is_an_error(tmp_path):
+    seg_dir = str(tmp_path / "SEG002")
+    _make_chunks(seg_dir, tmp_path, drop_index=1)
+    d = dl.Downloader(str(tmp_path))
+    # incomplete chunks -> not level 2
+    assert d.check_output_existence_level("SEG002.mp4", "h264", audio=False) == 0
+    with pytest.raises(FileNotFoundError, match="missing chunk"):
+        dl.concat_chunks(seg_dir, "h264", os.path.join(seg_dir, "out.mp4"))
+
+
+class DictStore:
+    """In-memory ChunkStore fake."""
+
+    def __init__(self, tree):
+        self.tree = tree  # {rel_dir: {name: bytes}}
+
+    def exists(self, rel_path):
+        return rel_path in self.tree
+
+    def listdir(self, rel_path):
+        return list(self.tree[rel_path])
+
+    def download(self, rel_path, local_path):
+        rel_dir, name = os.path.split(rel_path)
+        os.makedirs(os.path.dirname(local_path), exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(self.tree[rel_dir][name])
+
+
+def test_remote_resume_level_and_fetch(tmp_path):
+    # build chunks in a staging dir, load them into the fake remote store
+    staging = str(tmp_path / "staging")
+    _make_chunks(staging, tmp_path)
+    tree = {"SEG003": {
+        name: open(os.path.join(staging, name), "rb").read()
+        for name in os.listdir(staging)
+    }}
+    local = tmp_path / "segments"
+    local.mkdir()
+    d = dl.Downloader(str(local), store=DictStore(tree))
+    assert d.check_output_existence_level("SEG003.mp4", "h264", audio=False) == 1
+    d.fetch_remote_chunks("SEG003.mp4", audio=False)
+    assert d.check_output_existence_level("SEG003.mp4", "h264", audio=False) == 2
+    out = d.generate_full_segment("SEG003.mp4", "h264")
+    assert os.path.isfile(out)
+
+
+def test_bitmovin_force_regenerates_from_chunks(tmp_path):
+    """--force must regenerate from chunks, not abort the stage (the cloud
+    re-encode path needs the unavailable SDK)."""
+
+    class Seg:
+        pass
+
+    class QL:
+        audio_bitrate = None
+        video_codec = "h264"
+
+    seg = Seg()
+    seg.quality_level = QL()
+    seg.filename = "SEG004.mp4"
+
+    seg_dir = str(tmp_path / "SEG004")
+    _make_chunks(seg_dir, tmp_path)
+    d = dl.Downloader(str(tmp_path))
+    out = d.encode_bitmovin(seg)
+    assert os.path.isfile(out)
+    out2 = d.encode_bitmovin(seg, overwrite=True)  # regenerates, no raise
+    assert out2 == out and os.path.isfile(out)
+    # no chunks and no final -> clear error about the missing SDK
+    seg.filename = "SEG005.mp4"
+    with pytest.raises(RuntimeError, match="bitmovin-api-sdk"):
+        d.encode_bitmovin(seg)
+
+
+def test_collect_parts_orders_by_index():
+    names = ["x_10.m4s", "x_2.m4s", "x_init.mp4", "x_0.m4s", "x_1.m4s"] + [
+        f"x_{i}.m4s" for i in range(3, 10)
+    ]
+    init, parts = dl._collect_parts(names, "h264", "here")
+    assert init == "x_init.mp4"
+    assert parts[0] == "x_0.m4s" and parts[-1] == "x_10.m4s"
+    assert len(parts) == 11
