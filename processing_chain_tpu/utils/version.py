@@ -29,6 +29,14 @@ def get_processing_chain_version() -> str:
             return result.stdout.strip()
     except OSError:
         pass
+    # VERSION file maintained by release.sh (reference check_requirements
+    # falls back from `git describe` to its VERSION file the same way)
+    version_file = os.path.join(pkg_root, "VERSION")
+    if os.path.isfile(version_file):
+        with open(version_file) as f:
+            content = f.read().strip()
+        if content:
+            return content
     from .. import __version__
 
     return __version__

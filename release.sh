@@ -1,0 +1,55 @@
+#!/bin/bash
+#
+# Release helper (reference release.sh:20-65): bumps VERSION, prepends the
+# commit log since the last tag to CHANGES, commits, and tags. Push is only
+# attempted when a remote exists.
+#
+# Usage:
+#   ./release.sh            # interactive: suggests a patch bump
+#   RELEASE_VERSION=1.2.0 ./release.sh   # non-interactive
+
+set -euo pipefail
+cd "$(dirname "$0")"
+
+if [ ! -f VERSION ]; then
+    echo "0.0.1" > VERSION
+    {
+        echo "Version 0.0.1:"
+        git log --pretty=format:" - %s"
+        echo ""
+        echo ""
+    } > CHANGES
+    git add VERSION CHANGES
+    git commit -m "Added VERSION and CHANGES files, Version bump to v0.0.1"
+    git tag -a -m "Tagging version 0.0.1" "v0.0.1"
+else
+    BASE_STRING=$(cat VERSION)
+    IFS='.' read -r V_MAJOR V_MINOR V_PATCH <<< "$BASE_STRING"
+    SUGGESTED_VERSION="$V_MAJOR.$V_MINOR.$((V_PATCH + 1))"
+    if [ -n "${RELEASE_VERSION:-}" ]; then
+        INPUT_STRING="$RELEASE_VERSION"
+    else
+        echo "Current version : $BASE_STRING"
+        read -r -p "Enter a version number [$SUGGESTED_VERSION]: " INPUT_STRING
+        INPUT_STRING=${INPUT_STRING:-$SUGGESTED_VERSION}
+    fi
+    echo "Will set new version to be $INPUT_STRING"
+    echo "$INPUT_STRING" > VERSION
+    {
+        echo "Version $INPUT_STRING:"
+        git log --pretty=format:" - %s" "v$BASE_STRING"...HEAD
+        echo ""
+        echo ""
+        cat CHANGES 2>/dev/null || true
+    } > CHANGES.tmp
+    mv CHANGES.tmp CHANGES
+    git add CHANGES VERSION
+    git commit -m "Version bump to $INPUT_STRING"
+    git tag -a -m "Tagging version $INPUT_STRING" "v$INPUT_STRING"
+fi
+
+if git remote | grep -q .; then
+    git push && git push origin --tags
+else
+    echo "No git remote configured; skipping push."
+fi
