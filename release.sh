@@ -34,10 +34,17 @@ else
         INPUT_STRING=${INPUT_STRING:-$SUGGESTED_VERSION}
     fi
     echo "Will set new version to be $INPUT_STRING"
+    # VERSION can predate the first tag (it was committed with the initial
+    # tree): fall back to the full log when v$BASE_STRING does not exist
+    if git rev-parse -q --verify "refs/tags/v$BASE_STRING" >/dev/null; then
+        LOG_RANGE="v$BASE_STRING...HEAD"
+    else
+        LOG_RANGE="HEAD"
+    fi
     echo "$INPUT_STRING" > VERSION
     {
         echo "Version $INPUT_STRING:"
-        git log --pretty=format:" - %s" "v$BASE_STRING"...HEAD
+        git log --pretty=format:" - %s" "$LOG_RANGE"
         echo ""
         echo ""
         cat CHANGES 2>/dev/null || true
