@@ -16,6 +16,7 @@ from typing import Optional, Sequence
 from .config.errors import ConfigError
 from .utils import log as log_mod
 from .utils import parse_args as pa
+from .utils import tracing
 from .utils.runner import ChainError
 from .utils.version import check_requirements
 
@@ -30,11 +31,16 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
     from .utils.device import ensure_backend
 
     ensure_backend()
+    tracing_on = getattr(args, "trace", None) is not None
+    profiler = tracing.DeviceProfiler(args.trace or None) if tracing_on else None
+    test_config = None
     try:
+        if profiler is not None:
+            profiler.start()
         if stage is None:
             from .stages import p00_process_all
 
-            p00_process_all.run(args)
+            test_config = p00_process_all.run(args)
         else:
             from .stages import (
                 p01_generate_segments,
@@ -49,10 +55,29 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
                 "p03": p03_generate_avpvs,
                 "p04": p04_generate_cpvs,
             }[stage]
-            mod.run(args)
+            test_config = mod.run(args)
     except (ConfigError, ChainError) as exc:
         log_mod.get_logger().error("%s", exc)
         return 1
+    finally:
+        if profiler is not None:
+            profiler.stop()
+        if tracing_on:
+            tracer = tracing.get_tracer()
+            tracer.log_summary()
+            if test_config is not None:
+                logs_dir = test_config.get_logs_path()
+            else:
+                # stage failed before returning its config — persist next to
+                # the database anyway (default logs/ layout): failed runs are
+                # exactly the ones whose timing matters
+                import os
+
+                logs_dir = os.path.join(
+                    os.path.dirname(os.path.abspath(args.test_config)), "logs"
+                )
+            path = tracer.write_report(logs_dir)
+            log_mod.get_logger().info("timing report: %s", path)
     return 0
 
 

@@ -16,6 +16,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..utils import tracing
 from ..utils.log import get_logger
 from ..utils.runner import ParallelRunner
 from ..utils.version import get_processing_chain_version
@@ -58,7 +59,8 @@ class Job:
                 f.write(f"{key}: {json.dumps(value) if not isinstance(value, str) else value}\n")
 
     def run(self) -> Any:
-        result = self.fn()
+        with tracing.span(self.label, output=os.path.basename(self.output_path)):
+            result = self.fn()
         self.write_provenance()
         return result
 

@@ -16,8 +16,12 @@ device gather + spinner composite (ops/overlay — the bufferer
 re-implementation), with silence inserted into the audio during stalls.
 Frame-freeze HRCs use skipping mode (no spinner, length preserved).
 
-Device work is chunked over CHUNK-frame batches so arbitrarily long PVSes
-stream through bounded HBM.
+Execution model (engine/prefetch, SURVEY.md §7.4): decode runs ahead on a
+worker thread, the main loop does device resizes, and FFV1 encode drains on
+a writer thread — three-stage host↔device overlap in bounded memory, where
+the reference serializes decode→scale→encode inside one ffmpeg process per
+segment. CHUNK-frame batches bound both HBM and host RAM for arbitrarily
+long PVSes.
 """
 
 from __future__ import annotations
@@ -30,10 +34,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.domain import Pvs
+from ..engine import prefetch as pf
 from ..engine.jobs import Job
 from ..io import medialib
 from ..io.video import VideoReader, VideoWriter
-from ..ops import fps as fps_ops
 from ..ops import overlay as ov
 from ..utils.log import get_logger
 from . import frames as fr
@@ -76,24 +80,28 @@ def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
     )
 
 
-def _segment_to_canvas(seg, w: int, h: int, rate: float, pix_fmt: str):
-    """Decode one encoded segment and yield [T,H,W] uint8 plane chunks on
-    the canvas grid/rate (exactly round(duration*rate) frames)."""
+def _segment_canvas_chunks(seg, rate: float):
+    """Decode one encoded segment and yield raw [T,H,W] plane chunks on the
+    canvas time grid (exactly round(duration*rate) frames; trailing outputs
+    repeat the last decoded frame — the reference's nullsrc-canvas
+    semantics, lib/ffmpeg.py:1037-1038). Streaming: never holds more than
+    CHUNK decoded frames."""
     with VideoReader(seg.file_path) as reader:
-        planes = fr.stack_planes(list(reader))
         seg_fps = reader.fps
-    if not planes:
+        n_out = int(round(seg.duration * rate))
+        got_any = False
+        for chunk in pf.stream_monotonic_gather(
+            reader,
+            lambda k: int(np.floor(k / rate * seg_fps + 0.5)),
+            n_out,
+            CHUNK,
+        ):
+            got_any = True
+            yield chunk
+    # a segment whose duration rounds to zero canvas frames legitimately
+    # yields nothing; only a truly frameless source is an error
+    if not got_any and n_out > 0:
         raise medialib.MediaError(f"no frames in segment {seg.file_path}")
-    n = planes[0].shape[0]
-    n_out = int(round(seg.duration * rate))
-    t_out = np.arange(n_out) / rate
-    idx = np.clip(np.floor(t_out * seg_fps + 0.5).astype(np.int64), 0, n - 1)
-    sub = fr.chroma_subsampling(pix_fmt)
-    for start in range(0, n_out, CHUNK):
-        sel = idx[start : start + CHUNK]
-        chunk = [p[sel] for p in planes]
-        scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
-        yield fr.to_uint8(scaled, ten_bit="10" in pix_fmt)
 
 
 def create_avpvs_wo_buffer(
@@ -112,25 +120,33 @@ def create_avpvs_wo_buffer(
     w, h = avpvs_dimensions(pvs)
     pix_fmt = pvs.get_pix_fmt_for_avpvs()
 
+    def _pump(chunks, writer: pf.AsyncWriter) -> None:
+        """Decode-prefetched host chunks → device resize → async encode."""
+        sub = fr.chroma_subsampling(pix_fmt)
+        ten_bit = "10" in pix_fmt
+        with pf.Prefetcher(chunks, depth=2) as pre:
+            for chunk in pre:
+                scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
+                writer.put(fr.quantize_device(scaled, ten_bit))
+
     def run() -> str:
         if tc.is_short():
             # single segment, native segment frame rate unless -z/-f60
             seg = pvs.segments[0]
             with VideoReader(seg.file_path) as reader:
-                planes = fr.stack_planes(list(reader))
                 seg_fps = reader.fps
-            rate = pvs.src.get_fps() if avpvs_src_fps else (60.0 if force_60_fps else seg_fps)
-            n = planes[0].shape[0]
-            if rate != seg_fps:
-                idx = fps_ops.fps_resample_indices(n, seg_fps, rate)
-                planes = [p[idx] for p in planes]
-            sub = fr.chroma_subsampling(pix_fmt)
-            with _ffv1_writer(out_path, w, h, pix_fmt, rate, with_audio=False) as writer:
-                for start in range(0, planes[0].shape[0], CHUNK):
-                    chunk = [p[start : start + CHUNK] for p in planes]
-                    scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
-                    for out in zip(*(np.asarray(p) for p in fr.to_uint8(scaled, "10" in pix_fmt))):
-                        writer.write(*out)
+                rate = pvs.src.get_fps() if avpvs_src_fps else (
+                    60.0 if force_60_fps else seg_fps
+                )
+                chunks = (
+                    pf.stream_fps_resample(reader, seg_fps, rate, CHUNK)
+                    if rate != seg_fps
+                    else pf.iter_plane_chunks(reader, CHUNK)
+                )
+                with pf.AsyncWriter(
+                    _ffv1_writer(out_path, w, h, pix_fmt, rate, with_audio=False)
+                ) as writer:
+                    _pump(chunks, writer)
         else:
             rate = canvas_fps(pvs, avpvs_src_fps)
             total = float(sum(s.get_segment_duration() for s in pvs.segments))
@@ -139,14 +155,15 @@ def create_avpvs_wo_buffer(
             )
             if samples.ndim != 2 or samples.shape[1] != 2:
                 samples = np.repeat(samples.reshape(-1, 1), 2, axis=1)
-            with _ffv1_writer(
-                out_path, w, h, pix_fmt, rate, with_audio=True, sample_rate=srate
+            with pf.AsyncWriter(
+                _ffv1_writer(
+                    out_path, w, h, pix_fmt, rate, with_audio=True,
+                    sample_rate=srate,
+                )
             ) as writer:
                 writer.write_audio(samples)
                 for seg in pvs.segments:
-                    for chunk in _segment_to_canvas(seg, w, h, rate, pix_fmt):
-                        for out in zip(*(np.asarray(p) for p in chunk)):
-                            writer.write(*out)
+                    _pump(_segment_canvas_chunks(seg, rate), writer)
         return out_path
 
     return Job(
@@ -190,12 +207,19 @@ def apply_stalling(
     events = pvs.get_buff_events_media_time()
 
     def run() -> str:
-        with VideoReader(in_path) as reader:
-            planes = fr.stack_planes(list(reader))  # host uint8/uint16
-            rate = reader.fps
-            pix_fmt = reader.pix_fmt
-            w, hgt = reader.width, reader.height
-        n = planes[0].shape[0]
+        with VideoReader(in_path) as probe_reader:
+            rate = probe_reader.fps
+            pix_fmt = probe_reader.pix_fmt
+            w, hgt = probe_reader.width, probe_reader.height
+        # frame count without a decode pass: container metadata, else a
+        # packet scan (FFV1 is intra-only: one packet per frame)
+        vstreams = [
+            s for s in medialib.probe(in_path)["streams"]
+            if s["codec_type"] == "video"
+        ]
+        n = int(vstreams[0].get("nb_frames") or 0) if vstreams else 0
+        if n <= 0:
+            n = len(medialib.scan_packets(in_path, "video")["size"])
         ten_bit = "10" in pix_fmt
         plan = ov.plan_stalling(
             n, rate, events, skipping=skipping, black_frame=True,
@@ -238,39 +262,47 @@ def apply_stalling(
             pieces.append(audio[cursor:])
             audio = np.concatenate([p for p in pieces if len(p)])
 
-        with _ffv1_writer(
-            out_path, w, hgt, pix_fmt, rate,
-            with_audio=audio is not None and audio.size > 0, sample_rate=srate,
+        # stream the output timeline: the plan's source indices are
+        # monotonic nondecreasing (play/freeze/repeat), so one decode pass
+        # feeds the gather in CHUNK-frame batches — decode prefetched
+        # ahead, spinner composite on device, FFV1 writeback on the
+        # writer thread (bounded memory for arbitrarily long PVSes)
+        with VideoReader(in_path) as reader, pf.AsyncWriter(
+            _ffv1_writer(
+                out_path, w, hgt, pix_fmt, rate,
+                with_audio=audio is not None and audio.size > 0,
+                sample_rate=srate,
+            )
         ) as writer:
             if audio is not None and audio.size:
                 writer.write_audio(audio)
-            # stream the output timeline in CHUNK-frame device batches so
-            # long PVSes stay within bounded HBM (input stays host uint8;
-            # each batch gathers its own source frames)
-            for start in range(0, plan.n_out, CHUNK):
-                sel = plan.src_idx[start : start + CHUNK]
-                # gather source frames on host; batch-local plan indices
-                sub = ov.StallPlan(
-                    src_idx=np.arange(len(sel), dtype=np.int32),
-                    stall_mask=plan.stall_mask[start : start + CHUNK],
-                    black_mask=plan.black_mask[start : start + CHUNK],
-                    phase=plan.phase[start : start + CHUNK],
-                )
-                y = jnp.asarray(planes[0][sel], jnp.float32)
-                u = jnp.asarray(planes[1][sel], jnp.float32)
-                v = jnp.asarray(planes[2][sel], jnp.float32)
-                oy = ov.render_stalled_plane(
-                    y, sub, sp_y, sa, black_value=16.0 * depth_scale
-                )
-                ou = ov.render_stalled_plane(
-                    u, sub, sp_u, sa_c, black_value=128.0 * depth_scale
-                )
-                ovv = ov.render_stalled_plane(
-                    v, sub, sp_v, sa_c, black_value=128.0 * depth_scale
-                )
-                outs = fr.to_uint8([oy, ou, ovv], ten_bit)
-                for i in range(outs[0].shape[0]):
-                    writer.write(*(np.asarray(p[i]) for p in outs))
+            chunks = pf.stream_monotonic_gather(
+                reader, lambda k: int(plan.src_idx[k]), plan.n_out, CHUNK
+            )
+            with pf.Prefetcher(chunks, depth=2) as pre:
+                for chunk_no, gathered in enumerate(pre):
+                    start = chunk_no * CHUNK
+                    sel_len = gathered[0].shape[0]
+                    # batch-local plan over the pre-gathered frames
+                    sub = ov.StallPlan(
+                        src_idx=np.arange(sel_len, dtype=np.int32),
+                        stall_mask=plan.stall_mask[start : start + sel_len],
+                        black_mask=plan.black_mask[start : start + sel_len],
+                        phase=plan.phase[start : start + sel_len],
+                    )
+                    y = jnp.asarray(gathered[0], jnp.float32)
+                    u = jnp.asarray(gathered[1], jnp.float32)
+                    v = jnp.asarray(gathered[2], jnp.float32)
+                    oy = ov.render_stalled_plane(
+                        y, sub, sp_y, sa, black_value=16.0 * depth_scale
+                    )
+                    ou = ov.render_stalled_plane(
+                        u, sub, sp_u, sa_c, black_value=128.0 * depth_scale
+                    )
+                    ovv = ov.render_stalled_plane(
+                        v, sub, sp_v, sa_c, black_value=128.0 * depth_scale
+                    )
+                    writer.put(fr.quantize_device([oy, ou, ovv], ten_bit))
         return out_path
 
     return Job(

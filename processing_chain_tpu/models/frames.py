@@ -86,6 +86,23 @@ def chroma_subsampling(pix_fmt: str) -> tuple[int, int]:
     return (1, 1)
 
 
+def quantize_device(planes: list, ten_bit: bool = False) -> list[jnp.ndarray]:
+    """Round/clip device float planes to the container bit depth *on
+    device*, so the host transfer moves uint8/uint16 (¼ the bytes of
+    float32) and can happen off-thread (engine.prefetch.AsyncWriter)."""
+    hi, dt = (1023.0, jnp.uint16) if ten_bit else (255.0, jnp.uint8)
+    out = []
+    for p in planes:
+        if p.dtype == dt:
+            out.append(p)
+        elif p.dtype in (jnp.uint8, jnp.uint16):
+            # saturate, never wrap, on a narrowing integer cast
+            out.append(jnp.clip(p.astype(jnp.int32), 0, int(hi)).astype(dt))
+        else:
+            out.append(jnp.clip(jnp.floor(p + 0.5), 0, hi).astype(dt))
+    return out
+
+
 def to_uint8(planes: list, ten_bit: bool = False) -> list[np.ndarray]:
     """Device float/int planes → host numpy in the container bit depth."""
     out = []

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -215,13 +216,30 @@ def resize_plane(
                  f32 accumulation order differs, so round-half-up ties can
                  land one code value away (measured ≤1 LSB on ~4 px per
                  million vs "gather").
+      "fused"  — the Pallas two-pass kernel (pallas_kernels.resize_frames_
+                 fused): both passes in VMEM, no HBM intermediate. TPU only,
+                 [T, H, W] integer input, quantized output.
       "auto"   — "banded" on TPU (where the MXU pays for it), "gather"
-                 elsewhere.
+                 elsewhere; override with PC_RESIZE_METHOD=gather|banded|fused.
     """
     if method == "auto":
-        method = "banded" if jax.default_backend() == "tpu" else "gather"
+        method = os.environ.get("PC_RESIZE_METHOD") or (
+            "banded" if jax.default_backend() == "tpu" else "gather"
+        )
     src_h, src_w = x.shape[-2], x.shape[-1]
     integer_in = jnp.issubdtype(x.dtype, jnp.integer)
+    if method == "fused" and (src_h, src_w) != (dst_h, dst_w):
+        if x.ndim != 3 or not integer_in or not quantize_output:
+            raise ValueError(
+                "method='fused' needs [T, H, W] integer input with "
+                "quantize_output (got shape %r, dtype %s)" % (x.shape, x.dtype)
+            )
+        from . import pallas_kernels  # deferred: pallas_kernels imports us
+
+        return pallas_kernels.resize_frames_fused(
+            x, dst_h, dst_w, kernel,
+            interpret=not pallas_kernels.pallas_available(),
+        )
     xf = x.astype(jnp.float32)
     if (src_h, src_w) != (dst_h, dst_w):
         if method == "banded":

@@ -2,21 +2,87 @@
 
 The failure-detection analog of the reference's fail-fast subprocess model
 (SURVEY.md §5): the chain should degrade to the CPU backend with a warning
-when the configured accelerator backend cannot initialize (e.g. the TPU
-tunnel is down), instead of crashing every stage.
+when the configured accelerator backend cannot initialize, instead of
+crashing (or hanging) every stage.
+
+A wedged accelerator transport (e.g. a TPU tunnel that accepts the
+connection but never completes PJRT client creation) blocks *inside*
+native code — no exception ever surfaces, so a try/except around
+jax.devices() cannot catch it. The only safe probe is a disposable
+subprocess with a deadline; if it doesn't come back healthy, the parent
+deregisters the accelerator plugin and pins the CPU platform *before*
+its own (lazy) backend initialization runs.
 """
 
 from __future__ import annotations
 
+import os
+import subprocess
+import sys
+
 from .log import get_logger
 
 _checked = False
+PROBE_TIMEOUT_S = float(os.environ.get("PC_BACKEND_PROBE_TIMEOUT", "45"))
 
 
-def ensure_backend() -> str:
-    """Initialize the JAX backend, falling back to CPU if the configured
-    platform is unavailable. Returns the platform name in use."""
+def _probe_backend(timeout_s: float) -> str:
+    """Initialize JAX in a throwaway subprocess; return the platform name
+    it reached, or '' if it failed or hung past the deadline."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return ""
+    if proc.returncode != 0:
+        return ""
+    return proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+
+
+def _force_cpu() -> None:
+    """Pin the cpu platform and deregister non-cpu PJRT plugin factories so
+    nothing can touch the wedged transport when backends initialize."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:  # private API: harmless to skip if a jax upgrade moves it
+        from jax._src import xla_bridge as xb
+
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_backend(probe_timeout_s: float = PROBE_TIMEOUT_S) -> str:
+    """Initialize the JAX backend, falling back to CPU when the configured
+    accelerator is unavailable OR unresponsive. Returns the platform in use.
+    """
     global _checked
+    if _checked:
+        import jax
+
+        return jax.devices()[0].platform
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # explicit CPU request: still deregister accelerator plugins —
+        # a site-registered plugin wrapper can hijack backend init (and
+        # hang on its transport) even when only cpu was asked for
+        _force_cpu()
+    else:
+        platform = _probe_backend(probe_timeout_s)
+        if not platform:
+            get_logger().warning(
+                "accelerator backend failed or did not respond within %.0fs; "
+                "falling back to CPU", probe_timeout_s,
+            )
+            _force_cpu()
+
     import jax
 
     try:
@@ -27,8 +93,8 @@ def ensure_backend() -> str:
         get_logger().warning(
             "accelerator backend unavailable (%s); falling back to CPU", exc
         )
+        _force_cpu()
         try:
-            jax.config.update("jax_platforms", "cpu")
             devs = jax.devices()
             _checked = True
             return devs[0].platform

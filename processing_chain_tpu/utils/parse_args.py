@@ -100,6 +100,11 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
         "--skip-requirements", action="store_true",
         help="continue running even if requirements are not fulfilled",
     )
+    parser.add_argument(
+        "--trace", nargs="?", const="", default=None, metavar="DIR",
+        help="record per-op timing spans to the database logs/ folder; "
+        "with DIR, also capture a jax.profiler device trace there",
+    )
     return parser
 
 

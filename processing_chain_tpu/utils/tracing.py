@@ -1,0 +1,186 @@
+"""Tracing / profiling subsystem (SURVEY.md §5).
+
+The reference has no tracing at all — its closest artifacts are the
+per-command INFO/DEBUG lines (reference lib/cmd_utils.py:82-83) and the
+provenance .log files (reference p01:89-92, p03:41-59). This module adds
+what SURVEY.md §5 prescribes for the new framework: JAX profiler traces
+plus per-op wall-time spans tied to the same provenance-log concept.
+
+Usage:
+    with tracing.span("avpvs P2SXM00_SRC000_HRC000"):
+        ...
+    tracing.write_report(db_logs_path)       # logs/trace_<ts>.json
+
+`--trace DIR` on any stage CLI additionally captures a TensorBoard-loadable
+XLA device trace via jax.profiler (viewable with xprof/perfetto).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from .log import get_logger
+
+
+@dataclass
+class Span:
+    name: str
+    start: float
+    duration: float
+    thread: str
+    depth: int
+    meta: dict = field(default_factory=dict)
+
+
+class Tracer:
+    """Thread-safe span recorder. Spans nest per-thread (depth tracks the
+    nesting so reports can indent); recording is cheap enough to leave on —
+    a report is only materialized on demand."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+        self._local = threading.local()
+        self._t0 = time.perf_counter()
+        self.enabled = True
+
+    @contextmanager
+    def span(self, name: str, **meta) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - start
+            self._local.depth = depth
+            with self._lock:
+                self._spans.append(
+                    Span(
+                        name=name,
+                        start=start - self._t0,
+                        duration=dur,
+                        thread=threading.current_thread().name,
+                        depth=depth,
+                        meta=meta,
+                    )
+                )
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+        self._t0 = time.perf_counter()
+
+    def summary(self) -> dict[str, dict]:
+        """Aggregate by span name: {name: {count, total_s, max_s}}."""
+        agg: dict[str, dict] = {}
+        for s in self.spans():
+            entry = agg.setdefault(s.name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            entry["count"] += 1
+            entry["total_s"] += s.duration
+            entry["max_s"] = max(entry["max_s"], s.duration)
+        for entry in agg.values():
+            entry["total_s"] = round(entry["total_s"], 4)
+            entry["max_s"] = round(entry["max_s"], 4)
+        return agg
+
+    def write_report(self, logs_dir: str, name: str = "") -> str:
+        """Write spans + summary as JSON next to the provenance logs.
+        Returns the report path."""
+        os.makedirs(logs_dir, exist_ok=True)
+        stamp = name or time.strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(logs_dir, f"trace_{stamp}.json")
+        payload = {
+            "summary": self.summary(),
+            "spans": [
+                {
+                    "name": s.name,
+                    "start_s": round(s.start, 4),
+                    "duration_s": round(s.duration, 4),
+                    "thread": s.thread,
+                    "depth": s.depth,
+                    **({"meta": s.meta} if s.meta else {}),
+                }
+                for s in self.spans()
+            ],
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+        return path
+
+    def log_summary(self) -> None:
+        log = get_logger()
+        agg = sorted(self.summary().items(), key=lambda kv: -kv[1]["total_s"])
+        if not agg:
+            return
+        log.info("timing summary (top %d by total):", min(len(agg), 15))
+        for name, e in agg[:15]:
+            log.info(
+                "  %-48s %5dx  total %8.3fs  max %7.3fs",
+                name[:48], e["count"], e["total_s"], e["max_s"],
+            )
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def span(name: str, **meta):
+    return _tracer.span(name, **meta)
+
+
+class DeviceProfiler:
+    """jax.profiler capture — writes a TensorBoard/xprof trace of actual
+    device (TPU) activity to `trace_dir`. No-ops cleanly if the profiler
+    cannot start (e.g. no device runtime in a unit-test environment)."""
+
+    def __init__(self, trace_dir: Optional[str]) -> None:
+        self.trace_dir = trace_dir
+        self._active = False
+
+    def start(self) -> None:
+        if not self.trace_dir:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+            get_logger().info("device trace capturing to %s", self.trace_dir)
+        except Exception as exc:  # pragma: no cover - depends on runtime
+            get_logger().warning("device trace unavailable: %s", exc)
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            get_logger().info("device trace written to %s", self.trace_dir)
+        except Exception as exc:  # pragma: no cover
+            get_logger().warning("device trace stop failed: %s", exc)
+        self._active = False
+
+    def __enter__(self) -> "DeviceProfiler":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,6 +1,7 @@
 """Device kernel tests (run on the CPU backend via conftest; identical XLA
 semantics to TPU modulo float association order)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -333,3 +334,41 @@ def test_chroma_420_422_shapes():
     assert u2.shape == (108, 96)
     u3, v3 = pixfmt.chroma_422_to_420(u2, v2)
     assert u3.shape == (54, 96)
+
+
+def test_resize_plane_fused_method_routing(monkeypatch):
+    """method='fused' (and PC_RESIZE_METHOD=fused under 'auto') routes
+    through the Pallas kernel; non-3D/float inputs are rejected."""
+    from processing_chain_tpu.ops import resize
+
+    rng = np.random.default_rng(5)
+    src = jnp.asarray(rng.integers(0, 255, (2, 40, 64), np.uint8))
+    direct = np.asarray(resize.resize_plane(src, 80, 128, "bicubic", method="fused"))
+    banded = np.asarray(resize.resize_plane(src, 80, 128, "bicubic", method="banded"))
+    assert direct.dtype == np.uint8
+    assert np.mean(np.abs(direct.astype(int) - banded.astype(int))) < 0.01
+
+    monkeypatch.setenv("PC_RESIZE_METHOD", "fused")
+    via_env = np.asarray(resize.resize_plane(src, 80, 128, "bicubic", method="auto"))
+    np.testing.assert_array_equal(via_env, direct)
+
+    with pytest.raises(ValueError, match="fused"):
+        resize.resize_plane(src.astype(jnp.float32), 80, 128, method="fused")
+    with pytest.raises(ValueError, match="fused"):
+        resize.resize_plane(src[0], 80, 128, method="fused")
+
+
+def test_quantize_device_saturates_not_wraps():
+    from processing_chain_tpu.models import frames as fr
+
+    ten = jnp.asarray(np.array([[300, 80]], np.uint16))
+    out8 = np.asarray(fr.quantize_device([ten], ten_bit=False)[0])
+    assert out8.dtype == np.uint8
+    assert list(out8[0]) == [255, 80]  # saturate, not 300 % 256
+    eight = jnp.asarray(np.array([[200, 7]], np.uint8))
+    out16 = np.asarray(fr.quantize_device([eight], ten_bit=True)[0])
+    assert out16.dtype == np.uint16
+    assert list(out16[0]) == [200, 7]
+    flt = jnp.asarray(np.array([[1500.0, -3.0, 99.5]], np.float32))
+    out10 = np.asarray(fr.quantize_device([flt], ten_bit=True)[0])
+    assert list(out10[0]) == [1023, 0, 100]

@@ -1,0 +1,200 @@
+"""Tests for the async prefetch pipeline (engine/prefetch) and the
+tracing subsystem (utils/tracing)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu.engine import prefetch as pf
+from processing_chain_tpu.ops import fps as fps_ops
+from processing_chain_tpu.utils import tracing
+
+
+class FakeFrame:
+    def __init__(self, value, shape=(4, 6)):
+        self.planes = (
+            np.full(shape, value, np.uint8),
+            np.full((shape[0] // 2, shape[1] // 2), value, np.uint8),
+        )
+
+
+def _first_plane_ids(chunks):
+    out = []
+    for chunk in chunks:
+        out.extend(int(v) for v in chunk[0][:, 0, 0])
+    return out
+
+
+def test_prefetcher_preserves_order_and_values():
+    items = list(range(57))
+    got = list(pf.Prefetcher(iter(items), depth=3))
+    assert got == items
+
+
+def test_prefetcher_transform_runs_on_worker():
+    main = threading.get_ident()
+    seen = []
+
+    def transform(x):
+        seen.append(threading.get_ident())
+        return x * 2
+
+    got = list(pf.Prefetcher(range(5), depth=2, transform=transform))
+    assert got == [0, 2, 4, 6, 8]
+    assert all(t != main for t in seen)
+
+
+def test_prefetcher_propagates_source_error():
+    def source():
+        yield 1
+        raise ValueError("decode failed")
+
+    pre = pf.Prefetcher(source(), depth=2)
+    it = iter(pre)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="decode failed"):
+        list(it)
+
+
+def test_prefetcher_close_stops_worker():
+    produced = []
+
+    def source():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    pre = pf.Prefetcher(source(), depth=2)
+    next(iter(pre))
+    pre.close()
+    n = len(produced)
+    time.sleep(0.05)
+    assert len(produced) == n  # worker stopped pulling
+
+
+class RecordingWriter:
+    def __init__(self):
+        self.frames = []
+        self.audio = None
+        self.closed = False
+
+    def write(self, *planes):
+        self.frames.append([p.copy() for p in planes])
+
+    def write_audio(self, samples):
+        self.audio = samples
+
+    def close(self):
+        self.closed = True
+
+
+def test_async_writer_writes_all_frames_in_order():
+    rec = RecordingWriter()
+    with pf.AsyncWriter(rec, depth=2) as w:
+        for base in (0, 8):
+            chunk = [
+                np.arange(base, base + 8, dtype=np.uint8).reshape(8, 1, 1)
+                + np.zeros((8, 2, 3), np.uint8),
+                np.arange(base, base + 8, dtype=np.uint8).reshape(8, 1, 1)
+                + np.zeros((8, 1, 2), np.uint8),
+            ]
+            w.put(chunk)
+    assert rec.closed
+    assert len(rec.frames) == 16
+    assert [int(f[0][0, 0]) for f in rec.frames] == list(range(16))
+    assert rec.frames[3][0].shape == (2, 3)
+    assert rec.frames[3][1].shape == (1, 2)
+
+
+def test_async_writer_reraises_write_error():
+    class FailingWriter(RecordingWriter):
+        def write(self, *planes):
+            raise IOError("disk full")
+
+    w = pf.AsyncWriter(FailingWriter(), depth=2)
+    w.put([np.zeros((2, 4, 4), np.uint8)])
+    with pytest.raises(IOError, match="disk full"):
+        w.close()
+
+
+def test_iter_plane_chunks_boundaries():
+    frames = [FakeFrame(i) for i in range(10)]
+    chunks = list(pf.iter_plane_chunks(frames, chunk=4))
+    assert [c[0].shape[0] for c in chunks] == [4, 4, 2]
+    assert _first_plane_ids(chunks) == list(range(10))
+    assert chunks[0][1].shape == (4, 2, 3)  # chroma plane stacked too
+
+
+@pytest.mark.parametrize("src_fps,dst_fps", [(24, 60), (60, 24), (30, 30), (24, 25)])
+def test_stream_fps_resample_matches_index_plan(src_fps, dst_fps):
+    n = 48
+    frames = [FakeFrame(i) for i in range(n)]
+    idx = fps_ops.fps_resample_indices(n, src_fps, dst_fps)
+    got = _first_plane_ids(pf.stream_fps_resample(frames, src_fps, dst_fps, chunk=7))
+    assert got == [i % 256 for i in idx]
+
+
+def test_stream_monotonic_gather_repeats_and_skips():
+    frames = [FakeFrame(i) for i in range(6)]
+    # repeats (stall), skips (drop), and past-the-end clamping
+    idx = [0, 0, 1, 3, 3, 5, 9, 9]
+    got = _first_plane_ids(
+        pf.stream_monotonic_gather(frames, lambda k: idx[k], len(idx), chunk=3)
+    )
+    assert got == [0, 0, 1, 3, 3, 5, 5, 5]
+
+
+def test_stream_monotonic_gather_empty_source():
+    assert list(pf.stream_monotonic_gather([], lambda k: 0, 5)) == []
+
+
+def test_tracer_spans_nest_and_aggregate():
+    tracer = tracing.Tracer()
+    with tracer.span("outer"):
+        with tracer.span("inner"):
+            pass
+        with tracer.span("inner"):
+            pass
+    spans = tracer.spans()
+    by_name = {s.name: s for s in spans}
+    assert by_name["outer"].depth == 0
+    assert by_name["inner"].depth == 1
+    summary = tracer.summary()
+    assert summary["inner"]["count"] == 2
+    assert summary["outer"]["count"] == 1
+    assert summary["outer"]["total_s"] >= 0
+
+
+def test_tracer_threaded_spans_do_not_interleave_depth():
+    tracer = tracing.Tracer()
+
+    def work(name):
+        with tracer.span(name):
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=work, args=(f"t{i}",)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(s.depth == 0 for s in tracer.spans())
+    assert len(tracer.spans()) == 4
+
+
+def test_tracer_report_file(tmp_path):
+    tracer = tracing.Tracer()
+    with tracer.span("job x", output="a.avi"):
+        pass
+    path = tracer.write_report(str(tmp_path / "logs"), name="unit")
+    import json
+
+    payload = json.load(open(path))
+    assert payload["summary"]["job x"]["count"] == 1
+    assert payload["spans"][0]["meta"] == {"output": "a.avi"}
+
+
+def test_device_profiler_noops_without_dir():
+    with tracing.DeviceProfiler(None):
+        pass
