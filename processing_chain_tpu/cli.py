@@ -76,14 +76,21 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
                 logs_dir = os.path.join(
                     os.path.dirname(os.path.abspath(args.test_config)), "logs"
                 )
-            path = tracer.write_report(logs_dir)
-            log_mod.get_logger().info("timing report: %s", path)
+            try:
+                path = tracer.write_report(logs_dir)
+                log_mod.get_logger().info("timing report: %s", path)
+            except OSError as exc:
+                # never let report persistence replace the run's own
+                # outcome (exit code or original exception)
+                log_mod.get_logger().warning(
+                    "could not write timing report to %s: %s", logs_dir, exc
+                )
     return 0
 
 
 def _dispatch_tool(argv: Sequence[str]) -> int:
     """`tools <name> …` subcommands (reference util/ scripts)."""
-    tools = ("src-analysis", "complexity", "plots")
+    tools = ("src-analysis", "complexity", "plots", "metrics")
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
         return 2
@@ -98,10 +105,18 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import complexity
 
             return complexity.main(rest)
+        if name == "metrics":
+            from .utils.device import ensure_backend
+
+            ensure_backend()
+            from .tools import quality_metrics
+
+            return quality_metrics.main(rest)
         from .tools import plots
 
         return plots.main(rest)
-    except (OSError, ValueError, KeyError, ChainError) as exc:
+    except (OSError, ValueError, KeyError, RuntimeError) as exc:
+        # ConfigError ⊂ ValueError; ChainError/MediaError ⊂ RuntimeError
         log_mod.get_logger().error("tools %s: %s", name, exc)
         return 1
 

@@ -1,0 +1,177 @@
+"""Full-reference quality metrics tool: per-frame PSNR / SSIM / SI / TI of a
+PVS's AVPVS against its SRC, computed on device.
+
+Fills the role of the libvmaf build the reference carries but never invokes
+(reference Dockerfile:38-43, install_ffmpeg.sh:61 — `--enable-libvmaf`
+compiled into ffmpeg, no chain code calls it): pixel-model features over the
+AVPVS artifacts (BASELINE.json config 4). Where vmaf is a CPU filter over
+decoded frames, here both clips stream through the decode-prefetch pipeline
+and every metric is a vmapped device kernel (ops/metrics, ops/siti).
+
+Output: `<sideInfo>/<pvs_id>.metrics.csv` with one row per AVPVS frame:
+frame, psnr_y, psnr_u, psnr_v, ssim_y, si, ti. Identical frames give
+100 dB PSNR (ops/metrics clamps instead of emitting inf, so the CSV stays
+finite and averageable). 10-bit planes are normalized to the 8-bit scale
+before comparison, so mixed-depth AVPVS-vs-SRC pairs score correctly.
+
+CLI: `python -m processing_chain_tpu tools metrics -c DB/DB.yaml
+[--filter-pvs …] [-p N] [-f]`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..config import TestConfig
+from ..config.domain import Pvs
+from ..engine import prefetch as pf
+from ..io import medialib
+from ..io.video import VideoReader
+from ..ops import metrics as metrics_ops
+from ..ops import resize as resize_ops
+from ..ops import siti as siti_ops
+from ..utils import tracing
+from ..utils.log import get_logger
+
+CHUNK = 32
+
+
+def _paired_chunks(
+    deg: VideoReader, ref: VideoReader, chunk: int = CHUNK
+) -> Iterator[tuple[list[np.ndarray], list[np.ndarray]]]:
+    """Yield ((deg_y, deg_u, deg_v), (ref_y, ref_u, ref_v)) chunk pairs on
+    the AVPVS timeline: SRC frame for output k is the one at media time
+    k / avpvs_rate (monotonic index → single streaming decode of both)."""
+    rate = deg.fps
+    src_fps = ref.fps
+    deg_it = pf.iter_plane_chunks(deg, chunk)
+    # n_out unknown up front (follow the AVPVS stream); gather the SRC
+    # lazily and stop when the AVPVS side ends
+    ref_it = pf.stream_monotonic_gather(
+        ref,
+        lambda k: int(np.floor(k / rate * src_fps + 0.5)),
+        10**9,  # effectively unbounded; the AVPVS side stops us
+        chunk,
+    )
+    for deg_chunk in deg_it:
+        ref_chunk = next(ref_it, None)
+        if ref_chunk is None:
+            break
+        n = min(deg_chunk[0].shape[0], ref_chunk[0].shape[0])
+        yield (
+            [p[:n] for p in deg_chunk],
+            [p[:n] for p in ref_chunk],
+        )
+
+
+def compute_pvs_metrics(
+    pvs: Pvs, force: bool = False, out_dir: Optional[str] = None
+) -> Optional[str]:
+    """Write `<pvs_id>.metrics.csv`; returns the path (None if skipped)."""
+    import jax.numpy as jnp
+    import pandas as pd
+
+    tc = pvs.test_config
+    avpvs_path = pvs.get_avpvs_file_path()
+    if not os.path.isfile(avpvs_path):
+        raise medialib.MediaError(
+            f"AVPVS for {pvs.pvs_id} does not exist — run p03 first: {avpvs_path}"
+        )
+    out_dir = out_dir or tc.get_side_information_path()
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, pvs.pvs_id + ".metrics.csv")
+    if os.path.isfile(out_path) and not force:
+        get_logger().warning(
+            "file %s already exists, not overwriting. Use -f/--force to "
+            "force overwriting", out_path,
+        )
+        return None
+
+    rows = {k: [] for k in ("psnr_y", "psnr_u", "psnr_v", "ssim_y", "si", "ti")}
+    prev_last = None  # last deg luma of the previous chunk (TI continuity)
+    with tracing.span(f"metrics {pvs.pvs_id}"), VideoReader(
+        avpvs_path
+    ) as deg_reader, VideoReader(pvs.src.file_path) as ref_reader:
+        dh, dw = deg_reader.height, deg_reader.width
+        # 10-bit planes decode as uint16 in 0..1023: bring both clips onto
+        # the 8-bit scale so peak=255 PSNR and SSIM constants are correct
+        # for every depth pairing
+        deg_scale = 0.25 if deg_reader.dtype == np.uint16 else 1.0
+        ref_scale = 0.25 if ref_reader.dtype == np.uint16 else 1.0
+        with pf.Prefetcher(
+            _paired_chunks(deg_reader, ref_reader), depth=2
+        ) as pre:
+            for deg_chunk, ref_chunk in pre:
+                dy = jnp.asarray(deg_chunk[0]).astype(jnp.float32) * deg_scale
+                du = jnp.asarray(deg_chunk[1]).astype(jnp.float32) * deg_scale
+                dv = jnp.asarray(deg_chunk[2]).astype(jnp.float32) * deg_scale
+                # SRC on the AVPVS grid (device resize when dims differ)
+                ry = resize_ops.resize_frames(
+                    jnp.asarray(ref_chunk[0]).astype(jnp.float32) * ref_scale,
+                    dh, dw, "bicubic",
+                )
+                ru = resize_ops.resize_frames(
+                    jnp.asarray(ref_chunk[1]).astype(jnp.float32) * ref_scale,
+                    du.shape[-2], du.shape[-1], "bicubic",
+                )
+                rv = resize_ops.resize_frames(
+                    jnp.asarray(ref_chunk[2]).astype(jnp.float32) * ref_scale,
+                    dv.shape[-2], dv.shape[-1], "bicubic",
+                )
+
+                rows["psnr_y"].append(np.asarray(metrics_ops.psnr_frames(ry, dy)))
+                rows["psnr_u"].append(np.asarray(metrics_ops.psnr_frames(ru, du)))
+                rows["psnr_v"].append(np.asarray(metrics_ops.psnr_frames(rv, dv)))
+                rows["ssim_y"].append(np.asarray(metrics_ops.ssim_frames(ry, dy)))
+                rows["si"].append(np.asarray(siti_ops.si_frames(dy)))
+                ti = np.asarray(siti_ops.ti_frames(dy))
+                if prev_last is not None:
+                    # TI continuity across chunk boundaries
+                    ti = ti.copy()
+                    ti[0] = float(jnp.std(dy[0] - prev_last))
+                rows["ti"].append(ti)
+                prev_last = dy[-1]
+
+    table = {k: np.concatenate(v) if v else np.empty(0) for k, v in rows.items()}
+    n = len(table["psnr_y"])
+    df = pd.DataFrame({"frame": np.arange(n), **table})
+    df.to_csv(out_path, index=False, float_format="%.5f")
+    get_logger().info("wrote %s (%d frames)", out_path, n)
+    return out_path
+
+
+def run(
+    config_path: str,
+    filter_pvses: Optional[str] = None,
+    force: bool = False,
+    prober=None,
+) -> list[str]:
+    tc = TestConfig(config_path, filter_pvses=filter_pvses, prober=prober)
+    written = []
+    for pvs in tc.pvses.values():
+        path = compute_pvs_metrics(pvs, force=force)
+        if path:
+            written.append(path)
+    return written
+
+
+def build_parser(
+    parser: Optional[argparse.ArgumentParser] = None,
+) -> argparse.ArgumentParser:
+    parser = parser or argparse.ArgumentParser(
+        description="per-frame PSNR/SSIM/SI/TI of AVPVS files vs their SRC"
+    )
+    parser.add_argument("-c", "--test-config", required=True)
+    parser.add_argument("-f", "--force", action="store_true")
+    parser.add_argument("--filter-pvs", help="only these PVS-IDs ('|'-separated)")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    run(args.test_config, filter_pvses=args.filter_pvs, force=args.force)
+    return 0

@@ -250,3 +250,138 @@ def test_plot_default_name_uses_splitext(tmp_path):
     os.rename(cfg, yml)
     plots.plot_long(yml)
     assert os.path.isfile(str(tmp_path / "design.svg"))
+
+
+# ------------------------------------------------------- quality metrics
+
+
+def test_quality_metrics_identical_and_degraded(tmp_path):
+    """PSNR caps at 100/SSIM 1 for an identical pair; a noisy 'AVPVS' scores
+    strictly lower. Uses the reference's duck-typed-fake pattern
+    (reference util/complexity_classification.py:40-47)."""
+    from processing_chain_tpu.tools import quality_metrics as qm
+
+    rng = np.random.default_rng(3)
+    h, w, n = 96, 128, 10
+    frames = rng.integers(16, 235, size=(n, h, w), dtype=np.uint8)
+
+    def write(path, arr):
+        from processing_chain_tpu.io.video import VideoWriter
+
+        with VideoWriter(str(path), "ffv1", w, h, "yuv420p", (24, 1)) as wr:
+            for f in arr:
+                wr.write(
+                    f,
+                    np.full((h // 2, w // 2), 128, np.uint8),
+                    np.full((h // 2, w // 2), 128, np.uint8),
+                )
+
+    src = tmp_path / "src.avi"
+    write(src, frames)
+    clean = tmp_path / "clean.avi"
+    write(clean, frames)
+    noisy_arr = np.clip(
+        frames.astype(int) + rng.integers(-25, 25, frames.shape), 0, 255
+    ).astype(np.uint8)
+    noisy = tmp_path / "noisy.avi"
+    write(noisy, noisy_arr)
+
+    class FakeTc:
+        def get_side_information_path(self):
+            return str(tmp_path / "sideInfo")
+
+    class FakeSrc:
+        file_path = str(src)
+
+    class FakePvs:
+        test_config = FakeTc()
+        src = FakeSrc()
+
+        def __init__(self, pvs_id, avpvs):
+            self.pvs_id = pvs_id
+            self._avpvs = str(avpvs)
+
+        def get_avpvs_file_path(self):
+            return self._avpvs
+
+    out_clean = qm.compute_pvs_metrics(FakePvs("DB_S_H0", clean))
+    out_noisy = qm.compute_pvs_metrics(FakePvs("DB_S_H1", noisy))
+    dfc = pd.read_csv(out_clean)
+    dfn = pd.read_csv(out_noisy)
+    assert len(dfc) == n and len(dfn) == n
+    assert (dfc.psnr_y == 100.0).all()
+    assert (dfc.ssim_y > 0.9999).all()
+    assert dfc.ti.iloc[0] == 0.0
+    assert (dfn.psnr_y < 40).all() and (dfn.psnr_y > 10).all()
+    assert (dfn.ssim_y < dfc.ssim_y).all()
+    # SI/TI computed on the degraded clip itself, nonzero for noise
+    assert (dfn.si > 0).all()
+    assert (dfn.ti.iloc[1:] > 0).all()
+
+    # memoization: second call without force skips
+    assert qm.compute_pvs_metrics(FakePvs("DB_S_H0", clean)) is None
+
+
+def test_quality_metrics_missing_avpvs_raises(tmp_path):
+    from processing_chain_tpu.io.medialib import MediaError
+    from processing_chain_tpu.tools import quality_metrics as qm
+
+    class FakeTc:
+        def get_side_information_path(self):
+            return str(tmp_path)
+
+    class FakePvs:
+        test_config = FakeTc()
+        pvs_id = "DB_S_H9"
+        src = None
+
+        def get_avpvs_file_path(self):
+            return str(tmp_path / "missing.avi")
+
+    with pytest.raises(MediaError, match="run p03 first"):
+        qm.compute_pvs_metrics(FakePvs())
+
+
+def test_quality_metrics_mixed_bit_depth(tmp_path):
+    """A 10-bit AVPVS carrying the same content as an 8-bit SRC (values×4)
+    must score as identical: depths are normalized to one scale before
+    PSNR/SSIM."""
+    from processing_chain_tpu.io.video import VideoWriter
+    from processing_chain_tpu.tools import quality_metrics as qm
+
+    rng = np.random.default_rng(7)
+    h, w, n = 48, 64, 6
+    y8 = rng.integers(16, 235, (n, h, w), np.uint8)
+
+    src = tmp_path / "src.avi"
+    with VideoWriter(str(src), "ffv1", w, h, "yuv420p", (24, 1)) as wr:
+        for f in y8:
+            wr.write(f, np.full((h // 2, w // 2), 128, np.uint8),
+                     np.full((h // 2, w // 2), 118, np.uint8))
+    ten = tmp_path / "ten.avi"
+    with VideoWriter(str(ten), "ffv1", w, h, "yuv420p10le", (24, 1)) as wr:
+        for f in y8:
+            wr.write(f.astype(np.uint16) * 4,
+                     np.full((h // 2, w // 2), 512, np.uint16),
+                     np.full((h // 2, w // 2), 472, np.uint16))
+
+    class FakeTc:
+        def get_side_information_path(self):
+            return str(tmp_path / "sideInfo")
+
+    class FakeSrc:
+        file_path = str(src)
+
+    class FakePvs:
+        test_config = FakeTc()
+        src = FakeSrc()
+        pvs_id = "DB_S_H2"
+
+        def get_avpvs_file_path(self):
+            return str(ten)
+
+    df = pd.read_csv(qm.compute_pvs_metrics(FakePvs()))
+    assert len(df) == n
+    assert (df.psnr_y == 100.0).all()
+    assert (df.psnr_u == 100.0).all()
+    assert (df.ssim_y > 0.9999).all()
