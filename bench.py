@@ -41,21 +41,37 @@ T = int(os.environ.get("BENCH_FRAMES", "8"))
 ITERS = int(os.environ.get("BENCH_ITERS", "20"))
 
 
-def _tpu_usable(timeout_s: int = 60) -> bool:
+def _tpu_usable(timeout_s: int = 60, attempts: int = 3, backoff_s: int = 30) -> bool:
+    """Probe the TPU in a throwaway subprocess (a wedged tunnel blocks inside
+    PJRT client creation — unkillable from within, so probe with a deadline).
+    A transient tunnel outage shouldn't demote the bench to CPU: retry with
+    backoff before giving up."""
     code = (
         "import jax; d=jax.devices(); import jax.numpy as jnp;"
         "x=jnp.ones((8,8)); (x@x).block_until_ready(); print(d[0].platform)"
     )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
-        )
-        return proc.returncode == 0 and "cpu" not in proc.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode == 0:
+                # a clean probe is definitive either way: retrying can't
+                # turn a CPU-only machine into a TPU one
+                return "cpu" not in proc.stdout
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt + 1 < attempts:
+            print(
+                f"# tpu probe attempt {attempt + 1}/{attempts} failed; "
+                f"retrying in {backoff_s}s",
+                file=sys.stderr,
+            )
+            time.sleep(backoff_s)
+    return False
 
 
 def main() -> None:

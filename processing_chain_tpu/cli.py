@@ -14,6 +14,7 @@ import sys
 from typing import Optional, Sequence
 
 from .config.errors import ConfigError
+from .io.medialib import MediaError
 from .utils import log as log_mod
 from .utils import parse_args as pa
 from .utils import tracing
@@ -115,8 +116,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
         from .tools import plots
 
         return plots.main(rest)
-    except (OSError, ValueError, KeyError, RuntimeError) as exc:
-        # ConfigError ⊂ ValueError; ChainError/MediaError ⊂ RuntimeError
+    except (OSError, ValueError, KeyError, ChainError, MediaError) as exc:
+        # expected failure modes only (ConfigError ⊂ ValueError); anything
+        # else keeps its traceback — an XLA RuntimeError is a bug, not a
+        # user error
         log_mod.get_logger().error("tools %s: %s", name, exc)
         return 1
 

@@ -53,3 +53,78 @@ def shard_pvs_list(pvs_ids: list, process_id: int, num_processes: int) -> list:
     """Deterministic per-host shard of the PVS work list (the multi-host
     replacement for the reference's single-host pool fan-out)."""
     return [p for i, p in enumerate(sorted(pvs_ids)) if i % num_processes == process_id]
+
+
+def process_topology() -> tuple[int, int]:
+    """(process_id, num_processes) of this host — (0, 1) when not running
+    distributed. Reads the same env vars `initialize` consumes so stage
+    drivers can shard without forcing jax.distributed setup."""
+    num = int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1)
+    pid = int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
+    if num <= 1:
+        return 0, 1
+    if not 0 <= pid < num:
+        raise ValueError(f"JAX_PROCESS_ID {pid} out of range for {num} processes")
+    return pid, num
+
+
+def fs_barrier(
+    stage: str, sync_dir: str, timeout_s: float = 24 * 3600.0,
+    poll_s: float = 2.0,
+) -> None:
+    """Filesystem barrier between pipeline stages on a shared filesystem.
+
+    The stages communicate through files (the reference's design, SURVEY.md
+    §1), so the barrier does too: each host drops
+    `<sync_dir>/.barrier_<run>_<stage>.host<i>` when it finishes the stage
+    and waits until all `num_processes` markers exist. Needed because the
+    p01 shard is keyed by segment filename (segments are shared across
+    PVSes) while p02-p04 shard by pvs_id — a host's PVS may need segments
+    another host encoded. No-op single-host.
+
+    Markers from a previous invocation would satisfy the barrier instantly;
+    set a fresh `PC_RUN_ID` env var (same value on every host) per
+    multi-host run to namespace them."""
+    import time
+
+    pid, num = process_topology()
+    if num == 1:
+        return
+    os.makedirs(sync_dir, exist_ok=True)
+    run_id = os.environ.get("PC_RUN_ID", "run")
+    own = os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{pid}")
+    with open(own, "w") as f:
+        f.write(str(time.time()))
+    want = [
+        os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{i}")
+        for i in range(num)
+    ]
+    deadline = time.monotonic() + timeout_s
+    log = get_logger()
+    log.info("barrier %s: host %d/%d waiting", stage, pid, num)
+    while True:
+        missing = [p for p in want if not os.path.isfile(p)]
+        if not missing:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"barrier {stage}: timed out waiting for "
+                f"{[os.path.basename(m) for m in missing]}"
+            )
+        time.sleep(poll_s)
+
+
+def local_shard(keyed_items: dict) -> list:
+    """Shard a {key: item} work dict across hosts: each host takes every
+    num_processes-th key (sorted, deterministic). The filesystem stays the
+    synchronization point exactly as in single-host mode — each item writes
+    distinct files (reference's task-independence model, SURVEY.md §5)."""
+    pid, num = process_topology()
+    if num == 1:
+        return list(keyed_items.items())
+    keep = set(shard_pvs_list(list(keyed_items), pid, num))
+    get_logger().info(
+        "distributed shard: host %d/%d takes %d of %d items",
+        pid, num, len(keep), len(keyed_items),
+    )
+    return [(k, v) for k, v in keyed_items.items() if k in keep]

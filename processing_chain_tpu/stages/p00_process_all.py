@@ -30,10 +30,17 @@ def run(cli_args) -> Optional[TestConfig]:
     selection = cli_args.scripts_to_run
     if selection == "all":
         selection = "1234"
+    from ..parallel.distributed import fs_barrier, process_topology
+
     test_config = None
     for key in "1234":
         if key not in selection:
             continue
         log.info("=== stage p0%s ===", key)
         test_config = _STAGES[key].run(cli_args, test_config=test_config)
+        if process_topology()[1] > 1 and test_config is not None:
+            # multi-host: stage shards differ (p01 by segment, p02-p04 by
+            # PVS), so no host may advance until every host finished the
+            # stage — its inputs can live on another host's shard
+            fs_barrier(f"p0{key}", test_config.get_logs_path())
     return test_config

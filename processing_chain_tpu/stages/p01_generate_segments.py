@@ -7,6 +7,7 @@ from typing import Optional
 from ..config import TestConfig
 from ..engine.jobs import Job, JobRunner
 from ..models import segments as seg_model
+from ..parallel.distributed import local_shard
 from ..utils.log import get_logger
 
 
@@ -25,7 +26,10 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
         name="p01",
     )
     downloader = None
-    for segment in sorted(test_config.get_required_segments()):
+    # multi-host: each process takes a deterministic shard of the
+    # segment set (keyed by filename; distinct outputs per key)
+    all_segments = {s.filename: s for s in sorted(test_config.get_required_segments())}
+    for _, segment in local_shard(all_segments):
         if getattr(segment.video_coding, "is_online", False):
             if cli_args.skip_online_services:
                 log.warning("Skipping online segment %s", segment.filename)

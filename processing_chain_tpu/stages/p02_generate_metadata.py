@@ -6,6 +6,7 @@ from typing import Optional
 
 from ..config import TestConfig
 from ..models import metadata as md
+from ..parallel.distributed import local_shard
 from ..utils.log import get_logger
 
 
@@ -16,7 +17,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
             cli_args.filter_pvs,
         )
-    for pvs_id, pvs in test_config.pvses.items():
+    for pvs_id, pvs in local_shard(test_config.pvses):
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue

@@ -8,6 +8,7 @@ from typing import Optional
 from ..config import TestConfig
 from ..engine.jobs import JobRunner
 from ..models import avpvs as av
+from ..parallel.distributed import local_shard
 from ..utils.log import get_logger
 
 
@@ -33,7 +34,8 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     from ..utils.parse_args import _DEFAULT_SPINNER
 
     spinner = getattr(cli_args, "spinner_path", None) or _DEFAULT_SPINNER
-    for pvs_id, pvs in test_config.pvses.items():
+    shard = local_shard(test_config.pvses)
+    for pvs_id, pvs in shard:
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue
@@ -49,7 +51,9 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     stall_runner.run_serial()
 
     if cli_args.remove_intermediate:
-        for pvs in test_config.pvses.values():
+        # only this host's shard: other hosts own (and may still be
+        # reading) their own intermediates
+        for _, pvs in shard:
             if pvs.has_buffering():
                 tmp = pvs.get_avpvs_wo_buffer_file_path()
                 if os.path.isfile(tmp):

@@ -7,6 +7,7 @@ from typing import Optional
 from ..config import TestConfig
 from ..engine.jobs import JobRunner
 from ..models import cpvs as cp
+from ..parallel.distributed import local_shard
 from ..utils.log import get_logger
 
 
@@ -21,7 +22,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
         force=cli_args.force, dry_run=cli_args.dry_run,
         parallelism=cli_args.parallelism, name="p04",
     )
-    for pvs_id, pvs in test_config.pvses.items():
+    for pvs_id, pvs in local_shard(test_config.pvses):
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue

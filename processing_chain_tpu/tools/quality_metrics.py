@@ -40,20 +40,48 @@ from ..utils.log import get_logger
 CHUNK = 32
 
 
+def _src_index_map(pvs, rate: float, src_fps: float):
+    """out_index(k): SRC frame index aligned to AVPVS output frame k.
+
+    Without buffering (or with frame-freeze HRCs, whose AVPVS keeps the
+    original length) the AVPVS timeline IS the SRC timeline. With stall
+    events, apply_stalling inserted round(d*rate) frames per event, so the
+    played media time of output k comes from the same StallPlan the
+    renderer used: during a stall the SRC holds the last played frame —
+    the honest full-reference comparison for a frozen/spinner period."""
+    has_buffering = getattr(pvs, "has_buffering", lambda: False)()
+    has_freeze = getattr(pvs, "has_framefreeze", lambda: False)()
+    if not has_buffering or has_freeze:
+        return lambda k: int(np.floor(k / rate * src_fps + 0.5))
+
+    from ..ops import overlay as ov
+
+    events = pvs.get_buff_events_media_time()
+    played_s = float(
+        sum(s.get_segment_duration() for s in pvs.segments)
+    )
+    plan = ov.plan_stalling(int(round(played_s * rate)), rate, events)
+    src_idx = plan.src_idx  # played-frame index per output frame
+
+    def out_index(k: int) -> int:
+        j = src_idx[min(k, len(src_idx) - 1)]
+        return int(np.floor(j / rate * src_fps + 0.5))
+
+    return out_index
+
+
 def _paired_chunks(
-    deg: VideoReader, ref: VideoReader, chunk: int = CHUNK
+    deg: VideoReader, ref: VideoReader, out_index, chunk: int = CHUNK
 ) -> Iterator[tuple[list[np.ndarray], list[np.ndarray]]]:
     """Yield ((deg_y, deg_u, deg_v), (ref_y, ref_u, ref_v)) chunk pairs on
-    the AVPVS timeline: SRC frame for output k is the one at media time
-    k / avpvs_rate (monotonic index → single streaming decode of both)."""
-    rate = deg.fps
-    src_fps = ref.fps
+    the AVPVS timeline: SRC frame for output k is out_index(k) (monotonic
+    → single streaming decode of both clips)."""
     deg_it = pf.iter_plane_chunks(deg, chunk)
     # n_out unknown up front (follow the AVPVS stream); gather the SRC
     # lazily and stop when the AVPVS side ends
     ref_it = pf.stream_monotonic_gather(
         ref,
-        lambda k: int(np.floor(k / rate * src_fps + 0.5)),
+        out_index,
         10**9,  # effectively unbounded; the AVPVS side stops us
         chunk,
     )
@@ -102,8 +130,9 @@ def compute_pvs_metrics(
         # for every depth pairing
         deg_scale = 0.25 if deg_reader.dtype == np.uint16 else 1.0
         ref_scale = 0.25 if ref_reader.dtype == np.uint16 else 1.0
+        out_index = _src_index_map(pvs, deg_reader.fps, ref_reader.fps)
         with pf.Prefetcher(
-            _paired_chunks(deg_reader, ref_reader), depth=2
+            _paired_chunks(deg_reader, ref_reader, out_index), depth=2
         ) as pre:
             for deg_chunk, ref_chunk in pre:
                 dy = jnp.asarray(deg_chunk[0]).astype(jnp.float32) * deg_scale

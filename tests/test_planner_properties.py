@@ -1,0 +1,168 @@
+"""Property-based tests for the segment planner (SURVEY.md §4 calls for
+property tests against the planner's duration/truncation quirks;
+reference test_config.py:1162-1248 is the behavioral spec).
+
+Invariants, for any valid event list × segment duration × SRC length:
+  * segments tile the played timeline contiguously from t=0;
+  * every segment is exactly segmentDuration long except the last, which
+    is truncated against the SRC length;
+  * total planned duration = min(sum of event durations, SRC length);
+  * two PVSes sharing the same SRC×HRC plan dedup to one segment set.
+"""
+
+import textwrap
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from processing_chain_tpu.config import StaticProber, TestConfig
+from processing_chain_tpu.config.errors import ConfigError
+
+SRC_INFO = {
+    "width": 1920,
+    "height": 1080,
+    "pix_fmt": "yuv420p",
+    "r_frame_rate": "24/1",
+    "video_codec": "ffv1",
+}
+
+
+def _build_db(tmp_path, seg_dur, event_plan, src_duration, two_pvs=False):
+    """event_plan: list of (ql_index, n_segments) quality events."""
+    db_id = "P2LTR00"
+    db_dir = tmp_path / db_id
+    (db_dir / "srcVid").mkdir(parents=True, exist_ok=True)
+    (db_dir / "srcVid" / "SRC000.avi").write_bytes(b"")
+    events = "\n".join(
+        f"      - [Q{ql}, {n * seg_dur}]" for ql, n in event_plan
+    )
+    pvs_lines = [f"  - {db_id}_SRC000_HRC000"]
+    hrcs = [f"""  HRC000:
+    videoCodingId: VC01
+    audioCodingId: AC01
+    eventList:
+{events}"""]
+    if two_pvs:
+        # second HRC with the identical event plan → same segment set
+        hrcs.append(f"""  HRC001:
+    videoCodingId: VC01
+    audioCodingId: AC01
+    eventList:
+{events}""")
+        pvs_lines.append(f"  - {db_id}_SRC000_HRC001")
+    yaml_path = db_dir / f"{db_id}.yaml"
+    yaml_path.write_text(textwrap.dedent(f"""\
+databaseId: {db_id}
+syntaxVersion: 6
+type: long
+segmentDuration: {seg_dur}
+qualityLevelList:
+  Q0: {{index: 0, videoCodec: h264, videoBitrate: 500, width: 960, height: 540, fps: 24, audioCodec: aac, audioBitrate: 128}}
+  Q1: {{index: 1, videoCodec: h264, videoBitrate: 2000, width: 1920, height: 1080, fps: 24, audioCodec: aac, audioBitrate: 128}}
+codingList:
+  VC01: {{type: video, encoder: libx264, passes: 1, iFrameInterval: 2}}
+  AC01: {{type: audio, encoder: aac}}
+srcList:
+  SRC000: SRC000.avi
+hrcList:
+""") + "\n".join(hrcs) + "\npvsList:\n" + "\n".join(pvs_lines) + textwrap.dedent("""
+postProcessingList:
+  - {type: pc, displayWidth: 1920, displayHeight: 1080, codingWidth: 1920, codingHeight: 1080}
+"""))
+    prober = StaticProber(
+        {"SRC000.avi": {**SRC_INFO, "video_duration": float(src_duration)}}
+    )
+    return TestConfig(str(yaml_path), prober=prober)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    seg_dur=st.integers(1, 5),
+    event_plan=st.lists(
+        st.tuples(st.integers(0, 1), st.integers(1, 3)), min_size=1, max_size=4
+    ),
+    src_ratio=st.floats(0.3, 1.7),
+)
+def test_planner_tiles_and_truncates(tmp_path_factory, seg_dur, event_plan, src_ratio):
+    tmp_path = tmp_path_factory.mktemp("prop")
+    total_events = seg_dur * sum(n for _, n in event_plan)
+    src_duration = max(0.5, round(total_events * src_ratio, 2))
+    tc = _build_db(tmp_path, seg_dur, event_plan, src_duration)
+    (pvs,) = tc.pvses.values()
+    segs = pvs.segments
+
+    played = min(float(total_events), src_duration)
+    assert segs, "at least one segment must be planned"
+    # contiguous tiling from t=0
+    t = 0.0
+    for s in segs:
+        assert s.start_time == pytest.approx(t, abs=1e-9)
+        assert s.duration > 0
+        t += s.duration
+    assert t == pytest.approx(played, abs=1e-6)
+    # all but the last are exactly segmentDuration
+    for s in segs[:-1]:
+        assert s.duration == pytest.approx(seg_dur)
+    assert segs[-1].duration <= seg_dur + 1e-9
+    # segment indices are consecutive
+    assert [s.index for s in segs] == list(range(len(segs)))
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    seg_dur=st.integers(1, 4),
+    event_plan=st.lists(
+        st.tuples(st.integers(0, 1), st.integers(1, 2)), min_size=1, max_size=3
+    ),
+)
+def test_planner_dedups_identical_plans(tmp_path_factory, seg_dur, event_plan):
+    """Two PVSes with identical SRC×(coding, events) need one encode set."""
+    tmp_path = tmp_path_factory.mktemp("prop")
+    total = seg_dur * sum(n for _, n in event_plan)
+    tc = _build_db(tmp_path, seg_dur, event_plan, float(total), two_pvs=True)
+    pvs_a, pvs_b = tc.pvses.values()
+    assert len(pvs_a.segments) == len(pvs_b.segments)
+    assert len(tc.get_required_segments()) == len(pvs_a.segments)
+    filenames = {s.filename for s in tc.get_required_segments()}
+    assert filenames == {s.filename for s in pvs_a.segments}
+
+
+@settings(max_examples=15, deadline=None)
+@given(seg_dur=st.integers(2, 5), extra=st.integers(1, 10))
+def test_planner_rejects_nondivisible_durations(tmp_path_factory, seg_dur, extra):
+    """Any event duration not divisible by segmentDuration is a ConfigError
+    (reference :1195-1199)."""
+    if extra % seg_dur == 0:
+        extra += 1
+    tmp_path = tmp_path_factory.mktemp("prop")
+    db_id = "P2LTR00"
+    db_dir = tmp_path / db_id
+    (db_dir / "srcVid").mkdir(parents=True, exist_ok=True)
+    (db_dir / "srcVid" / "SRC000.avi").write_bytes(b"")
+    yaml_path = db_dir / f"{db_id}.yaml"
+    yaml_path.write_text(textwrap.dedent(f"""\
+        databaseId: {db_id}
+        syntaxVersion: 6
+        type: long
+        segmentDuration: {seg_dur}
+        qualityLevelList:
+          Q0: {{index: 0, videoCodec: h264, videoBitrate: 500, width: 960, height: 540, fps: 24, audioCodec: aac, audioBitrate: 128}}
+        codingList:
+          VC01: {{type: video, encoder: libx264, passes: 1, iFrameInterval: 2}}
+          AC01: {{type: audio, encoder: aac}}
+        srcList:
+          SRC000: SRC000.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            audioCodingId: AC01
+            eventList:
+              - [Q0, {extra}]
+        pvsList:
+          - {db_id}_SRC000_HRC000
+        postProcessingList:
+          - {{type: pc, displayWidth: 1920, displayHeight: 1080, codingWidth: 1920, codingHeight: 1080}}
+    """))
+    prober = StaticProber({"SRC000.avi": {**SRC_INFO, "video_duration": 60.0}})
+    with pytest.raises(ConfigError, match="does not match"):
+        TestConfig(str(yaml_path), prober=prober)

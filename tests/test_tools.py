@@ -385,3 +385,75 @@ def test_quality_metrics_mixed_bit_depth(tmp_path):
     assert (df.psnr_y == 100.0).all()
     assert (df.psnr_u == 100.0).all()
     assert (df.ssim_y > 0.9999).all()
+
+
+def test_quality_metrics_stall_alignment(tmp_path):
+    """With buffering, SRC frames must realign after inserted stall frames:
+    non-stall rows score as identical, stall rows compare black vs held
+    frame (low PSNR), and nothing drifts post-stall."""
+    from processing_chain_tpu.io.video import VideoWriter
+    from processing_chain_tpu.tools import quality_metrics as qm
+
+    h, w, fps = 48, 64, 24
+    n_src = 48  # 2.0 s
+    stall_at, stall_dur = 1.0, 0.5  # 12 inserted frames at frame 24
+    # distinct flat luma per frame → any misalignment breaks PSNR=100
+    def luma(i):
+        return np.full((h, w), 20 + 4 * (i % 50), np.uint8)
+
+    def chroma():
+        return np.full((h // 2, w // 2), 128, np.uint8)
+
+    src = tmp_path / "src.avi"
+    with VideoWriter(str(src), "ffv1", w, h, "yuv420p", (fps, 1)) as wr:
+        for i in range(n_src):
+            wr.write(luma(i), chroma(), chroma())
+
+    avpvs = tmp_path / "avpvs.avi"
+    n_stall = int(round(stall_dur * fps))
+    insert_at = int(round(stall_at * fps))
+    with VideoWriter(str(avpvs), "ffv1", w, h, "yuv420p", (fps, 1)) as wr:
+        for i in range(insert_at):
+            wr.write(luma(i), chroma(), chroma())
+        for _ in range(n_stall):
+            wr.write(np.full((h, w), 16, np.uint8), chroma(), chroma())
+        for i in range(insert_at, n_src):
+            wr.write(luma(i), chroma(), chroma())
+
+    class FakeSeg:
+        def get_segment_duration(self):
+            return n_src / fps
+
+    class FakeTc:
+        def get_side_information_path(self):
+            return str(tmp_path / "sideInfo")
+
+    class FakeSrc:
+        file_path = str(src)
+
+    class FakePvs:
+        test_config = FakeTc()
+        src = FakeSrc()
+        pvs_id = "DB_S_H3"
+        segments = [FakeSeg()]
+
+        def get_avpvs_file_path(self):
+            return str(avpvs)
+
+        def has_buffering(self):
+            return True
+
+        def has_framefreeze(self):
+            return False
+
+        def get_buff_events_media_time(self):
+            return [[stall_at, stall_dur]]
+
+    df = pd.read_csv(qm.compute_pvs_metrics(FakePvs()))
+    assert len(df) == n_src + n_stall
+    mask_stall = np.zeros(len(df), bool)
+    mask_stall[insert_at : insert_at + n_stall] = True
+    # every played frame realigns exactly — before AND after the stall
+    assert (df.psnr_y[~mask_stall] == 100.0).all()
+    # stall frames show black vs the held SRC frame: clearly not identical
+    assert (df.psnr_y[mask_stall] < 40).all()
