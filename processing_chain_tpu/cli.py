@@ -91,7 +91,7 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
 
 def _dispatch_tool(argv: Sequence[str]) -> int:
     """`tools <name> …` subcommands (reference util/ scripts)."""
-    tools = ("src-analysis", "complexity", "plots", "metrics")
+    tools = ("src-analysis", "complexity", "plots", "metrics", "clean-logs")
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
         return 2
@@ -113,6 +113,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import quality_metrics
 
             return quality_metrics.main(rest)
+        if name == "clean-logs":
+            from .tools import clean_logs
+
+            return clean_logs.main(rest)
         from .tools import plots
 
         return plots.main(rest)

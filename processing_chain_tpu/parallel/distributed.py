@@ -70,7 +70,7 @@ def process_topology() -> tuple[int, int]:
 
 def fs_barrier(
     stage: str, sync_dir: str, timeout_s: float = 24 * 3600.0,
-    poll_s: float = 2.0,
+    poll_s: float = 2.0, min_mtime: Optional[float] = None,
 ) -> None:
     """Filesystem barrier between pipeline stages on a shared filesystem.
 
@@ -82,9 +82,13 @@ def fs_barrier(
     PVSes) while p02-p04 shard by pvs_id — a host's PVS may need segments
     another host encoded. No-op single-host.
 
-    Markers from a previous invocation would satisfy the barrier instantly;
-    set a fresh `PC_RUN_ID` env var (same value on every host) per
-    multi-host run to namespace them."""
+    Stale markers from a previous invocation must not satisfy a new
+    barrier: each host deletes its own leftovers before writing, and with
+    `min_mtime` set (p00 passes its own start time) a marker only counts
+    when written after that instant — roughly-synced host clocks (NTP)
+    are assumed, with slack applied by the caller. `PC_RUN_ID` additionally
+    namespaces concurrent runs sharing one database."""
+    import glob as glob_mod
     import time
 
     pid, num = process_topology()
@@ -92,6 +96,14 @@ def fs_barrier(
         return
     os.makedirs(sync_dir, exist_ok=True)
     run_id = os.environ.get("PC_RUN_ID", "run")
+    # clear this host's leftovers from older runs (any run_id, any stage
+    # marker older than the gate)
+    for old in glob_mod.glob(os.path.join(sync_dir, f".barrier_*.host{pid}")):
+        try:
+            if min_mtime is None or os.path.getmtime(old) < min_mtime:
+                os.unlink(old)
+        except OSError:
+            pass
     own = os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{pid}")
     with open(own, "w") as f:
         f.write(str(time.time()))
@@ -99,13 +111,35 @@ def fs_barrier(
         os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{i}")
         for i in range(num)
     ]
+
+    def present(path: str) -> bool:
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return False
+        return min_mtime is None or mtime >= min_mtime
+
     deadline = time.monotonic() + timeout_s
     log = get_logger()
     log.info("barrier %s: host %d/%d waiting", stage, pid, num)
+    warned_old = set()
     while True:
-        missing = [p for p in want if not os.path.isfile(p)]
+        missing = [p for p in want if not present(p)]
         if not missing:
             return
+        for p in missing:
+            # a marker that exists but predates the gate is ambiguous:
+            # stale leftovers, or a host that started >slack earlier in
+            # THIS run. Surface it so the operator can set PC_RUN_ID
+            # instead of silently passing (corruption) or opaquely
+            # timing out.
+            if os.path.isfile(p) and p not in warned_old:
+                warned_old.add(p)
+                log.warning(
+                    "barrier %s: ignoring marker %s older than this run's "
+                    "start; if hosts launched far apart, set a shared "
+                    "PC_RUN_ID per run", stage, os.path.basename(p),
+                )
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"barrier {stage}: timed out waiting for "

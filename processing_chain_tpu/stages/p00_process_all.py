@@ -30,8 +30,13 @@ def run(cli_args) -> Optional[TestConfig]:
     selection = cli_args.scripts_to_run
     if selection == "all":
         selection = "1234"
+    import time
+
     from ..parallel.distributed import fs_barrier, process_topology
 
+    # barrier gate: only markers written after this run started count
+    # (2 min slack for host clock skew)
+    run_start = time.time() - 120.0
     test_config = None
     for key in "1234":
         if key not in selection:
@@ -42,5 +47,7 @@ def run(cli_args) -> Optional[TestConfig]:
             # multi-host: stage shards differ (p01 by segment, p02-p04 by
             # PVS), so no host may advance until every host finished the
             # stage — its inputs can live on another host's shard
-            fs_barrier(f"p0{key}", test_config.get_logs_path())
+            fs_barrier(
+                f"p0{key}", test_config.get_logs_path(), min_mtime=run_start
+            )
     return test_config

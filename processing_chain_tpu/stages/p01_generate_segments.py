@@ -37,7 +37,9 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             if downloader is None:
                 from ..services import Downloader
 
-                downloader = Downloader(test_config.get_video_segments_path())
+                downloader = Downloader.from_settings(
+                    test_config.get_video_segments_path()
+                )
             encoder = segment.video_coding.encoder.casefold()
             seg, force = segment, cli_args.force
             if encoder == "bitmovin":

@@ -57,10 +57,20 @@ def _src_index_map(pvs, rate: float, src_fps: float):
     from ..ops import overlay as ov
 
     events = pvs.get_buff_events_media_time()
-    played_s = float(
-        sum(s.get_segment_duration() for s in pvs.segments)
-    )
-    plan = ov.plan_stalling(int(round(played_s * rate)), rate, events)
+    # played-frame count from the ACTUAL rendered file, exactly as the
+    # renderer saw it: n_played = avpvs frames − inserted stall frames
+    # (apply_stalling built its plan from the wo_buffer frame count, so a
+    # duration-based estimate can drift by a frame on fps-converted PVSes)
+    avpvs_path = pvs.get_avpvs_file_path()
+    vstreams = [
+        s for s in medialib.probe(avpvs_path)["streams"]
+        if s["codec_type"] == "video"
+    ]
+    n_avpvs = int(vstreams[0].get("nb_frames") or 0) if vstreams else 0
+    if n_avpvs <= 0:
+        n_avpvs = len(medialib.scan_packets(avpvs_path, "video")["size"])
+    n_stall = sum(int(round(float(e[1]) * rate)) for e in events)
+    plan = ov.plan_stalling(max(n_avpvs - n_stall, 1), rate, events)
     src_idx = plan.src_idx  # played-frame index per output frame
 
     def out_index(k: int) -> int:

@@ -300,3 +300,61 @@ def test_collect_parts_orders_by_index():
     assert init == "x_init.mp4"
     assert parts[0] == "x_0.m4s" and parts[-1] == "x_10.m4s"
     assert len(parts) == 11
+
+
+# ------------------------------------------------------- settings loading
+
+
+def test_load_bitmovin_settings_roundtrip(tmp_path):
+    from processing_chain_tpu.services import downloader as dl
+
+    d = tmp_path / "bitmovin_settings"
+    d.mkdir()
+    (d / "keyfile.txt").write_text("KEY123\n")
+    (d / "input_details.yaml").write_text("type: https\nhost: in.example\n")
+    (d / "output_details.yaml").write_text(
+        "type: sftp\nhost: out.example\nuser: u\npassword: p\nroot: /enc\n"
+    )
+    s = dl.load_bitmovin_settings(str(d))
+    assert s.api_key == "KEY123"
+    assert s.input_details["host"] == "in.example"
+    assert s.output_details["type"] == "sftp"
+
+
+def test_load_bitmovin_settings_missing_file(tmp_path):
+    from processing_chain_tpu.services import downloader as dl
+
+    d = tmp_path / "bitmovin_settings"
+    d.mkdir()
+    (d / "keyfile.txt").write_text("KEY123")
+    with pytest.raises(FileNotFoundError, match="input_details"):
+        dl.load_bitmovin_settings(str(d))
+
+
+def test_load_bitmovin_settings_empty_key(tmp_path):
+    from processing_chain_tpu.services import downloader as dl
+
+    d = tmp_path / "s"
+    d.mkdir()
+    (d / "keyfile.txt").write_text("  \n")
+    (d / "input_details.yaml").write_text("type: https\n")
+    (d / "output_details.yaml").write_text("type: sftp\n")
+    with pytest.raises(ValueError, match="API key"):
+        dl.load_bitmovin_settings(str(d))
+
+
+def test_make_chunk_store_non_sftp_warns(tmp_path, caplog):
+    from processing_chain_tpu.services import downloader as dl
+
+    s = dl.BitmovinSettings("k", {}, {"type": "azure"})
+    assert dl.make_chunk_store(s) is None
+
+
+def test_downloader_from_settings_without_dir(tmp_path):
+    """No settings dir and no yt-dlp: constructs with both clients absent."""
+    from processing_chain_tpu.services import downloader as dl
+
+    d = dl.Downloader.from_settings(
+        str(tmp_path), settings_dir=str(tmp_path / "nope")
+    )
+    assert d.store is None

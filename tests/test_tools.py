@@ -457,3 +457,48 @@ def test_quality_metrics_stall_alignment(tmp_path):
     assert (df.psnr_y[~mask_stall] == 100.0).all()
     # stall frames show black vs the held SRC frame: clearly not identical
     assert (df.psnr_y[mask_stall] < 40).all()
+
+
+# ----------------------------------------------------------- clean-logs
+
+
+def test_clean_logs_transient_vs_provenance(tmp_path):
+    from processing_chain_tpu.tools import clean_logs
+
+    keep = tmp_path / "avpvs" / "X.avi"
+    keep.parent.mkdir()
+    keep.write_bytes(b"data")
+    prov = tmp_path / "logs" / "X.log"
+    prov.parent.mkdir()
+    prov.write_text("provenance")
+    trace = tmp_path / "logs" / "trace_1.json"
+    trace.write_text("{}")
+    for name in ("a.mbtree", "b.temp", "c.stats", ".barrier_r1_p01.host0"):
+        (tmp_path / name).write_text("x")
+
+    removed = clean_logs.run(str(tmp_path))
+    assert len(removed) == 4
+    assert keep.exists() and prov.exists() and trace.exists()
+
+    removed2 = clean_logs.run(str(tmp_path), include_provenance=True)
+    assert not prov.exists() and not trace.exists()
+    assert keep.exists()
+    assert len(removed2) == 2
+
+
+def test_clean_logs_dry_run(tmp_path):
+    from processing_chain_tpu.tools import clean_logs
+
+    f = tmp_path / "x.temp"
+    f.write_text("x")
+    removed = clean_logs.run(str(tmp_path), dry_run=True)
+    assert removed and f.exists()
+
+
+def test_clean_logs_cli(tmp_path):
+    from processing_chain_tpu import cli
+
+    (tmp_path / "x.mbtree").write_text("x")
+    assert cli.main(["tools", "clean-logs", str(tmp_path)]) == 0
+    assert not (tmp_path / "x.mbtree").exists()
+    assert cli.main(["tools", "clean-logs", str(tmp_path / "missing")]) == 1
