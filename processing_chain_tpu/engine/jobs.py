@@ -18,7 +18,7 @@ from typing import Any, Callable, Optional
 
 from ..utils import tracing
 from ..utils.log import get_logger
-from ..utils.runner import ParallelRunner
+from ..utils.runner import ChainError, ParallelRunner
 from ..utils.version import get_processing_chain_version
 
 
@@ -76,10 +76,28 @@ class JobRunner:
         self.parallelism = parallelism
         self.name = name
         self.jobs: list[Job] = []
+        self._writers: dict[str, str] = {}
 
     def add(self, job: Optional[Job]) -> None:
+        """Plan a job. Two *different* jobs targeting one output file is a
+        write-write race the reference could silently hit (its pool dedups
+        only identical command strings, reference cmd_utils.py:73-79, and
+        concurrency safety rests on task independence — SURVEY.md §5);
+        here it fails loudly at plan time. The same job added twice (the
+        reference's dedup case, e.g. one segment shared by many PVSes)
+        stays a silent dedup."""
         if job is None:
             return
+        if job.output_path:
+            prior = self._writers.get(job.output_path)
+            if prior == job.label:
+                return  # same plan submitted again: dedup
+            if prior is not None:
+                raise ChainError(
+                    f"{self.name}: jobs '{prior}' and '{job.label}' both "
+                    f"write {job.output_path} — write-write race"
+                )
+            self._writers[job.output_path] = job.label
         if job.should_run(self.force):
             self.jobs.append(job)
 
@@ -90,22 +108,23 @@ class JobRunner:
                 log.info("[dry-run] %s -> %s", job.label, job.output_path)
             planned = self.jobs
             self.jobs = []
+            self._writers.clear()
             return {j.label: None for j in planned}
         runner = ParallelRunner(max_parallel=self.parallelism, name=self.name)
         for job in self.jobs:
             runner.add(job.run, label=job.label)
         self.jobs = []
+        self._writers.clear()
         return runner.run()
 
     def run_serial(self) -> dict[str, Any]:
         """Run jobs one by one in order (for device-bound stages — one chip,
         serialized device queue). Failures become ChainError so the CLI can
         map them to a clean exit 1."""
-        from ..utils.runner import ChainError
-
         log = get_logger()
         results = {}
         jobs, self.jobs = self.jobs, []
+        self._writers.clear()
         for job in jobs:
             if self.dry_run:
                 log.info("[dry-run] %s -> %s", job.label, job.output_path)

@@ -11,8 +11,10 @@ mesh.
 from __future__ import annotations
 
 import os
+import re
 from typing import Optional
 
+from ..config.errors import ConfigError
 from ..utils.log import get_logger
 
 
@@ -64,46 +66,75 @@ def process_topology() -> tuple[int, int]:
     if num <= 1:
         return 0, 1
     if not 0 <= pid < num:
-        raise ValueError(f"JAX_PROCESS_ID {pid} out of range for {num} processes")
+        raise ConfigError(f"JAX_PROCESS_ID {pid} out of range for {num} processes")
     return pid, num
+
+
+def barrier_run_id() -> str:
+    """The multi-host run namespace. Multi-host mode REQUIRES a fresh
+    `PC_RUN_ID` per run (same value on every host): heuristics like marker
+    mtimes cannot distinguish a stale marker from a host that simply
+    launched earlier, so the id is the single source of truth. The
+    orchestrator that already distributes JAX_PROCESS_ID per host sets it
+    (e.g. a launch timestamp)."""
+    run_id = os.environ.get("PC_RUN_ID", "")
+    if not run_id:
+        raise ConfigError(
+            "multi-host runs require PC_RUN_ID (a fresh shared id per run, "
+            "e.g. a launch timestamp) so stage barriers can tell this "
+            "run's markers from a previous run's"
+        )
+    if not re.fullmatch(r"[A-Za-z0-9._-]+", run_id):
+        raise ConfigError(
+            f"PC_RUN_ID {run_id!r} must be filename-safe ([A-Za-z0-9._-])"
+        )
+    return run_id
+
+
+def fs_barrier_init(sync_dir: str) -> None:
+    """Call once per host before the first stage: removes this host's own
+    markers for the current run id, so an operator who reuses a PC_RUN_ID
+    after a crash gets a clean slate for their own markers. (A reused id
+    is still unsafe if other hosts lag — use a fresh id per run.)"""
+    import glob as glob_mod
+
+    pid, num = process_topology()
+    if num == 1:
+        return
+    run_id = barrier_run_id()
+    for old in glob_mod.glob(
+        os.path.join(sync_dir, f".barrier_{run_id}_*.host{pid}")
+    ):
+        try:
+            os.unlink(old)
+        except OSError:
+            pass
 
 
 def fs_barrier(
     stage: str, sync_dir: str, timeout_s: float = 24 * 3600.0,
-    poll_s: float = 2.0, min_mtime: Optional[float] = None,
+    poll_s: float = 2.0,
 ) -> None:
     """Filesystem barrier between pipeline stages on a shared filesystem.
 
     The stages communicate through files (the reference's design, SURVEY.md
     §1), so the barrier does too: each host drops
-    `<sync_dir>/.barrier_<run>_<stage>.host<i>` when it finishes the stage
-    and waits until all `num_processes` markers exist. Needed because the
-    p01 shard is keyed by segment filename (segments are shared across
-    PVSes) while p02-p04 shard by pvs_id — a host's PVS may need segments
-    another host encoded. No-op single-host.
+    `<sync_dir>/.barrier_<run_id>_<stage>.host<i>` when it finishes the
+    stage and waits until all `num_processes` markers of its run id exist.
+    Needed because the p01 shard is keyed by segment filename (segments are
+    shared across PVSes) while p02-p04 shard by pvs_id — a host's PVS may
+    need segments another host encoded. No-op single-host.
 
-    Stale markers from a previous invocation must not satisfy a new
-    barrier: each host deletes its own leftovers before writing, and with
-    `min_mtime` set (p00 passes its own start time) a marker only counts
-    when written after that instant — roughly-synced host clocks (NTP)
-    are assumed, with slack applied by the caller. `PC_RUN_ID` additionally
-    namespaces concurrent runs sharing one database."""
-    import glob as glob_mod
+    Correctness rests entirely on PC_RUN_ID freshness (see barrier_run_id):
+    markers of other run ids are never read nor deleted, so concurrent runs
+    on one database can't interfere."""
     import time
 
     pid, num = process_topology()
     if num == 1:
         return
+    run_id = barrier_run_id()
     os.makedirs(sync_dir, exist_ok=True)
-    run_id = os.environ.get("PC_RUN_ID", "run")
-    # clear this host's leftovers from older runs (any run_id, any stage
-    # marker older than the gate)
-    for old in glob_mod.glob(os.path.join(sync_dir, f".barrier_*.host{pid}")):
-        try:
-            if min_mtime is None or os.path.getmtime(old) < min_mtime:
-                os.unlink(old)
-        except OSError:
-            pass
     own = os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{pid}")
     with open(own, "w") as f:
         f.write(str(time.time()))
@@ -111,35 +142,13 @@ def fs_barrier(
         os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{i}")
         for i in range(num)
     ]
-
-    def present(path: str) -> bool:
-        try:
-            mtime = os.path.getmtime(path)
-        except OSError:
-            return False
-        return min_mtime is None or mtime >= min_mtime
-
     deadline = time.monotonic() + timeout_s
     log = get_logger()
     log.info("barrier %s: host %d/%d waiting", stage, pid, num)
-    warned_old = set()
     while True:
-        missing = [p for p in want if not present(p)]
+        missing = [p for p in want if not os.path.isfile(p)]
         if not missing:
             return
-        for p in missing:
-            # a marker that exists but predates the gate is ambiguous:
-            # stale leftovers, or a host that started >slack earlier in
-            # THIS run. Surface it so the operator can set PC_RUN_ID
-            # instead of silently passing (corruption) or opaquely
-            # timing out.
-            if os.path.isfile(p) and p not in warned_old:
-                warned_old.add(p)
-                log.warning(
-                    "barrier %s: ignoring marker %s older than this run's "
-                    "start; if hosts launched far apart, set a shared "
-                    "PC_RUN_ID per run", stage, os.path.basename(p),
-                )
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"barrier {stage}: timed out waiting for "

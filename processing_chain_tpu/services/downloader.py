@@ -211,39 +211,55 @@ class ChunkStore(Protocol):
 class SftpStore:
     """Paramiko-backed ChunkStore (reference download_from_sftp /
     check_output_existence_level SFTP branches, :746-785, :940-1001).
-    Constructed lazily; raises when paramiko is unavailable."""
+    The connection is deferred to first use: construction happens at p01
+    plan time even for databases whose segments never touch the store, and
+    a plan step must not block on a TCP dial. paramiko-missing surfaces at
+    construction (cheap, actionable); network errors surface at first
+    access."""
 
     def __init__(self, host: str, port: int, user: str, password: str, root: str) -> None:
         try:
-            import paramiko  # type: ignore
+            import paramiko  # type: ignore  # noqa: F401
         except ImportError as exc:
             raise RuntimeError("paramiko is not installed; SFTP store unavailable") from exc
-        transport = paramiko.Transport((host.split(":")[0], port))
-        transport.connect(username=user, password=password)
-        self._sftp = paramiko.SFTPClient.from_transport(transport)
-        self._transport = transport
+        self._params = (host.split(":")[0], port, user, password)
+        self._sftp = None
+        self._transport = None
         self.root = root
+
+    def _client(self):
+        if self._sftp is None:
+            import paramiko  # type: ignore
+
+            host, port, user, password = self._params
+            transport = paramiko.Transport((host, port))
+            transport.connect(username=user, password=password)
+            self._sftp = paramiko.SFTPClient.from_transport(transport)
+            self._transport = transport
+        return self._sftp
 
     def _abs(self, rel_path: str) -> str:
         return os.path.join(self.root, rel_path)
 
     def exists(self, rel_path: str) -> bool:
         try:
-            self._sftp.stat(self._abs(rel_path))
+            self._client().stat(self._abs(rel_path))
             return True
         except OSError:
             return False
 
     def listdir(self, rel_path: str) -> list[str]:
-        return self._sftp.listdir(self._abs(rel_path))
+        return self._client().listdir(self._abs(rel_path))
 
     def download(self, rel_path: str, local_path: str) -> None:
         os.makedirs(os.path.dirname(local_path), exist_ok=True)
-        self._sftp.get(self._abs(rel_path), local_path)
+        self._client().get(self._abs(rel_path), local_path)
 
     def close(self) -> None:
-        self._sftp.close()
-        self._transport.close()
+        if self._sftp is not None:
+            self._sftp.close()
+            self._transport.close()
+            self._sftp = self._transport = None
 
 
 # ------------------------------------------------------- settings loading

@@ -30,24 +30,29 @@ def run(cli_args) -> Optional[TestConfig]:
     selection = cli_args.scripts_to_run
     if selection == "all":
         selection = "1234"
-    import time
+    from ..parallel.distributed import (
+        barrier_run_id,
+        fs_barrier,
+        fs_barrier_init,
+        process_topology,
+    )
 
-    from ..parallel.distributed import fs_barrier, process_topology
-
-    # barrier gate: only markers written after this run started count
-    # (2 min slack for host clock skew)
-    run_start = time.time() - 120.0
+    multi_host = process_topology()[1] > 1
+    if multi_host:
+        barrier_run_id()  # fail fast if PC_RUN_ID is missing/unsafe
+    barrier_ready = False
     test_config = None
     for key in "1234":
         if key not in selection:
             continue
         log.info("=== stage p0%s ===", key)
         test_config = _STAGES[key].run(cli_args, test_config=test_config)
-        if process_topology()[1] > 1 and test_config is not None:
+        if multi_host and test_config is not None:
+            if not barrier_ready:
+                fs_barrier_init(test_config.get_logs_path())
+                barrier_ready = True
             # multi-host: stage shards differ (p01 by segment, p02-p04 by
             # PVS), so no host may advance until every host finished the
             # stage — its inputs can live on another host's shard
-            fs_barrier(
-                f"p0{key}", test_config.get_logs_path(), min_mtime=run_start
-            )
+            fs_barrier(f"p0{key}", test_config.get_logs_path())
     return test_config

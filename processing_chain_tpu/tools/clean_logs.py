@@ -19,22 +19,36 @@ from ..utils.log import get_logger
 
 TRANSIENT_PATTERNS = (
     "*.mbtree", "*.temp", "*.stats", "*.stats.cutree", "*.stats.mbtree",
-    ".barrier_*",
 )
 PROVENANCE_PATTERNS = ("*.log", "trace_*.json")
+#: barrier markers are only swept once no run can still be polling them
+#: (fs_barrier's wait times out after 24 h)
+BARRIER_PATTERN = ".barrier_*"
+BARRIER_MIN_AGE_S = 25 * 3600.0
 
 
 def collect(
     root: str, include_provenance: bool = False
 ) -> list[str]:
+    import time
+
     patterns = TRANSIENT_PATTERNS + (
         PROVENANCE_PATTERNS if include_provenance else ()
     )
+    now = time.time()
     hits: list[str] = []
     for dirpath, _dirnames, filenames in os.walk(root):
         for name in filenames:
+            path = os.path.join(dirpath, name)
             if any(fnmatch.fnmatch(name, pat) for pat in patterns):
-                hits.append(os.path.join(dirpath, name))
+                hits.append(path)
+            elif fnmatch.fnmatch(name, BARRIER_PATTERN):
+                # an active multi-host run may be waiting on this marker
+                try:
+                    if now - os.path.getmtime(path) > BARRIER_MIN_AGE_S:
+                        hits.append(path)
+                except OSError:
+                    pass
     return sorted(hits)
 
 

@@ -166,3 +166,33 @@ def test_fs_barrier_single_host_noop(monkeypatch, tmp_path):
     monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
     dist.fs_barrier("p01", str(tmp_path))
     assert list(tmp_path.iterdir()) == []
+
+
+def test_fs_barrier_requires_run_id_multihost(monkeypatch, tmp_path):
+    from processing_chain_tpu.parallel import distributed as dist
+
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    monkeypatch.delenv("PC_RUN_ID", raising=False)
+    with pytest.raises(ValueError, match="PC_RUN_ID"):
+        dist.fs_barrier("p01", str(tmp_path))
+    monkeypatch.setenv("PC_RUN_ID", "bad/id")
+    with pytest.raises(ValueError, match="filename-safe"):
+        dist.fs_barrier("p01", str(tmp_path))
+
+
+def test_fs_barrier_init_clears_own_run_markers(monkeypatch, tmp_path):
+    from processing_chain_tpu.parallel import distributed as dist
+
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    monkeypatch.setenv("PC_RUN_ID", "r9")
+    mine = tmp_path / ".barrier_r9_p01.host0"
+    other_host = tmp_path / ".barrier_r9_p01.host1"
+    other_run = tmp_path / ".barrier_r8_p01.host0"
+    for f in (mine, other_host, other_run):
+        f.write_text("x")
+    dist.fs_barrier_init(str(tmp_path))
+    assert not mine.exists()          # own marker of this run: cleared
+    assert other_host.exists()        # other hosts' markers: untouched
+    assert other_run.exists()         # other runs' markers: untouched

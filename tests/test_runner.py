@@ -26,3 +26,16 @@ def test_run_task_wraps_errors():
     with pytest.raises(ChainError):
         run_task(lambda: 1 / 0)
     assert run_task(lambda: 42) == 42
+
+
+def test_jobrunner_detects_write_write_race():
+    from processing_chain_tpu.engine.jobs import Job, JobRunner
+    from processing_chain_tpu.utils.runner import ChainError
+
+    r = JobRunner(name="t")
+    r.add(Job(label="a", output_path="/tmp/x.avi", fn=lambda: None))
+    # identical plan: silent dedup
+    r.add(Job(label="a", output_path="/tmp/x.avi", fn=lambda: None))
+    assert len(r.jobs) == 1
+    with pytest.raises(ChainError, match="write-write race"):
+        r.add(Job(label="b", output_path="/tmp/x.avi", fn=lambda: None))

@@ -473,16 +473,23 @@ def test_clean_logs_transient_vs_provenance(tmp_path):
     prov.write_text("provenance")
     trace = tmp_path / "logs" / "trace_1.json"
     trace.write_text("{}")
-    for name in ("a.mbtree", "b.temp", "c.stats", ".barrier_r1_p01.host0"):
+    for name in ("a.mbtree", "b.temp", "c.stats"):
         (tmp_path / name).write_text("x")
+    fresh_barrier = tmp_path / ".barrier_r1_p01.host0"
+    fresh_barrier.write_text("x")
+    old_barrier = tmp_path / ".barrier_r0_p01.host0"
+    old_barrier.write_text("x")
+    two_days_ago = __import__("time").time() - 48 * 3600
+    __import__("os").utime(old_barrier, (two_days_ago, two_days_ago))
 
     removed = clean_logs.run(str(tmp_path))
-    assert len(removed) == 4
+    assert len(removed) == 4  # 3 transient + the aged-out barrier marker
     assert keep.exists() and prov.exists() and trace.exists()
+    assert fresh_barrier.exists() and not old_barrier.exists()
 
     removed2 = clean_logs.run(str(tmp_path), include_provenance=True)
     assert not prov.exists() and not trace.exists()
-    assert keep.exists()
+    assert keep.exists() and fresh_barrier.exists()
     assert len(removed2) == 2
 
 
