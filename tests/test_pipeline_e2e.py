@@ -231,3 +231,32 @@ def test_long_chain_cpvs_audio_normalized(long_db):
     x = samples.astype(np.float64) / 32768.0
     rms_db = 20 * np.log10(np.sqrt(np.mean(x * x)) + 1e-12)
     assert -26.0 < rms_db < -20.0  # ~-23 dBFS RMS target
+
+
+def test_p03_force_60_fps(short_db):
+    """-f60 resamples the AVPVS canvas to 60 fps via the streaming fps
+    filter: round(48/24*60)=120 frames, duplicates of the 24 fps content."""
+    db = os.path.dirname(short_db)
+    try:
+        rc = cli_main([
+            "p03", "-c", short_db, "--skip-requirements", "-f60", "--force",
+            "--filter-hrc", "HRC000",
+        ])
+        assert rc == 0
+        av = os.path.join(db, "avpvs", "P2SXM90_SRC000_HRC000.avi")
+        with VideoReader(av) as r:
+            assert abs(r.fps - 60.0) < 1e-6
+            planes, _ = r.read_all()
+        assert planes[0].shape[0] == 120
+        # ffmpeg fps= semantics: output k shows source floor(k*24/60 + 0.5),
+        # so each source frame appears 2-3 times; outputs 0 and 1 both map
+        # to source frame 0
+        assert np.array_equal(planes[0][0], planes[0][1])
+    finally:
+        # restore the 24 fps artifact: the fixture is module-scoped and
+        # other tests assert its 48-frame/24fps shape
+        rc = cli_main([
+            "p03", "-c", short_db, "--skip-requirements", "--force",
+            "--filter-hrc", "HRC000",
+        ])
+        assert rc == 0

@@ -509,3 +509,23 @@ def test_clean_logs_cli(tmp_path):
     assert cli.main(["tools", "clean-logs", str(tmp_path)]) == 0
     assert not (tmp_path / "x.mbtree").exists()
     assert cli.main(["tools", "clean-logs", str(tmp_path / "missing")]) == 1
+
+
+def test_complexity_csv_feeds_config(tmp_path):
+    """Cross-component roundtrip: the tool's CSV is consumable by
+    TestConfig's complexity-ladder parser (flips complex_bitrates and
+    fills complexity_dict) without any massaging."""
+    from processing_chain_tpu.config import TestConfig
+    from tests.fixtures import write_short_db
+
+    src = str(tmp_path / "SRC000.avi")
+    write_test_video(src, codec="ffv1", n=8)
+    data = complexity.run([src], tmp_dir=str(tmp_path / "ca"), parallelism=1)
+    assert "complexity_class" in data.columns
+
+    yaml_path, prober = write_short_db(tmp_path)
+    tc = TestConfig(
+        yaml_path, prober=prober, complexity_csv_dir=str(tmp_path / "ca")
+    )
+    assert tc.complex_bitrates
+    assert tc.complexity_dict["SRC000.avi"] in (0, 1, 2, 3)
