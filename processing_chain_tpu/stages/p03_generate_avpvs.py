@@ -47,8 +47,11 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             )
         )
         stall_runner.add(av.apply_stalling(pvs, spinner_path=spinner))
-    runner.run_serial()
-    stall_runner.run_serial()
+    from ..utils.device import select_device
+
+    with select_device(getattr(cli_args, "set_gpu_loc", -1)):
+        runner.run_serial()
+        stall_runner.run_serial()
 
     if cli_args.remove_intermediate:
         # only this host's shard: other hosts own (and may still be

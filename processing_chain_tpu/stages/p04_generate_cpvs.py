@@ -36,5 +36,8 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             )
         if getattr(cli_args, "lightweight_preview", False):
             runner.add(cp.create_preview(pvs))
-    runner.run_serial()
+    from ..utils.device import select_device
+
+    with select_device(getattr(cli_args, "set_gpu_loc", -1)):
+        runner.run_serial()
     return test_config

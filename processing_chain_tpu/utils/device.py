@@ -108,3 +108,27 @@ def device_count() -> int:
     if not _checked:
         ensure_backend()
     return jax.device_count()
+
+
+def select_device(index: int):
+    """Pin subsequent device computations to `jax.devices()[index]` — the
+    accelerator-placement analog of the reference's nvenc `-gpu N` splice
+    (reference parse_args.py:88-94, p01:64-68). Returns the jax.default_device
+    context manager, or a no-op context for index < 0 (auto)."""
+    import contextlib
+
+    if index is None or index < 0:
+        return contextlib.nullcontext()
+    if not _checked:
+        ensure_backend()  # never touch an un-probed backend (hang hazard)
+    import jax
+
+    devs = jax.devices()
+    if index >= len(devs):
+        from ..config.errors import ConfigError
+
+        raise ConfigError(
+            f"device index {index} out of range: {len(devs)} device(s) visible"
+        )
+    get_logger().info("pinning device work to %s", devs[index])
+    return jax.default_device(devs[index])

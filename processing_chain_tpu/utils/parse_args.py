@@ -1,9 +1,10 @@
 """CLI flag surface — parity with reference lib/parse_args.py:25-137.
 
 All shared flags (-c -f -v -n -p -r --filter-src/hrc/pvs -sos -str
---skip-requirements) plus per-stage extras: p01 -g/--set-gpu-loc (device
-index here), p03 -s/--spinner-path -z/--avpvs-src-fps -f60/--force-60-fps,
-p04 -e -a -ccrf.
+--skip-requirements --trace) plus per-stage extras: -g/--set-gpu-loc on
+p00/p01/p03/p04 (device index pinning the p03/p04 device work; accepted on
+p01 for reference-CLI compatibility), p03 -s/--spinner-path
+-z/--avpvs-src-fps -f60/--force-60-fps, p04 -e -a -ccrf.
 """
 
 from __future__ import annotations
@@ -65,10 +66,13 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
         "-str", "--scripts-to-run", default="1234",
         help='which stages p00 shall execute (e.g. "all", "1234", "34")',
     )
-    if script == 1:
+    if script in (None, 1, 3, 4):
+        # reference exposes -g on p01 (nvenc placement); here the device
+        # work lives in p03/p04, so those and the p00 orchestrator
+        # accept it too
         parser.add_argument(
             "-g", "--set-gpu-loc", default=-1, type=int,
-            help="accelerator device index to pin encodes to (-1 = auto)",
+            help="accelerator device index to pin device work to (-1 = auto)",
         )
     if script == 3:
         parser.add_argument(

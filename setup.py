@@ -1,0 +1,35 @@
+"""Build hook: compiles the native media boundary (libpcmedia.so) during
+`pip install` / `python -m build` by delegating to processing_chain_tpu/
+native/Makefile — the counterpart of the reference's Docker-time FFmpeg
+build (reference Dockerfile:1-56), except we link the system libav instead
+of compiling a pinned FFmpeg.
+
+Runtime loading falls back to building on first use (io/medialib._build),
+so a source checkout works without this step; packaging just front-loads it.
+"""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        native_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "processing_chain_tpu",
+            "native",
+        )
+        subprocess.run(["make", "-C", native_dir], check=True)
+        super().run()
+
+
+def _version() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "VERSION")) as f:
+        return f.read().strip()
+
+
+setup(version=_version(), cmdclass={"build_py": BuildWithNative})

@@ -196,3 +196,24 @@ def test_fs_barrier_init_clears_own_run_markers(monkeypatch, tmp_path):
     assert not mine.exists()          # own marker of this run: cleared
     assert other_host.exists()        # other hosts' markers: untouched
     assert other_run.exists()         # other runs' markers: untouched
+
+
+def test_select_device_pins_and_validates(devices8):
+    import jax
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.utils.device import select_device
+
+    with select_device(3):
+        x = jnp.ones((4,)) + 1
+        assert x.devices() == {jax.devices()[3]}
+    with select_device(-1):
+        pass  # auto: no-op context
+
+
+def test_select_device_out_of_range_is_config_error(devices8):
+    from processing_chain_tpu.config.errors import ConfigError
+    from processing_chain_tpu.utils.device import select_device
+
+    with pytest.raises(ConfigError, match="out of range"):
+        select_device(99)
