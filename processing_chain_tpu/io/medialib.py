@@ -88,9 +88,10 @@ class MediaError(RuntimeError):
     pass
 
 
-def _build() -> None:
+def _build(force: bool = False) -> None:
+    cmd = ["make", "-C", _NATIVE_DIR] + (["-B"] if force else [])
     subprocess.run(
-        ["make", "-C", _NATIVE_DIR],
+        cmd,
         check=True,
         capture_output=True,
         text=True,
@@ -104,7 +105,14 @@ def ensure_loaded() -> ct.CDLL:
             return _lib
         if not os.path.exists(_SO_PATH):
             _build()
-        lib = ct.CDLL(_SO_PATH)
+        try:
+            lib = ct.CDLL(_SO_PATH)
+        except OSError:
+            # a stale or foreign-platform binary (e.g. a checkout moved
+            # between architectures): force a rebuild for THIS host once
+            # (-B: the broken .so may look up-to-date to make)
+            _build(force=True)
+            lib = ct.CDLL(_SO_PATH)
 
         u8p = ct.POINTER(ct.c_uint8)
         i16p = ct.POINTER(ct.c_int16)

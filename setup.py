@@ -22,7 +22,13 @@ class BuildWithNative(build_py):
             "processing_chain_tpu",
             "native",
         )
-        subprocess.run(["make", "-C", native_dir], check=True)
+        try:
+            subprocess.run(["make", "-C", native_dir], check=True)
+        except (OSError, subprocess.CalledProcessError) as exc:
+            # no toolchain / no libav headers at install time is fine: the
+            # runtime builds lazily on first media call (io/medialib._build)
+            print(f"warning: native build skipped ({exc}); "
+                  "libpcmedia.so will be built on first use")
         super().run()
 
 

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# End-to-end smoke test — the counterpart of the reference's Docker smoke
+# run (reference test/build_and_test.sh:1-15: clone example-databases,
+# build image, run p00 on P2SXM00). No Docker and no external fixture
+# corpus here: a synthetic P2SXM00-shaped database is generated through
+# the framework's own encoder, then the full 4-stage chain plus the
+# quality-metrics tool run on it. Success = exit 0.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORKDIR="$(mktemp -d)"
+trap 'rm -rf "$WORKDIR"' EXIT
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python - "$WORKDIR" <<'PY'
+import sys, textwrap
+sys.path.insert(0, "."); sys.path.insert(0, "tests")
+from pathlib import Path
+import test_pipeline_e2e as e2e
+
+yaml_text = textwrap.dedent("""\
+    databaseId: P2SXM00
+    syntaxVersion: 6
+    type: short
+    qualityLevelList:
+      Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24}
+      Q1: {index: 1, videoCodec: h264, videoCrf: 28, width: 320, height: 180, fps: 24}
+    codingList:
+      VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+      VC02: {type: video, encoder: libx264, crf: yes, iFrameInterval: 1, preset: ultrafast}
+    srcList:
+      SRC000: SRC000.avi
+    hrcList:
+      HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}
+      HRC001: {videoCodingId: VC02, eventList: [[Q1, 2]]}
+      HRC002: {videoCodingId: VC01, eventList: [[Q0, 2], [stall, 0.5]]}
+    pvsList: [P2SXM00_SRC000_HRC000, P2SXM00_SRC000_HRC001, P2SXM00_SRC000_HRC002]
+    postProcessingList:
+      - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+""")
+path = e2e.write_db(Path(sys.argv[1]), "P2SXM00", yaml_text, {"SRC000.avi": dict(n=48)})
+print(path)
+PY
+
+DB_YAML="$WORKDIR/P2SXM00/P2SXM00.yaml"
+python -m processing_chain_tpu -c "$DB_YAML" -v --skip-requirements
+python -m processing_chain_tpu tools metrics -c "$DB_YAML"
+python -m processing_chain_tpu tools clean-logs "$WORKDIR/P2SXM00" -n
+
+# every artifact family must exist (reference README.md:17-31)
+for d in videoSegments qualityChangeEventFiles videoFrameInformation avpvs cpvs sideInformation logs; do
+  [ -n "$(ls -A "$WORKDIR/P2SXM00/$d" 2>/dev/null)" ] || { echo "FAIL: $d empty"; exit 1; }
+done
+echo "E2E OK"
