@@ -1,6 +1,6 @@
 """Benchmark: AVPVS hot path — 1080p→4K Lanczos upscale + SI/TI per frame.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 value        = frames/sec/chip of the jitted device step (luma+chroma
                Lanczos resample to 4K + Sobel SI + frame-diff TI).
@@ -22,8 +22,17 @@ body), then fetches a scalar reduction to the host — the elapsed wall
 time therefore covers ITERS full executions plus one tunnel round-trip,
 which is amortized out by a measured-overhead correction.
 
-The TPU backend is probed in a subprocess first so a wedged tunnel cannot
-hang the bench; it falls back to CPU (and says so in the "platform" field).
+Robustness (round-2 rework; round 1 timed out before emitting its line):
+the process is budgeted against BENCH_DEADLINE (default 240 s wall).
+The TPU is probed ONCE in a throwaway subprocess with a hard 30 s
+deadline (a wedged tunnel blocks inside PJRT client creation —
+unkillable from within); no retries, immediate CPU fallback.  The
+device measurement itself also runs in a watchdogged subprocess
+(`bench.py --child`) so a tunnel that wedges mid-run still cannot stop
+the parent from printing a (CPU-fallback) JSON line.  The CPU baseline
+uses ≥20 frames for a stable denominator, deadline-guarded.  The
+optional banded-vs-fused method comparison runs only if enough budget
+remains and lands in the same single JSON line.
 """
 
 import functools
@@ -39,66 +48,64 @@ H, W = 1080, 1920
 DH, DW = 2160, 3840
 T = int(os.environ.get("BENCH_FRAMES", "8"))
 ITERS = int(os.environ.get("BENCH_ITERS", "20"))
+DEADLINE = float(os.environ.get("BENCH_DEADLINE", "240"))
+_T0 = time.monotonic()
 
 
-def _tpu_usable(timeout_s: int = 60, attempts: int = 3, backoff_s: int = 30) -> bool:
-    """Probe the TPU in a throwaway subprocess (a wedged tunnel blocks inside
-    PJRT client creation — unkillable from within, so probe with a deadline).
-    A transient tunnel outage shouldn't demote the bench to CPU: retry with
-    backoff before giving up."""
+def _remaining() -> float:
+    return DEADLINE - (time.monotonic() - _T0)
+
+
+def _tpu_usable() -> bool:
+    """Probe the TPU once in a throwaway subprocess with a hard deadline.
+    One attempt only: round 1 burned 4 minutes in a retry/backoff loop and
+    the driver killed the bench before it printed anything."""
     code = (
         "import jax; d=jax.devices(); import jax.numpy as jnp;"
         "x=jnp.ones((8,8)); (x@x).block_until_ready(); print(d[0].platform)"
     )
-    for attempt in range(attempts):
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", code],
-                timeout=timeout_s,
-                capture_output=True,
-                text=True,
-            )
-            if proc.returncode == 0:
-                # a clean probe is definitive either way: retrying can't
-                # turn a CPU-only machine into a TPU one
-                return "cpu" not in proc.stdout
-        except subprocess.TimeoutExpired:
-            pass
-        if attempt + 1 < attempts:
-            print(
-                f"# tpu probe attempt {attempt + 1}/{attempts} failed; "
-                f"retrying in {backoff_s}s",
-                file=sys.stderr,
-            )
-            time.sleep(backoff_s)
-    return False
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=min(30, max(5, _remaining() - 60)),
+            capture_output=True,
+            text=True,
+        )
+        return proc.returncode == 0 and "cpu" not in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
 
 
-def main() -> None:
-    if not _tpu_usable():
-        os.environ["JAX_PLATFORMS"] = "cpu"
+def _child() -> None:
+    """Device measurement; prints one JSON dict {"per_step", "platform"}.
+
+    Run as a subprocess so the parent survives a mid-run tunnel wedge."""
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # the axon plugin's get_backend monkeypatch initializes the tunnel
+        # even under JAX_PLATFORMS=cpu; deregister it (same as tests/conftest)
         try:
             from jax._src import xla_bridge as _xb
 
             getattr(_xb, "_backend_factories", {}).pop("axon", None)
         except Exception:
             pass
-
     import jax
     import jax.numpy as jnp
 
-    try:
-        jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "") or None)
-    except Exception:
-        pass
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
+    # CPU fallback exists only so the bench always emits a line: shrink the
+    # problem (per-frame fps is what's reported, so T doesn't bias it)
+    t = T if platform != "cpu" else min(T, 2)
+    iters = ITERS if platform != "cpu" else 2
 
     from processing_chain_tpu.parallel import avpvs_siti_step
 
     rng = np.random.default_rng(0)
-    y = jnp.asarray(rng.integers(0, 255, size=(T, H, W), dtype=np.uint8))
-    u = jnp.asarray(rng.integers(0, 255, size=(T, H // 2, W // 2), dtype=np.uint8))
-    v = jnp.asarray(rng.integers(0, 255, size=(T, H // 2, W // 2), dtype=np.uint8))
+    y = jnp.asarray(rng.integers(0, 255, size=(t, H, W), dtype=np.uint8))
+    u = jnp.asarray(rng.integers(0, 255, size=(t, H // 2, W // 2), dtype=np.uint8))
+    v = jnp.asarray(rng.integers(0, 255, size=(t, H // 2, W // 2), dtype=np.uint8))
 
     @functools.partial(jax.jit, static_argnames=("iters",))
     def bench(y, u, v, iters):
@@ -119,36 +126,93 @@ def main() -> None:
         carry, sums = jax.lax.scan(body, jnp.uint8(0), None, length=iters)
         return jnp.sum(sums) + carry.astype(jnp.float32)
 
-    # warmup / compile both lengths; the scalar float() forces completion
-    float(bench(y, u, v, 1))
-    float(bench(y, u, v, ITERS))
+    # warmup / compile; the scalar float() forces completion
+    float(bench(y, u, v, iters))
+    if platform == "cpu":
+        # no tunnel overhead to amortize on CPU: one timed run suffices
+        t0 = time.perf_counter()
+        float(bench(y, u, v, iters))
+        per_step = (time.perf_counter() - t0) / iters
+    else:
+        float(bench(y, u, v, 1))
+        t0 = time.perf_counter()
+        float(bench(y, u, v, 1))
+        t_one = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(bench(y, u, v, iters))
+        t_many = time.perf_counter() - t0
+        # subtract the fixed tunnel/dispatch overhead (one-iter run ≈
+        # overhead + one step): per-step time from the marginal cost of
+        # iters-1 extra steps
+        per_step = (
+            max((t_many - t_one) / (iters - 1), 1e-9) if iters > 1 else t_many
+        )
+    print(
+        json.dumps(
+            {"per_step": per_step, "platform": platform, "iters": iters, "t": t}
+        )
+    )
 
-    t0 = time.perf_counter()
-    float(bench(y, u, v, 1))
-    t_one = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    float(bench(y, u, v, ITERS))
-    t_many = time.perf_counter() - t0
-    # subtract the fixed tunnel/dispatch overhead (one-iter run ≈ overhead +
-    # one step): per-step time from the marginal cost of ITERS-1 extra steps
-    per_step = max((t_many - t_one) / (ITERS - 1), 1e-9) if ITERS > 1 else t_many
-    device_fps = T / per_step
 
-    # CPU single-core baseline: swscale Lanczos + numpy Sobel SI / diff TI
+def _run_child(env_extra: dict, timeout_s: float) -> dict | None:
+    if timeout_s < 20:
+        return None
+    env = dict(os.environ, **env_extra)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    tpu_ok = _tpu_usable()
+    cpu_env = {"JAX_PLATFORMS": "cpu"}
+
+    res = None
+    if tpu_ok:
+        res = _run_child({}, min(_remaining() - 45, 120))
+    if res is None:
+        res = _run_child(cpu_env, min(_remaining() - 30, 120))
+    if res is None:  # last resort: never exit without the JSON line
+        res = {"per_step": float("inf"), "platform": "none", "iters": 0, "t": T}
+    device_fps = res.get("t", T) / res["per_step"]
+
+    # CPU single-core baseline: swscale Lanczos + numpy Sobel SI / diff TI.
+    # ≥20 frames for a stable denominator (round-1 used 2), deadline-guarded.
     from processing_chain_tpu.io import medialib
     from scipy.ndimage import convolve
 
-    ys = np.asarray(y[:2])
+    rng = np.random.default_rng(0)
+    ys = rng.integers(0, 255, size=(H, W), dtype=np.uint8)
     kx = np.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], float)
-    n_base = 2
+    n_base = max(1, int(os.environ.get("BENCH_BASE_FRAMES", "20")))
+    base_deadline = time.perf_counter() + max(10.0, _remaining() - 20)
     t0 = time.perf_counter()
     prev = None
+    done = 0
     for i in range(n_base):
-        up = medialib.sws_scale_plane(ys[i], DW, DH, medialib.SWS_LANCZOS)
-        _ = medialib.sws_scale_plane(
-            np.ascontiguousarray(ys[i][::2, ::2]), DW // 2, DH // 2,
-            medialib.SWS_LANCZOS,
-        )
+        up = medialib.sws_scale_plane(ys, DW, DH, medialib.SWS_LANCZOS)
+        for _chroma in range(2):  # U and V, matching the device step
+            _ = medialib.sws_scale_plane(
+                np.ascontiguousarray(ys[::2, ::2]), DW // 2, DH // 2,
+                medialib.SWS_LANCZOS,
+            )
         upf = up.astype(np.float64)
         gx = convolve(upf, kx)[1:-1, 1:-1]
         gy = convolve(upf, kx.T)[1:-1, 1:-1]
@@ -156,22 +220,41 @@ def main() -> None:
         if prev is not None:
             _ti = np.std(upf - prev)
         prev = upf
-    cpu_core_fps = n_base / (time.perf_counter() - t0)
+        done += 1
+        if done >= 4 and time.perf_counter() > base_deadline:
+            break
+    cpu_core_fps = done / (time.perf_counter() - t0)
     baseline_8core = 8.0 * cpu_core_fps
 
-    print(
-        json.dumps(
-            {
-                "metric": "AVPVS frames/sec/chip (1080p->4K Lanczos + SI/TI)",
-                "value": round(device_fps, 2),
-                "unit": "frames/s/chip",
-                "vs_baseline": round(device_fps / baseline_8core, 2),
-                "platform": platform,
-                "baseline_8core_fps": round(baseline_8core, 2),
-            }
-        )
-    )
+    out = {
+        "metric": "AVPVS frames/sec/chip (1080p->4K Lanczos + SI/TI)",
+        "value": round(device_fps, 2),
+        "unit": "frames/s/chip",
+        "vs_baseline": round(device_fps / baseline_8core, 2),
+        "platform": res["platform"],
+        "baseline_8core_fps": round(baseline_8core, 2),
+        "baseline_frames": done,
+    }
+
+    # Optional: fused-Pallas vs banded method comparison (TPU only, only if
+    # enough budget remains). Lands in the same single JSON line.
+    # (skipped when the parent env pins PC_RESIZE_METHOD: the headline child
+    # inherited it, so labeling the pair banded-vs-fused would be wrong)
+    if (
+        res["platform"] == "tpu"
+        and _remaining() > 100
+        and not os.environ.get("PC_RESIZE_METHOD")
+    ):
+        fused = _run_child({"PC_RESIZE_METHOD": "fused"}, _remaining() - 15)
+        if fused:
+            out["fused_fps"] = round(fused.get("t", T) / fused["per_step"], 2)
+            out["banded_fps"] = out["value"]
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _child()
+    else:
+        main()
