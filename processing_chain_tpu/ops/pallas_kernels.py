@@ -12,14 +12,16 @@ scalar-prefetch so the kernel can dynamic-slice its VMEM-resident frame.
 Replaces the decode+upscale inner loop of the reference's AVPVS stage
 (reference lib/ffmpeg.py:948, :1037 — swscale `scale=W:H:flags=...`).
 
-Layout per grid step (t, rb):
-  in    u8 [src_h, src_w]      whole frame, VMEM-resident across rb steps
-  wv    f32 [1, 128, band_v]   vertical weights for row block rb (streamed)
-  wh    f32 [ncb, 128, band_h] horizontal weights, resident
-  out   u8/f32 [1, 128, dst_w] one output row block
-  mid   f32 [128, src_w]       scratch: vertical pass result
+Layout per grid step (t, cb) — horizontal pass first, matching swscale's
+stage order so the 15-bit intermediate top-clamp sits between H and V like
+the golden integer path (ops/resize._swscale_exact):
+  in    u8 [src_h, src_w]       whole frame, VMEM-resident across cb steps
+  wv    f32 [nrb, 128, band_v]  vertical weights, resident
+  wh    f32 [1, 128, band_h]    horizontal weights for col block cb (streamed)
+  out   u8/f32 [1, dst_h, 128]  one output column stripe
+  mid   f32 [src_h, 128]        scratch: horizontal pass result (clamped)
 
-VMEM @ 1080p→4K ≈ 2 MB (in) + 1.2 MB (wh) + 1 MB (mid) + 0.5 MB (out):
+VMEM @ 1080p→4K ≈ 2 MB (in) + 0.7 MB (wv) + 0.6 MB (mid) + 0.5 MB (out):
 well under the ~16 MB/core budget; a 4K source (8.3 MB u8) still fits.
 """
 
@@ -42,35 +44,43 @@ def _fused_resize_kernel(
     starts_v_ref,   # SMEM [nrb]    (scalar prefetch)
     starts_h_ref,   # SMEM [ncb]    (scalar prefetch)
     in_ref,         # VMEM [1, src_h, src_w] u8
-    wv_ref,         # VMEM [1, BLOCK, band_v]
-    wh_ref,         # VMEM [ncb, BLOCK, band_h]
-    out_ref,        # VMEM [1, BLOCK, ncb * BLOCK]
-    mid_ref,        # VMEM scratch [BLOCK, src_w] f32
+    wv_ref,         # VMEM [nrb, BLOCK, band_v]
+    wh_ref,         # VMEM [1, BLOCK, band_h]
+    out_ref,        # VMEM [1, nrb * BLOCK, BLOCK]
+    mid_ref,        # VMEM scratch [src_h, BLOCK] f32
     *,
     band_v: int,
     band_h: int,
-    ncb: int,
+    nrb: int,
     quantize: bool,
     maxval: int,
 ):
-    rb = pl.program_id(1)
-    sv = starts_v_ref[rb]
-    src = in_ref[0, pl.ds(sv, band_v), :].astype(jnp.float32)
-    mid_ref[:, :] = jax.lax.dot(
-        wv_ref[0], src, precision=jax.lax.Precision.HIGHEST,
+    """One (frame, column-block) step: horizontal pass for this column
+    stripe first — matching swscale's stage order so the 15-bit
+    intermediate top-clamp lands between H and V exactly like the golden
+    integer path (resize._swscale_exact) — then all vertical row blocks
+    of the stripe from VMEM scratch."""
+    cb = pl.program_id(1)
+    sh = starts_h_ref[cb]
+    src = in_ref[0, :, pl.ds(sh, band_h)].astype(jnp.float32)
+    mid = jax.lax.dot(
+        src, wh_ref[0].T, precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     )
-    for cb in range(ncb):  # static unroll: ncb is small (dst_w / 128)
-        sh = starts_h_ref[cb]
+    if quantize and maxval == 255:
+        # swscale's hScale8To15 top-clamp in normalized units
+        mid = jnp.minimum(mid, 32767.0 / 128.0)
+    mid_ref[:, :] = mid
+    for rb in range(nrb):  # static unroll: nrb is small (dst_h / 128)
+        sv = starts_v_ref[rb]
         tile = jax.lax.dot(
-            mid_ref[:, pl.ds(sh, band_h)],
-            wh_ref[cb].T,
+            wv_ref[rb], mid_ref[pl.ds(sv, band_v), :],
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32,
         )
         if quantize:
             tile = jnp.clip(jnp.floor(tile + 0.5), 0, maxval)
-        out_ref[0, :, cb * BLOCK : (cb + 1) * BLOCK] = tile.astype(out_ref.dtype)
+        out_ref[0, rb * BLOCK : (rb + 1) * BLOCK, :] = tile.astype(out_ref.dtype)
 
 
 @functools.partial(
@@ -96,33 +106,33 @@ def resize_frames_fused(
     starts_h, wh, band_h = make_banded_plan(src_w, dst_w, kernel, BLOCK)
     nrb = wv.shape[0]
     ncb = wh.shape[0]
-    pad_w = ncb * BLOCK
+    pad_h = nrb * BLOCK
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(t, nrb),
+        grid=(t, ncb),
         in_specs=[
-            pl.BlockSpec((1, src_h, src_w), lambda ti, rb, *_: (ti, 0, 0)),
-            pl.BlockSpec((1, BLOCK, band_v), lambda ti, rb, *_: (rb, 0, 0)),
-            pl.BlockSpec((ncb, BLOCK, band_h), lambda ti, rb, *_: (0, 0, 0)),
+            pl.BlockSpec((1, src_h, src_w), lambda ti, cb, *_: (ti, 0, 0)),
+            pl.BlockSpec((nrb, BLOCK, band_v), lambda ti, cb, *_: (0, 0, 0)),
+            pl.BlockSpec((1, BLOCK, band_h), lambda ti, cb, *_: (cb, 0, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, BLOCK, pad_w), lambda ti, rb, *_: (ti, rb, 0)
+            (1, pad_h, BLOCK), lambda ti, cb, *_: (ti, 0, cb)
         ),
-        scratch_shapes=[pltpu.VMEM((BLOCK, src_w), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((src_h, BLOCK), jnp.float32)],
     )
     kernel_fn = functools.partial(
         _fused_resize_kernel,
         band_v=band_v,
         band_h=band_h,
-        ncb=ncb,
+        nrb=nrb,
         quantize=True,
         maxval=255 if frames.dtype == jnp.uint8 else 1023,
     )
     out = pl.pallas_call(
         kernel_fn,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((t, nrb * BLOCK, pad_w), frames.dtype),
+        out_shape=jax.ShapeDtypeStruct((t, pad_h, ncb * BLOCK), frames.dtype),
         interpret=interpret,
     )(jnp.asarray(starts_v), jnp.asarray(starts_h), frames,
       jnp.asarray(wv), jnp.asarray(wh))

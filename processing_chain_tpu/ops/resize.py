@@ -74,6 +74,18 @@ def make_plan(
     """
     if kernel not in _KERNELS:
         raise ValueError(f"unknown resize kernel {kernel!r}")
+    if (
+        quantize
+        and kernel in _SWSCALE_EXACT_KERNELS
+        and src_size != dst_size
+        and src_size / dst_size <= _SWSCALE_EXACT_MAX_RATIO
+    ):
+        # share the exact libswscale geometry (positions, edge-tap
+        # reduction, border folding, 14-bit error-diffused weights) so the
+        # float paths (banded/fused) differ from the golden integer path
+        # only by float accumulation rounding — including at borders
+        idx, co = _swscale_tap_matrix(src_size, dst_size, kernel, 1 << 14)
+        return idx, (co.astype(np.float64) / (1 << 14)).astype(np.float32)
     fn, support = _KERNELS[kernel]
     ratio = src_size / dst_size
     fscale = max(1.0, ratio)
@@ -107,6 +119,222 @@ def make_plan(
     # (swscale clips filterPos and folds edge weights)
     idx = np.clip(idx, 0, src_size - 1)
     return idx.astype(np.int32), w.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Exact libswscale integer plans (golden path)
+# ---------------------------------------------------------------------------
+#
+# Reconstruction of libswscale's initFilter (libswscale/utils.c) +
+# hScale8To15 + yuv2planeX_8 integer pipeline, validated bit-exact against
+# the installed libswscale under SWS_ACCURATE_RND|SWS_BITEXACT (its
+# deterministic C reference path) on noise inputs across up/downscales
+# including the 1080p->4K north-star ratio (tests/test_ops.py).
+#
+# Spec note (why ACCURATE_RND is the oracle): without SWS_ACCURATE_RND,
+# libswscale dispatches CPU-dependent SIMD kernels (SSE/AVX pmulhw-style
+# per-tap truncation in the vertical pass) whose output differs from its
+# own C reference by ±1 LSB and is not stable across hosts — measured here:
+# default-flags output vs ACCURATE_RND output deviates by exactly <=1 on
+# noise. "Bit-exact vs libswscale" is therefore only well-defined against
+# the C path; vs default flags the contract is <=1 LSB.
+
+_SWSCALE_EXACT_KERNELS = ("lanczos", "bicubic")
+_SWSCALE_EXACT_MAX_RATIO = 16.0  # validated envelope; chain max is ~8x
+
+
+def _trunc_div(a: int, b: int) -> int:
+    q, r = divmod(a, b)
+    if r != 0 and (a < 0) != (b < 0):
+        q += 1
+    return q
+
+
+@functools.lru_cache(maxsize=256)
+def make_swscale_plan(
+    src_size: int, dst_size: int, kernel: str, one: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """libswscale initFilter reconstruction for one axis.
+
+    Returns (pos [dst] int32, coeffs [dst, K] int32) where output i is
+    sum_k src[clip(pos[i]+k)] * coeffs[i, k] at `one` fixed-point scale
+    (1<<14 horizontal, 1<<12 vertical — swscale's hLumFilter/vLumFilter
+    scales). Mirrors utils.c: 16.16 xInc source mapping, double-precision
+    kernel eval scaled to fone=2^(54-min(log2(ratio),8)), cumulative-cutoff
+    edge-tap reduction (SWS_MAX_REDUCE_CUTOFF=0.002), border folding onto
+    edge taps, and sum-preserving error-diffusion quantization
+    (ROUNDED_DIV with carried remainder).
+    """
+    x_inc = ((src_size << 16) + (dst_size >> 1)) // dst_size
+    if abs(x_inc - 0x10000) < 10:  # identity
+        pos = np.arange(dst_size, dtype=np.int32)
+        return pos, np.full((dst_size, 1), one, dtype=np.int32)
+
+    srcW, dstW = src_size, dst_size
+    ratio_log2 = (srcW // dstW).bit_length() - 1 if srcW // dstW > 0 else 0
+    fone = 1 << (54 - min(ratio_log2, 8))
+    size_factor = {"lanczos": 6, "bicubic": 4}[kernel]
+    if x_inc <= 1 << 16:
+        filter_size = 1 + size_factor
+    else:
+        filter_size = 1 + (size_factor * srcW + dstW - 1) // dstW
+    filter_size = max(min(filter_size, srcW - 2), 1)
+
+    filt = np.zeros((dstW, filter_size), dtype=np.int64)
+    fpos = np.zeros(dstW, dtype=np.int64)
+    # center_i = (i+0.5)*ratio - 0.5 tracked in 1/2^17 px (utils.c xDstInSrc)
+    xDstInSrc = x_inc - 65536
+    for i in range(dstW):
+        xx = _trunc_div(xDstInSrc - (filter_size - 2) * 65536, 131072)
+        fpos[i] = xx
+        for j in range(filter_size):
+            d = abs((xx + j) * 131072 - xDstInSrc) << 13  # 1/2^30 px
+            if x_inc > 1 << 16:
+                d = d * dstW // srcW  # downscale kernel stretch
+            floatd = d * (1.0 / (1 << 30))
+            if kernel == "bicubic":
+                B, C = 0, int(0.6 * (1 << 24))
+                if d >= 1 << 31:
+                    coeff = 0
+                else:
+                    dd = (d * d) >> 30
+                    ddd = (dd * d) >> 30
+                    if d < 1 << 30:
+                        coeff = (
+                            (12 * (1 << 24) - 9 * B - 6 * C) * ddd
+                            + (-18 * (1 << 24) + 12 * B + 6 * C) * dd
+                            + (6 * (1 << 24) - 2 * B) * (1 << 30)
+                        )
+                    else:
+                        coeff = (
+                            (-B - 6 * C) * ddd
+                            + (6 * B + 30 * C) * dd
+                            + (-12 * B - 48 * C) * d
+                            + (8 * B + 24 * C) * (1 << 30)
+                        )
+                    coeff = coeff // ((1 << 54) // fone)
+            else:  # lanczos, p=3
+                if floatd == 0.0:
+                    coeff = int(fone)
+                elif floatd > 3.0:
+                    coeff = 0
+                else:
+                    v = (
+                        math.sin(floatd * math.pi)
+                        * math.sin(floatd * math.pi / 3.0)
+                        / (floatd * floatd * math.pi * math.pi / 3.0)
+                    )
+                    coeff = int(v * fone)  # C double->int64 truncates
+            filt[i, j] = coeff
+        xDstInSrc += 2 * x_inc
+
+    # reduce: trim near-zero edge taps (cumulative |coeff| cutoff 0.002)
+    cutoff = int(0.002 * fone)
+    min_filter_size = 0
+    for i in range(dstW - 1, -1, -1):
+        mn = filter_size
+        cut = 0
+        while True:
+            cut += abs(int(filt[i, 0]))
+            if cut > cutoff:
+                break
+            if i < dstW - 1 and fpos[i] >= fpos[i + 1]:
+                break
+            filt[i, :-1] = filt[i, 1:]
+            filt[i, -1] = 0
+            fpos[i] += 1
+        cut = 0
+        for j in range(filter_size - 1, 0, -1):
+            cut += abs(int(filt[i, j]))
+            if cut > cutoff:
+                break
+            mn -= 1
+        min_filter_size = max(min_filter_size, mn)
+    filt = filt[:, :min_filter_size]
+    filter_size = min_filter_size
+
+    # fix borders: fold out-of-range taps onto the edge samples
+    for i in range(dstW):
+        if fpos[i] < 0:
+            g = np.zeros(filter_size, dtype=np.int64)
+            for j in range(filter_size):
+                g[max(j + int(fpos[i]), 0)] += filt[i, j]
+            filt[i] = g
+            fpos[i] = 0
+        if fpos[i] + filter_size > srcW:
+            shift = int(fpos[i] + min(filter_size - srcW, 0))
+            g = filt[i].copy()
+            acc = 0
+            for j in range(filter_size - 1, -1, -1):
+                if fpos[i] + j >= srcW:
+                    acc += g[j]
+                    g[j] = 0
+            g2 = np.zeros(filter_size, dtype=np.int64)
+            g2[shift:] = g[: filter_size - shift] if shift > 0 else g
+            fpos[i] -= shift
+            g2[srcW - 1 - int(fpos[i])] += acc
+            filt[i] = g2
+
+    # normalize + quantize with error diffusion (sum preserved per row)
+    out = np.zeros((dstW, filter_size), dtype=np.int32)
+    for i in range(dstW):
+        s = (int(filt[i].sum()) + one // 2) // one
+        if s == 0:
+            s = 1
+        err = 0
+        for j in range(filter_size):
+            v = int(filt[i, j]) + err
+            iv = _trunc_div(v + (s >> 1) if v >= 0 else v - (s >> 1), s)
+            out[i, j] = iv
+            err = v - iv * s
+    return fpos.astype(np.int32), out
+
+
+def _swscale_tap_matrix(
+    src_size: int, dst_size: int, kernel: str, one: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Expand a make_swscale_plan into a clipped [dst, K] index matrix +
+    int32 coeffs, the _apply_axis input shape. Out-of-range taps (always
+    zero-coefficient after border folding) clip to the edge sample."""
+    pos, co = make_swscale_plan(src_size, dst_size, kernel, one)
+    k = co.shape[1]
+    idx = np.clip(
+        pos[:, None].astype(np.int64) + np.arange(k)[None, :], 0, src_size - 1
+    )
+    return idx.astype(np.int32), co
+
+
+def swscale_exact_applicable(
+    src_h: int, src_w: int, dst_h: int, dst_w: int, kernel: str
+) -> bool:
+    return (
+        kernel in _SWSCALE_EXACT_KERNELS
+        and src_h / dst_h <= _SWSCALE_EXACT_MAX_RATIO
+        and src_w / dst_w <= _SWSCALE_EXACT_MAX_RATIO
+    )
+
+
+def _swscale_exact(
+    x: jnp.ndarray, dst_h: int, dst_w: int, kernel: str
+) -> jnp.ndarray:
+    """uint8 [..., H, W] -> uint8 [..., dst_h, dst_w], bit-exact vs the
+    libswscale C reference path (SWS_ACCURATE_RND|SWS_BITEXACT).
+
+    Integer pipeline, horizontal first like swscale: hScale8To15
+    (int32 MAC of 14-bit coeffs, >>7 arithmetic, clip top to 32767), then
+    yuv2planeX_8 (int32 MAC of 12-bit coeffs + dither 64<<12, >>19, clip
+    to u8). The identity-axis case degenerates to the same formulas
+    (yuv2plane1's (v+64)>>7 == (v<<12 + 64<<12)>>19).
+    """
+    src_h, src_w = x.shape[-2], x.shape[-1]
+    idx_h, hco = _swscale_tap_matrix(src_w, dst_w, kernel, 1 << 14)
+    idx_v, vco = _swscale_tap_matrix(src_h, dst_h, kernel, 1 << 12)
+    xi = x.astype(jnp.int32)
+    inter = _apply_axis(xi, jnp.asarray(idx_h), jnp.asarray(hco), x.ndim - 1)
+    inter = jnp.minimum(jnp.right_shift(inter, 7), 32767)
+    val = _apply_axis(inter, jnp.asarray(idx_v), jnp.asarray(vco), x.ndim - 2)
+    out = jnp.right_shift(val + (64 << 12), 19)
+    return jnp.clip(out, 0, 255).astype(jnp.uint8)
 
 
 # ---------------------------------------------------------------------------
@@ -210,12 +438,15 @@ def resize_plane(
     round-half-up when quantize_output and input was integer, else float32.
 
     method:
-      "gather" — K per-tap gathers + FMAs (VPU; bit-exact vs libswscale,
-                 the golden-test reference path).
+      "gather" — for u8 lanczos/bicubic: the exact libswscale integer
+                 pipeline (_swscale_exact; bit-exact vs the C reference
+                 path, the golden-test contract). Otherwise K per-tap
+                 float gathers + FMAs (VPU).
       "banded" — block-banded dense matmuls (MXU; see make_banded_plan).
-                 f32 accumulation order differs, so round-half-up ties can
-                 land one code value away (measured ≤1 LSB on ~4 px per
-                 million vs "gather").
+                 Same geometry + intermediate clamp as the golden path but
+                 f32 arithmetic with 14-bit weights on both axes (the exact
+                 path's vertical stage is 12-bit), so ~1-2% of noise pixels
+                 land one code value away (measured; never more than 1).
       "fused"  — the Pallas two-pass kernel (pallas_kernels.resize_frames_
                  fused): both passes in VMEM, no HBM intermediate. TPU only,
                  [T, H, W] integer input, quantized output.
@@ -240,11 +471,27 @@ def resize_plane(
             x, dst_h, dst_w, kernel,
             interpret=not pallas_kernels.pallas_available(),
         )
+    if (
+        method == "gather"
+        and (src_h, src_w) != (dst_h, dst_w)
+        and x.dtype == jnp.uint8
+        and quantize_output
+        and swscale_exact_applicable(src_h, src_w, dst_h, dst_w, kernel)
+    ):
+        # golden path: bit-exact vs libswscale's C reference (see
+        # make_swscale_plan); float gather remains for 10-bit/float inputs
+        return _swscale_exact(x, dst_h, dst_w, kernel)
     xf = x.astype(jnp.float32)
     if (src_h, src_w) != (dst_h, dst_w):
         if method == "banded":
-            xf = _banded_axis_rows(xf, src_h, dst_h, kernel)
+            # swscale order: horizontal first, then its 15-bit intermediate
+            # top-clamp (32767/128 in normalized units) — without it Lanczos
+            # overshoot on noise diverges from the golden path by dozens of
+            # code values (the oracle clamps in hScale8To15)
             xf = _banded_axis_last(xf, src_w, dst_w, kernel)
+            if x.dtype == jnp.uint8:
+                xf = jnp.minimum(xf, 32767.0 / 128.0)
+            xf = _banded_axis_rows(xf, src_h, dst_h, kernel)
         elif method != "gather":
             raise ValueError(f"unknown resize method {method!r}")
         else:

@@ -52,9 +52,12 @@ def test_resize_batched_matches_single():
 @pytest.mark.parametrize("dst", [(1080, 1920), (540, 960), (96, 128), (270, 480)])
 def test_resize_banded_matches_gather(kernel, dst):
     """The MXU block-banded matmul path must agree with the golden gather
-    path to 1 LSB (f32 accumulation-order ties at the .5 rounding edge) on
-    all but a vanishing fraction of pixels — up, down, and non-multiple-of-
-    block sizes."""
+    path (exact libswscale integers) to 1 LSB on noise — up, down, and
+    non-multiple-of-block sizes. The residual mismatch rate (~1-2%) is the
+    float path's 14-bit-everywhere weights vs the exact path's 12-bit
+    vertical stage plus truncation-vs-round differences; both share the
+    same geometry and the 15-bit intermediate clamp, which is what bounds
+    the deviation to 1."""
     rng = np.random.default_rng(7)
     src = rng.integers(0, 255, size=(3, 270, 480), dtype=np.uint8)
     dh, dw = dst
@@ -62,7 +65,7 @@ def test_resize_banded_matches_gather(kernel, dst):
     b = np.asarray(resize.resize_plane(src, dh, dw, kernel, method="banded"))
     diff = np.abs(a.astype(int) - b.astype(int))
     assert diff.max() <= 1, f"max {diff.max()}"
-    assert (diff != 0).mean() < 1e-4
+    assert (diff != 0).mean() < 0.03
 
 
 def test_resize_banded_plan_band_covers_taps():
@@ -378,18 +381,59 @@ def test_quantize_device_saturates_not_wraps():
     ("lanczos", medialib.SWS_LANCZOS),
     ("bicubic", medialib.SWS_BICUBIC),
 ])
-@pytest.mark.parametrize("dst", [(540, 960), (68, 120)])
-def test_resize_golden_vs_swscale_noise(kernel, flag, dst):
+@pytest.mark.parametrize("dst", [(540, 960), (68, 120), (270, 480)])
+def test_resize_golden_vs_swscale_noise_bitexact(kernel, flag, dst):
     """Golden on pure noise — the adversarial rounding case (every output
-    value sits near a different fixed-point edge than smooth content)."""
+    value sits near a different fixed-point edge than smooth content).
+
+    The gather path must be BIT-EXACT (diff == 0) against libswscale's
+    deterministic C reference (SWS_ACCURATE_RND|SWS_BITEXACT). That is the
+    only well-defined 'bit-exact vs libswscale' contract: without
+    ACCURATE_RND, libswscale runs CPU-dependent SIMD kernels whose vertical
+    pass truncates per-tap (pmulhw) and deviates from its own C reference
+    by ±1 LSB — covered by the companion default-flags test below.
+    (270, 480) is the 2x north-star upscale ratio (1080p->4K)."""
+    rng = np.random.default_rng(11)
+    src = rng.integers(0, 255, size=(135, 240), dtype=np.uint8)
+    dh, dw = dst
+    ref = medialib.sws_scale_plane(
+        src, dw, dh, flag | medialib.SWS_ACCURATE_RND | medialib.SWS_BITEXACT
+    )
+    ours = np.asarray(resize.resize_plane(src, dh, dw, kernel, method="gather"))
+    diff = np.abs(ref.astype(int) - ours.astype(int))
+    assert diff.max() == 0, f"max {diff.max()} at {np.argwhere(diff == diff.max())[:3]}"
+
+
+@pytest.mark.parametrize("kernel,flag", [
+    ("lanczos", medialib.SWS_LANCZOS),
+    ("bicubic", medialib.SWS_BICUBIC),
+])
+@pytest.mark.parametrize("dst", [(540, 960), (68, 120)])
+def test_resize_golden_vs_swscale_noise_default_flags(kernel, flag, dst):
+    """vs the default-flags oracle (what the reference's ffmpeg CLI runs):
+    the host SIMD path deviates ≤1 LSB from the C reference it and we
+    implement, so the contract here is ≤1 with high exact fraction."""
     rng = np.random.default_rng(11)
     src = rng.integers(0, 255, size=(135, 240), dtype=np.uint8)
     dh, dw = dst
     ref = medialib.sws_scale_plane(src, dw, dh, flag)
-    ours = np.asarray(resize.resize_plane(src, dh, dw, kernel))
+    ours = np.asarray(resize.resize_plane(src, dh, dw, kernel, method="gather"))
     diff = np.abs(ref.astype(int) - ours.astype(int))
     assert diff.max() <= 1, f"max {diff.max()}"
     assert (diff == 0).mean() > 0.80
+
+
+def test_swscale_exact_1080p_to_4k_noise():
+    """Full-size north-star case: 1080p noise -> 4K, bit-exact vs the C
+    reference path for the chain's default Lanczos kernel."""
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, 255, size=(1080, 1920), dtype=np.uint8)
+    ref = medialib.sws_scale_plane(
+        src, 3840, 2160,
+        medialib.SWS_LANCZOS | medialib.SWS_ACCURATE_RND | medialib.SWS_BITEXACT,
+    )
+    ours = np.asarray(resize.resize_plane(src, 2160, 3840, "lanczos", method="gather"))
+    np.testing.assert_array_equal(ref, np.asarray(ours))
 
 
 @pytest.mark.parametrize("kernel,flag", [
