@@ -55,7 +55,10 @@ def resolve_fps_spec(fps_spec, src_fps: float) -> Optional[float]:
         raise ConfigError(f"unsupported SRC frame rate {src_fps} for 50/60")
     if "/" in str(fps_spec):
         return src_fps * float(Fraction(str(fps_spec)))
-    return float(int(fps_spec))
+    # the reference coerces with int() (lib/ffmpeg.py:388), silently
+    # flooring a numeric 29.97 to 29 — a do-not-copy bug; non-integer
+    # specs keep their value here (integer specs behave identically)
+    return float(fps_spec)
 
 
 def select_indices(n_frames: int, src_fps: float, dst_fps: float) -> np.ndarray:

@@ -215,19 +215,25 @@ class SftpStore:
     plan time even for databases whose segments never touch the store, and
     a plan step must not block on a TCP dial. paramiko-missing surfaces at
     construction (cheap, actionable); network errors surface at first
-    access."""
+    access. All operations serialize on one lock: p01 runs online jobs
+    `-p`-wide and a paramiko SFTP channel is not safe for concurrent
+    requests (nor is the lazy connect's check-then-set)."""
 
     def __init__(self, host: str, port: int, user: str, password: str, root: str) -> None:
         try:
             import paramiko  # type: ignore  # noqa: F401
         except ImportError as exc:
             raise RuntimeError("paramiko is not installed; SFTP store unavailable") from exc
+        import threading
+
         self._params = (host.split(":")[0], port, user, password)
         self._sftp = None
         self._transport = None
+        self._lock = threading.Lock()
         self.root = root
 
     def _client(self):
+        # callers hold self._lock
         if self._sftp is None:
             import paramiko  # type: ignore
 
@@ -242,24 +248,28 @@ class SftpStore:
         return os.path.join(self.root, rel_path)
 
     def exists(self, rel_path: str) -> bool:
-        try:
-            self._client().stat(self._abs(rel_path))
-            return True
-        except OSError:
-            return False
+        with self._lock:
+            try:
+                self._client().stat(self._abs(rel_path))
+                return True
+            except OSError:
+                return False
 
     def listdir(self, rel_path: str) -> list[str]:
-        return self._client().listdir(self._abs(rel_path))
+        with self._lock:
+            return self._client().listdir(self._abs(rel_path))
 
     def download(self, rel_path: str, local_path: str) -> None:
         os.makedirs(os.path.dirname(local_path), exist_ok=True)
-        self._client().get(self._abs(rel_path), local_path)
+        with self._lock:
+            self._client().get(self._abs(rel_path), local_path)
 
     def close(self) -> None:
-        if self._sftp is not None:
-            self._sftp.close()
-            self._transport.close()
-            self._sftp = self._transport = None
+        with self._lock:
+            if self._sftp is not None:
+                self._sftp.close()
+                self._transport.close()
+                self._sftp = self._transport = None
 
 
 # ------------------------------------------------------- settings loading
@@ -381,11 +391,15 @@ class Downloader:
         youtube: Optional[YoutubeClient] = None,
         store: Optional[ChunkStore] = None,
         overwrite: bool = False,
+        bitmovin_api: Optional["bitmovin.BitmovinApi"] = None,
+        bitmovin_settings: Optional[BitmovinSettings] = None,
     ) -> None:
         self.video_segments_folder = video_segments_folder
         self.youtube = youtube
         self.store = store
         self.overwrite = overwrite
+        self.bitmovin_api = bitmovin_api
+        self.bitmovin_settings = bitmovin_settings
 
     @classmethod
     def from_settings(
@@ -404,6 +418,8 @@ class Downloader:
                 "bitmovin_settings",
             )
         store = None
+        bm_settings = None
+        bm_api = None
         if os.path.isdir(settings_dir):
             # misconfigured credentials must degrade (store=None), never
             # abort p01: YouTube-only databases need no Bitmovin settings
@@ -418,6 +434,16 @@ class Downloader:
                     )
                 else:
                     store = make_chunk_store(settings)
+                    bm_settings = settings
+                    try:
+                        from .bitmovin import SdkBitmovinApi
+
+                        bm_api = SdkBitmovinApi(settings.api_key)
+                    except RuntimeError as exc:
+                        get_logger().info(
+                            "Bitmovin cloud submission unavailable (%s); "
+                            "resume levels 1-3 still served", exc,
+                        )
             except Exception as exc:  # noqa: BLE001 - degrade by design
                 get_logger().warning(
                     "bitmovin settings unusable (%s); continuing without a "
@@ -430,7 +456,8 @@ class Downloader:
             pass  # no yt-dlp in the environment; YouTube paths unavailable
         return cls(
             video_segments_folder, youtube=youtube, store=store,
-            overwrite=overwrite,
+            overwrite=overwrite, bitmovin_api=bm_api,
+            bitmovin_settings=bm_settings,
         )
 
     # ------------------------------------------------------------- youtube
@@ -615,22 +642,32 @@ class Downloader:
     def encode_bitmovin(self, seg, overwrite: bool = False) -> Optional[str]:
         """Resume-aware Bitmovin path for one segment (reference
         encode_bitmovin, :387-744). Levels 3/2/1 are served from existing
-        artifacts; level 0 requires the Bitmovin SDK to submit a cloud
-        encode, which is not available in this environment."""
+        artifacts; level 0 submits a cloud encode through the injected
+        `bitmovin_api` client (services.bitmovin), then reassembles the
+        resulting chunks exactly like a level-1 resume."""
         log = get_logger()
         audio = seg.quality_level.audio_bitrate is not None
         filename = seg.filename
         codec = seg.quality_level.video_codec
 
         force = overwrite or self.overwrite
+        h26x = str(codec).casefold() in ("h264", "h265", "hevc", "avc")
         if not force and os.path.isfile(
             os.path.join(self.video_segments_folder, filename)
         ):
             log.info("%s already exists. Use -f for overwriting", filename)
             return os.path.join(self.video_segments_folder, filename)
 
+        # h26x cloud encodes land as ONE finished mp4 (the plan's MP4Muxing,
+        # reference :698-711), not a chunk tree: try pulling it directly
+        # (reference's download_from_sftp pre-check, :418-421)
+        if not force and h26x:
+            final = self._download_final_mp4(filename)
+            if final:
+                return final
+
         # with --force the final segment is still regenerated from chunks —
-        # a cloud *re-encode* would need the SDK, which is unavailable here
+        # a cloud re-encode of identical settings would be wasted spend
         chunk_level = self._chunk_level(filename, codec, audio)
         if chunk_level == 2:
             log.info("%s will be generated from existing local chunks", filename)
@@ -639,7 +676,47 @@ class Downloader:
             log.info("%s will be generated from remote chunks", filename)
             self.fetch_remote_chunks(filename, audio)
             return self.generate_full_segment(filename, codec, audio)
-        raise RuntimeError(
-            "Bitmovin cloud encoding requires the bitmovin-api-sdk, which is "
-            "not installed; only resume levels 1-3 are available"
-        )
+        if self.bitmovin_api is None or self.bitmovin_settings is None:
+            raise RuntimeError(
+                "no cloud artifacts exist for this segment and no Bitmovin "
+                "API client is configured (Downloader(bitmovin_api=...) plus "
+                "bitmovin_settings/); only resume levels 1-3 are available"
+            )
+        if self.store is None:
+            # check BEFORE submitting: a cloud encode whose output cannot
+            # be fetched back is pure spend
+            raise RuntimeError(
+                "no remote chunk store configured (output_details.yaml) — "
+                "refusing to submit a Bitmovin encode whose output could "
+                "not be fetched back"
+            )
+        from . import bitmovin as bm
+
+        plan = bm.plan_encoding(seg, self.bitmovin_settings)
+        log.info("submitting Bitmovin encode for %s (%s)", filename, plan.codec)
+        bm.submit_encoding(self.bitmovin_api, plan)
+        if h26x:
+            final = self._download_final_mp4(filename)
+            if final is None:
+                raise RuntimeError(
+                    f"Bitmovin encode for {filename} finished but "
+                    f"{os.path.splitext(filename)[0]}.mp4 is not on the store"
+                )
+            return final
+        self.fetch_remote_chunks(filename, audio)
+        return self.generate_full_segment(filename, codec, audio)
+
+    def _download_final_mp4(self, filename: str) -> Optional[str]:
+        """Pull `<name>/<name>.mp4` (the MP4Muxing layout plan_encoding
+        requests) from the store into the segments folder; None when the
+        store is absent or the file is not there."""
+        if self.store is None:
+            return None
+        name = os.path.splitext(filename)[0]
+        rel = os.path.join(name, f"{name}.mp4")
+        if not self.store.exists(rel):
+            return None
+        final = os.path.join(self.video_segments_folder, filename)
+        self.store.download(rel, final)
+        get_logger().info("downloaded finished cloud encode %s", filename)
+        return final

@@ -54,7 +54,8 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             continue
         runner.add(seg_model.encode_segment(segment))
     log.info("p01: %d segment encodes planned", len(runner.jobs))
-    # device work is serialized through the single chip; host decode/encode
-    # parallelism lives inside the native layer
-    runner.run_serial()
+    # pure host work (libav encode via ctypes releases the GIL): run the
+    # encodes `-p`-wide like the reference's Pool(4) (cmd_utils.py:93-101);
+    # each encode stays -threads 1, so parallelism comes from the pool
+    runner.run()
     return test_config

@@ -20,13 +20,19 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             cli_args.filter_pvs,
         )
 
+    # device-stage jobs run ≤2-wide: compiled-graph executions serialize
+    # through the chip's queue anyway, but 2 in flight lets PVS N+1's host
+    # decode overlap PVS N's device/encode (each job already pipelines
+    # decode→device→encode internally via engine/prefetch); wider only
+    # multiplies host RAM (CHUNK frames per in-flight PVS) for no overlap
+    pvs_par = max(1, min(cli_args.parallelism, 2))
     runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
-        parallelism=cli_args.parallelism, name="p03",
+        parallelism=pvs_par, name="p03",
     )
     stall_runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
-        parallelism=cli_args.parallelism, name="p03-stall",
+        parallelism=pvs_par, name="p03-stall",
     )
     # p00 parses without the p03-only flags; fall back to the default
     # spinner so orchestrated runs still composite it (the reference p00
@@ -50,8 +56,9 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     from ..utils.device import select_device
 
     with select_device(getattr(cli_args, "set_gpu_loc", -1)):
-        runner.run_serial()
-        stall_runner.run_serial()
+        # two phases: stalling reads the wo_buffer outputs of phase one
+        runner.run()
+        stall_runner.run()
 
     if cli_args.remove_intermediate:
         # only this host's shard: other hosts own (and may still be

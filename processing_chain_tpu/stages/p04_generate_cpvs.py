@@ -18,9 +18,11 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
             cli_args.filter_pvs,
         )
+    # ≤2-wide like p03: overlap adjacent PVSes' host decode with device
+    # work without multiplying host RAM (see p03_generate_avpvs)
     runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
-        parallelism=cli_args.parallelism, name="p04",
+        parallelism=max(1, min(cli_args.parallelism, 2)), name="p04",
     )
     for pvs_id, pvs in local_shard(test_config.pvses):
         if cli_args.skip_online_services and pvs.is_online():
@@ -39,5 +41,5 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     from ..utils.device import select_device
 
     with select_device(getattr(cli_args, "set_gpu_loc", -1)):
-        runner.run_serial()
+        runner.run()
     return test_config

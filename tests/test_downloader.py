@@ -286,9 +286,9 @@ def test_bitmovin_force_regenerates_from_chunks(tmp_path):
     assert os.path.isfile(out)
     out2 = d.encode_bitmovin(seg, overwrite=True)  # regenerates, no raise
     assert out2 == out and os.path.isfile(out)
-    # no chunks and no final -> clear error about the missing SDK
+    # no chunks, no final, no API client -> clear configuration error
     seg.filename = "SEG005.mp4"
-    with pytest.raises(RuntimeError, match="bitmovin-api-sdk"):
+    with pytest.raises(RuntimeError, match="no Bitmovin API client"):
         d.encode_bitmovin(seg)
 
 
@@ -369,3 +369,222 @@ def test_downloader_from_settings_without_dir(tmp_path):
         str(tmp_path), settings_dir=str(tmp_path / "nope")
     )
     assert d.store is None
+
+
+# -------------------------------------------------------- bitmovin level 0
+
+
+def _bm_seg(codec="h264", pixfmt="yuv420p", audio=False, fps="original",
+            filename=None, **vc_over):
+    """Minimal domain-shaped segment for plan tests."""
+    from types import SimpleNamespace as NS
+
+    ql = NS(video_codec=codec, video_bitrate=1500, width=1920, height=1080,
+            fps=fps, max_gop=60, min_gop=None,
+            audio_bitrate=320 if audio else None,
+            audio_codec="aac" if audio else None)
+    vc = NS(minrate_factor=None, maxrate_factor=None, bufsize_factor=None,
+            bframes=2, quality="good")
+    for k, v in vc_over.items():
+        setattr(vc, k, v)
+    src = NS(filename="SRC000.avi", get_fps=lambda: 60.0)
+    ext = ".webm" if codec == "vp9" else ".mp4"
+    return NS(filename=filename or f"P2SXM00_SRC000_HRC000{ext}",
+              quality_level=ql, video_coding=vc, src=src,
+              target_pix_fmt=pixfmt)
+
+
+def _bm_settings():
+    return dl.BitmovinSettings(
+        api_key="k",
+        input_details={"type": "https", "host": "in.example", "user": "u",
+                       "password": "p", "path": "/srcVid"},
+        output_details={"type": "sftp", "host": "out.example", "port": 22,
+                        "user": "u", "password": "p", "root": "/enc"},
+    )
+
+
+def test_bitmovin_plan_h264_audio_and_factors():
+    from processing_chain_tpu.services import bitmovin as bm
+
+    seg = _bm_seg(audio=True, minrate_factor=0.5, maxrate_factor=1.5,
+                  bufsize_factor=2.0)
+    plan = bm.plan_encoding(seg, _bm_settings())
+    assert plan.codec == "h264"
+    assert plan.input_kind == "https"
+    assert plan.input_path == "/srcVid/SRC000.avi"
+    assert plan.output_path == "/enc/P2SXM00_SRC000_HRC000"
+    cfg = plan.codec_config
+    assert cfg["bitrate"] == 1_500_000
+    assert cfg["min_bitrate"] == 750_000
+    assert cfg["max_bitrate"] == 2_250_000
+    assert cfg["bufsize"] == 3_000_000
+    assert cfg["bframes"] == 2 and cfg["max_gop"] == 60
+    assert cfg["pixel_format"] == "YUV420P"
+    assert cfg["rate"] is None  # fps 'original'
+    # audio capped at 256 kbit/s AAC@48k (reference :405-412)
+    assert plan.audio_config == {
+        "name": "P2SXM00_SRC000_HRC000_audio_configuration",
+        "bitrate": 256, "rate": 48000,
+    }
+    # ONE mp4 muxing with both streams (the reference double-creates)
+    assert len(plan.muxings) == 1
+    assert plan.muxings[0]["kind"] == "mp4"
+    assert plan.muxings[0]["streams"] == ["video", "audio"]
+
+
+def test_bitmovin_plan_h265_10bit():
+    from processing_chain_tpu.services import bitmovin as bm
+
+    plan = bm.plan_encoding(
+        _bm_seg(codec="hevc", pixfmt="yuv422p10le", fps="30"), _bm_settings()
+    )
+    assert plan.codec == "h265"
+    assert plan.codec_config["profile"] == "main10"
+    assert plan.codec_config["pixel_format"] == "YUV422P10LE"
+    assert plan.codec_config["rate"] == 30.0
+
+
+def test_bitmovin_plan_vp9_webm_chunks_and_pct_factors():
+    from processing_chain_tpu.services import bitmovin as bm
+
+    plan = bm.plan_encoding(
+        _bm_seg(codec="vp9", audio=True, minrate_factor=0.5,
+                maxrate_factor=1.45, quality="best"),
+        _bm_settings(),
+    )
+    cfg = plan.codec_config
+    assert cfg["quality"] == "BEST"
+    assert cfg["rate_undershoot_pct"] == 50
+    assert cfg["rate_overshoot_pct"] == 145
+    kinds = [m["kind"] for m in plan.muxings]
+    assert kinds == ["webm", "fmp4"]
+    assert plan.muxings[0]["segment_naming"] == "P2SXM00_SRC000_HRC000_%number%.chk"
+    assert plan.muxings[0]["init_segment_name"] == "P2SXM00_SRC000_HRC000_init.hdr"
+    assert plan.muxings[1]["output_path"].endswith("/audio")
+
+
+def test_bitmovin_plan_rejects_non_aac_audio():
+    from processing_chain_tpu.services import bitmovin as bm
+
+    seg = _bm_seg(audio=True)
+    seg.quality_level.audio_codec = "opus"
+    with pytest.raises(bm.BitmovinPlanError, match="aac"):
+        bm.plan_encoding(seg, _bm_settings())
+
+
+class FakeBitmovinApi:
+    """Records the reference call sequence; optionally runs a hook when
+    the encoding starts (to simulate the cloud writing chunks)."""
+
+    def __init__(self, on_start=None):
+        self.calls = []
+        self.on_start = on_start
+        self._n = 0
+
+    def _mk(self, kind):
+        self._n += 1
+        return f"{kind}-{self._n}"
+
+    def create_input(self, kind, spec):
+        self.calls.append(("input", kind, dict(spec)))
+        return self._mk("in")
+
+    def create_output(self, kind, spec):
+        self.calls.append(("output", kind, dict(spec)))
+        return self._mk("out")
+
+    def create_codec_config(self, codec, spec):
+        self.calls.append(("config", codec, dict(spec)))
+        return self._mk(f"cfg-{codec}")
+
+    def create_encoding(self, name):
+        self.calls.append(("encoding", name))
+        return self._mk("enc")
+
+    def create_stream(self, encoding_id, codec_config_id, input_id,
+                      input_path, name):
+        self.calls.append(("stream", encoding_id, codec_config_id, input_path, name))
+        return self._mk("stream")
+
+    def create_muxing(self, encoding_id, kind, spec):
+        self.calls.append(("muxing", encoding_id, kind, dict(spec)))
+        return self._mk("mux")
+
+    def start(self, encoding_id):
+        self.calls.append(("start", encoding_id))
+        if self.on_start:
+            self.on_start()
+
+    def wait_until_finished(self, encoding_id):
+        self.calls.append(("wait", encoding_id))
+
+
+def test_bitmovin_submit_call_sequence():
+    from processing_chain_tpu.services import bitmovin as bm
+
+    api = FakeBitmovinApi()
+    plan = bm.plan_encoding(_bm_seg(audio=True), _bm_settings())
+    enc_id = bm.submit_encoding(api, plan)
+    names = [c[0] for c in api.calls]
+    # input/output/encoding before configs/streams, muxings before start,
+    # start before wait (reference :446-740 ordering)
+    assert names.index("muxing") < names.index("start") < names.index("wait")
+    mux = next(c for c in api.calls if c[0] == "muxing")
+    assert mux[1] == enc_id
+    assert all(s.startswith("stream-") for s in mux[3]["streams"])
+    assert mux[3]["output_id"].startswith("out-")
+
+
+def test_encode_bitmovin_level0_submits_then_downloads_final_mp4(tmp_path):
+    """Level 0 end to end offline for h26x: no artifacts anywhere, the
+    fake cloud 'writes' the finished MP4 (the plan's MP4Muxing layout,
+    <name>/<name>.mp4) into the store when the encoding starts, and the
+    downloader pulls it straight into the segments folder — no chunk
+    reassembly for h26x (reference downloads_from_sftp after :740)."""
+    full = str(tmp_path / "cloud.mp4")
+    write_test_video(full, codec="libx264", n=24, audio=False, gop=6,
+                     opts="crf=28:preset=ultrafast")
+    tree = {}
+    store = DictStore(tree)
+
+    def cloud_writes_final():
+        tree["SEG010"] = {"SEG010.mp4": open(full, "rb").read()}
+
+    api = FakeBitmovinApi(on_start=cloud_writes_final)
+    local = tmp_path / "segments"
+    local.mkdir()
+    d = dl.Downloader(str(local), store=store, bitmovin_api=api,
+                      bitmovin_settings=_bm_settings())
+    seg = _bm_seg(filename="SEG010.mp4")
+    out = d.encode_bitmovin(seg)
+    assert out == str(local / "SEG010.mp4") and os.path.isfile(out)
+    assert [c[0] for c in api.calls if c[0] in ("start", "wait")] == ["start", "wait"]
+
+    from processing_chain_tpu.io import medialib
+
+    assert len(medialib.scan_packets(out, "video")["size"]) == 24
+    # a second run resumes from the store copy without resubmitting
+    os.unlink(out)
+    api2 = FakeBitmovinApi()
+    d2 = dl.Downloader(str(local), store=store, bitmovin_api=api2,
+                       bitmovin_settings=_bm_settings())
+    out2 = d2.encode_bitmovin(seg)
+    assert os.path.isfile(out2) and api2.calls == []
+
+
+def test_encode_bitmovin_level0_without_store_refuses_submit(tmp_path):
+    """A submit with no way to fetch the result back must fail BEFORE
+    spending cloud money."""
+    api = FakeBitmovinApi()
+    d = dl.Downloader(str(tmp_path), bitmovin_api=api,
+                      bitmovin_settings=_bm_settings())
+    with pytest.raises(RuntimeError, match="refusing to submit"):
+        d.encode_bitmovin(_bm_seg(filename="SEG012.mp4"))
+    assert api.calls == []
+
+
+def test_encode_bitmovin_level0_without_api_raises(tmp_path):
+    d = dl.Downloader(str(tmp_path))
+    with pytest.raises(RuntimeError, match="no Bitmovin API client"):
+        d.encode_bitmovin(_bm_seg(filename="SEG011.mp4"))

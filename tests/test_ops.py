@@ -474,3 +474,43 @@ def test_resize_same_size_passthrough(method):
     src = jnp.asarray(smooth_image(64, 96)[None])
     out = np.asarray(resize.resize_plane(src, 64, 96, method=method))
     np.testing.assert_array_equal(out, np.asarray(src))
+
+
+def test_resolve_fps_spec_reference_grammar_property():
+    """Exact-match property test vs the reference fps grammar
+    (lib/ffmpeg.py:321-396) over its whole input space: original/auto,
+    the 24/25/30 and 50/60 selectors for every supported SRC rate,
+    fractions, and plain numbers. One documented deviation: the reference
+    coerces numeric specs with int() (:388), flooring 29.97 to 29 — a
+    do-not-copy bug; non-integer numeric specs keep their value here."""
+    from processing_chain_tpu.config.domain import ConfigError
+
+    # (spec, src_fps) -> expected (None = keep SRC rate)
+    exact = {
+        ("original", 24.0): None,
+        ("auto", 60.0): None,
+        ("24/25/30", 24.0): None,
+        ("24/25/30", 25.0): None,
+        ("24/25/30", 30.0): None,
+        ("24/25/30", 50.0): 25.0,
+        ("24/25/30", 60.0): 30.0,
+        ("24/25/30", 120.0): 30.0,
+        ("50/60", 50.0): None,
+        ("50/60", 60.0): None,
+        ("50/60", 120.0): 60.0,
+        ("1/2", 60.0): 30.0,
+        ("2/3", 60.0): 40.0,
+        ("1/2", 50.0): 25.0,
+        ("30", 24.0): 30.0,
+        (15, 24.0): 15.0,
+        (60, 120.0): 60.0,
+    }
+    for (spec, src), want in exact.items():
+        assert fps.resolve_fps_spec(spec, src) == want, (spec, src)
+    # reference error exits -> ConfigError here
+    for spec, src in [("24/25/30", 48.0), ("50/60", 24.0), ("50/60", 100.0)]:
+        with pytest.raises(ConfigError):
+            fps.resolve_fps_spec(spec, src)
+    # the documented deviation: fractional numeric specs survive
+    assert fps.resolve_fps_spec(29.97, 30.0) == 29.97
+    assert fps.resolve_fps_spec("23.976", 24.0) == 23.976
