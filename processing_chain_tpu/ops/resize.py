@@ -234,7 +234,9 @@ def make_swscale_plan(
     for i in range(dstW - 1, -1, -1):
         mn = filter_size
         cut = 0
-        while True:
+        # bounded like initFilter's C loop: an all-zero coefficient row on
+        # the last output index would otherwise never hit either break
+        for _ in range(filter_size):
             cut += abs(int(filt[i, 0]))
             if cut > cutoff:
                 break

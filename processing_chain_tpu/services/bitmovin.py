@@ -205,8 +205,10 @@ def plan_encoding(seg, settings) -> BitmovinPlan:
             int(vc.bufsize_factor * bitrate) if vc.bufsize_factor else None
         )
         cfg["bframes"] = vc.bframes
-        cfg["max_gop"] = ql.max_gop
-        cfg["min_gop"] = ql.min_gop
+        # gop bounds live on the Coding (domain.py Coding.max_gop/min_gop,
+        # reference seg.video_coding.max_gop :614-615), not the quality level
+        cfg["max_gop"] = vc.max_gop
+        cfg["min_gop"] = vc.min_gop
         if codec == "h264":
             cfg["profile"] = "MAIN"  # repo config drops `profile` (domain.py)
         else:

@@ -201,7 +201,12 @@ class YtdlClient:
 class ChunkStore(Protocol):
     """Remote artifact store (reference SFTP/Azure outputs)."""
 
-    def exists(self, rel_path: str) -> bool: ...
+    def exists(self, rel_path: str) -> bool:
+        """True if `rel_path` exists on the store, whether it names a
+        DIRECTORY (chunk tree `<name>/`) or a FILE (`<name>/<name>.mp4`,
+        the finished-MP4 layout). Implementations must answer for both —
+        SftpStore stat()s either kind."""
+        ...
 
     def listdir(self, rel_path: str) -> list[str]: ...
 
@@ -717,6 +722,16 @@ class Downloader:
         if not self.store.exists(rel):
             return None
         final = os.path.join(self.video_segments_folder, filename)
-        self.store.download(rel, final)
+        # download to a temp name and rename into place: an interrupted
+        # transfer must never leave a truncated file at the final segment
+        # path, where every later run's isfile pre-check would treat it
+        # as a finished encode
+        tmp = final + ".part"
+        try:
+            self.store.download(rel, tmp)
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         get_logger().info("downloaded finished cloud encode %s", filename)
         return final

@@ -26,6 +26,12 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     # decode→device→encode internally via engine/prefetch); wider only
     # multiplies host RAM (CHUNK frames per in-flight PVS) for no overlap
     pvs_par = max(1, min(cli_args.parallelism, 2))
+    if cli_args.parallelism > pvs_par:
+        log.info(
+            "p03: capping parallelism %d -> %d (device jobs pipeline "
+            "decode/compute/encode internally; wider only costs host RAM)",
+            cli_args.parallelism, pvs_par,
+        )
     runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
         parallelism=pvs_par, name="p03",

@@ -20,9 +20,16 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
         )
     # ≤2-wide like p03: overlap adjacent PVSes' host decode with device
     # work without multiplying host RAM (see p03_generate_avpvs)
+    pvs_par = max(1, min(cli_args.parallelism, 2))
+    if cli_args.parallelism > pvs_par:
+        log.info(
+            "p04: capping parallelism %d -> %d (device jobs pipeline "
+            "decode/compute/encode internally; wider only costs host RAM)",
+            cli_args.parallelism, pvs_par,
+        )
     runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
-        parallelism=max(1, min(cli_args.parallelism, 2)), name="p04",
+        parallelism=pvs_par, name="p04",
     )
     for pvs_id, pvs in local_shard(test_config.pvses):
         if cli_args.skip_online_services and pvs.is_online():

@@ -234,7 +234,12 @@ class DictStore:
         self.tree = tree  # {rel_dir: {name: bytes}}
 
     def exists(self, rel_path):
-        return rel_path in self.tree
+        # ChunkStore.exists answers for FILE paths as well as directory
+        # paths (SftpStore stat()s either); model both here
+        if rel_path in self.tree:
+            return True
+        rel_dir, name = os.path.split(rel_path)
+        return name in self.tree.get(rel_dir, {})
 
     def listdir(self, rel_path):
         return list(self.tree[rel_path])
@@ -380,11 +385,13 @@ def _bm_seg(codec="h264", pixfmt="yuv420p", audio=False, fps="original",
     from types import SimpleNamespace as NS
 
     ql = NS(video_codec=codec, video_bitrate=1500, width=1920, height=1080,
-            fps=fps, max_gop=60, min_gop=None,
+            fps=fps,
             audio_bitrate=320 if audio else None,
             audio_codec="aac" if audio else None)
+    # gop bounds sit on the coding, mirroring the real domain shape
+    # (config/domain.py Coding.max_gop/min_gop)
     vc = NS(minrate_factor=None, maxrate_factor=None, bufsize_factor=None,
-            bframes=2, quality="good")
+            bframes=2, quality="good", max_gop=60, min_gop=None)
     for k, v in vc_over.items():
         setattr(vc, k, v)
     src = NS(filename="SRC000.avi", get_fps=lambda: 60.0)
