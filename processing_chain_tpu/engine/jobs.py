@@ -65,6 +65,24 @@ class Job:
         return result
 
 
+def device_stage_parallelism(requested: int, stage: str, cap: int = 2) -> int:
+    """Clamp a device stage's `-p` to `cap`, telling the user when it bites.
+
+    Device-stage jobs already pipeline decode→device→encode internally
+    (engine/prefetch) and compiled-graph executions serialize through the
+    chip's queue, so 2 in flight is enough to overlap PVS N+1's host decode
+    with PVS N's device/encode; wider only multiplies host RAM (CHUNK
+    frames per in-flight PVS) for no extra overlap."""
+    capped = max(1, min(requested, cap))
+    if requested > capped:
+        get_logger().info(
+            "%s: capping parallelism %d -> %d (device jobs pipeline "
+            "decode/compute/encode internally; wider only costs host RAM)",
+            stage, requested, capped,
+        )
+    return capped
+
+
 class JobRunner:
     """Plans and executes jobs with skip-existing / force / dry-run
     semantics and fail-fast parallel execution."""

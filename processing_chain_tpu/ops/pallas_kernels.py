@@ -41,17 +41,18 @@ BLOCK = 128
 
 
 def _fused_resize_kernel(
-    starts_v_ref,   # SMEM [nrb]    (scalar prefetch)
-    starts_h_ref,   # SMEM [ncb]    (scalar prefetch)
-    in_ref,         # VMEM [1, src_h, src_w] u8
-    wv_ref,         # VMEM [nrb, BLOCK, band_v]
-    wh_ref,         # VMEM [1, BLOCK, band_h]
+    starts_v_ref,   # SMEM [nrb]    (scalar prefetch; 8-aligned)
+    starts_h_ref,   # SMEM [ncb]    (scalar prefetch; 128-aligned)
+    in_ref,         # VMEM [1, src_h, src_w_pad] u8
+    wv_ref,         # VMEM [nrb, BLOCK, band_v_pad]
+    wh_ref,         # VMEM [1, BLOCK, band_h_pad]
     out_ref,        # VMEM [1, nrb * BLOCK, BLOCK]
-    mid_ref,        # VMEM scratch [src_h, BLOCK] f32
+    mid_ref,        # VMEM scratch [src_h_pad, BLOCK] f32
     *,
     band_v: int,
     band_h: int,
     nrb: int,
+    src_h: int,
     quantize: bool,
     maxval: int,
 ):
@@ -59,10 +60,17 @@ def _fused_resize_kernel(
     stripe first — matching swscale's stage order so the 15-bit
     intermediate top-clamp lands between H and V exactly like the golden
     integer path (resize._swscale_exact) — then all vertical row blocks
-    of the stripe from VMEM scratch."""
+    of the stripe from VMEM scratch.
+
+    Mosaic constraints shape the layout: dynamic slices must start at
+    multiples of 128 on the lane axis and 8 on the sublane axis — and the
+    compiler must be able to PROVE it statically, so the prefetch arrays
+    carry start/align and the kernel multiplies the alignment back in.
+    Weight rows are shifted to compensate (zero-padded bands), and u8
+    loads widen through int32 (u8->f32 has no direct lowering)."""
     cb = pl.program_id(1)
-    sh = starts_h_ref[cb]
-    src = in_ref[0, :, pl.ds(sh, band_h)].astype(jnp.float32)
+    sh = starts_h_ref[cb] * 128
+    src = in_ref[0, :, pl.ds(sh, band_h)].astype(jnp.int32).astype(jnp.float32)
     mid = jax.lax.dot(
         src, wh_ref[0].T, precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
@@ -70,9 +78,15 @@ def _fused_resize_kernel(
     if quantize and maxval == 255:
         # swscale's hScale8To15 top-clamp in normalized units
         mid = jnp.minimum(mid, 32767.0 / 128.0)
-    mid_ref[:, :] = mid
+    mid_ref[:src_h, :] = mid
+    if mid_ref.shape[0] > src_h:
+        # scratch rows past src_h are read through zero weights; NaN
+        # garbage × 0 is NaN, so they must actually BE zero
+        mid_ref[src_h:, :] = jnp.zeros(
+            (mid_ref.shape[0] - src_h, mid_ref.shape[1]), jnp.float32
+        )
     for rb in range(nrb):  # static unroll: nrb is small (dst_h / 128)
-        sv = starts_v_ref[rb]
+        sv = starts_v_ref[rb] * 8
         tile = jax.lax.dot(
             wv_ref[rb], mid_ref[pl.ds(sv, band_v), :],
             precision=jax.lax.Precision.HIGHEST,
@@ -80,6 +94,9 @@ def _fused_resize_kernel(
         )
         if quantize:
             tile = jnp.clip(jnp.floor(tile + 0.5), 0, maxval)
+        if out_ref.dtype in (jnp.uint8, jnp.uint16):
+            # f32 -> narrow unsigned also needs the int32 intermediate
+            tile = tile.astype(jnp.int32)
         out_ref[0, rb * BLOCK : (rb + 1) * BLOCK, :] = tile.astype(out_ref.dtype)
 
 
@@ -104,28 +121,41 @@ def resize_frames_fused(
         return frames
     starts_v, wv, band_v = make_banded_plan(src_h, dst_h, kernel, BLOCK)
     starts_h, wh, band_h = make_banded_plan(src_w, dst_w, kernel, BLOCK)
+    # Mosaic dynamic-slice alignment: 128 on the lane axis (horizontal
+    # bands slice the frame's width), 8 on the sublane axis (vertical
+    # bands slice the f32 scratch's height). Shift each start down to
+    # alignment and shift its weight row up by the same offset inside a
+    # zero-padded band.
+    starts_h, wh, band_h = _align_band(starts_h, wh, band_h, 128)
+    starts_v, wv, band_v = _align_band(starts_v, wv, band_v, 8)
     nrb = wv.shape[0]
     ncb = wh.shape[0]
     pad_h = nrb * BLOCK
+    # aligned loads may extend past src_w; pad the frame so they stay in
+    # bounds (zero weights cover the padding)
+    src_w_pad = src_w + band_h
+    frames = jnp.pad(frames, ((0, 0), (0, 0), (0, src_w_pad - src_w)))
+    src_h_pad = src_h + band_v
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(t, ncb),
         in_specs=[
-            pl.BlockSpec((1, src_h, src_w), lambda ti, cb, *_: (ti, 0, 0)),
+            pl.BlockSpec((1, src_h, src_w_pad), lambda ti, cb, *_: (ti, 0, 0)),
             pl.BlockSpec((nrb, BLOCK, band_v), lambda ti, cb, *_: (0, 0, 0)),
             pl.BlockSpec((1, BLOCK, band_h), lambda ti, cb, *_: (cb, 0, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, pad_h, BLOCK), lambda ti, cb, *_: (ti, 0, cb)
         ),
-        scratch_shapes=[pltpu.VMEM((src_h, BLOCK), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((src_h_pad, BLOCK), jnp.float32)],
     )
     kernel_fn = functools.partial(
         _fused_resize_kernel,
         band_v=band_v,
         band_h=band_h,
         nrb=nrb,
+        src_h=src_h,
         quantize=True,
         maxval=255 if frames.dtype == jnp.uint8 else 1023,
     )
@@ -134,9 +164,26 @@ def resize_frames_fused(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, pad_h, ncb * BLOCK), frames.dtype),
         interpret=interpret,
-    )(jnp.asarray(starts_v), jnp.asarray(starts_h), frames,
+    )(jnp.asarray(starts_v) // 8, jnp.asarray(starts_h) // 128, frames,
       jnp.asarray(wv), jnp.asarray(wh))
     return out[:, :dst_h, :dst_w]
+
+
+def _align_band(starts, w, band: int, align: int):
+    """Re-express a banded plan with `align`-multiple starts.
+
+    Each block's start rounds DOWN to alignment and its weight row shifts
+    right by the rounding offset inside a wider zero-padded band, so the
+    weighted sum is unchanged. New band = band + align - 1, rounded up to
+    a multiple of `align` (slice extents share the alignment rule)."""
+    starts = np.asarray(starts)
+    nb, blk, _ = w.shape
+    new_band = -(-(band + align - 1) // align) * align
+    off = starts % align
+    w2 = np.zeros((nb, blk, new_band), w.dtype)
+    for i in range(nb):
+        w2[i, :, off[i]: off[i] + band] = w[i]
+    return starts - off, w2, new_band
 
 
 def pallas_available() -> bool:

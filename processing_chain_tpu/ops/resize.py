@@ -451,16 +451,28 @@ def resize_plane(
                  land one code value away (measured; never more than 1).
       "fused"  — the Pallas two-pass kernel (pallas_kernels.resize_frames_
                  fused): both passes in VMEM, no HBM intermediate. TPU only,
-                 [T, H, W] integer input, quantized output.
-      "auto"   — "banded" on TPU (where the MXU pays for it), "gather"
+                 [T, H, W] integer input, quantized output. Same tolerance
+                 class as "banded" vs the golden path (≤1 code value,
+                 measured on TPU); differs from "banded" itself only on
+                 rounding-tie pixels (different f32 accumulation order).
+      "auto"   — on TPU: "fused" where eligible ([T, H, W] integer input,
+                 quantized, actually resizing), else "banded"; "gather"
                  elsewhere; override with PC_RESIZE_METHOD=gather|banded|fused.
     """
-    if method == "auto":
-        method = os.environ.get("PC_RESIZE_METHOD") or (
-            "banded" if jax.default_backend() == "tpu" else "gather"
-        )
     src_h, src_w = x.shape[-2], x.shape[-1]
     integer_in = jnp.issubdtype(x.dtype, jnp.integer)
+    if method == "auto":
+        env = os.environ.get("PC_RESIZE_METHOD")
+        if env:
+            method = env
+        elif jax.default_backend() == "tpu":
+            fused_ok = (
+                x.ndim == 3 and integer_in and quantize_output
+                and (src_h, src_w) != (dst_h, dst_w)
+            )
+            method = "fused" if fused_ok else "banded"
+        else:
+            method = "gather"
     if method == "fused" and (src_h, src_w) != (dst_h, dst_w):
         if x.ndim != 3 or not integer_in or not quantize_output:
             raise ValueError(

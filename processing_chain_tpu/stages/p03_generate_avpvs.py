@@ -6,7 +6,7 @@ import os
 from typing import Optional
 
 from ..config import TestConfig
-from ..engine.jobs import JobRunner
+from ..engine.jobs import JobRunner, device_stage_parallelism
 from ..models import avpvs as av
 from ..parallel.distributed import local_shard
 from ..utils.log import get_logger
@@ -20,18 +20,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             cli_args.filter_pvs,
         )
 
-    # device-stage jobs run ≤2-wide: compiled-graph executions serialize
-    # through the chip's queue anyway, but 2 in flight lets PVS N+1's host
-    # decode overlap PVS N's device/encode (each job already pipelines
-    # decode→device→encode internally via engine/prefetch); wider only
-    # multiplies host RAM (CHUNK frames per in-flight PVS) for no overlap
-    pvs_par = max(1, min(cli_args.parallelism, 2))
-    if cli_args.parallelism > pvs_par:
-        log.info(
-            "p03: capping parallelism %d -> %d (device jobs pipeline "
-            "decode/compute/encode internally; wider only costs host RAM)",
-            cli_args.parallelism, pvs_par,
-        )
+    pvs_par = device_stage_parallelism(cli_args.parallelism, "p03")
     runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
         parallelism=pvs_par, name="p03",

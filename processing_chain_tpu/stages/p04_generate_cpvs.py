@@ -5,7 +5,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..config import TestConfig
-from ..engine.jobs import JobRunner
+from ..engine.jobs import JobRunner, device_stage_parallelism
 from ..models import cpvs as cp
 from ..parallel.distributed import local_shard
 from ..utils.log import get_logger
@@ -18,15 +18,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
             cli_args.filter_pvs,
         )
-    # ≤2-wide like p03: overlap adjacent PVSes' host decode with device
-    # work without multiplying host RAM (see p03_generate_avpvs)
-    pvs_par = max(1, min(cli_args.parallelism, 2))
-    if cli_args.parallelism > pvs_par:
-        log.info(
-            "p04: capping parallelism %d -> %d (device jobs pipeline "
-            "decode/compute/encode internally; wider only costs host RAM)",
-            cli_args.parallelism, pvs_par,
-        )
+    pvs_par = device_stage_parallelism(cli_args.parallelism, "p04")
     runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
         parallelism=pvs_par, name="p04",
