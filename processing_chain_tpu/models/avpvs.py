@@ -104,6 +104,44 @@ def _segment_canvas_chunks(seg, rate: float):
         raise medialib.MediaError(f"no frames in segment {seg.file_path}")
 
 
+def _short_rate_chunks(
+    pvs: Pvs, reader: VideoReader, avpvs_src_fps: bool, force_60_fps: bool
+):
+    """(canvas rate, decoded chunk stream) for the short path: native
+    segment frame rate unless -z/-f60 (reference create_avpvs_short
+    :940-1000). Shared by the per-PVS job and the sharded batch path."""
+    seg_fps = reader.fps
+    rate = pvs.src.get_fps() if avpvs_src_fps else (
+        60.0 if force_60_fps else seg_fps
+    )
+    chunks = (
+        pf.stream_fps_resample(reader, seg_fps, rate, CHUNK)
+        if rate != seg_fps
+        else pf.iter_plane_chunks(reader, CHUNK)
+    )
+    return rate, chunks
+
+
+def _wo_buffer_out_path(pvs: Pvs) -> str:
+    return (
+        pvs.get_avpvs_wo_buffer_file_path()
+        if pvs.has_buffering()
+        else pvs.get_avpvs_file_path()
+    )
+
+
+def _wo_buffer_provenance(pvs: Pvs, w: int, h: int, pix_fmt: str) -> dict:
+    return {
+        "pvs": pvs.pvs_id,
+        "pipeline": {
+            "canvas": [w, h],
+            "pix_fmt": pix_fmt,
+            "segments": [s.filename for s in pvs.segments],
+            "codec": "ffv1(level3,slicecrc)",
+        },
+    }
+
+
 def create_avpvs_wo_buffer(
     pvs: Pvs,
     avpvs_src_fps: bool = False,
@@ -112,11 +150,7 @@ def create_avpvs_wo_buffer(
     """The decode+rescale(+concat+audio) stage producing the pre-stalling
     AVPVS (or the final one when the HRC has no buffering)."""
     tc = pvs.test_config
-    out_path = (
-        pvs.get_avpvs_wo_buffer_file_path()
-        if pvs.has_buffering()
-        else pvs.get_avpvs_file_path()
-    )
+    out_path = _wo_buffer_out_path(pvs)
     w, h = avpvs_dimensions(pvs)
     pix_fmt = pvs.get_pix_fmt_for_avpvs()
 
@@ -134,14 +168,8 @@ def create_avpvs_wo_buffer(
             # single segment, native segment frame rate unless -z/-f60
             seg = pvs.segments[0]
             with VideoReader(seg.file_path) as reader:
-                seg_fps = reader.fps
-                rate = pvs.src.get_fps() if avpvs_src_fps else (
-                    60.0 if force_60_fps else seg_fps
-                )
-                chunks = (
-                    pf.stream_fps_resample(reader, seg_fps, rate, CHUNK)
-                    if rate != seg_fps
-                    else pf.iter_plane_chunks(reader, CHUNK)
+                rate, chunks = _short_rate_chunks(
+                    pvs, reader, avpvs_src_fps, force_60_fps
                 )
                 with pf.AsyncWriter(
                     _ffv1_writer(out_path, w, h, pix_fmt, rate, with_audio=False)
@@ -171,15 +199,113 @@ def create_avpvs_wo_buffer(
         output_path=out_path,
         fn=run,
         logfile_path=pvs.get_logfile_path(),
-        provenance={
-            "pvs": pvs.pvs_id,
-            "pipeline": {
-                "canvas": [w, h],
-                "pix_fmt": pix_fmt,
-                "segments": [s.filename for s in pvs.segments],
-                "codec": "ffv1(level3,slicecrc)",
-            },
-        },
+        provenance=_wo_buffer_provenance(pvs, w, h, pix_fmt),
+    )
+
+
+def create_avpvs_wo_buffer_batch(
+    pvses: list,
+    avpvs_src_fps: bool = False,
+    force_60_fps: bool = False,
+) -> Optional[Job]:
+    """Multi-device p03: ONE job running a short-test PVS batch through the
+    (pvs × time) device mesh (parallel/p03_batch), instead of one device
+    job per PVS. Same math as create_avpvs_wo_buffer's short path —
+    byte-identical artifacts (tests/test_parallel.py proves it) — but the
+    device step is data-parallel over the PVS axis and sequence-parallel
+    over frame time. Skip-existing/--force filtering happens in the stage
+    (per-PVS), so every pvs passed here is due for (re)generation."""
+    if not pvses:
+        return None
+    from contextlib import ExitStack
+
+    from ..io import probe
+    from ..parallel import p03_batch
+    from ..parallel.mesh import make_mesh
+
+    def run() -> str:
+        import jax
+
+        devs = jax.devices()
+        mesh = make_mesh(
+            devs,
+            time_parallel=2 if len(devs) > 1 and len(devs) % 2 == 0 else 1,
+        )
+        n_pvs = mesh.shape["pvs"]
+        log = get_logger()
+        # bucket by full geometry (p03_batch's bucketing policy) using
+        # header probes only — decoders/encoders open later, per wave, so
+        # a 300-PVS database never holds 300 open codec contexts at once
+        buckets: dict = {}
+        for pvs in pvses:
+            seg = pvs.segments[0]
+            w, h = avpvs_dimensions(pvs)
+            pix_fmt = pvs.get_pix_fmt_for_avpvs()
+            info = probe.get_segment_info(seg.file_path)
+            key = (info["video_height"], info["video_width"], h, w, pix_fmt)
+            buckets.setdefault(key, []).append((pvs, w, h, pix_fmt))
+        for (sh, sw, dh, dw, pix_fmt), entries in buckets.items():
+            log.info(
+                "p03 batch: %d PVS(es) %dx%d->%dx%d %s over mesh %s",
+                len(entries), sw, sh, dw, dh, pix_fmt, dict(mesh.shape),
+            )
+            # longest-first so each wave groups similar lengths
+            entries.sort(key=lambda e: -e[0].segments[0].duration)
+            for w0 in range(0, len(entries), n_pvs):
+                wave = entries[w0: w0 + n_pvs]
+                out_paths = [_wo_buffer_out_path(p) for p, *_ in wave]
+                try:
+                    with ExitStack() as stack:
+                        lanes = []
+                        for (pvs, w, h, _), out_path in zip(wave, out_paths):
+                            reader = stack.enter_context(
+                                VideoReader(pvs.segments[0].file_path)
+                            )
+                            rate, chunks = _short_rate_chunks(
+                                pvs, reader, avpvs_src_fps, force_60_fps
+                            )
+                            writer = stack.enter_context(
+                                pf.AsyncWriter(_ffv1_writer(
+                                    out_path, w, h, pix_fmt, rate,
+                                    with_audio=False,
+                                ))
+                            )
+                            lanes.append(p03_batch.Lane(
+                                chunks=chunks,
+                                emit=writer.put,
+                                n_frames_hint=int(
+                                    round(pvs.segments[0].duration * rate)
+                                ),
+                            ))
+                        p03_batch.run_bucket(
+                            lanes, mesh, dh, dw, "bicubic",
+                            fr.chroma_subsampling(pix_fmt),
+                            ten_bit="10" in pix_fmt,
+                            chunk=CHUNK,
+                        )
+                except BaseException:
+                    # the writers were opened (files created/truncated):
+                    # a partial artifact must never survive to satisfy a
+                    # later run's skip-existing check
+                    for p in out_paths:
+                        if os.path.isfile(p):
+                            os.unlink(p)
+                    raise
+                # per-PVS provenance, identical to the single-device jobs'
+                for (pvs, w, h, _), out_path in zip(wave, out_paths):
+                    Job(
+                        label=f"avpvs {pvs.pvs_id}",
+                        output_path=out_path,
+                        fn=lambda: None,
+                        logfile_path=pvs.get_logfile_path(),
+                        provenance=_wo_buffer_provenance(pvs, w, h, pix_fmt),
+                    ).write_provenance()
+        return f"{len(pvses)} AVPVS"
+
+    return Job(
+        label=f"avpvs-batch[{len(pvses)}] " + " ".join(p.pvs_id for p in pvses),
+        output_path="",
+        fn=run,
     )
 
 

@@ -1,3 +1,4 @@
+from . import p03_batch
 from .mesh import batch_sharding, make_mesh, scalar_sharding
 from .pipeline import avpvs_siti_step, make_batch_metrics_step, make_sharded_step
 
@@ -8,4 +9,5 @@ __all__ = [
     "avpvs_siti_step",
     "make_batch_metrics_step",
     "make_sharded_step",
+    "p03_batch",
 ]

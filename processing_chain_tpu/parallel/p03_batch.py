@@ -1,0 +1,189 @@
+"""Sharded batch execution of p03's AVPVS rescale — the product path on a
+multi-device mesh.
+
+Where the reference fans independent ffmpeg processes over a pool
+(reference p03_generateAvPvs.py:190, lib/cmd_utils.py:93-101), this module
+batches the *same* per-PVS rescale (models/avpvs._pump: device resize +
+bit-depth quantize) over a (pvs × time) `jax.sharding.Mesh`: the PVS batch
+axis is data parallelism, the frame-time axis is sequence parallelism.
+The rescale is frame-local, so time sharding needs no halo (the TI halo
+lives in pipeline.make_sharded_step, the features path).
+
+Padding/bucketing policy for variable-length PVSes (SURVEY.md §7 hard
+part), explicit and documented:
+
+  * Lanes (PVS streams) batch together only when their full geometry
+    matches — (src_h, src_w, dst_h, dst_w, pix_fmt) — the bucket key.
+    Different geometries recompile anyway; bucketing never pads space.
+  * The time axis is consumed in fixed steps of `t_step = t_loc × n_time`
+    frames per lane; a lane's tail block is padded by REPEATING ITS LAST
+    FRAME up to t_step (repeat, not zeros: the pad rides the same compiled
+    step, and repeated real frames keep the value range — but pad outputs
+    are dropped before the writer, so they never land in an artifact).
+  * Lanes of unequal length: a lane that exhausts keeps contributing
+    zero-valid blocks (its slot computes garbage that is discarded) until
+    every lane in the bucket finishes. Waste is bounded by the length
+    spread within a bucket; sort_lanes groups similar lengths per wave.
+  * The batch axis pads up to a multiple of the mesh's "pvs" size with
+    zero lanes (valid = 0, outputs discarded).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+
+@dataclass
+class Lane:
+    """One PVS stream through the batch: decoded chunks in, scaled frames
+    out. `chunks` yields [y, u, v] plane stacks ([T, H, W] each, chroma at
+    its subsampled size); `emit` receives the scaled/quantized planes of
+    each block, already trimmed to the valid frame count."""
+
+    chunks: Iterable[list]
+    emit: Callable[[list], None]
+    n_frames_hint: int = 0  # for wave grouping only; 0 = unknown
+
+
+def _rechunk(chunks: Iterable[list], t_step: int) -> Iterator[tuple[list, int]]:
+    """Re-chunk a variable-size chunk stream into exact t_step blocks.
+    Yields (planes, valid): the tail block pads by repeating the last
+    frame, valid < t_step."""
+    buf: Optional[list] = None
+    for ch in chunks:
+        ch = [np.asarray(p) for p in ch]
+        buf = ch if buf is None else [
+            np.concatenate([b, c]) for b, c in zip(buf, ch)
+        ]
+        while buf[0].shape[0] >= t_step:
+            yield [b[:t_step] for b in buf], t_step
+            buf = [b[t_step:] for b in buf]
+    if buf is not None and buf[0].shape[0] > 0:
+        n = buf[0].shape[0]
+        pad = t_step - n
+        yield [
+            np.concatenate([b, np.repeat(b[-1:], pad, axis=0)]) for b in buf
+        ], n
+
+
+@functools.cache
+def _sharded_resize_step(
+    mesh, dst_h: int, dst_w: int, kernel: str,
+    sub_h: int, sub_w: int, ten_bit: bool,
+):
+    """Jit the _pump math (models/avpvs) over the (pvs, time) mesh:
+    [B, T, H, W] u8/u16 planes -> scaled + quantized planes, sharded
+    P("pvs", "time", None, None). Cached per (mesh, geometry)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..models import frames as fr
+
+    def shard_fn(y, u, v):
+        b, t = y.shape[0], y.shape[1]
+
+        def flat(p):
+            return p.reshape((-1,) + p.shape[2:])
+
+        # identical call chain to the single-device path (models/avpvs
+        # _pump): scale_yuv_frames + quantize_device, on [b*t, H, W] so
+        # the fused Pallas kernel stays eligible on TPU
+        scaled = fr.scale_yuv_frames(
+            [flat(y), flat(u), flat(v)], dst_h, dst_w, kernel, (sub_h, sub_w)
+        )
+        quant = fr.quantize_device(scaled, ten_bit)
+        return tuple(q.reshape((b, t) + q.shape[1:]) for q in quant)
+
+    spec = P("pvs", "time", None, None)
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec),
+    )
+    return jax.jit(mapped)
+
+
+def sort_lanes(lanes: list[Lane]) -> list[Lane]:
+    """Longest-first so each wave groups similar lengths (minimizes the
+    exhausted-lane waste of the padding policy)."""
+    return sorted(lanes, key=lambda ln: -ln.n_frames_hint)
+
+
+def run_bucket(
+    lanes: list[Lane],
+    mesh,
+    dst_h: int,
+    dst_w: int,
+    kernel: str = "bicubic",
+    chroma_sub: tuple[int, int] = (2, 2),
+    ten_bit: bool = False,
+    *,
+    chunk: int,
+) -> None:
+    """Drive one geometry bucket of lanes through the sharded step in
+    waves of the mesh's "pvs" size. `chunk` is the global frame budget per
+    step across the time axis — callers pass their own memory knob
+    (models/avpvs passes its CHUNK) so the two paths cannot silently
+    diverge. Callers that must bound open decoders/encoders should pass
+    wave-sized lane groups (≤ mesh "pvs" size), as models/avpvs does."""
+    import jax
+
+    from .mesh import batch_sharding
+
+    n_pvs = mesh.shape["pvs"]
+    n_time = mesh.shape["time"]
+    t_loc = max(1, chunk // n_time)
+    t_step = t_loc * n_time
+    sub_h, sub_w = chroma_sub
+    sharding = batch_sharding(mesh)
+    step = _sharded_resize_step(
+        mesh, dst_h, dst_w, kernel, sub_h, sub_w, ten_bit
+    )
+
+    ordered = sort_lanes(lanes)
+    for w0 in range(0, len(ordered), n_pvs):
+        wave = ordered[w0: w0 + n_pvs]
+        iters = [_rechunk(ln.chunks, t_step) for ln in wave]
+        done = [False] * len(wave)
+        zero_block: Optional[list] = None
+        while not all(done):
+            blocks: list[Optional[list]] = []
+            valids: list[int] = []
+            for i, it in enumerate(iters):
+                blk = None if done[i] else next(it, None)
+                if blk is None:
+                    done[i] = True
+                    blocks.append(None)
+                    valids.append(0)
+                else:
+                    blocks.append(blk[0])
+                    valids.append(blk[1])
+                    if zero_block is None:
+                        zero_block = [np.zeros_like(p) for p in blk[0]]
+            if all(v == 0 for v in valids):
+                break
+            assert zero_block is not None
+            filled = [b if b is not None else zero_block for b in blocks]
+            # pad the wave's batch axis up to the mesh's pvs size
+            while len(filled) < n_pvs:
+                filled.append(zero_block)
+            planes = [
+                jax.device_put(
+                    np.stack([blk[p] for blk in filled]), sharding
+                )
+                for p in range(3)
+            ]
+            oy, ou, ov = step(*planes)
+            host = [np.asarray(o) for o in (oy, ou, ov)]
+            for i, ln in enumerate(wave):
+                if valids[i]:
+                    ln.emit([h[i][: valids[i]] for h in host])
+
+
+def wave_count(n_lanes: int, mesh) -> int:
+    return math.ceil(n_lanes / mesh.shape["pvs"])

@@ -35,22 +35,60 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     from ..utils.parse_args import _DEFAULT_SPINNER
 
     spinner = getattr(cli_args, "spinner_path", None) or _DEFAULT_SPINNER
+    avpvs_src_fps = getattr(cli_args, "avpvs_src_fps", False)
+    force_60_fps = getattr(cli_args, "force_60_fps", False)
     shard = local_shard(test_config.pvses)
+    eligible = []
     for pvs_id, pvs in shard:
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue
-        runner.add(
-            av.create_avpvs_wo_buffer(
-                pvs,
-                avpvs_src_fps=getattr(cli_args, "avpvs_src_fps", False),
-                force_60_fps=getattr(cli_args, "force_60_fps", False),
-            )
-        )
+        eligible.append(pvs)
         stall_runner.add(av.apply_stalling(pvs, spinner_path=spinner))
-    from ..utils.device import select_device
+    from ..utils.device import device_count, select_device
 
-    with select_device(getattr(cli_args, "set_gpu_loc", -1)):
+    gpu_loc = getattr(cli_args, "set_gpu_loc", -1)
+    with select_device(gpu_loc):
+        # batch route preconditions, cheap-first: dry-run must not touch a
+        # backend at all, and device_count() is the hang-guarded probe
+        # (utils/device), never a bare jax.devices(). A -g pin means the
+        # user wants ONE device busy — meshing over all of them would
+        # override the pin via explicit shardings, so the pin disables
+        # batching.
+        if (
+            test_config.is_short()
+            and not cli_args.dry_run
+            and gpu_loc < 0
+            and device_count() > 1
+        ):
+            # multi-device: batch the whole short-test PVS set through the
+            # (pvs × time) mesh instead of one device job per PVS. The
+            # per-PVS skip-existing/--force decision stays with Job
+            # semantics (should_run), then due PVSes run as one batch.
+            per_pvs = {
+                pvs: av.create_avpvs_wo_buffer(
+                    pvs, avpvs_src_fps=avpvs_src_fps, force_60_fps=force_60_fps
+                )
+                for pvs in eligible
+            }
+            todo = [
+                pvs for pvs, job in per_pvs.items()
+                if job.should_run(cli_args.force)
+            ]
+            runner.add(
+                av.create_avpvs_wo_buffer_batch(
+                    todo, avpvs_src_fps=avpvs_src_fps, force_60_fps=force_60_fps
+                )
+            )
+        else:
+            for pvs in eligible:
+                runner.add(
+                    av.create_avpvs_wo_buffer(
+                        pvs,
+                        avpvs_src_fps=avpvs_src_fps,
+                        force_60_fps=force_60_fps,
+                    )
+                )
         # two phases: stalling reads the wo_buffer outputs of phase one
         runner.run()
         stall_runner.run()

@@ -217,3 +217,49 @@ def test_select_device_out_of_range_is_config_error(devices8):
 
     with pytest.raises(ConfigError, match="out of range"):
         select_device(99)
+
+
+def test_p03_batch_padding_and_exhaustion(devices8):
+    """run_bucket's variable-length policy: tail blocks pad by repeating
+    the last frame, exhausted lanes idle with discarded outputs — emitted
+    frames must equal a direct per-lane resize, nothing more."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import resize
+    from processing_chain_tpu.parallel import p03_batch
+
+    mesh = make_mesh(None, time_parallel=2)
+    rng = np.random.default_rng(7)
+    lengths = [11, 4, 2, 7, 5]  # > mesh pvs size -> two waves; all uneven
+    sh, sw, dh, dw = 36, 64, 72, 128
+    outs = {i: [] for i in range(len(lengths))}
+    lanes = []
+    srcs = []
+    for i, n in enumerate(lengths):
+        yuv = [
+            rng.integers(0, 255, size=(n, sh, sw), dtype=np.uint8),
+            rng.integers(0, 255, size=(n, sh // 2, sw // 2), dtype=np.uint8),
+            rng.integers(0, 255, size=(n, sh // 2, sw // 2), dtype=np.uint8),
+        ]
+        srcs.append(yuv)
+        # deliver in ragged sub-chunks to exercise the re-chunker
+        parts = [
+            [p[:3] for p in yuv], [p[3:] for p in yuv]
+        ] if n > 3 else [yuv]
+        lanes.append(p03_batch.Lane(
+            chunks=iter(parts), emit=outs[i].append, n_frames_hint=n,
+        ))
+    p03_batch.run_bucket(
+        lanes, mesh, dh, dw, "bicubic", (2, 2), False, chunk=4
+    )
+    for i, n in enumerate(lengths):
+        got = [np.concatenate([blk[p] for blk in outs[i]]) for p in range(3)]
+        assert got[0].shape == (n, dh, dw)
+        want_y = np.asarray(
+            resize.resize_frames(jnp.asarray(srcs[i][0]), dh, dw, "bicubic")
+        )
+        np.testing.assert_array_equal(got[0], want_y)
+        want_u = np.asarray(resize.resize_frames(
+            jnp.asarray(srcs[i][1]), dh // 2, dw // 2, "bicubic"
+        ))
+        np.testing.assert_array_equal(got[1], want_u)

@@ -260,3 +260,83 @@ def test_p03_force_60_fps(short_db):
             "--filter-hrc", "HRC000",
         ])
         assert rc == 0
+
+
+@pytest.fixture(scope="module")
+def batch_db(tmp_path_factory):
+    """Short DB with variable-length PVSes (2 s and 1 s events) in one
+    geometry bucket plus a second geometry (other QL): the sharded p03
+    batch path's bucketing + tail-padding + lane-exhaustion policy all
+    engage."""
+    tmp = tmp_path_factory.mktemp("batchdb")
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2SXM91
+        syntaxVersion: 6
+        type: short
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24}
+          Q1: {index: 1, videoCodec: h264, videoBitrate: 300, width: 320, height: 180, fps: 24}
+        codingList:
+          VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+        srcList:
+          SRC000: SRC000.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            eventList: [[Q0, 2]]
+          HRC001:
+            videoCodingId: VC01
+            eventList: [[Q0, 1]]
+          HRC002:
+            videoCodingId: VC01
+            eventList: [[Q1, 2]]
+        pvsList:
+          - P2SXM91_SRC000_HRC000
+          - P2SXM91_SRC000_HRC001
+          - P2SXM91_SRC000_HRC002
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+    """)
+    yaml_path = write_db(tmp, "P2SXM91", yaml_text, {"SRC000.avi": dict(n=48)})
+    rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+    return yaml_path
+
+
+def test_p03_batch_byte_identical_to_single_device(batch_db):
+    """The multi-device batch path (engaged automatically: the test env has
+    8 virtual devices) must produce byte-identical AVPVS files to the
+    single-device per-PVS jobs."""
+    import jax
+
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.models import avpvs as av
+
+    assert len(jax.devices()) > 1  # precondition for the batch route
+    db = os.path.dirname(batch_db)
+    tc = TestConfig(batch_db)
+
+    # reference: the single-device model jobs, run directly
+    for pvs in tc.pvses.values():
+        av.create_avpvs_wo_buffer(pvs).run()
+    paths = {
+        pid: os.path.join(db, "avpvs", f"{pid}.avi") for pid in tc.pvses
+    }
+    ref = {}
+    for pid, p in paths.items():
+        assert os.path.isfile(p), p
+        ref[pid] = open(p, "rb").read()
+        os.unlink(p)
+
+    rc = cli_main(["p03", "-c", batch_db, "--skip-requirements"])
+    assert rc == 0
+    for pid, p in paths.items():
+        got = open(p, "rb").read()
+        assert got == ref[pid], f"{pid}: batch path diverged from single"
+
+    # the batch job must leave the same per-PVS provenance logs as the
+    # per-PVS jobs (asserted here, in the test that ran p03)
+    logfile = os.path.join(db, "logs", "P2SXM91_SRC000_HRC001.log")
+    assert os.path.isfile(logfile)
+    content = open(logfile).read()
+    assert "processingChain" in content and "avpvs" in content
