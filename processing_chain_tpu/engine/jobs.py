@@ -60,19 +60,28 @@ class Job:
 
     def run(self) -> Any:
         with tracing.span(self.label, output=os.path.basename(self.output_path)):
-            result = self.fn()
+            try:
+                result = self.fn()
+            except BaseException:
+                # streaming jobs surface decode errors mid-write: a partial
+                # artifact must never survive to satisfy a later run's
+                # skip-existing check (enforced here once, for every job)
+                if self.output_path and os.path.isfile(self.output_path):
+                    os.unlink(self.output_path)
+                raise
         self.write_provenance()
         return result
 
 
-def device_stage_parallelism(requested: int, stage: str, cap: int = 2) -> int:
+def device_stage_parallelism(requested: int, stage: str, cap: int = 4) -> int:
     """Clamp a device stage's `-p` to `cap`, telling the user when it bites.
 
-    Device-stage jobs already pipeline decode→device→encode internally
-    (engine/prefetch) and compiled-graph executions serialize through the
-    chip's queue, so 2 in flight is enough to overlap PVS N+1's host decode
-    with PVS N's device/encode; wider only multiplies host RAM (CHUNK
-    frames per in-flight PVS) for no extra overlap."""
+    Device-stage jobs pipeline decode→device→encode internally
+    (engine/prefetch) in O(CHUNK) memory, so extra width buys host
+    decode/encode overlap across PVSes at ~CHUNK×depth frames of RAM each;
+    compiled-graph executions still serialize through the chip's queue, so
+    past the reference's own pool width (4, lib/parse_args.py:67-72) more
+    workers only queue."""
     capped = max(1, min(requested, cap))
     if requested > capped:
         get_logger().info(

@@ -20,15 +20,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.domain import PostProcessing, Pvs
+from ..engine import prefetch as pfe
 from ..engine.jobs import Job
 from ..io import medialib
 from ..io.video import VideoReader, VideoWriter
-from ..ops import fps as fps_ops
 from ..ops import pad as pad_ops
 from ..ops import pixfmt as pf
-from ..utils.log import get_logger
 from . import frames as fr
-from .avpvs import avpvs_dimensions
 
 CHUNK = 64
 
@@ -45,11 +43,14 @@ def normalize_rms(samples: np.ndarray, target_dbfs: float = -23.0) -> np.ndarray
     return np.clip(x * gain * 32768.0, -32768, 32767).astype(np.int16)
 
 
-def _read_avpvs(pvs: Pvs):
-    path = pvs.get_avpvs_file_path()
-    with VideoReader(path) as r:
-        planes = fr.stack_planes(list(r))
-        return planes, r.fps, r.pix_fmt, r.width, r.height
+def _avpvs_chunks(reader: VideoReader, dst_rate: Optional[float] = None):
+    """Stream an open AVPVS reader as CHUNK-frame plane stacks, resampled
+    to dst_rate when it differs (ffmpeg `fps=` semantics, streaming).
+    O(CHUNK) memory for arbitrarily long PVSes — never the whole AVPVS
+    (a 3-min 1080p60 10-bit one is ~30 GB stacked)."""
+    if dst_rate is not None and dst_rate != reader.fps:
+        return pfe.stream_fps_resample(reader, reader.fps, dst_rate, CHUNK)
+    return pfe.iter_plane_chunks(reader, CHUNK)
 
 
 def _audio_for_long(pvs: Pvs, normalize: bool):
@@ -78,39 +79,32 @@ def create_cpvs(
     is_pc = pp.processing_type in ("pc", "hd-pc-home", "uhd-pc-home")
 
     def run() -> str:
-        planes, rate, pix_fmt, w, h = _read_avpvs(pvs)
-        n = planes[0].shape[0]
-        # display frame rate resample (reference fps=displayFrameRate filter)
-        if rate != pp.display_frame_rate:
-            idx = fps_ops.fps_resample_indices(n, rate, float(pp.display_frame_rate))
-            planes = [p[idx] for p in planes]
-        out_rate = Fraction(pp.display_frame_rate).limit_denominator(1001)
-        ten_bit = "10" in pix_fmt
+        with VideoReader(pvs.get_avpvs_file_path()) as reader:
+            pix_fmt = reader.pix_fmt
+            w, h = reader.width, reader.height
+            # display frame rate resample, streaming (reference
+            # fps=displayFrameRate filter)
+            chunks = _avpvs_chunks(reader, float(pp.display_frame_rate))
+            out_rate = Fraction(pp.display_frame_rate).limit_denominator(1001)
+            ten_bit = "10" in pix_fmt
 
-        audio = None
-        srate = 48000
-        if tc.is_long():
-            audio, srate = _audio_for_long(pvs, normalize=True)
+            audio = None
+            srate = 48000
+            if tc.is_long():
+                audio, srate = _audio_for_long(pvs, normalize=True)
 
-        if is_pc:
-            vcodec, target_pix_fmt = pvs.get_vcodec_and_pix_fmt_for_cpvs(rawvideo)
-            need_pad = h < pp.coding_height
-            dw, dh = pp.display_width, pp.display_height
-            aud = (
-                dict(audio_codec="pcm_s16le", sample_rate=srate, channels=2)
-                if (tc.is_long() and audio is not None and audio.size)
-                else {}
-            )
-            with VideoWriter(
-                out_path, vcodec, dw if need_pad else w, dh if need_pad else h,
-                target_pix_fmt, (out_rate.numerator, out_rate.denominator), **aud,
-            ) as writer:
-                if aud:
-                    writer.write_audio(audio)
-                for start in range(0, planes[0].shape[0], CHUNK):
-                    y = jnp.asarray(planes[0][start : start + CHUNK])
-                    u = jnp.asarray(planes[1][start : start + CHUNK])
-                    v = jnp.asarray(planes[2][start : start + CHUNK])
+            if is_pc:
+                vcodec, target_pix_fmt = pvs.get_vcodec_and_pix_fmt_for_cpvs(rawvideo)
+                need_pad = h < pp.coding_height
+                dw, dh = pp.display_width, pp.display_height
+                aud = (
+                    dict(audio_codec="pcm_s16le", sample_rate=srate, channels=2)
+                    if (tc.is_long() and audio is not None and audio.size)
+                    else {}
+                )
+
+                def pc_chunk(chunk):
+                    y, u, v = (jnp.asarray(p) for p in chunk[:3])
                     if "420" in pix_fmt and not rawvideo:
                         # packed/uyvy and v210 outputs are 422-based: lift
                         # chroma; rawvideo passes through the AVPVS layout
@@ -124,50 +118,47 @@ def create_cpvs(
                         v = pad_ops.pad_center(v, c_h, dw // 2, 128.0 if not ten_bit else 512.0)
                     if rawvideo:
                         # raw passthrough in the AVPVS pix_fmt
-                        outs = fr.to_uint8([y, u, v], ten_bit)
-                        for i in range(outs[0].shape[0]):
-                            writer.write(*(np.asarray(p[i]) for p in outs))
-                    elif not ten_bit:
+                        return fr.to_uint8([y, u, v], ten_bit)
+                    if not ten_bit:
                         # packed UYVY422 via the rawvideo encoder
                         yq, uq, vq = fr.to_uint8([y, u, v], False)
-                        packed = pf.pack_uyvy422(
+                        return [pf.pack_uyvy422(
                             jnp.asarray(yq), jnp.asarray(uq), jnp.asarray(vq)
-                        )
-                        for i in range(packed.shape[0]):
-                            writer.write(np.asarray(packed[i]))
-                    else:
-                        # v210 encoder takes planar yuv422p10le input
-                        outs = fr.to_uint8([y, u, v], True)
-                        for i in range(outs[0].shape[0]):
-                            writer.write(*(np.asarray(p[i]) for p in outs))
-        else:
-            # mobile / tablet: x264 CRF mp4, scale (+pad) to display dims;
-            # output is always 8-bit yuv420p, so 10-bit AVPVS planes are
-            # depth-converted first
-            if ten_bit:
-                planes = [
-                    np.asarray(pf.depth_10_to_8(jnp.asarray(p))) for p in planes
-                ]
-            dw, dh = pp.display_width, pp.display_height
-            aud = (
-                dict(audio_codec="aac", sample_rate=srate, channels=2,
-                     audio_bitrate_kbps=512)
-                if (tc.is_long() and audio is not None and audio.size)
-                else {}
-            )
-            opts = (
-                f"crf={nonraw_crf}:preset={mobile_preset}:"
-                f"profile={mobile_vprofile}:movflags=+faststart"
-            )
-            need_pad = (pp.display_height != pp.coding_height) or (h < pp.coding_height)
-            with VideoWriter(
-                out_path, "libx264", dw, dh, "yuv420p",
-                (out_rate.numerator, out_rate.denominator), opts=opts, **aud,
-            ) as writer:
-                if aud:
-                    writer.write_audio(audio)
-                for start in range(0, planes[0].shape[0], CHUNK):
-                    chunk = [p[start : start + CHUNK] for p in planes]
+                        )]
+                    # v210 encoder takes planar yuv422p10le input
+                    return fr.to_uint8([y, u, v], True)
+
+                with pfe.AsyncWriter(VideoWriter(
+                    out_path, vcodec, dw if need_pad else w, dh if need_pad else h,
+                    target_pix_fmt, (out_rate.numerator, out_rate.denominator),
+                    **aud,
+                )) as writer:
+                    if aud:
+                        writer.write_audio(audio)
+                    with pfe.Prefetcher(chunks, depth=2) as pre:
+                        for chunk in pre:
+                            writer.put(pc_chunk(chunk))
+            else:
+                # mobile / tablet: x264 CRF mp4, scale (+pad) to display
+                # dims; output is always 8-bit yuv420p, so 10-bit AVPVS
+                # chunks are depth-converted first
+                dw, dh = pp.display_width, pp.display_height
+                aud = (
+                    dict(audio_codec="aac", sample_rate=srate, channels=2,
+                         audio_bitrate_kbps=512)
+                    if (tc.is_long() and audio is not None and audio.size)
+                    else {}
+                )
+                opts = (
+                    f"crf={nonraw_crf}:preset={mobile_preset}:"
+                    f"profile={mobile_vprofile}:movflags=+faststart"
+                )
+                need_pad = (pp.display_height != pp.coding_height) or (h < pp.coding_height)
+
+                def mobile_chunk(chunk):
+                    chunk = list(chunk[:3])
+                    if ten_bit:
+                        chunk = [pf.depth_10_to_8(jnp.asarray(p)) for p in chunk]
                     if need_pad:
                         # pad-only at native AVPVS size (letterbox), the
                         # reference's padding branch applies no scale
@@ -176,11 +167,18 @@ def create_cpvs(
                             tuple(jnp.asarray(p) for p in chunk), dh, dw, "yuv420p"
                         )
                     else:
-                        scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
-                        y, u, v = scaled
-                    outs = fr.to_uint8([y, u, v], False)
-                    for i in range(outs[0].shape[0]):
-                        writer.write(*(np.asarray(p[i]) for p in outs))
+                        y, u, v = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
+                    return fr.to_uint8([y, u, v], False)
+
+                with pfe.AsyncWriter(VideoWriter(
+                    out_path, "libx264", dw, dh, "yuv420p",
+                    (out_rate.numerator, out_rate.denominator), opts=opts, **aud,
+                )) as writer:
+                    if aud:
+                        writer.write_audio(audio)
+                    with pfe.Prefetcher(chunks, depth=2) as pre:
+                        for chunk in pre:
+                            writer.put(mobile_chunk(chunk))
         return out_path
 
     return Job(
@@ -200,9 +198,13 @@ def create_preview(pvs: Pvs) -> Optional[Job]:
     """ProRes + AAC preview (reference create_preview :1250-1259)."""
     out_path = pvs.get_preview_file_path()
 
+    def fr_round(*planes):
+        return tuple(
+            jnp.clip(jnp.floor(p.astype(jnp.float32) + 0.5), 0, 255).astype(jnp.uint8)
+            for p in planes
+        )
+
     def run() -> str:
-        planes, rate, pix_fmt, w, h = _read_avpvs(pvs)
-        frac = Fraction(rate).limit_denominator(1001)
         audio = None
         srate = 48000
         try:
@@ -214,30 +216,33 @@ def create_preview(pvs: Pvs) -> Optional[Job]:
             if audio is not None and audio.size
             else {}
         )
-        with VideoWriter(
-            out_path, "prores_ks", w, h, "yuv422p10le",
-            (frac.numerator, frac.denominator), **aud,
-        ) as writer:
-            if aud:
-                writer.write_audio(audio)
-            for start in range(0, planes[0].shape[0], CHUNK):
-                y = jnp.asarray(planes[0][start : start + CHUNK])
-                u = jnp.asarray(planes[1][start : start + CHUNK])
-                v = jnp.asarray(planes[2][start : start + CHUNK])
+        with VideoReader(pvs.get_avpvs_file_path()) as reader:
+            pix_fmt = reader.pix_fmt
+            frac = Fraction(reader.fps).limit_denominator(1001)
+
+            def preview_chunk(chunk):
+                y, u, v = (jnp.asarray(p) for p in chunk[:3])
                 if "420" in pix_fmt:
                     u, v = pf.chroma_420_to_422(u, v)
                 if "10" not in pix_fmt:
-                    y, u, v = (pf.depth_8_to_10(q.astype(jnp.uint8)) for q in fr_round(y, u, v))
-                outs = [np.asarray(q) for q in (y, u, v)]
-                for i in range(outs[0].shape[0]):
-                    writer.write(*(p[i] for p in outs))
-        return out_path
+                    y, u, v = (
+                        pf.depth_8_to_10(q.astype(jnp.uint8))
+                        for q in fr_round(y, u, v)
+                    )
+                return [y, u, v]
 
-    def fr_round(*planes):
-        return tuple(
-            jnp.clip(jnp.floor(p.astype(jnp.float32) + 0.5), 0, 255).astype(jnp.uint8)
-            for p in planes
-        )
+            with pfe.AsyncWriter(VideoWriter(
+                out_path, "prores_ks", reader.width, reader.height,
+                "yuv422p10le", (frac.numerator, frac.denominator), **aud,
+            )) as writer:
+                if aud:
+                    writer.write_audio(audio)
+                with pfe.Prefetcher(
+                    pfe.iter_plane_chunks(reader, CHUNK), depth=2
+                ) as pre:
+                    for chunk in pre:
+                        writer.put(preview_chunk(chunk))
+        return out_path
 
     return Job(
         label=f"preview {pvs.pvs_id}",

@@ -14,15 +14,16 @@ import os
 from fractions import Fraction
 from typing import Optional
 
-import numpy as np
-
 from ..config.domain import Segment
+from ..engine import prefetch as pfe
 from ..engine.jobs import Job
 from ..io.video import VideoReader, VideoWriter
 from ..io import medialib
 from ..ops import fps as fps_ops
 from ..utils.log import get_logger
 from . import frames as fr
+
+CHUNK = 64  # frames per decode/scale batch
 
 #: encoder name → libav encoder + default private options
 _ENCODERS = {
@@ -125,22 +126,35 @@ def encode_segment(segment: Segment) -> Optional[Job]:
 
     def run() -> str:
         src_fps = segment.src.get_fps()
-        with VideoReader(
-            segment.src.file_path, segment.start_time, segment.duration
-        ) as reader:
-            decoded = fr.stack_planes(list(reader))
-        if not decoded:
-            raise medialib.MediaError(
-                f"no frames decoded for {segment} from {segment.src.file_path}"
-            )
-        n = decoded[0].shape[0]
-        if target_fps is not None and target_fps != src_fps:
-            keep = fps_ops.select_indices(n, src_fps, target_fps)
-            decoded = [p[keep] for p in decoded]
         sub = fr.chroma_subsampling(segment.target_pix_fmt)
-        scaled = fr.scale_yuv_frames(decoded, target_h, target_w, "bicubic", sub)
         ten_bit = bool(segment.uses_10_bit())
-        planes = fr.to_uint8(scaled, ten_bit)
+        # drop-table ratio check up front, not first-chunk-deep into decode
+        if target_fps is not None and target_fps != src_fps:
+            fps_ops.select_table(src_fps, target_fps)
+
+        def scaled_chunks():
+            """Decode window → fps select → device scale, in CHUNK-frame
+            batches (O(CHUNK) memory for any window length; the reference's
+            ffmpeg process streams the same way). 2-pass encodes consume
+            this twice — two decodes, exactly like the reference's two
+            ffmpeg invocations."""
+            with VideoReader(
+                segment.src.file_path, segment.start_time, segment.duration
+            ) as reader:
+                decoded_any = False
+                stream = pfe.iter_plane_chunks(reader, CHUNK)
+                if target_fps is not None and target_fps != src_fps:
+                    stream = fps_ops.stream_select(stream, src_fps, target_fps)
+                for chunk in stream:
+                    decoded_any = True
+                    scaled = fr.scale_yuv_frames(
+                        chunk, target_h, target_w, "bicubic", sub
+                    )
+                    yield fr.to_uint8(scaled, ten_bit)
+            if not decoded_any:
+                raise medialib.MediaError(
+                    f"no frames decoded for {segment} from {segment.src.file_path}"
+                )
 
         fps_frac = Fraction(out_fps).limit_denominator(1001)
         gop = -1
@@ -185,19 +199,28 @@ def encode_segment(segment: Segment) -> Optional[Job]:
                 pass_num=pass_num if passes == 2 else 0,
                 stats_path=stats if passes == 2 else "",
             )
-            with VideoWriter(path, **kw, **(audio if pass_num != 1 or passes == 1 else {})) as w:
+            with pfe.AsyncWriter(VideoWriter(
+                path, **kw, **(audio if pass_num != 1 or passes == 1 else {})
+            )) as w:
                 if audio and (pass_num != 1 or passes == 1):
                     w.write_audio(samples)
-                for i in range(planes[0].shape[0]):
-                    w.write(*(p[i] for p in planes))
+                with pfe.Prefetcher(scaled_chunks(), depth=2) as pre:
+                    for chunk in pre:
+                        w.put(chunk)
 
-        if passes == 2:
-            null_out = out_path + ".pass1.tmp" + os.path.splitext(out_path)[1]
-            encode_pass(1, null_out)
-            os.unlink(null_out)
-            encode_pass(2, out_path)
-        else:
-            encode_pass(1, out_path)
+        null_out = out_path + ".pass1.tmp" + os.path.splitext(out_path)[1]
+        try:
+            if passes == 2:
+                encode_pass(1, null_out)
+                os.unlink(null_out)
+                encode_pass(2, out_path)
+            else:
+                encode_pass(1, out_path)
+        except BaseException:
+            # Job.run cleans out_path; the pass-1 tmp is ours to clean
+            if os.path.isfile(null_out):
+                os.unlink(null_out)
+            raise
         return out_path
 
     job = Job(

@@ -61,22 +61,47 @@ def resolve_fps_spec(fps_spec, src_fps: float) -> Optional[float]:
     return float(fps_spec)
 
 
-def select_indices(n_frames: int, src_fps: float, dst_fps: float) -> np.ndarray:
-    """Indices of source frames to keep for src_fps → dst_fps, using the
-    reference's drop tables; raises ConfigError for unsupported ratios
-    exactly like the reference (lib/ffmpeg.py:827-829)."""
-    if dst_fps == src_fps:
-        return np.arange(n_frames)
+def select_table(src_fps: float, dst_fps: float) -> tuple[int, tuple[int, ...]]:
+    """(cycle_len, kept_phases) of the reference's drop table for
+    src_fps → dst_fps; raises ConfigError for unsupported ratios exactly
+    like the reference (lib/ffmpeg.py:827-829)."""
     perc = 100.0 * dst_fps / src_fps
     key = perc if perc in _SELECT_TABLES else float(int(perc))
     if key not in _SELECT_TABLES:
         raise ConfigError(
             f"Frame rate conversion from {src_fps} to {dst_fps} is not supported"
         )
-    cycle, phases = _SELECT_TABLES[key]
+    return _SELECT_TABLES[key]
+
+
+def select_indices(n_frames: int, src_fps: float, dst_fps: float) -> np.ndarray:
+    """Indices of source frames to keep for src_fps → dst_fps, using the
+    reference's drop tables."""
+    if dst_fps == src_fps:
+        return np.arange(n_frames)
+    cycle, phases = select_table(src_fps, dst_fps)
     n = np.arange(n_frames)
     mask = np.isin(n % cycle, phases)
     return n[mask]
+
+
+def stream_select(chunks, src_fps: float, dst_fps: float):
+    """Streaming select_indices: the drop mask is periodic in the SOURCE
+    frame index, so it applies chunk-by-chunk with a running offset —
+    O(chunk) memory for arbitrarily long windows. Chunks are per-plane
+    [T, H, W] stacks; emitted chunks shrink to the kept frames (empty ones
+    are dropped)."""
+    if dst_fps == src_fps:
+        yield from chunks
+        return
+    cycle, phases = select_table(src_fps, dst_fps)
+    off = 0
+    for chunk in chunks:
+        n = chunk[0].shape[0]
+        mask = np.isin((np.arange(n) + off) % cycle, phases)
+        off += n
+        if mask.any():
+            yield [p[mask] for p in chunk]
 
 
 def fps_resample_indices(n_frames: int, src_fps: float, dst_fps: float) -> np.ndarray:

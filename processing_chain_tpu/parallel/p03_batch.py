@@ -145,44 +145,61 @@ def run_bucket(
         mesh, dst_h, dst_w, kernel, sub_h, sub_w, ten_bit
     )
 
+    from contextlib import ExitStack
+
+    from ..engine import prefetch as pfe
+
     ordered = sort_lanes(lanes)
     for w0 in range(0, len(ordered), n_pvs):
         wave = ordered[w0: w0 + n_pvs]
-        iters = [_rechunk(ln.chunks, t_step) for ln in wave]
-        done = [False] * len(wave)
-        zero_block: Optional[list] = None
-        while not all(done):
-            blocks: list[Optional[list]] = []
-            valids: list[int] = []
-            for i, it in enumerate(iters):
-                blk = None if done[i] else next(it, None)
-                if blk is None:
-                    done[i] = True
-                    blocks.append(None)
-                    valids.append(0)
-                else:
-                    blocks.append(blk[0])
-                    valids.append(blk[1])
-                    if zero_block is None:
-                        zero_block = [np.zeros_like(p) for p in blk[0]]
-            if all(v == 0 for v in valids):
-                break
-            assert zero_block is not None
-            filled = [b if b is not None else zero_block for b in blocks]
-            # pad the wave's batch axis up to the mesh's pvs size
-            while len(filled) < n_pvs:
-                filled.append(zero_block)
-            planes = [
-                jax.device_put(
-                    np.stack([blk[p] for blk in filled]), sharding
-                )
-                for p in range(3)
+        with ExitStack() as stack:
+            # one decode-ahead thread per lane, like the single-device
+            # path's Prefetcher: the device step runs while the next
+            # blocks decode
+            iters = [
+                iter(stack.enter_context(
+                    pfe.Prefetcher(_rechunk(ln.chunks, t_step), depth=2)
+                ))
+                for ln in wave
             ]
-            oy, ou, ov = step(*planes)
-            host = [np.asarray(o) for o in (oy, ou, ov)]
-            for i, ln in enumerate(wave):
-                if valids[i]:
-                    ln.emit([h[i][: valids[i]] for h in host])
+            _drive_wave(wave, iters, n_pvs, step, sharding)
+
+
+def _drive_wave(wave, iters, n_pvs, step, sharding) -> None:
+    import jax
+
+    done = [False] * len(wave)
+    zero_block: Optional[list] = None
+    while not all(done):
+        blocks: list[Optional[list]] = []
+        valids: list[int] = []
+        for i, it in enumerate(iters):
+            blk = None if done[i] else next(it, None)
+            if blk is None:
+                done[i] = True
+                blocks.append(None)
+                valids.append(0)
+            else:
+                blocks.append(blk[0])
+                valids.append(blk[1])
+                if zero_block is None:
+                    zero_block = [np.zeros_like(p) for p in blk[0]]
+        if all(v == 0 for v in valids):
+            break
+        assert zero_block is not None
+        filled = [b if b is not None else zero_block for b in blocks]
+        # pad the wave's batch axis up to the mesh's pvs size
+        while len(filled) < n_pvs:
+            filled.append(zero_block)
+        planes = [
+            jax.device_put(np.stack([blk[p] for blk in filled]), sharding)
+            for p in range(3)
+        ]
+        oy, ou, ov = step(*planes)
+        host = [np.asarray(o) for o in (oy, ou, ov)]
+        for i, ln in enumerate(wave):
+            if valids[i]:
+                ln.emit([h[i][: valids[i]] for h in host])
 
 
 def wave_count(n_lanes: int, mesh) -> int:

@@ -514,3 +514,26 @@ def test_resolve_fps_spec_reference_grammar_property():
     # the documented deviation: fractional numeric specs survive
     assert fps.resolve_fps_spec(29.97, 30.0) == 29.97
     assert fps.resolve_fps_spec("23.976", 24.0) == 23.976
+
+
+def test_stream_select_matches_select_indices():
+    """The streaming select (O(chunk) p01 decode) must keep exactly the
+    frames of the batch drop table, across chunk boundaries and for every
+    supported ratio."""
+    from processing_chain_tpu.ops import fps
+
+    rng = np.random.default_rng(3)
+    for src, dst in [(60, 30), (60, 24), (60, 15), (30, 24), (24, 15), (25, 15)]:
+        n = int(rng.integers(30, 90))
+        frames = np.arange(n, dtype=np.uint8).reshape(n, 1, 1)
+        chunks = []
+        i = 0
+        while i < n:  # ragged chunks to cross cycle boundaries
+            step = int(rng.integers(1, 17))
+            chunks.append([frames[i: i + step]])
+            i += step
+        got = np.concatenate(
+            [c[0] for c in fps.stream_select(iter(chunks), src, dst)]
+        ).ravel()
+        want = fps.select_indices(n, src, dst)
+        np.testing.assert_array_equal(got, want)
