@@ -69,9 +69,10 @@ def canvas_fps(pvs: Pvs, avpvs_src_fps: bool = False) -> float:
 
 
 def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
-                 with_audio: bool, sample_rate: int = 48000) -> VideoWriter:
+                 with_audio: bool, sample_rate: int = 48000,
+                 audio_codec: str = "pcm_s16le") -> VideoWriter:
     frac = Fraction(rate).limit_denominator(1001)
-    audio = dict(audio_codec="pcm_s16le", sample_rate=sample_rate, channels=2) if with_audio else {}
+    audio = dict(audio_codec=audio_codec, sample_rate=sample_rate, channels=2) if with_audio else {}
     # FFV1 level 3 + slicecrc stream integrity (reference :1047: -level 3
     # -coder 1 -context 1 -slicecrc 1); -threads 4 parity
     return VideoWriter(
@@ -122,6 +123,25 @@ def _short_rate_chunks(
     return rate, chunks
 
 
+def _short_segment_audio(seg):
+    """The short path carries the encoded segment's audio into the AVPVS
+    as FLAC (reference create_avpvs_short's bare `-i segment ... -c:a
+    flac`, lib/ffmpeg.py:995). (samples, rate) or (None, rate)."""
+    try:
+        samples, srate = medialib.decode_audio_s16(seg.file_path)
+    except medialib.MediaError:
+        return None, 48000
+    if samples.size == 0:
+        return None, srate
+    if samples.ndim == 1:
+        samples = samples[:, None]
+    if samples.shape[1] == 1:  # mono -> duplicate to stereo
+        samples = np.repeat(samples, 2, axis=1)
+    elif samples.shape[1] > 2:  # multichannel -> front pair (never flatten
+        samples = samples[:, :2]  # channels into the time axis)
+    return samples, srate
+
+
 def _wo_buffer_out_path(pvs: Pvs) -> str:
     return (
         pvs.get_avpvs_wo_buffer_file_path()
@@ -167,13 +187,20 @@ def create_avpvs_wo_buffer(
         if tc.is_short():
             # single segment, native segment frame rate unless -z/-f60
             seg = pvs.segments[0]
+            audio, srate = _short_segment_audio(seg)
             with VideoReader(seg.file_path) as reader:
                 rate, chunks = _short_rate_chunks(
                     pvs, reader, avpvs_src_fps, force_60_fps
                 )
                 with pf.AsyncWriter(
-                    _ffv1_writer(out_path, w, h, pix_fmt, rate, with_audio=False)
+                    _ffv1_writer(
+                        out_path, w, h, pix_fmt, rate,
+                        with_audio=audio is not None, sample_rate=srate,
+                        audio_codec="flac",
+                    )
                 ) as writer:
+                    if audio is not None:
+                        writer.write_audio(audio)
                     _pump(chunks, writer)
         else:
             rate = canvas_fps(pvs, avpvs_src_fps)
@@ -258,6 +285,7 @@ def create_avpvs_wo_buffer_batch(
                     with ExitStack() as stack:
                         lanes = []
                         for (pvs, w, h, _), out_path in zip(wave, out_paths):
+                            audio, srate = _short_segment_audio(pvs.segments[0])
                             reader = stack.enter_context(
                                 VideoReader(pvs.segments[0].file_path)
                             )
@@ -267,9 +295,12 @@ def create_avpvs_wo_buffer_batch(
                             writer = stack.enter_context(
                                 pf.AsyncWriter(_ffv1_writer(
                                     out_path, w, h, pix_fmt, rate,
-                                    with_audio=False,
+                                    with_audio=audio is not None,
+                                    sample_rate=srate, audio_codec="flac",
                                 ))
                             )
+                            if audio is not None:
+                                writer.write_audio(audio)
                             lanes.append(p03_batch.Lane(
                                 chunks=chunks,
                                 emit=writer.put,

@@ -47,16 +47,6 @@ def scale_to_width_keep_ar(
     return h, target_w
 
 
-def stack_planes(frames: list) -> list[np.ndarray]:
-    """[Frame, ...] → per-plane [T, H, W] arrays."""
-    if not frames:
-        return []
-    return [
-        np.stack([f.planes[p] for f in frames])
-        for p in range(len(frames[0].planes))
-    ]
-
-
 def scale_yuv_frames(
     planes: list,
     dst_h: int,

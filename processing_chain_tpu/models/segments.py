@@ -175,6 +175,34 @@ def encode_segment(segment: Segment) -> Optional[Job]:
                 channels=samples.shape[1] if samples.size else 2,
                 audio_bitrate_kbps=float(segment.quality_level.audio_bitrate or 128),
             )
+        elif tc.is_short():
+            # the reference emits neither -c:a nor -an for short tests
+            # (ffmpeg.py:839-845 "only for long"), so ffmpeg's default
+            # encodes SRC audio with the container's default codec —
+            # aac for .mp4, opus for .webm; 128k stands in for the
+            # codec-default bitrate
+            try:
+                samples, rate = medialib.decode_audio_s16(
+                    segment.src.file_path, segment.start_time, segment.duration
+                )
+            except medialib.MediaError:
+                samples = None
+            if samples is not None and samples.size:
+                is_webm = segment.filename.endswith(".webm")
+                if is_webm and rate not in (8000, 12000, 16000, 24000, 48000):
+                    # opus accepts only these rates; default-audio parity
+                    # is not worth a resampler here
+                    log.warning(
+                        "%s: SRC audio rate %d unsupported by opus; "
+                        "segment will carry no audio", segment.filename, rate,
+                    )
+                else:
+                    audio = dict(
+                        audio_codec="libopus" if is_webm else "aac",
+                        sample_rate=rate,
+                        channels=samples.shape[1],
+                        audio_bitrate_kbps=128.0,
+                    )
 
         stats = os.path.join(
             tc.get_logs_path(),

@@ -1,13 +1,4 @@
-from . import fps, metrics, overlay, pad, pixfmt, resize, siti
-
-# Import the Pallas TPU kernels at package-import time, NOT lazily: the
-# pallas.tpu import registers MLIR lowerings for platform "tpu", and in a
-# CPU-only process (tests, virtual-mesh runs) that registration is only
-# accepted while JAX's backends are still uninitialized. A deferred import
-# after the first jax.devices()/jit call raises NotImplementedError
-# ("unknown platform tpu") and would make resize method="fused" (and its
-# interpreter-mode tests) fail depending on what ran first.
-from . import pallas_kernels  # noqa: E402  (import-order is the point)
+from . import fps, metrics, overlay, pad, pallas_kernels, pixfmt, resize, siti
 
 __all__ = [
     "fps", "metrics", "overlay", "pad", "pallas_kernels", "pixfmt",

@@ -32,10 +32,35 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from .resize import make_banded_plan
+
+# Importing jax.experimental.pallas registers MLIR lowerings for platform
+# "tpu", which jax only accepts once its plugin discovery has made "tpu" a
+# known platform — i.e. AFTER the first backend initialization. At package
+# import time (CPU-only test processes, CLI startup before any device
+# touch) that registration raises NotImplementedError("unknown platform
+# tpu"). So: attempt the import, and on failure retry lazily at first
+# kernel use (failed module imports are removed from sys.modules, so the
+# retry re-executes them — by then a backend exists and it succeeds).
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # retried in _pallas()
+    pl = None
+    pltpu = None
+
+
+def _pallas():
+    global pl, pltpu
+    if pl is None or pltpu is None:
+        jax.devices()  # force plugin discovery so "tpu" is a known platform
+        from jax.experimental import pallas as _pl
+        from jax.experimental.pallas import tpu as _pltpu
+
+        pl, pltpu = _pl, _pltpu
+    return pl, pltpu
+
 
 BLOCK = 128
 
@@ -68,6 +93,7 @@ def _fused_resize_kernel(
     carry start/align and the kernel multiplies the alignment back in.
     Weight rows are shifted to compensate (zero-padded bands), and u8
     loads widen through int32 (u8->f32 has no direct lowering)."""
+    pl, _ = _pallas()
     cb = pl.program_id(1)
     sh = starts_h_ref[cb] * 128
     src = in_ref[0, :, pl.ds(sh, band_h)].astype(jnp.int32).astype(jnp.float32)
@@ -116,6 +142,7 @@ def resize_frames_fused(
     the Pallas counterpart of `resize.resize_frames(..., method="banded")`.
     `interpret=True` runs the kernel in the Pallas interpreter (CPU tests).
     """
+    pl, pltpu = _pallas()
     t, src_h, src_w = frames.shape
     if (src_h, src_w) == (dst_h, dst_w):
         return frames

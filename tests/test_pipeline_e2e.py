@@ -340,3 +340,43 @@ def test_p03_batch_byte_identical_to_single_device(batch_db):
     assert os.path.isfile(logfile)
     content = open(logfile).read()
     assert "processingChain" in content and "avpvs" in content
+
+
+def test_short_chain_audio_flac_parity(tmp_path):
+    """Short chain with an audio SRC: p01 carries the SRC audio into the
+    segment (ffmpeg's default-codec behavior the reference relies on —
+    no -c:a/-an emitted for short tests), and p03 muxes it into the AVPVS
+    as FLAC (reference create_avpvs_short's -c:a flac, lib/ffmpeg.py:995)."""
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2SXM95
+        syntaxVersion: 6
+        type: short
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h264, videoBitrate: 300, width: 320, height: 180, fps: 24}
+        codingList:
+          VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+        srcList:
+          SRC000: SRC000.avi
+        hrcList:
+          HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}
+        pvsList:
+          - P2SXM95_SRC000_HRC000
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+    """)
+    yaml_path = write_db(tmp_path, "P2SXM95", yaml_text,
+                         {"SRC000.avi": dict(n=48, audio=True)})
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "13", "--skip-requirements"])
+    assert rc == 0
+    db = os.path.dirname(yaml_path)
+
+    seg = os.path.join(db, "videoSegments", "P2SXM95_SRC000_Q0_VC01_0000_0-2.mp4")
+    seg_streams = {s["codec_type"]: s for s in medialib.probe(seg)["streams"]}
+    assert seg_streams["audio"]["codec_name"] == "aac"
+
+    av = os.path.join(db, "avpvs", "P2SXM95_SRC000_HRC000.avi")
+    av_streams = {s["codec_type"]: s for s in medialib.probe(av)["streams"]}
+    assert av_streams["video"]["codec_name"] == "ffv1"
+    assert av_streams["audio"]["codec_name"] == "flac"
+    samples, rate = medialib.decode_audio_s16(av)
+    assert samples.shape[0] >= int(1.8 * rate)  # ~2 s of audio carried
