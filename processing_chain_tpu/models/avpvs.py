@@ -123,23 +123,36 @@ def _short_rate_chunks(
     return rate, chunks
 
 
+def _to_stereo(samples: np.ndarray) -> np.ndarray:
+    """int16 [n] / [n, C] -> [n, 2]: mono duplicates, multichannel takes
+    the front pair — NEVER a reshape that flattens channels into the time
+    axis (that turns [n, 6] into 3x-duration noise)."""
+    if samples.ndim == 1:
+        samples = samples[:, None]
+    if samples.shape[1] == 1:
+        return np.repeat(samples, 2, axis=1)
+    if samples.shape[1] > 2:
+        return samples[:, :2]
+    return samples
+
+
 def _short_segment_audio(seg):
     """The short path carries the encoded segment's audio into the AVPVS
     as FLAC (reference create_avpvs_short's bare `-i segment ... -c:a
     flac`, lib/ffmpeg.py:995). (samples, rate) or (None, rate)."""
     try:
         samples, srate = medialib.decode_audio_s16(seg.file_path)
-    except medialib.MediaError:
+    except medialib.MediaError as exc:
+        # no-audio-stream and decode-failure are one exception type; the
+        # warning keeps a real failure from silently shipping an
+        # audio-less AVPVS (the reference's -c:a flac would hard-fail)
+        get_logger().warning(
+            "%s: no audio carried into AVPVS (%s)", seg.filename, exc
+        )
         return None, 48000
     if samples.size == 0:
         return None, srate
-    if samples.ndim == 1:
-        samples = samples[:, None]
-    if samples.shape[1] == 1:  # mono -> duplicate to stereo
-        samples = np.repeat(samples, 2, axis=1)
-    elif samples.shape[1] > 2:  # multichannel -> front pair (never flatten
-        samples = samples[:, :2]  # channels into the time axis)
-    return samples, srate
+    return _to_stereo(samples), srate
 
 
 def _wo_buffer_out_path(pvs: Pvs) -> str:
@@ -208,8 +221,7 @@ def create_avpvs_wo_buffer(
             samples, srate = medialib.decode_audio_s16(
                 pvs.src.file_path, 0.0, total
             )
-            if samples.ndim != 2 or samples.shape[1] != 2:
-                samples = np.repeat(samples.reshape(-1, 1), 2, axis=1)
+            samples = _to_stereo(samples)
             with pf.AsyncWriter(
                 _ffv1_writer(
                     out_path, w, h, pix_fmt, rate, with_audio=True,

@@ -185,7 +185,13 @@ def encode_segment(segment: Segment) -> Optional[Job]:
                 samples, rate = medialib.decode_audio_s16(
                     segment.src.file_path, segment.start_time, segment.duration
                 )
-            except medialib.MediaError:
+            except medialib.MediaError as exc:
+                # audio-less SRCs land here by design; the warning keeps a
+                # real decode failure from silently dropping audio
+                log.warning(
+                    "%s: segment will carry no audio (%s)",
+                    segment.filename, exc,
+                )
                 samples = None
             if samples is not None and samples.size:
                 is_webm = segment.filename.endswith(".webm")

@@ -14,9 +14,28 @@ blend:
     the event duration while content underneath is skipped; output length
     is unchanged and no spinner is drawn.
 
-Behavioral-spec note: upstream bufferer's exact spinner angular rate is not
-documented; we rotate one revolution per second (`spinner_rps`,
-configurable), with precomputed rotations at `n_rotations` phases.
+Behavioral spec, by provenance:
+
+  CITED (reference invocation, p03:242-243, and the .buff media-time
+  contract, test_config.py:312-333):
+    * stall events are [[media_time_s, duration_s], ...]; each inserts
+      round(duration*fps) frames at round(media_time*fps) — output grows;
+    * --black-frame: inserted frames show black, not the frozen frame;
+    * -e --skipping (frame-freeze HRCs): no spinner, content frames are
+      *replaced* by the freeze — output length is unchanged;
+    * --force-framerate: output CFR at the input rate (our writer is CFR
+      by construction);
+    * -v ffv1 -a pcm_s16le: FFV1 video, pcm_s16le audio out.
+
+  ASSUMED (upstream bufferer's pip source is unreachable from this
+  offline build environment, so its exact spinner kinematics cannot be
+  cited): angular rate = `spinner_rps` (default 1.0 rev/s), clockwise,
+  phase continuous across consecutive stall events. These are pinned in
+  ONE place (plan_stalling's spinner_rps/phase logic) and are
+  *calibratable*: `estimate_spinner_rps` recovers the rate from any
+  rendered clip, and `tools/bufferer_calibrate.py` runs it against a real
+  bufferer output to produce replacement constants (tested round-trip on
+  our own renders in tests/test_ops.py).
 """
 
 from __future__ import annotations
@@ -219,3 +238,45 @@ def downsample_alpha(alpha: np.ndarray) -> np.ndarray:
     """[R, H, W] alpha → chroma-grid alpha [R, H/2, W/2] (2x2 mean)."""
     return alpha.reshape(alpha.shape[0], alpha.shape[1] // 2, 2,
                          alpha.shape[2] // 2, 2).mean(axis=(2, 4))
+
+
+# ---------------------------------------------------------------------------
+# Calibration: recover spinner kinematics from a rendered clip
+# ---------------------------------------------------------------------------
+
+
+def estimate_spinner_rps(
+    frames: np.ndarray, fps: float
+) -> tuple[float, float]:
+    """Estimate the spinner's angular rate from stall-zone luma frames.
+
+    frames: [T, H, W] luma of consecutive stall frames, cropped roughly to
+    the spinner region (dark background). Method: the luminance-weighted
+    centroid of a rotationally-asymmetric spinner (the reference spinner's
+    gradient tail) traces a circle; the unwrapped centroid angle against
+    frame index gives rad/frame, hence revolutions/second.
+
+    Returns (rps, residual): rps > 0 means clockwise on screen (image y
+    points down); residual is the RMS of the linear-fit error in radians —
+    large residual means the clip wasn't a steadily rotating spinner.
+    """
+    t = frames.shape[0]
+    if t < 3:
+        raise ValueError("need at least 3 stall frames to estimate a rate")
+    h, w = frames.shape[1:]
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float64)
+    cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+    angles = np.empty(t)
+    for k, f in enumerate(np.asarray(frames, np.float64)):
+        wgt = np.clip(f - f.min(), 0, None)
+        s = wgt.sum()
+        if s <= 0:
+            raise ValueError(f"stall frame {k} is uniform; cannot locate spinner")
+        angles[k] = np.arctan2(
+            (wgt * yy).sum() / s - cy, (wgt * xx).sum() / s - cx
+        )
+    ang = np.unwrap(angles)
+    n = np.arange(t)
+    slope, intercept = np.polyfit(n, ang, 1)
+    resid = float(np.sqrt(np.mean((ang - (slope * n + intercept)) ** 2)))
+    return float(slope * fps / (2.0 * np.pi)), resid

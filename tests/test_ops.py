@@ -537,3 +537,84 @@ def test_stream_select_matches_select_indices():
         ).ravel()
         want = fps.select_indices(n, src, dst)
         np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------------------- bufferer calibration
+
+
+def _spinner_bank(n_rotations=64):
+    from processing_chain_tpu.models.avpvs import load_spinner
+    from processing_chain_tpu.utils.parse_args import _DEFAULT_SPINNER
+    from processing_chain_tpu.ops import overlay as ov
+
+    return ov.prepare_spinner(load_spinner(_DEFAULT_SPINNER), n_rotations)
+
+
+def _render_stalled_luma(events, n_in=24, fps=24.0, rps=1.0, size=192):
+    """Render a stalled luma clip with a known spinner rate."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import overlay as ov
+
+    bank_yuv, bank_a = _spinner_bank()
+    plan = ov.plan_stalling(
+        n_in, fps, events, skipping=False, black_frame=True, spinner_rps=rps
+    )
+    frames = jnp.full((n_in, size, size), 120.0, jnp.float32)
+    out = ov.render_stalled_plane(
+        frames, plan, bank_yuv[:, 0], bank_a, black_value=16.0
+    )
+    return np.asarray(out), plan
+
+
+def test_estimate_spinner_rps_recovers_known_rate():
+    """The calibration estimator must recover the renderer's own pinned
+    cadence — the round-trip that makes the bufferer-spec assumption
+    measurable against a real bufferer clip."""
+    from processing_chain_tpu.ops import overlay as ov
+
+    for rps in (1.0, 0.5):
+        luma, plan = _render_stalled_luma([[0.25, 1.0]], rps=rps)
+        a = int(np.argmax(plan.stall_mask))
+        b = a + int(plan.stall_mask[a:].sum())
+        crop = luma[a:b, 32:160, 32:160]
+        got, resid = ov.estimate_spinner_rps(crop, 24.0)
+        assert abs(got - rps) < 0.08, (rps, got)
+        assert got > 0  # clockwise on screen
+        assert resid < 0.2
+
+
+def test_spinner_phase_continuous_across_events():
+    """Pinned assumption, explicit: rotation does not reset between
+    consecutive stall events."""
+    from processing_chain_tpu.ops import overlay as ov
+
+    plan = ov.plan_stalling(48, 24.0, [[0.5, 0.5], [1.0, 0.5]],
+                            skipping=False, spinner_rps=1.0)
+    phases = plan.phase[plan.stall_mask.astype(bool)]
+    # 24 stall frames total; phase index advances int(k*64/24) cumulatively
+    want = np.array([int(k * 64 / 24) % 64 for k in range(len(phases))])
+    np.testing.assert_array_equal(phases, want)
+
+
+def test_bufferer_calibrate_roundtrip(tmp_path):
+    """tools/bufferer_calibrate measures insertion count, black background,
+    and spinner rate from a rendered file — proven on our own renderer so
+    it can be trusted against a real bufferer output."""
+    from processing_chain_tpu.io.video import VideoWriter
+    from processing_chain_tpu.tools import bufferer_calibrate as bc
+
+    events = [[0.5, 0.75]]
+    luma, plan = _render_stalled_luma(events, n_in=24, fps=24.0, rps=1.0)
+    path = str(tmp_path / "stalled.avi")
+    with VideoWriter(path, "ffv1", 192, 192, "yuv420p", (24, 1)) as wr:
+        for f in np.clip(luma + 0.5, 0, 255).astype(np.uint8):
+            wr.write(f, np.full((96, 96), 128, np.uint8),
+                     np.full((96, 96), 128, np.uint8))
+    report = bc.calibrate(path, events, n_input_frames=24, crop=128)
+    assert report["insertion_matches_plan"]
+    assert report["inserted_frames"] == 18  # round(0.75*24)
+    ev = report["events"][0]
+    assert ev["background_black"]
+    assert abs(ev["spinner_rps"] - 1.0) < 0.1
+    assert report["spinner_direction"] == "clockwise"
