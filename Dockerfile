@@ -1,0 +1,64 @@
+# Reproducible build + run environment for processing_chain_tpu.
+#
+# Counterpart of the reference's Dockerfile (reference Dockerfile:1-56 +
+# docker/install_ffmpeg.sh:31-67): where the reference compiles a pinned
+# FFmpeg 7.0.2 CLI toolchain from source, this framework links its native
+# media boundary (processing_chain_tpu/native/libpcmedia.so) against
+# Debian bookworm's pinned libav 5.1 packages — the same library major
+# versions the in-tree golden tests were validated against (libavcodec 59 /
+# libavformat 59 / libswscale 6).
+#
+#   docker build -t processing-chain-tpu .
+#   docker run --rm processing-chain-tpu python -m pytest tests/ -q
+#
+# TPU note: inside a TPU VM, base the image on your TPU-runtime image of
+# choice instead and keep ONLY the apt + native-build layers below; the
+# jax[tpu] wheel pin must match the host runtime. On CPU the image runs
+# the full test suite on a virtual 8-device mesh out of the box.
+
+FROM python:3.12.12-slim-bookworm
+
+# --- native toolchain + pinned libav (Debian bookworm: FFmpeg 5.1 ABI) ---
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ \
+        make \
+        libavcodec-dev \
+        libavformat-dev \
+        libavutil-dev \
+        libswscale-dev \
+        libswresample-dev \
+        libx264-dev \
+        libx265-dev \
+        libvpx-dev \
+        libaom-dev \
+    && rm -rf /var/lib/apt/lists/*
+
+# --- python deps, pinned to the versions the suite is validated against ---
+RUN pip install --no-cache-dir \
+        "jax==0.9.0" \
+        "flax==0.12.3" \
+        "optax==0.2.6" \
+        "chex==0.1.91" \
+        "einops==0.8.2" \
+        "numpy==2.0.2" \
+        "scipy==1.17.0" \
+        "pandas==3.0.3" \
+        "matplotlib==3.10.8" \
+        "pillow==12.1.0" \
+        "pyyaml==6.0.3" \
+        "pytest==8.4.2" \
+        "hypothesis==6.142.1"
+
+WORKDIR /chain
+COPY . /chain
+
+# --- build the native media boundary against the pinned libav ---
+RUN make -C processing_chain_tpu/native \
+    && python -c "from processing_chain_tpu.io import medialib; medialib.ensure_loaded(); print('libpcmedia OK')"
+
+# tests run on a virtual 8-device CPU mesh (same partitioning/collective
+# code paths XLA uses on a real v5e-8; tests/conftest.py sets this too)
+ENV JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+CMD ["python", "-m", "pytest", "tests/", "-q"]

@@ -57,7 +57,7 @@ def _encoder_opts(segment: Segment, current_pass: int, total_passes: int) -> str
         if not coding.scenecut:
             params.append("scenecut=-1")
         if params:
-            opts.append("x264-params=" + ":".join(params))
+            opts.append("x264-params=" + _escape_opt_value(":".join(params)))
     elif encoder == "libx265":
         params = ["log-level=error"]
         # reference quirk (do-not-copy list): x265 scenecut=0 was appended
@@ -67,7 +67,7 @@ def _encoder_opts(segment: Segment, current_pass: int, total_passes: int) -> str
             params.append("scenecut=0")
         if total_passes == 2:
             params.append(f"pass={current_pass}")
-        opts.append("x265-params=" + ":".join(params))
+        opts.append("x265-params=" + _escape_opt_value(":".join(params)))
     elif encoder == "libvpx-vp9":
         speed = coding.speed
         # first pass runs at speed 4 (reference :100-102)
@@ -81,9 +81,64 @@ def _encoder_opts(segment: Segment, current_pass: int, total_passes: int) -> str
         opts.append("usage=realtime")
 
     if coding.enc_options:
-        # reference passes raw ffmpeg flags; accept "k=v:k=v" style here
-        opts.append(str(coding.enc_options))
+        opts.append(enc_options_to_opts(coding.enc_options))
     return ":".join(o for o in opts if o)
+
+
+def _escape_opt_value(value: str) -> str:
+    """Escape an option VALUE for the ':'-joined opts string the native
+    boundary parses with av_dict_parse_string(.., "=", ":", 0): a bare ':'
+    in a value (x265-params=a=1:b=2, x264opts keyint=48:min-keyint=48)
+    would otherwise split the value into bogus extra options that fall
+    through to the muxer and are silently dropped. av_get_token honors
+    backslash escapes."""
+    return value.replace("\\", "\\\\").replace(":", "\\:")
+
+
+def enc_options_to_opts(enc_options: str) -> str:
+    """Translate a database's `enc_options` into codec-context options.
+
+    The reference splices enc_options RAW into its ffmpeg command line
+    (reference lib/ffmpeg.py:122-124 spliced at :169/:238), so databases
+    carry flag syntax like `-tune zerolatency -bf 0`. Here encoder options
+    are AVOptions on the codec context, so `-k v` pairs map to `k=v` (a
+    valueless flag becomes `k=1`, AVOption bool style); `k=v:k=v` strings
+    pass through unchanged. ffmpeg *stream-specifier* flags (`-b:v` etc.)
+    belong to the rate-control surface, which is first-class on the
+    Coding — a specifier key here raises rather than misconfiguring the
+    encoder silently."""
+    s = str(enc_options).strip()
+    if not s.startswith("-"):
+        return s
+
+    def is_flag(tok: str) -> bool:
+        return tok.startswith("-") and len(tok) > 1 and not (
+            tok[1].isdigit() or tok[1] == "."
+        )
+
+    toks = s.split()
+    pairs = []
+    i = 0
+    while i < len(toks):
+        tok = toks[i]
+        if not is_flag(tok):
+            raise ValueError(
+                f"enc_options: cannot parse {tok!r} in {s!r} (expected a "
+                f"-flag)"
+            )
+        key = tok.lstrip("-")
+        if ":" in key:
+            raise ValueError(
+                f"enc_options: stream-specifier flag {tok!r} is not a codec "
+                "option; use the Coding's first-class rate-control fields"
+            )
+        if i + 1 < len(toks) and not is_flag(toks[i + 1]):
+            pairs.append(f"{key}={_escape_opt_value(toks[i + 1])}")
+            i += 2
+        else:
+            pairs.append(f"{key}=1")
+            i += 1
+    return ":".join(pairs)
 
 
 def plan_segment_frames(segment: Segment):

@@ -64,7 +64,14 @@ class BitmovinApi(Protocol):
 
     def start(self, encoding_id: str) -> None: ...
 
-    def wait_until_finished(self, encoding_id: str) -> None: ...
+    def wait_until_finished(self, encoding_id: str) -> None:
+        """Block until the cloud encode completes. MUST raise on a
+        terminal failure state (ERROR/CANCELED) and MUST NOT block
+        forever on a hung encode (deadline -> TimeoutError): p01 runs
+        online jobs pool-wide and a silently wedged encode would stall
+        the whole stage with no diagnostic (the reference exits the
+        process on BitmovinError, downloader.py:736-740)."""
+        ...
 
 
 @dataclass
@@ -387,11 +394,21 @@ class SdkBitmovinApi:
     def start(self, encoding_id: str) -> None:
         self._api.encoding.encodings.start(encoding_id)
 
-    def wait_until_finished(self, encoding_id: str, poll_s: float = 5.0) -> None:
+    #: a cloud encode of a <=20 s segment that hasn't finished in 2 hours
+    #: is wedged, not slow (reference SRCs are single segments)
+    WAIT_TIMEOUT_S = 2 * 3600.0
+
+    def wait_until_finished(
+        self, encoding_id: str, poll_s: float = 5.0,
+        timeout_s: Optional[float] = None,
+    ) -> None:
         import time
 
         sdk = self._sdk
-        while True:
+        timeout = timeout_s if timeout_s is not None else self.WAIT_TIMEOUT_S
+        deadline = time.monotonic() + timeout
+        status = None
+        while time.monotonic() < deadline:
             status = self._api.encoding.encodings.status(encoding_id)
             if status.status == sdk.Status.FINISHED:
                 return
@@ -400,6 +417,11 @@ class SdkBitmovinApi:
                     f"Bitmovin encoding {encoding_id} ended as {status.status}"
                 )
             time.sleep(poll_s)
+        raise TimeoutError(
+            f"Bitmovin encoding {encoding_id} did not finish within "
+            f"{timeout:.0f}s "
+            f"(last status: {getattr(status, 'status', 'never polled')})"
+        )
 
 
 def submit_encoding(api: BitmovinApi, plan: BitmovinPlan) -> str:

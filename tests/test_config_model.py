@@ -239,3 +239,72 @@ def test_mixed_src_duration_rejected(tmp_path):
         _yaml.safe_dump(data, f)
     with pytest.raises(ConfigError, match="src_duration"):
         TestConfig(yaml_path, prober=prober)
+
+
+def test_shipped_complexity_fixtures_drive_ladder(tmp_path):
+    """The committed util/complexityAnalysis CSVs (regenerated equivalents
+    of the reference's 80+30-row fixtures) load through _parse_complexity
+    and flip the ladder exactly like the reference (test_config.py:426-445,
+    :1086-1087): class > 1 picks the high rung, else the low one."""
+    import csv
+
+    import yaml as _yaml
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cdir = os.path.join(repo, "util", "complexityAnalysis")
+    main_csv = os.path.join(cdir, "complexity_classification.csv")
+    val_csv = os.path.join(cdir, "complexity_classification_validation.csv")
+    rows = list(csv.DictReader(open(main_csv)))
+    val_rows = list(csv.DictReader(open(val_csv)))
+    assert len(rows) >= 80 and len(val_rows) >= 30
+    classes = {int(r["complexity_class"]) for r in rows}
+    assert classes <= {0, 1, 2, 3} and len(classes) >= 3
+    for col in ("file", "norm_bitrate", "complexity", "framerate",
+                "complexity_class"):
+        assert col in rows[0], col
+
+    hard = next(r["file"] for r in rows if int(r["complexity_class"]) > 1)
+    easy = next(r["file"] for r in rows if int(r["complexity_class"]) <= 1)
+    for fixture_name, want in ((hard, [800.0, 3000.0]), (easy, [400.0, 1500.0])):
+        yaml_path, prober = write_short_db(tmp_path / fixture_name[:6])
+        data = _yaml.safe_load(open(yaml_path))
+        data["qualityLevelList"]["Q0"]["videoBitrate"] = "400/800"
+        data["qualityLevelList"]["Q1"]["videoBitrate"] = "1500/3000"
+        data["srcList"]["SRC000"] = fixture_name
+        with open(yaml_path, "w") as f:
+            _yaml.safe_dump(data, f)
+        src_dir = os.path.join(os.path.dirname(yaml_path), "srcVid")
+        os.rename(os.path.join(src_dir, "SRC000.avi"),
+                  os.path.join(src_dir, fixture_name))
+        prober = StaticProber({fixture_name: dict(SRC_INFO_1080)})
+        tc = TestConfig(yaml_path, prober=prober, complexity_csv_dir=cdir)
+        assert tc.is_complex()
+        assert sorted(s.target_video_bitrate for s in tc.segments) == want
+
+
+def test_enc_options_flag_syntax_translation():
+    """Databases written for the reference carry RAW ffmpeg flags in
+    enc_options (spliced verbatim there, lib/ffmpeg.py:122-124); they must
+    map onto codec-context options, not get glued into an opts string as-is."""
+    from processing_chain_tpu.models.segments import enc_options_to_opts
+
+    assert enc_options_to_opts("-tune zerolatency -bf 0") == "tune=zerolatency:bf=0"
+    assert enc_options_to_opts("-qcomp -0.5") == "qcomp=-0.5"
+    assert enc_options_to_opts("-fastfirstpass") == "fastfirstpass=1"
+    # k=v style keeps working
+    assert enc_options_to_opts("tune=film:bf=2") == "tune=film:bf=2"
+    with pytest.raises(ValueError, match="stream-specifier"):
+        enc_options_to_opts("-b:v 500k")
+    with pytest.raises(ValueError, match="cannot parse"):
+        enc_options_to_opts("-tune zerolatency stray")
+
+
+def test_enc_options_escapes_colon_values():
+    """Values containing ':' (x264opts keyint=48:min-keyint=48) must be
+    backslash-escaped for the native av_dict_parse_string(.., "=", ":")
+    boundary — unescaped they split into bogus extra options that are
+    silently dropped."""
+    from processing_chain_tpu.models.segments import enc_options_to_opts
+
+    assert (enc_options_to_opts("-x264opts keyint=48:min-keyint=48")
+            == "x264opts=keyint=48\\:min-keyint=48")

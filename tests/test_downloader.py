@@ -595,3 +595,42 @@ def test_encode_bitmovin_level0_without_api_raises(tmp_path):
     d = dl.Downloader(str(tmp_path))
     with pytest.raises(RuntimeError, match="no Bitmovin API client"):
         d.encode_bitmovin(_bm_seg(filename="SEG011.mp4"))
+
+
+def _wait_client(statuses):
+    """SdkBitmovinApi with injected fake SDK/api for the wait loop."""
+    from types import SimpleNamespace as NS
+
+    from processing_chain_tpu.services import bitmovin as bm
+
+    client = object.__new__(bm.SdkBitmovinApi)
+    sdk = NS(Status=NS(FINISHED="FINISHED", ERROR="ERROR", CANCELED="CANCELED",
+                       RUNNING="RUNNING"))
+    seq = iter(statuses)
+    last = statuses[-1]
+
+    def status(encoding_id):
+        return NS(status=next(seq, last))
+
+    client._sdk = sdk
+    client._api = NS(encoding=NS(encodings=NS(status=status)))
+    return client
+
+
+def test_bitmovin_wait_finishes_after_polls():
+    c = _wait_client(["RUNNING", "RUNNING", "FINISHED"])
+    c.wait_until_finished("enc-1", poll_s=0.0)  # returns, no raise
+
+
+def test_bitmovin_wait_surfaces_failed_encode():
+    c = _wait_client(["RUNNING", "ERROR"])
+    with pytest.raises(RuntimeError, match="ended as ERROR"):
+        c.wait_until_finished("enc-2", poll_s=0.0)
+
+
+def test_bitmovin_wait_times_out_on_hung_encode():
+    """A wedged cloud encode must not block p01 forever: the deadline
+    raises with the last observed status as the diagnostic."""
+    c = _wait_client(["RUNNING"])
+    with pytest.raises(TimeoutError, match="did not finish.*RUNNING"):
+        c.wait_until_finished("enc-3", poll_s=0.0, timeout_s=0.05)
