@@ -36,7 +36,10 @@ _ENCODERS = {
 }
 
 
-def _encoder_opts(segment: Segment, current_pass: int, total_passes: int) -> str:
+def _encoder_opts(
+    segment: Segment, current_pass: int, total_passes: int,
+    stats_path: str = "",
+) -> str:
     """Private-option string mirroring _get_video_encoder_command semantics
     (reference lib/ffmpeg.py:61-318), minus what VideoWriter takes as
     first-class arguments (bitrate/min/max/bufsize/gop/bframes)."""
@@ -66,7 +69,12 @@ def _encoder_opts(segment: Segment, current_pass: int, total_passes: int) -> str
         if not coding.scenecut:
             params.append("scenecut=0")
         if total_passes == 2:
+            # libx265 has no "stats" AVOption (x264's route): pass AND the
+            # stats path both travel inside x265-params, else x265 writes
+            # ./x265_2pass.log into the process cwd
             params.append(f"pass={current_pass}")
+            if stats_path:
+                params.append(f"stats={stats_path}")
         opts.append("x265-params=" + _escape_opt_value(":".join(params)))
     elif encoder == "libvpx-vp9":
         speed = coding.speed
@@ -284,7 +292,7 @@ def encode_segment(segment: Segment) -> Optional[Job]:
                 gop=gop,
                 bframes=bframes,
                 threads=1,  # determinism (reference -threads 1, :790)
-                opts=_encoder_opts(segment, pass_num, passes),
+                opts=_encoder_opts(segment, pass_num, passes, stats),
                 pass_num=pass_num if passes == 2 else 0,
                 stats_path=stats if passes == 2 else "",
             )

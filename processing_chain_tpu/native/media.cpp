@@ -525,6 +525,7 @@ struct MPEncoder {
     std::vector<int16_t> abuf;  // pending audio (interleaved s16)
     int64_t last_dts[2] = {INT64_MIN, INT64_MIN};  // per-stream mux fixup
     FILE* stats_file = nullptr;       // two-pass: pass 1 stats out
+    std::string stats_out_path;       // lazy pass-1 fallback target
     std::string stats_in;             // two-pass: pass 2 stats
     bool header_written = false;
     char errbuf[512] = {0};
@@ -556,8 +557,10 @@ static int enc_write_packets(MPEncoder* e, AVCodecContext* ctx, AVStream* st) {
             av_packet_free(&pkt);
             return wret;
         }
-        if (ctx == e->venc && e->stats_file && ctx->stats_out) {
-            fputs(ctx->stats_out, e->stats_file);
+        if (ctx == e->venc && ctx->stats_out && !e->stats_out_path.empty()) {
+            if (!e->stats_file)
+                e->stats_file = fopen(e->stats_out_path.c_str(), "w");
+            if (e->stats_file) fputs(ctx->stats_out, e->stats_file);
         }
     }
     av_packet_free(&pkt);
@@ -616,33 +619,27 @@ EXPORT MPEncoder* mp_encoder_open(
 
     if (pass == 1) {
         e->venc->flags |= AV_CODEC_FLAG_PASS1;
-        // x264/x265 write the stats file themselves via their private
-        // "stats" option (what the ffmpeg CLI's -passlogfile maps to);
-        // libvpx-style encoders emit ctx->stats_out instead, which we
-        // collect into the file ourselves.
+        // x264 writes the stats file itself via its private "stats" option
+        // (what the ffmpeg CLI's -passlogfile maps to); libvpx-style
+        // encoders emit ctx->stats_out instead, which we collect into the
+        // file ourselves — LAZILY, on the first stats_out, because an
+        // encoder that handles stats fully internally (x265 via
+        // x265-params stats=...) never emits stats_out and must not be
+        // left an empty junk file.
         if (av_opt_set(e->venc, "stats", stats_path,
                        AV_OPT_SEARCH_CHILDREN) != 0) {
-            e->stats_file = fopen(stats_path, "w");
-            if (!e->stats_file) {
-                set_err(err, errlen, "cannot open stats file for writing");
-                avcodec_free_context(&e->venc);
-                avformat_free_context(e->fmt);
-                delete e;
-                return nullptr;
-            }
+            e->stats_out_path = stats_path;
         }
     } else if (pass == 2) {
         e->venc->flags |= AV_CODEC_FLAG_PASS2;
         if (av_opt_set(e->venc, "stats", stats_path,
                        AV_OPT_SEARCH_CHILDREN) != 0) {
+            // a missing file is not an error here: encoders that manage
+            // stats fully internally (x265 via x265-params stats=...)
+            // leave nothing at stats_path; encoders that truly need
+            // stats_in (libvpx) will themselves fail at open/encode
             FILE* f = fopen(stats_path, "r");
-            if (!f) {
-                set_err(err, errlen, "cannot open stats file for reading");
-                avcodec_free_context(&e->venc);
-                avformat_free_context(e->fmt);
-                delete e;
-                return nullptr;
-            }
+            if (f) {
             fseek(f, 0, SEEK_END);
             long sz = ftell(f);
             fseek(f, 0, SEEK_SET);
@@ -650,6 +647,7 @@ EXPORT MPEncoder* mp_encoder_open(
             if (fread(&e->stats_in[0], 1, sz, f) != (size_t)sz) { /* best effort */ }
             fclose(f);
             e->venc->stats_in = av_strdup(e->stats_in.c_str());
+            }
         }
     }
 
@@ -867,8 +865,10 @@ EXPORT int mp_encoder_close(MPEncoder* e, char* err, int errlen) {
             avcodec_send_frame(e->aenc, nullptr);
             if (enc_write_packets(e, e->aenc, e->astream) < 0) rc = -1;
         }
-        if (e->stats_file && e->venc->stats_out) {
-            fputs(e->venc->stats_out, e->stats_file);
+        if (e->venc->stats_out && !e->stats_out_path.empty()) {
+            if (!e->stats_file)
+                e->stats_file = fopen(e->stats_out_path.c_str(), "w");
+            if (e->stats_file) fputs(e->venc->stats_out, e->stats_file);
         }
         av_write_trailer(e->fmt);
     }

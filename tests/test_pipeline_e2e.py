@@ -454,3 +454,6 @@ def test_p01_x265_two_pass(tmp_path):
     # pass=2 read it) — its presence proves the pass directive took effect
     logs = os.listdir(os.path.join(db, "logs"))
     assert any("passlogfile_P2SXM97" in f for f in logs), logs
+    # ...and nowhere else: without stats= inside x265-params, x265 used to
+    # drop x265_2pass.log into the process cwd
+    assert not [f for f in os.listdir(".") if f.startswith("x265_2pass")]
