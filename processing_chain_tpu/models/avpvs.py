@@ -155,6 +155,71 @@ def _short_segment_audio(seg):
     return _to_stereo(samples), srate
 
 
+def siti_sidecar_path(avpvs_path: str) -> str:
+    """Per-frame feature sidecar written by the p03 device pass."""
+    return avpvs_path + ".siti.csv"
+
+
+class SiTiAccumulator:
+    """Per-frame SI/TI of the upscaled luma, computed ON DEVICE during the
+    AVPVS render while the frames are already in HBM — the "device-side
+    feature tensors" of the north star (BASELINE.json), so downstream
+    consumers (tools/quality_metrics, complexity work) read a sidecar
+    instead of decoding the AVPVS again. Features are computed on the
+    QUANTIZED luma (container bit depth): exactly what a tool decoding the
+    file would see. TI[0] = 0; TI carries across chunk boundaries."""
+
+    def __init__(self) -> None:
+        # device arrays until write(): the [T]-sized features must not
+        # force a device->host sync inside the pump loop (AsyncWriter's
+        # whole point is that the main loop never blocks on the device)
+        self.si: list = []
+        self.ti: list = []
+        self._prev = None  # device luma f32 of the previous chunk's last frame
+
+    def update(self, y_quant) -> None:
+        from ..ops import siti as siti_ops
+
+        dy = jnp.asarray(y_quant).astype(jnp.float32)
+        si = siti_ops.si_frames(dy)
+        ti = siti_ops.ti_frames(dy)
+        if self._prev is not None:
+            ti = ti.at[0].set(jnp.std(dy[0] - self._prev))
+        self._prev = dy[-1]
+        self.si.append(si)
+        self.ti.append(ti)
+
+    def extend(self, si: np.ndarray, ti: np.ndarray) -> None:
+        """Batch-path entry: features already computed by the sharded step."""
+        self.si.append(si)
+        self.ti.append(ti)
+
+    def write(self, avpvs_path: str) -> Optional[str]:
+        if not self.si:
+            return None
+        path = siti_sidecar_path(avpvs_path)
+        si = np.concatenate([np.asarray(s) for s in self.si])
+        ti = np.concatenate([np.asarray(t) for t in self.ti])
+        # temp + rename: an interrupted write must never leave a truncated
+        # sidecar next to a complete AVPVS
+        tmp = path + ".part"
+        with open(tmp, "w") as f:
+            f.write("frame,si,ti\n")
+            for k, (s, t) in enumerate(zip(si, ti)):
+                f.write(f"{k},{s:.6f},{t:.6f}\n")
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def discard(avpvs_path: str) -> None:
+        """Remove a (possibly stale) sidecar: called before re-rendering
+        and on render failure, so a sidecar can never describe an AVPVS
+        from a different render."""
+        p = siti_sidecar_path(avpvs_path)
+        if os.path.isfile(p):
+            os.unlink(p)
+
+
 def _wo_buffer_out_path(pvs: Pvs) -> str:
     return (
         pvs.get_avpvs_wo_buffer_file_path()
@@ -187,16 +252,21 @@ def create_avpvs_wo_buffer(
     w, h = avpvs_dimensions(pvs)
     pix_fmt = pvs.get_pix_fmt_for_avpvs()
 
-    def _pump(chunks, writer: pf.AsyncWriter) -> None:
-        """Decode-prefetched host chunks → device resize → async encode."""
+    def _pump(chunks, writer: pf.AsyncWriter, feat: SiTiAccumulator) -> None:
+        """Decode-prefetched host chunks → device resize (+ on-device
+        SI/TI features) → async encode."""
         sub = fr.chroma_subsampling(pix_fmt)
         ten_bit = "10" in pix_fmt
         with pf.Prefetcher(chunks, depth=2) as pre:
             for chunk in pre:
                 scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
-                writer.put(fr.quantize_device(scaled, ten_bit))
+                quant = fr.quantize_device(scaled, ten_bit)
+                feat.update(quant[0])
+                writer.put(quant)
 
     def run() -> str:
+        SiTiAccumulator.discard(out_path)  # never leave a stale sidecar
+        feat = SiTiAccumulator()
         if tc.is_short():
             # single segment, native segment frame rate unless -z/-f60
             seg = pvs.segments[0]
@@ -214,7 +284,7 @@ def create_avpvs_wo_buffer(
                 ) as writer:
                     if audio is not None:
                         writer.write_audio(audio)
-                    _pump(chunks, writer)
+                    _pump(chunks, writer, feat)
         else:
             rate = canvas_fps(pvs, avpvs_src_fps)
             total = float(sum(s.get_segment_duration() for s in pvs.segments))
@@ -230,7 +300,8 @@ def create_avpvs_wo_buffer(
             ) as writer:
                 writer.write_audio(samples)
                 for seg in pvs.segments:
-                    _pump(_segment_canvas_chunks(seg, rate), writer)
+                    _pump(_segment_canvas_chunks(seg, rate), writer, feat)
+        feat.write(out_path)
         return out_path
 
     return Job(
@@ -293,6 +364,9 @@ def create_avpvs_wo_buffer_batch(
             for w0 in range(0, len(entries), n_pvs):
                 wave = entries[w0: w0 + n_pvs]
                 out_paths = [_wo_buffer_out_path(p) for p, *_ in wave]
+                for p in out_paths:
+                    SiTiAccumulator.discard(p)  # never leave a stale sidecar
+                feats: list[tuple[SiTiAccumulator, str]] = []
                 try:
                     with ExitStack() as stack:
                         lanes = []
@@ -313,12 +387,15 @@ def create_avpvs_wo_buffer_batch(
                             )
                             if audio is not None:
                                 writer.write_audio(audio)
+                            feat = SiTiAccumulator()
+                            feats.append((feat, out_path))
                             lanes.append(p03_batch.Lane(
                                 chunks=chunks,
                                 emit=writer.put,
                                 n_frames_hint=int(
                                     round(pvs.segments[0].duration * rate)
                                 ),
+                                emit_features=feat.extend,
                             ))
                         p03_batch.run_bucket(
                             lanes, mesh, dh, dw, "bicubic",
@@ -333,7 +410,10 @@ def create_avpvs_wo_buffer_batch(
                     for p in out_paths:
                         if os.path.isfile(p):
                             os.unlink(p)
+                        SiTiAccumulator.discard(p)
                     raise
+                for feat, feat_out in feats:
+                    feat.write(feat_out)
                 # per-PVS provenance, identical to the single-device jobs'
                 for (pvs, w, h, _), out_path in zip(wave, out_paths):
                     Job(

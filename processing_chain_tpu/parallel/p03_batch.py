@@ -43,11 +43,14 @@ class Lane:
     """One PVS stream through the batch: decoded chunks in, scaled frames
     out. `chunks` yields [y, u, v] plane stacks ([T, H, W] each, chroma at
     its subsampled size); `emit` receives the scaled/quantized planes of
-    each block, already trimmed to the valid frame count."""
+    each block, already trimmed to the valid frame count; `emit_features`
+    (optional) receives the device-computed per-frame (si, ti) arrays of
+    the same frames."""
 
     chunks: Iterable[list]
     emit: Callable[[list], None]
     n_frames_hint: int = 0  # for wave grouping only; 0 = unknown
+    emit_features: Optional[Callable[[np.ndarray, np.ndarray], None]] = None
 
 
 def _rechunk(chunks: Iterable[list], t_step: int) -> Iterator[tuple[list, int]]:
@@ -77,14 +80,25 @@ def _sharded_resize_step(
     sub_h: int, sub_w: int, ten_bit: bool,
 ):
     """Jit the _pump math (models/avpvs) over the (pvs, time) mesh:
-    [B, T, H, W] u8/u16 planes -> scaled + quantized planes, sharded
-    P("pvs", "time", None, None). Cached per (mesh, geometry)."""
+    [B, T, H, W] u8/u16 planes -> scaled + quantized planes PLUS per-frame
+    SI/TI features of the quantized luma, sharded P("pvs", "time", ...).
+    TI needs each time shard's first frame to see the previous shard's
+    last frame: a one-frame halo exchanged with lax.ppermute over the
+    "time" axis (ICI neighbor communication); the first time shard takes
+    `prev` instead — the carried last frame of the lane's previous block
+    (replicated over "time"), with `first` marking the lane's very first
+    block (TI[0] = 0). Cached per (mesh, geometry)."""
     import jax
+    import jax.numpy as jnp
+    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     from ..models import frames as fr
+    from ..ops import siti as siti_ops
 
-    def shard_fn(y, u, v):
+    n_time = mesh.shape["time"]
+
+    def shard_fn(y, u, v, prev, first):
         b, t = y.shape[0], y.shape[1]
 
         def flat(p):
@@ -97,13 +111,34 @@ def _sharded_resize_step(
             [flat(y), flat(u), flat(v)], dst_h, dst_w, kernel, (sub_h, sub_w)
         )
         quant = fr.quantize_device(scaled, ten_bit)
-        return tuple(q.reshape((b, t) + q.shape[1:]) for q in quant)
+        qy, qu, qv = (q.reshape((b, t) + q.shape[1:]) for q in quant)
+
+        # device-side features on the quantized luma (what a decoder of
+        # the written AVPVS would see), matching SiTiAccumulator
+        dy = qy.astype(jnp.float32)
+        si = jax.vmap(siti_ops.si_frames)(dy)
+        last = dy[:, -1]
+        perm = [(i, (i + 1) % n_time) for i in range(n_time)]
+        halo = lax.ppermute(last, "time", perm)
+        t_idx = lax.axis_index("time")
+        prev_first = jnp.where(t_idx == 0, prev, halo)
+        prevs = jnp.concatenate([prev_first[:, None], dy[:, :-1]], axis=1)
+        ti = jnp.std(dy - prevs, axis=(2, 3))
+        # the lane's very first frame has no predecessor: TI[0] = 0
+        ti = jnp.where(
+            first & (t_idx == 0),
+            ti.at[:, 0].set(0.0),
+            ti,
+        )
+        return qy, qu, qv, si, ti
 
     spec = P("pvs", "time", None, None)
+    prev_spec = P("pvs", None, None)     # replicated over "time"
+    feat_spec = P("pvs", "time")
     mapped = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, prev_spec, P()),
+        out_specs=(spec, spec, spec, feat_spec, feat_spec),
     )
     return jax.jit(mapped)
 
@@ -162,14 +197,19 @@ def run_bucket(
                 ))
                 for ln in wave
             ]
-            _drive_wave(wave, iters, n_pvs, step, sharding)
+            _drive_wave(wave, iters, n_pvs, step, sharding, mesh, dst_h, dst_w)
 
 
-def _drive_wave(wave, iters, n_pvs, step, sharding) -> None:
+def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
+                dst_h: int, dst_w: int) -> None:
     import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
+    prev_sharding = NamedSharding(mesh, P("pvs", None, None))
     done = [False] * len(wave)
     zero_block: Optional[list] = None
+    prev = np.zeros((n_pvs, dst_h, dst_w), np.float32)
+    first = True
     while not all(done):
         blocks: list[Optional[list]] = []
         valids: list[int] = []
@@ -195,11 +235,20 @@ def _drive_wave(wave, iters, n_pvs, step, sharding) -> None:
             jax.device_put(np.stack([blk[p] for blk in filled]), sharding)
             for p in range(3)
         ]
-        oy, ou, ov = step(*planes)
+        oy, ou, ov, si, ti = step(
+            *planes, jax.device_put(prev, prev_sharding), first
+        )
         host = [np.asarray(o) for o in (oy, ou, ov)]
+        si_h, ti_h = np.asarray(si), np.asarray(ti)
         for i, ln in enumerate(wave):
             if valids[i]:
                 ln.emit([h[i][: valids[i]] for h in host])
+                if ln.emit_features is not None:
+                    ln.emit_features(si_h[i][: valids[i]], ti_h[i][: valids[i]])
+        # inter-block TI carry: the tail-repeat padding means [:, -1] is
+        # the lane's last REAL frame even on a partial block
+        prev = host[0][:, -1].astype(np.float32)
+        first = False
 
 
 def wave_count(n_lanes: int, mesh) -> int:

@@ -102,4 +102,6 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
                 if os.path.isfile(tmp):
                     log.debug("removing intermediate %s", tmp)
                     os.unlink(tmp)
+                # the intermediate's feature sidecar goes with it
+                av.SiTiAccumulator.discard(tmp)
     return test_config

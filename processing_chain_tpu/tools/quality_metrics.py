@@ -107,9 +107,18 @@ def _paired_chunks(
 
 
 def compute_pvs_metrics(
-    pvs: Pvs, force: bool = False, out_dir: Optional[str] = None
+    pvs: Pvs, force: bool = False, out_dir: Optional[str] = None,
+    use_sidecar: bool = True,
 ) -> Optional[str]:
-    """Write `<pvs_id>.metrics.csv`; returns the path (None if skipped)."""
+    """Write `<pvs_id>.metrics.csv`; returns the path (None if skipped).
+
+    When the p03 device pass left a per-frame SI/TI sidecar next to the
+    AVPVS (models/avpvs.SiTiAccumulator — the north star's "consume
+    device-side feature tensors instead of reparsing files"), those
+    columns are reused instead of recomputed; PSNR/SSIM always need the
+    SRC comparison and are computed regardless. A buffered PVS's final
+    AVPVS has no sidecar (the sidecar describes the pre-stall render), so
+    it computes everything — path-keyed lookup handles that naturally."""
     import jax.numpy as jnp
     import pandas as pd
 
@@ -128,6 +137,40 @@ def compute_pvs_metrics(
             "force overwriting", out_path,
         )
         return None
+
+    from ..models.avpvs import siti_sidecar_path
+
+    sidecar = None
+    if use_sidecar:
+        sc_path = siti_sidecar_path(avpvs_path)
+        if os.path.isfile(sc_path):
+            try:
+                sidecar = np.atleast_1d(
+                    np.genfromtxt(sc_path, delimiter=",", names=True)
+                )
+            except ValueError:
+                get_logger().warning(
+                    "%s: unreadable SI/TI sidecar; recomputing features "
+                    "inline", pvs.pvs_id,
+                )
+            else:
+                # validate BEFORE the expensive pass: sidecar rows must
+                # cover the AVPVS's frames (cheap packet scan — FFV1 is
+                # intra-only, one packet per frame). The paired metrics
+                # table may be SHORTER (SRC ends first); sidecar[:n]
+                # aligns exactly in that case.
+                n_deg = len(medialib.scan_packets(avpvs_path, "video")["size"])
+                if len(sidecar) != n_deg:
+                    get_logger().warning(
+                        "%s: SI/TI sidecar has %d rows for %d AVPVS "
+                        "frames; recomputing features inline",
+                        pvs.pvs_id, len(sidecar), n_deg,
+                    )
+                    sidecar = None
+                else:
+                    get_logger().debug(
+                        "reusing device features from %s", sc_path
+                    )
 
     rows = {k: [] for k in ("psnr_y", "psnr_u", "psnr_v", "ssim_y", "si", "ti")}
     prev_last = None  # last deg luma of the previous chunk (TI continuity)
@@ -166,14 +209,23 @@ def compute_pvs_metrics(
                 rows["psnr_u"].append(np.asarray(metrics_ops.psnr_frames(ru, du)))
                 rows["psnr_v"].append(np.asarray(metrics_ops.psnr_frames(rv, dv)))
                 rows["ssim_y"].append(np.asarray(metrics_ops.ssim_frames(ry, dy)))
-                rows["si"].append(np.asarray(siti_ops.si_frames(dy)))
-                ti = np.asarray(siti_ops.ti_frames(dy))
-                if prev_last is not None:
-                    # TI continuity across chunk boundaries
-                    ti = ti.copy()
-                    ti[0] = float(jnp.std(dy[0] - prev_last))
-                rows["ti"].append(ti)
-                prev_last = dy[-1]
+                if sidecar is None:
+                    rows["si"].append(np.asarray(siti_ops.si_frames(dy)))
+                    ti = np.asarray(siti_ops.ti_frames(dy))
+                    if prev_last is not None:
+                        # TI continuity across chunk boundaries
+                        ti = ti.copy()
+                        ti[0] = float(jnp.std(dy[0] - prev_last))
+                    rows["ti"].append(ti)
+                    prev_last = dy[-1]
+
+    if sidecar is not None:
+        n_paired = sum(len(r) for r in rows["psnr_y"])
+        # SI/TI are stds of linear functions of the luma: the sidecar's
+        # container-depth values scale exactly by deg_scale onto the
+        # 8-bit scale the metrics table uses
+        rows["si"] = [sidecar["si"][:n_paired] * deg_scale]
+        rows["ti"] = [sidecar["ti"][:n_paired] * deg_scale]
 
     table = {k: np.concatenate(v) if v else np.empty(0) for k, v in rows.items()}
     n = len(table["psnr_y"])

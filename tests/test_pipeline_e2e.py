@@ -323,16 +323,27 @@ def test_p03_batch_byte_identical_to_single_device(batch_db):
         pid: os.path.join(db, "avpvs", f"{pid}.avi") for pid in tc.pvses
     }
     ref = {}
+    ref_sidecars = {}
     for pid, p in paths.items():
         assert os.path.isfile(p), p
         ref[pid] = open(p, "rb").read()
         os.unlink(p)
+        ref_sidecars[pid] = np.genfromtxt(
+            p + ".siti.csv", delimiter=",", names=True
+        )
+        os.unlink(p + ".siti.csv")
 
     rc = cli_main(["p03", "-c", batch_db, "--skip-requirements"])
     assert rc == 0
     for pid, p in paths.items():
         got = open(p, "rb").read()
         assert got == ref[pid], f"{pid}: batch path diverged from single"
+        # the device-feature sidecars must agree too: the batch path's
+        # halo'd + carried TI equals the single path's sequential TI
+        got_sc = np.genfromtxt(p + ".siti.csv", delimiter=",", names=True)
+        ref_sc = ref_sidecars[pid]
+        np.testing.assert_allclose(got_sc["si"], ref_sc["si"], rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(got_sc["ti"], ref_sc["ti"], rtol=1e-4, atol=1e-3)
 
     # the batch job must leave the same per-PVS provenance logs as the
     # per-PVS jobs (asserted here, in the test that ran p03)
@@ -457,3 +468,56 @@ def test_p01_x265_two_pass(tmp_path):
     # ...and nowhere else: without stats= inside x265-params, x265 used to
     # drop x265_2pass.log into the process cwd
     assert not [f for f in os.listdir(".") if f.startswith("x265_2pass")]
+
+
+def test_p03_writes_siti_sidecar(short_db):
+    """The p03 device pass leaves a per-frame SI/TI sidecar next to the
+    AVPVS it rendered (the north star's device-side feature tensors),
+    matching a fresh on-device computation from the decoded file."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import siti as siti_ops
+
+    db = os.path.dirname(short_db)
+    av = os.path.join(db, "avpvs", "P2SXM90_SRC000_HRC000.avi")
+    sc = av + ".siti.csv"
+    assert os.path.isfile(sc)
+    rows = np.genfromtxt(sc, delimiter=",", names=True)
+    with VideoReader(av) as r:
+        planes, _ = r.read_all()
+    assert len(rows) == planes[0].shape[0]
+    dy = jnp.asarray(planes[0]).astype(jnp.float32)
+    si = np.asarray(siti_ops.si_frames(dy))
+    ti = np.asarray(siti_ops.ti_frames(dy))
+    np.testing.assert_allclose(rows["si"], si, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(rows["ti"], ti, rtol=1e-4, atol=1e-3)
+
+
+def test_quality_metrics_consumes_sidecar(short_db):
+    """quality_metrics reuses the p03 sidecar instead of recomputing —
+    proven by planting sentinel values and finding them in the output."""
+    import pandas as pd
+
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.tools import quality_metrics as qm
+
+    db = os.path.dirname(short_db)
+    av = os.path.join(db, "avpvs", "P2SXM90_SRC000_HRC000.avi")
+    sc = av + ".siti.csv"
+    original = open(sc).read()
+    n = len(original.strip().splitlines()) - 1
+    try:
+        with open(sc, "w") as f:
+            f.write("frame,si,ti\n")
+            for k in range(n):
+                f.write(f"{k},123.456000,77.700000\n")
+        tc = TestConfig(short_db, filter_pvses="P2SXM90_SRC000_HRC000")
+        pvs = tc.pvses["P2SXM90_SRC000_HRC000"]
+        out = qm.compute_pvs_metrics(pvs, force=True)
+        df = pd.read_csv(out)
+        assert np.allclose(df["si"], 123.456) and np.allclose(df["ti"], 77.7)
+        # and PSNR was still really computed (not sentinel, not empty)
+        assert df["psnr_y"].notna().all() and len(df) == n
+    finally:
+        with open(sc, "w") as f:
+            f.write(original)
