@@ -180,12 +180,14 @@ class SiTiAccumulator:
     def update(self, y_quant) -> None:
         from ..ops import siti as siti_ops
 
-        dy = jnp.asarray(y_quant).astype(jnp.float32)
-        si = siti_ops.si_frames(dy)
-        ti = siti_ops.ti_frames(dy)
+        yq = jnp.asarray(y_quant)
+        # container-depth input: the TPU path streams u8/u16 through the
+        # fused Pallas kernels without materializing an f32 batch
+        si = siti_ops.si_frames(yq)
+        ti = siti_ops.ti_frames(yq)
         if self._prev is not None:
-            ti = ti.at[0].set(jnp.std(dy[0] - self._prev))
-        self._prev = dy[-1]
+            ti = ti.at[0].set(jnp.std(yq[0].astype(jnp.float32) - self._prev))
+        self._prev = yq[-1].astype(jnp.float32)
         self.si.append(si)
         self.ti.append(ti)
 

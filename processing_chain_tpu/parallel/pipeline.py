@@ -28,10 +28,6 @@ from ..ops import resize as resize_ops
 from ..ops import siti as siti_ops
 
 
-def _si_frames(y: jnp.ndarray) -> jnp.ndarray:
-    return jax.vmap(siti_ops.si_frame)(y)
-
-
 def avpvs_siti_step(
     y: jnp.ndarray,
     u: jnp.ndarray,
@@ -51,13 +47,13 @@ def avpvs_siti_step(
     up_u = resize_ops.resize_plane(u, dst_h // 2, dst_w // 2, kernel)
     up_v = resize_ops.resize_plane(v, dst_h // 2, dst_w // 2, kernel)
 
-    yf = up_y.astype(jnp.float32)
-    si = _si_frames(yf)
     if prev_last is None:
-        prev = jnp.concatenate([yf[:1], yf[:-1]], axis=0)
-        ti = jax.vmap(jnp.std)(yf - prev)
-        ti = ti.at[0].set(0.0)
+        # quantized-depth input feeds the fused feature kernels directly
+        # on TPU (no f32 materialization of the 4K batch)
+        si, ti = siti_ops.siti(up_y)
     else:
+        yf = up_y.astype(jnp.float32)
+        si = siti_ops.si_frames(yf)
         prev = jnp.concatenate([prev_last[None], yf[:-1]], axis=0)
         ti = jax.vmap(jnp.std)(yf - prev)
     return up_y, up_u, up_v, si, ti
@@ -74,11 +70,25 @@ def make_sharded_step(mesh: Mesh, dst_h: int, dst_w: int, kernel: str = "lanczos
     n_time = mesh.shape["time"]
 
     def shard_fn(y, u, v):
-        # y: [B_loc, T_loc, H, W] local block
-        def per_pvs(y1, u1, v1):
-            return avpvs_siti_step(y1, u1, v1, dst_h, dst_w, kernel=kernel)
+        # y: [B_loc, T_loc, H, W] local block; flatten the (pvs, time)
+        # leading dims so resize/SI run un-vmapped (the fused Pallas
+        # kernels have no batching rule)
+        b, t = y.shape[0], y.shape[1]
 
-        up_y, up_u, up_v, si, _ = jax.vmap(per_pvs)(y, u, v)
+        def flat(p):
+            return p.reshape((-1,) + p.shape[2:])
+
+        def unflat(p):
+            return p.reshape((b, t) + p.shape[1:])
+
+        up_y = unflat(resize_ops.resize_plane(flat(y), dst_h, dst_w, kernel))
+        up_u = unflat(
+            resize_ops.resize_plane(flat(u), dst_h // 2, dst_w // 2, kernel)
+        )
+        up_v = unflat(
+            resize_ops.resize_plane(flat(v), dst_h // 2, dst_w // 2, kernel)
+        )
+        si = siti_ops.si_frames(flat(up_y)).reshape(b, t)
 
         # halo: previous time-shard's last upscaled luma frame
         yf = up_y.astype(jnp.float32)

@@ -618,3 +618,24 @@ def test_bufferer_calibrate_roundtrip(tmp_path):
     assert ev["background_black"]
     assert abs(ev["spinner_rps"] - 1.0) < 0.1
     assert report["spinner_direction"] == "clockwise"
+
+
+def test_pallas_siti_matches_xla():
+    """The fused Pallas SI/TI kernels (interpret mode on CPU) agree with
+    the XLA implementations within the documented tolerance, for u8 and
+    f32 inputs and non-multiple-of-128 widths."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import pallas_kernels as pk
+    from processing_chain_tpu.ops import siti
+
+    rng = np.random.default_rng(11)
+    y = rng.integers(0, 255, (4, 72, 200), np.uint8)
+    yf = jnp.asarray(y).astype(jnp.float32)
+    si_ref = np.asarray(siti.si_frames(yf))
+    ti_ref = np.asarray(siti.ti_frames(yf))
+    for inp in (jnp.asarray(y), yf):
+        si = np.asarray(pk.si_frames_fused(inp, interpret=True))
+        ti = np.asarray(pk.ti_frames_fused(inp, interpret=True))
+        np.testing.assert_allclose(si, si_ref, rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(ti, ti_ref, rtol=1e-4, atol=1e-3)

@@ -518,6 +518,9 @@ def test_quality_metrics_consumes_sidecar(short_db):
         assert np.allclose(df["si"], 123.456) and np.allclose(df["ti"], 77.7)
         # and PSNR was still really computed (not sentinel, not empty)
         assert df["psnr_y"].notna().all() and len(df) == n
+        # the metrics CSV carries sentinel features: don't leak it into
+        # the module-scoped fixture
+        os.unlink(out)
     finally:
         with open(sc, "w") as f:
             f.write(original)
