@@ -118,16 +118,25 @@ def _child() -> None:
         float(bench(y, u, v, iters))
         per_step = (time.perf_counter() - t0) / iters
     else:
+        # best-of-3: repeated measurements on this chip are bimodal
+        # (~2x spread from tunnel/tenant interference and power-state
+        # ramp); the minimum is the chip's actual steady-state throughput.
+        # Minimize t_one and t_many INDEPENDENTLY before subtracting: a
+        # min over paired differences would cherry-pick a (fast t_many,
+        # slow t_one) pairing and overstate throughput — both minima
+        # represent the interference-free mode of the same fixed
+        # dispatch-overhead + k-steps quantity, so their difference is
+        # the unbiased marginal cost of iters-1 steps.
         float(bench(y, u, v, 1))
-        t0 = time.perf_counter()
-        float(bench(y, u, v, 1))
-        t_one = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(bench(y, u, v, iters))
-        t_many = time.perf_counter() - t0
-        # subtract the fixed tunnel/dispatch overhead (one-iter run ≈
-        # overhead + one step): per-step time from the marginal cost of
-        # iters-1 extra steps
+        t_one = float("inf")
+        t_many = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(bench(y, u, v, 1))
+            t_one = min(t_one, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            float(bench(y, u, v, iters))
+            t_many = min(t_many, time.perf_counter() - t0)
         per_step = (
             max((t_many - t_one) / (iters - 1), 1e-9) if iters > 1 else t_many
         )

@@ -10,7 +10,12 @@ the previous shard's last frame: a one-frame halo exchanged with
 communication, riding ICI.
 
 `avpvs_siti_step` is the single-chip flagship step (also the bench body);
-`make_sharded_step` wraps it in shard_map over a (pvs, time) mesh.
+`make_sharded_step` runs the same resize+features math in shard_map over a
+(pvs, time) mesh — inlined rather than calling avpvs_siti_step, because
+the sharded body flattens its (pvs, time) leading dims (the fused Pallas
+kernels have no vmap batching rule) and owns the TI halo. A change to the
+per-frame math must be applied to both (and to
+parallel/p03_batch._sharded_resize_step, the p03 product variant).
 """
 
 from __future__ import annotations
@@ -52,8 +57,8 @@ def avpvs_siti_step(
         # on TPU (no f32 materialization of the 4K batch)
         si, ti = siti_ops.siti(up_y)
     else:
+        si = siti_ops.si_frames(up_y)  # container depth: see above
         yf = up_y.astype(jnp.float32)
-        si = siti_ops.si_frames(yf)
         prev = jnp.concatenate([prev_last[None], yf[:-1]], axis=0)
         ti = jax.vmap(jnp.std)(yf - prev)
     return up_y, up_u, up_v, si, ti
