@@ -127,7 +127,8 @@ def _fused_resize_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("dst_h", "dst_w", "kernel", "interpret")
+    jax.jit,
+    static_argnames=("dst_h", "dst_w", "kernel", "interpret", "block_w"),
 )
 def resize_frames_fused(
     frames: jnp.ndarray,
@@ -135,19 +136,29 @@ def resize_frames_fused(
     dst_w: int,
     kernel: str = "lanczos",
     interpret: bool = False,
+    block_w: int = BLOCK,
 ) -> jnp.ndarray:
     """Fused two-pass resize of [T, src_h, src_w] u8 planes on TPU.
 
     Output u8 [T, dst_h, dst_w] with swscale round-half-up quantization —
     the Pallas counterpart of `resize.resize_frames(..., method="banded")`.
     `interpret=True` runs the kernel in the Pallas interpreter (CPU tests).
+
+    `block_w` is the horizontal output-stripe width. Wider stripes
+    amortize the fixed ~127-col alignment padding of the source band but
+    measured SLOWER on v5e at 1080p->4K (3.43/3.55/3.64 ms for
+    128/256/384; 512 exceeds the 16 MB VMEM budget with pipeline
+    double-buffering) — the kernel is pipeline-bound, not MXU-bound, so
+    the default stays 128.
     """
     pl, pltpu = _pallas()
     t, src_h, src_w = frames.shape
     if (src_h, src_w) == (dst_h, dst_w):
         return frames
+    # stripes wider than the output would make an empty grid
+    block_w = min(block_w, -(-dst_w // 128) * 128)
     starts_v, wv, band_v = make_banded_plan(src_h, dst_h, kernel, BLOCK)
-    starts_h, wh, band_h = make_banded_plan(src_w, dst_w, kernel, BLOCK)
+    starts_h, wh, band_h = make_banded_plan(src_w, dst_w, kernel, block_w)
     # Mosaic dynamic-slice alignment: 128 on the lane axis (horizontal
     # bands slice the frame's width), 8 on the sublane axis (vertical
     # bands slice the f32 scratch's height). Shift each start down to
@@ -170,12 +181,12 @@ def resize_frames_fused(
         in_specs=[
             pl.BlockSpec((1, src_h, src_w_pad), lambda ti, cb, *_: (ti, 0, 0)),
             pl.BlockSpec((nrb, BLOCK, band_v), lambda ti, cb, *_: (0, 0, 0)),
-            pl.BlockSpec((1, BLOCK, band_h), lambda ti, cb, *_: (cb, 0, 0)),
+            pl.BlockSpec((1, block_w, band_h), lambda ti, cb, *_: (cb, 0, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, pad_h, BLOCK), lambda ti, cb, *_: (ti, 0, cb)
+            (1, pad_h, block_w), lambda ti, cb, *_: (ti, 0, cb)
         ),
-        scratch_shapes=[pltpu.VMEM((src_h_pad, BLOCK), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((src_h_pad, block_w), jnp.float32)],
     )
     kernel_fn = functools.partial(
         _fused_resize_kernel,
@@ -189,7 +200,9 @@ def resize_frames_fused(
     out = pl.pallas_call(
         kernel_fn,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((t, pad_h, ncb * BLOCK), frames.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (t, pad_h, ncb * block_w), frames.dtype
+        ),
         interpret=interpret,
     )(jnp.asarray(starts_v) // 8, jnp.asarray(starts_h) // 128, frames,
       jnp.asarray(wv), jnp.asarray(wh))
