@@ -16,13 +16,15 @@ Layout per grid step (t, cb) — horizontal pass first, matching swscale's
 stage order so the 15-bit intermediate top-clamp sits between H and V like
 the golden integer path (ops/resize._swscale_exact):
   in    u8 [src_h, src_w]       whole frame, VMEM-resident across cb steps
-  wv    f32 [nrb, 128, band_v]  vertical weights, resident
-  wh    f32 [1, 128, band_h]    horizontal weights for col block cb (streamed)
-  out   u8/f32 [1, dst_h, 128]  one output column stripe
-  mid   f32 [src_h, 128]        scratch: horizontal pass result (clamped)
+  wv    f32 [nrb, 128, band_v]      vertical weights, resident
+  wh    f32 [1, block_w, band_h]    horizontal weights for col stripe cb
+  out   u8/f32 [1, dst_h, block_w]  one output column stripe
+  mid   f32 [src_h, block_w]        scratch: horizontal pass result
 
-VMEM @ 1080p→4K ≈ 2 MB (in) + 0.7 MB (wv) + 0.6 MB (mid) + 0.5 MB (out):
-well under the ~16 MB/core budget; a 4K source (8.3 MB u8) still fits.
+block_w defaults to 128; wh/out/mid (and their pipeline double-buffers)
+scale linearly with it. VMEM @ 1080p→4K, block_w=128 ≈ 2 MB (in) +
+0.7 MB (wv) + 0.6 MB (mid) + 0.5 MB (out): well under the 16 MB/core
+budget; block_w=512 measures over it once double-buffering is counted.
 """
 
 from __future__ import annotations
@@ -70,9 +72,9 @@ def _fused_resize_kernel(
     starts_h_ref,   # SMEM [ncb]    (scalar prefetch; 128-aligned)
     in_ref,         # VMEM [1, src_h, src_w_pad] u8
     wv_ref,         # VMEM [nrb, BLOCK, band_v_pad]
-    wh_ref,         # VMEM [1, BLOCK, band_h_pad]
-    out_ref,        # VMEM [1, nrb * BLOCK, BLOCK]
-    mid_ref,        # VMEM scratch [src_h_pad, BLOCK] f32
+    wh_ref,         # VMEM [1, block_w, band_h_pad]
+    out_ref,        # VMEM [1, nrb * BLOCK, block_w]
+    mid_ref,        # VMEM scratch [src_h_pad, block_w] f32
     *,
     band_v: int,
     band_h: int,
@@ -155,7 +157,11 @@ def resize_frames_fused(
     t, src_h, src_w = frames.shape
     if (src_h, src_w) == (dst_h, dst_w):
         return frames
-    # stripes wider than the output would make an empty grid
+    if block_w <= 0 or block_w % 128:
+        raise ValueError(f"block_w must be a positive multiple of 128, got {block_w}")
+    # clamp to the (128-rounded) output width: an over-wide stripe would
+    # still make a 1-block grid, but its padded out/weight buffers would
+    # waste VMEM proportionally
     block_w = min(block_w, -(-dst_w // 128) * 128)
     starts_v, wv, band_v = make_banded_plan(src_h, dst_h, kernel, BLOCK)
     starts_h, wh, band_h = make_banded_plan(src_w, dst_w, kernel, block_w)
