@@ -155,10 +155,10 @@ def resize_frames_fused(
     """
     pl, pltpu = _pallas()
     t, src_h, src_w = frames.shape
-    if (src_h, src_w) == (dst_h, dst_w):
-        return frames
     if block_w <= 0 or block_w % 128:
         raise ValueError(f"block_w must be a positive multiple of 128, got {block_w}")
+    if (src_h, src_w) == (dst_h, dst_w):
+        return frames
     # clamp to the (128-rounded) output width: an over-wide stripe would
     # still make a 1-block grid, but its padded out/weight buffers would
     # waste VMEM proportionally

@@ -529,3 +529,37 @@ def test_complexity_csv_feeds_config(tmp_path):
     )
     assert tc.complex_bitrates
     assert tc.complexity_dict["SRC000.avi"] in (0, 1, 2, 3)
+
+
+def test_metric_frames_mesh_matches_direct():
+    """With >1 device visible (this env: 8 CPU devices), _metric_frames
+    shards the Y plane through the (pvs x time) mesh; results must equal
+    the direct vmapped kernels exactly (frame-local math), including the
+    pad-to-mesh-grain tail."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import metrics as metrics_ops
+    from processing_chain_tpu.tools.quality_metrics import _metric_frames
+
+    rng = np.random.default_rng(5)
+    t = 13  # not a multiple of the 8-device grain: exercises padding
+    ry, dy = (jnp.asarray(rng.integers(0, 255, (t, 48, 64)).astype(np.float32))
+              for _ in range(2))
+    ru, du, rv, dv = (
+        jnp.asarray(rng.integers(0, 255, (t, 24, 32)).astype(np.float32))
+        for _ in range(4)
+    )
+    got = _metric_frames(ry, dy, ru, du, rv, dv)
+    assert len(got["psnr_y"]) == t
+    np.testing.assert_array_equal(
+        got["psnr_y"], np.asarray(metrics_ops.psnr_frames(ry, dy))
+    )
+    np.testing.assert_array_equal(
+        got["ssim_y"], np.asarray(metrics_ops.ssim_frames(ry, dy))
+    )
+    np.testing.assert_array_equal(
+        got["psnr_u"], np.asarray(metrics_ops.psnr_frames(ru, du))
+    )
+    np.testing.assert_array_equal(
+        got["psnr_v"], np.asarray(metrics_ops.psnr_frames(rv, dv))
+    )
