@@ -184,10 +184,7 @@ class SiTiAccumulator:
         # container-depth input: the TPU path streams u8/u16 through the
         # fused Pallas kernels without materializing an f32 batch
         si = siti_ops.si_frames(yq)
-        ti = siti_ops.ti_frames(yq)
-        if self._prev is not None:
-            ti = ti.at[0].set(jnp.std(yq[0].astype(jnp.float32) - self._prev))
-        self._prev = yq[-1].astype(jnp.float32)
+        ti, self._prev = siti_ops.ti_frames_continued(yq, self._prev)
         self.si.append(si)
         self.ti.append(ti)
 

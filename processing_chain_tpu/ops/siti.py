@@ -88,6 +88,18 @@ def ti_frames(y: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros((1,), ti.dtype), ti])
 
 
+def ti_frames_continued(y: jnp.ndarray, prev_last):
+    """(TI[T], new prev_last) for one chunk of a streamed clip: TI[0]
+    diffs against the previous chunk's last luma frame (f32) when given,
+    else stays 0 (clip start). The single boundary-continuity idiom shared
+    by every streaming SI/TI consumer (p03 sidecars, SRC analysis,
+    quality metrics)."""
+    ti = ti_frames(y)
+    if prev_last is not None:
+        ti = ti.at[0].set(jnp.std(y[0].astype(jnp.float32) - prev_last))
+    return ti, y[-1].astype(jnp.float32)
+
+
 @jax.jit
 def siti(y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(SI[T], TI[T]) for a [T, H, W] luma tensor — the batched feature

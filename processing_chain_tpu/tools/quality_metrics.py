@@ -264,13 +264,8 @@ def compute_pvs_metrics(
                     rows[k].append(vals)
                 if sidecar is None:
                     rows["si"].append(np.asarray(siti_ops.si_frames(dy)))
-                    ti = np.asarray(siti_ops.ti_frames(dy))
-                    if prev_last is not None:
-                        # TI continuity across chunk boundaries
-                        ti = ti.copy()
-                        ti[0] = float(jnp.std(dy[0] - prev_last))
-                    rows["ti"].append(ti)
-                    prev_last = dy[-1]
+                    ti, prev_last = siti_ops.ti_frames_continued(dy, prev_last)
+                    rows["ti"].append(np.asarray(ti))
 
     if sidecar is not None:
         n_paired = sum(len(r) for r in rows["psnr_y"])

@@ -82,11 +82,52 @@ def check_or_write_md5(video_path: str) -> Md5Result:
     return Md5Result(video_path, current, "written")
 
 
-def analyse_src(video_path: str) -> str:
+def src_siti_summary(video_path: str, chunk: int = 64) -> dict:
+    """Device-computed SI/TI summary of a SRC (mean/max/p95 over frames):
+    the "SRC_analysis consumes device-side feature tensors" leg of the
+    north star (BASELINE.json). Streams the decode in CHUNK batches
+    through the same ops.siti kernels as the p03 sidecars (fused Pallas on
+    TPU); O(chunk) memory for any SRC length. Values are on the 8-BIT
+    scale regardless of container depth (10-bit luma is normalized like
+    tools/quality_metrics does), so summaries compare across SRC depths."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..engine import prefetch as pf
+    from ..io.video import VideoReader
+    from ..ops import siti as siti_ops
+
+    si_parts, ti_parts = [], []
+    prev = None
+    with VideoReader(video_path) as reader:
+        # SI/TI are stds of linear functions of the luma: computing at
+        # container depth and scaling the RESULTS by 0.25 equals scaling
+        # the 10-bit planes first
+        depth_scale = 0.25 if reader.dtype == np.uint16 else 1.0
+        for planes in pf.iter_plane_chunks(reader, chunk):
+            y = jnp.asarray(planes[0])
+            si_parts.append(siti_ops.si_frames(y))
+            ti, prev = siti_ops.ti_frames_continued(y, prev)
+            ti_parts.append(ti)
+    si = np.concatenate([np.asarray(s) for s in si_parts]) * depth_scale
+    ti = np.concatenate([np.asarray(t) for t in ti_parts]) * depth_scale
+    return {
+        "si_mean": round(float(si.mean()), 4),
+        "si_max": round(float(si.max()), 4),
+        "si_p95": round(float(np.percentile(si, 95)), 4),
+        "ti_mean": round(float(ti.mean()), 4),
+        "ti_max": round(float(ti.max()), 4),
+        "ti_p95": round(float(np.percentile(ti, 95)), 4),
+    }
+
+
+def analyse_src(video_path: str, with_siti: bool = False) -> str:
     """Write the `<src>.yaml` info sidecar and return its path (reference
     analyse_src, util/SRC_analysis.py:119-147). The sidecar schema
     {md5sum, get_stream_size: {v, a}, get_src_info} is the contract with
-    io/probe.LibavProber.src_info's cache reader."""
+    io/probe.LibavProber.src_info's cache reader; `with_siti` adds a
+    `siti` block of device-computed P.910 features (an extension — the
+    reference has no SRC feature pass)."""
     sidecar = video_path + ".yaml"
     # LibavProber writes the full sidecar (info + stream sizes) itself; we
     # then stamp the md5 from the .md5 sidecar if one exists.
@@ -103,6 +144,8 @@ def analyse_src(video_path: str) -> str:
     with open(sidecar) as f:
         data = yaml.safe_load(f)
     data["md5sum"] = md5
+    if with_siti:
+        data["siti"] = src_siti_summary(video_path)
     with open(sidecar, "w") as f:
         yaml.safe_dump(data, f, default_flow_style=False)
     return sidecar
@@ -130,12 +173,29 @@ def run(
     skip_src: bool = False,
     force: bool = False,
     summary_path: Optional[str] = "./outsummary_md5.txt",
+    with_siti: bool = False,
 ) -> dict:
     """Analyse all SRCs; returns {"md5": [Md5Result…], "sidecars": [path…]}."""
     log = get_logger()
     files = collect_video_files(inputs)
     if not force:
-        files = [f for f in files if not os.path.isfile(f + ".yaml")]
+        def _needs_work(f: str) -> bool:
+            sidecar = f + ".yaml"
+            if not os.path.isfile(sidecar):
+                return True
+            if not with_siti:
+                return False
+            # --siti over previously analysed SRCs must add the feature
+            # block, not silently no-op behind the existing-sidecar skip
+            import yaml
+
+            try:
+                data = yaml.safe_load(open(sidecar)) or {}
+            except Exception:
+                return True
+            return "siti" not in data
+
+        files = [f for f in files if _needs_work(f)]
     log.info("%d files will be processed", len(files))
 
     out: dict = {"md5": [], "sidecars": []}
@@ -154,7 +214,7 @@ def run(
     if not skip_src and files:
         runner = ParallelRunner(max_parallel=concurrency, name="src-info")
         for f in files:
-            runner.add(analyse_src, f, label=f)
+            runner.add(analyse_src, f, with_siti, label=f)
         results = runner.run()
         out["sidecars"] = [results[f] for f in files]
         for path in out["sidecars"]:
@@ -175,6 +235,9 @@ def build_parser(parser: Optional[argparse.ArgumentParser] = None) -> argparse.A
                    help="do not probe or write .yaml info sidecars")
     p.add_argument("-f", "--force-overwrite", action="store_true",
                    help="force overwrite of existing .yaml sidecars")
+    p.add_argument("--siti", action="store_true",
+                   help="add a device-computed SI/TI summary (P.910 "
+                        "mean/max/p95) to each .yaml sidecar")
     return p
 
 
@@ -186,6 +249,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         skip_md5=args.skip_md5,
         skip_src=args.skip_src,
         force=args.force_overwrite,
+        with_siti=args.siti,
     )
     return 0
 

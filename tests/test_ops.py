@@ -639,3 +639,19 @@ def test_pallas_siti_matches_xla():
         ti = np.asarray(pk.ti_frames_fused(inp, interpret=True))
         np.testing.assert_allclose(si, si_ref, rtol=1e-4, atol=1e-3)
         np.testing.assert_allclose(ti, ti_ref, rtol=1e-4, atol=1e-3)
+
+
+def test_resize_fused_10bit_matches_banded():
+    """The fused kernel's u16 path (10-bit AVPVS planes, maxval 1023)
+    agrees with the banded formulation bit-for-bit in interpret mode."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import pallas_kernels as pk
+    from processing_chain_tpu.ops import resize
+
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.integers(0, 1023, (2, 90, 160), np.uint16))
+    fused = np.asarray(pk.resize_frames_fused(x, 180, 320, "bicubic", interpret=True))
+    banded = np.asarray(resize.resize_frames(x, 180, 320, "bicubic", method="banded"))
+    assert fused.dtype == np.uint16
+    np.testing.assert_array_equal(fused, banded)

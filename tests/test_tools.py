@@ -563,3 +563,39 @@ def test_metric_frames_mesh_matches_direct():
     np.testing.assert_array_equal(
         got["psnr_v"], np.asarray(metrics_ops.psnr_frames(rv, dv))
     )
+
+
+def test_src_analysis_siti_summary(tmp_path):
+    """--siti adds a device-computed P.910 feature block to the .yaml
+    sidecar; values match the siti kernels on the decoded SRC."""
+    import jax.numpy as jnp
+    import yaml
+
+    from processing_chain_tpu.io.video import VideoReader
+    from processing_chain_tpu.ops import siti as siti_ops
+    from processing_chain_tpu.tools import src_analysis
+
+    path = str(tmp_path / "SRC0.avi")
+    write_test_video(path, codec="ffv1", n=12)
+    # first pass without features; --siti on an already-analysed corpus
+    # must still add the block (not no-op behind skip-existing)
+    src_analysis.run([path], summary_path=None)
+    assert "siti" not in (yaml.safe_load(open(path + ".yaml")) or {})
+    out = src_analysis.run([path], with_siti=True, summary_path=None)
+    assert len(out["sidecars"]) == 1
+    data = yaml.safe_load(open(out["sidecars"][0]))
+    assert set(data["siti"]) == {
+        "si_mean", "si_max", "si_p95", "ti_mean", "ti_max", "ti_p95"
+    }
+    with VideoReader(path) as r:
+        planes, _ = r.read_all()
+    y = jnp.asarray(np.stack([p for p in planes[0]]))
+    si = np.asarray(siti_ops.si_frames(y))
+    ti = np.asarray(siti_ops.ti_frames(y))
+    assert abs(data["siti"]["si_mean"] - float(si.mean())) < 1e-3
+    assert abs(data["siti"]["ti_mean"] - float(ti.mean())) < 1e-3
+    # chunked summary must equal the whole-clip computation across chunk
+    # boundaries (the TI-continuity carry)
+    small = src_analysis.src_siti_summary(path, chunk=4)
+    assert abs(small["ti_mean"] - float(ti.mean())) < 1e-3
+    assert abs(small["si_mean"] - float(si.mean())) < 1e-3
