@@ -186,6 +186,11 @@ def ensure_loaded() -> ct.CDLL:
         lib.mp_remux.argtypes = [
             ct.c_char_p, ct.c_char_p, ct.c_char_p, ct.c_char_p, ct.c_int,
         ]
+        lib.mp_concat.restype = ct.c_int
+        lib.mp_concat.argtypes = [
+            ct.POINTER(ct.c_char_p), ct.c_int, ct.c_char_p, ct.c_char_p,
+            ct.c_int,
+        ]
         lib.mp_version.restype = ct.c_char_p
         _lib = lib
         return lib
@@ -356,6 +361,19 @@ def remux(video_path: str, out_path: str, audio_path: str = "") -> None:
     )
     if ret < 0:
         raise MediaError(f"remux {video_path} -> {out_path}: {err.value.decode()}")
+
+
+def concat_video(paths: list, out_path: str) -> None:
+    """Sequential stream-copy concat of the video streams of `paths` with
+    timestamp offsetting — the reference's concat-demuxer pass
+    (`ffmpeg -f concat -c copy`, lib/ffmpeg.py:1094-1100) as one native
+    call. Inputs must share codec parameters (the per-segment AVPVS tmp
+    renders do). Audio is merged afterwards with remux()."""
+    lib = ensure_loaded()
+    err = _err_buf()
+    arr = (ct.c_char_p * len(paths))(*[p.encode() for p in paths])
+    if lib.mp_concat(arr, len(paths), out_path.encode(), err, 512) < 0:
+        raise MediaError(f"concat -> {out_path}: {err.value.decode()}")
 
 
 def extract_annexb(path: str, bsf_name: str, out_path: str) -> None:

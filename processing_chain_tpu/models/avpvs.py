@@ -312,18 +312,59 @@ def create_avpvs_wo_buffer(
     )
 
 
+class _BoundarySink:
+    """Forwards scaled blocks to the writer while keeping the lane's first
+    and last luma frames (for TI stitching at long-test segment joins)."""
+
+    def __init__(self, writer) -> None:
+        self._writer = writer
+        self.first = None
+        self.last = None
+
+    def emit(self, planes) -> None:
+        if self.first is None:
+            self.first = np.asarray(planes[0][0]).copy()
+        self.last = np.asarray(planes[0][-1]).copy()
+        self._writer.put(planes)
+
+
+def _write_wav(path: str, samples: np.ndarray, rate: int) -> None:
+    """pcm_s16le stereo .wav — the audio side-file mp_remux merges into
+    the concatenated long-test AVPVS (pure-python: the wave module)."""
+    import wave
+
+    with wave.open(path, "wb") as f:
+        f.setnchannels(samples.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(rate)
+        f.writeframes(np.ascontiguousarray(samples, np.int16).tobytes())
+
+
 def create_avpvs_wo_buffer_batch(
     pvses: list,
     avpvs_src_fps: bool = False,
     force_60_fps: bool = False,
 ) -> Optional[Job]:
-    """Multi-device p03: ONE job running a short-test PVS batch through the
+    """Multi-device p03: ONE job running the PVS batch through the
     (pvs × time) device mesh (parallel/p03_batch), instead of one device
-    job per PVS. Same math as create_avpvs_wo_buffer's short path —
-    byte-identical artifacts (tests/test_parallel.py proves it) — but the
-    device step is data-parallel over the PVS axis and sequence-parallel
-    over frame time. Skip-existing/--force filtering happens in the stage
-    (per-PVS), so every pvs passed here is due for (re)generation."""
+    job per PVS.
+
+    Short tests: one lane per PVS, straight into the final FFV1(+FLAC)
+    writer — byte-identical to the single-device path (proven in
+    tests/test_pipeline_e2e.py).
+
+    Long tests: one lane per (PVS, segment) rendering an FFV1 tmp file —
+    the reference's own parallel-tmp design (p03:88-104) with device lanes
+    instead of ffmpeg processes — then per PVS a native stream-copy concat
+    (medialib.concat_video, the concat-demuxer pass :1094-1100) + SRC
+    audio remux. Decoded frames are identical to the single-device render
+    (FFV1 is lossless; the byte stream differs because per-segment encoder
+    contexts reset where the single continuous encode adapts across
+    segments). SI/TI sidecars are stitched with the segment-join TI fixed
+    from the captured boundary frames, matching the single path's carry.
+
+    Skip-existing/--force filtering happens in the stage (per-PVS), so
+    every pvs passed here is due for (re)generation."""
     if not pvses:
         return None
     from contextlib import ExitStack
@@ -333,6 +374,25 @@ def create_avpvs_wo_buffer_batch(
     from ..parallel.mesh import make_mesh
 
     def run() -> str:
+        specs = []
+        assembly: dict = {}
+        try:
+            return _run(specs, assembly)
+        except BaseException:
+            # sweep EVERY long-test tmp render, not just the failing
+            # wave/PVS's: completed waves' full-resolution FFV1 tmps
+            # (potentially many GB) must not outlive a failed batch
+            for spec in specs:
+                if spec["kind"] == "long_seg" and os.path.isfile(spec["out"]):
+                    os.unlink(spec["out"])
+            for pvs_specs in assembly.values():
+                final = pvs_specs[0]["final"]
+                for p in (final + ".cat.tmp.avi", final + ".audio.tmp.wav"):
+                    if os.path.isfile(p):
+                        os.unlink(p)
+            raise
+
+    def _run(specs, assembly) -> str:
         import jax
 
         devs = jax.devices()
@@ -342,57 +402,100 @@ def create_avpvs_wo_buffer_batch(
         )
         n_pvs = mesh.shape["pvs"]
         log = get_logger()
-        # bucket by full geometry (p03_batch's bucketing policy) using
-        # header probes only — decoders/encoders open later, per wave, so
-        # a 300-PVS database never holds 300 open codec contexts at once
-        buckets: dict = {}
+
+        # lane specs: one per short PVS, one per long (PVS, segment) —
+        # probe-only here; decoders/encoders open later, per wave, so a
+        # 300-PVS database never holds 300 open codec contexts at once.
+        # (specs/assembly are the caller's lists so the outer failure
+        # sweep sees everything planned so far.)
         for pvs in pvses:
-            seg = pvs.segments[0]
+            tc = pvs.test_config
             w, h = avpvs_dimensions(pvs)
             pix_fmt = pvs.get_pix_fmt_for_avpvs()
-            info = probe.get_segment_info(seg.file_path)
-            key = (info["video_height"], info["video_width"], h, w, pix_fmt)
-            buckets.setdefault(key, []).append((pvs, w, h, pix_fmt))
+            out_path = _wo_buffer_out_path(pvs)
+            SiTiAccumulator.discard(out_path)
+            if tc.is_short():
+                seg = pvs.segments[0]
+                info = probe.get_segment_info(seg.file_path)
+                specs.append(dict(
+                    kind="short", pvs=pvs, seg=seg, out=out_path,
+                    final=out_path, w=w, h=h, pix_fmt=pix_fmt,
+                    key=(info["video_height"], info["video_width"], h, w,
+                         pix_fmt),
+                ))
+            else:
+                rate = canvas_fps(pvs, avpvs_src_fps)
+                pvs_specs = []
+                for idx, seg in enumerate(pvs.segments):
+                    info = probe.get_segment_info(seg.file_path)
+                    spec = dict(
+                        kind="long_seg", pvs=pvs, seg=seg, idx=idx,
+                        rate=rate, final=out_path,
+                        out=f"{out_path}.seg{idx:04d}.tmp.avi",
+                        w=w, h=h, pix_fmt=pix_fmt,
+                        key=(info["video_height"], info["video_width"], h, w,
+                             pix_fmt),
+                    )
+                    specs.append(spec)
+                    pvs_specs.append(spec)
+                assembly[pvs] = pvs_specs
+
+        buckets: dict = {}
+        for spec in specs:
+            buckets.setdefault(spec["key"], []).append(spec)
+
         for (sh, sw, dh, dw, pix_fmt), entries in buckets.items():
             log.info(
-                "p03 batch: %d PVS(es) %dx%d->%dx%d %s over mesh %s",
+                "p03 batch: %d lane(s) %dx%d->%dx%d %s over mesh %s",
                 len(entries), sw, sh, dw, dh, pix_fmt, dict(mesh.shape),
             )
             # longest-first so each wave groups similar lengths
-            entries.sort(key=lambda e: -e[0].segments[0].duration)
+            entries.sort(key=lambda e: -e["seg"].duration)
             for w0 in range(0, len(entries), n_pvs):
                 wave = entries[w0: w0 + n_pvs]
-                out_paths = [_wo_buffer_out_path(p) for p, *_ in wave]
-                for p in out_paths:
-                    SiTiAccumulator.discard(p)  # never leave a stale sidecar
-                feats: list[tuple[SiTiAccumulator, str]] = []
                 try:
                     with ExitStack() as stack:
                         lanes = []
-                        for (pvs, w, h, _), out_path in zip(wave, out_paths):
-                            audio, srate = _short_segment_audio(pvs.segments[0])
-                            reader = stack.enter_context(
-                                VideoReader(pvs.segments[0].file_path)
-                            )
-                            rate, chunks = _short_rate_chunks(
-                                pvs, reader, avpvs_src_fps, force_60_fps
-                            )
-                            writer = stack.enter_context(
-                                pf.AsyncWriter(_ffv1_writer(
-                                    out_path, w, h, pix_fmt, rate,
-                                    with_audio=audio is not None,
-                                    sample_rate=srate, audio_codec="flac",
-                                ))
-                            )
-                            if audio is not None:
-                                writer.write_audio(audio)
+                        for spec in wave:
+                            pvs, out_path = spec["pvs"], spec["out"]
+                            w, h = spec["w"], spec["h"]
+                            if spec["kind"] == "short":
+                                audio, srate = _short_segment_audio(spec["seg"])
+                                reader = stack.enter_context(
+                                    VideoReader(spec["seg"].file_path)
+                                )
+                                rate, chunks = _short_rate_chunks(
+                                    pvs, reader, avpvs_src_fps, force_60_fps
+                                )
+                                writer = stack.enter_context(
+                                    pf.AsyncWriter(_ffv1_writer(
+                                        out_path, w, h, pix_fmt, rate,
+                                        with_audio=audio is not None,
+                                        sample_rate=srate, audio_codec="flac",
+                                    ))
+                                )
+                                if audio is not None:
+                                    writer.write_audio(audio)
+                            else:
+                                rate = spec["rate"]
+                                chunks = _segment_canvas_chunks(
+                                    spec["seg"], rate
+                                )
+                                writer = stack.enter_context(
+                                    pf.AsyncWriter(_ffv1_writer(
+                                        out_path, w, h, pix_fmt, rate,
+                                        with_audio=False,
+                                    ))
+                                )
+                            sink = _BoundarySink(writer)
                             feat = SiTiAccumulator()
-                            feats.append((feat, out_path))
+                            spec["feat"] = feat
+                            spec["sink"] = sink
                             lanes.append(p03_batch.Lane(
                                 chunks=chunks,
-                                emit=writer.put,
+                                emit=sink.emit,
                                 n_frames_hint=int(
-                                    round(pvs.segments[0].duration * rate)
+                                    round(spec["seg"].duration * rate)
                                 ),
                                 emit_features=feat.extend,
                             ))
@@ -403,25 +506,93 @@ def create_avpvs_wo_buffer_batch(
                             chunk=CHUNK,
                         )
                 except BaseException:
-                    # the writers were opened (files created/truncated):
-                    # a partial artifact must never survive to satisfy a
+                    # the writers were opened (files created/truncated): a
+                    # partial artifact must never survive to satisfy a
                     # later run's skip-existing check
-                    for p in out_paths:
-                        if os.path.isfile(p):
-                            os.unlink(p)
-                        SiTiAccumulator.discard(p)
+                    for spec in wave:
+                        for p in (spec["out"], spec["final"]):
+                            if os.path.isfile(p):
+                                os.unlink(p)
+                        SiTiAccumulator.discard(spec["final"])
                     raise
-                for feat, feat_out in feats:
-                    feat.write(feat_out)
-                # per-PVS provenance, identical to the single-device jobs'
-                for (pvs, w, h, _), out_path in zip(wave, out_paths):
-                    Job(
-                        label=f"avpvs {pvs.pvs_id}",
-                        output_path=out_path,
-                        fn=lambda: None,
-                        logfile_path=pvs.get_logfile_path(),
-                        provenance=_wo_buffer_provenance(pvs, w, h, pix_fmt),
-                    ).write_provenance()
+                # short lanes are final the moment their wave drains
+                for spec in wave:
+                    if spec["kind"] == "short":
+                        spec["feat"].write(spec["out"])
+                        Job(
+                            label=f"avpvs {spec['pvs'].pvs_id}",
+                            output_path=spec["out"],
+                            fn=lambda: None,
+                            logfile_path=spec["pvs"].get_logfile_path(),
+                            provenance=_wo_buffer_provenance(
+                                spec["pvs"], spec["w"], spec["h"],
+                                spec["pix_fmt"],
+                            ),
+                        ).write_provenance()
+
+        # long-test assembly: native stream-copy concat of the tmp
+        # renders + SRC audio remux + stitched feature sidecar
+        for pvs, pvs_specs in assembly.items():
+            out_path = pvs_specs[0]["final"]
+            cat_tmp = out_path + ".cat.tmp.avi"
+            wav_tmp = out_path + ".audio.tmp.wav"
+            try:
+                medialib.concat_video([s["out"] for s in pvs_specs], cat_tmp)
+                total = float(
+                    sum(s.get_segment_duration() for s in pvs.segments)
+                )
+                samples, srate = medialib.decode_audio_s16(
+                    pvs.src.file_path, 0.0, total
+                )
+                _write_wav(wav_tmp, _to_stereo(samples), srate)
+                medialib.remux(cat_tmp, out_path, audio_path=wav_tmp)
+
+                # stitch features: TI at each segment join diffs the next
+                # segment's first frame against the previous one's last
+                # (the single path's accumulator carry)
+                stitched = SiTiAccumulator()
+                prev_last = None
+                for spec in pvs_specs:
+                    if not spec["feat"].si:
+                        # a segment whose duration rounds to zero canvas
+                        # frames legitimately emits nothing
+                        # (_segment_canvas_chunks); continuity carries
+                        # over it untouched
+                        continue
+                    si = np.concatenate(
+                        [np.asarray(x) for x in spec["feat"].si]
+                    )
+                    ti = np.concatenate(
+                        [np.asarray(x) for x in spec["feat"].ti]
+                    )
+                    if prev_last is not None:
+                        ti = ti.copy()
+                        ti[0] = float(jnp.std(
+                            jnp.asarray(spec["sink"].first, jnp.float32)
+                            - jnp.asarray(prev_last, jnp.float32)
+                        ))
+                    prev_last = spec["sink"].last
+                    stitched.extend(si, ti)
+                stitched.write(out_path)
+                Job(
+                    label=f"avpvs {pvs.pvs_id}",
+                    output_path=out_path,
+                    fn=lambda: None,
+                    logfile_path=pvs.get_logfile_path(),
+                    provenance=_wo_buffer_provenance(
+                        pvs, pvs_specs[0]["w"], pvs_specs[0]["h"],
+                        pvs_specs[0]["pix_fmt"],
+                    ),
+                ).write_provenance()
+            except BaseException:
+                if os.path.isfile(out_path):
+                    os.unlink(out_path)
+                SiTiAccumulator.discard(out_path)
+                raise
+            finally:
+                for p in [cat_tmp, wav_tmp] + [s["out"] for s in pvs_specs]:
+                    if os.path.isfile(p):
+                        os.unlink(p)
         return f"{len(pvses)} AVPVS"
 
     return Job(

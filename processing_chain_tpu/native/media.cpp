@@ -1282,6 +1282,128 @@ EXPORT int mp_remux(const char* video_path, const char* audio_path,
     return 0;
 }
 
+
+// ---------------------------------------------------------------------------
+// Sequential stream-copy concat: the video streams of `paths[0..n)` into
+// `out_path`, no transcoding, timestamps offset so segment k starts where
+// k-1 ended — the native equivalent of the reference's concat demuxer pass
+// (reference lib/ffmpeg.py:1094-1100, `ffmpeg -f concat -c copy`). All
+// inputs must share codec parameters (the per-segment AVPVS tmp renders
+// do: same encoder, geometry, rate). Audio is merged separately via
+// mp_remux.
+
+EXPORT int mp_concat(const char* const* paths, int n, const char* out_path,
+                     char* err, int errlen) {
+    if (n <= 0) {
+        set_err(err, errlen, "mp_concat: no inputs");
+        return -1;
+    }
+    AVFormatContext* out = nullptr;
+    int ret = avformat_alloc_output_context2(&out, nullptr, nullptr, out_path);
+    if (ret < 0 || !out) {
+        set_err(err, errlen, std::string(out_path) + ": " + av_errstr(ret));
+        return -1;
+    }
+    auto fail = [&](const std::string& msg) {
+        set_err(err, errlen, msg);
+        if (out) {
+            if (!(out->oformat->flags & AVFMT_NOFILE) && out->pb) avio_closep(&out->pb);
+            avformat_free_context(out);
+        }
+        return -1;
+    };
+
+    AVStream* vs = nullptr;
+    int64_t offset = 0;           // in the OUTPUT stream's time_base
+    AVRational out_tb{0, 1};
+    AVPacket* pkt = av_packet_alloc();
+
+    for (int i = 0; i < n; i++) {
+        AVFormatContext* in = nullptr;
+        if ((ret = avformat_open_input(&in, paths[i], nullptr, nullptr)) < 0) {
+            av_packet_free(&pkt);
+            return fail(std::string(paths[i]) + ": " + av_errstr(ret));
+        }
+        if ((ret = avformat_find_stream_info(in, nullptr)) < 0) {
+            avformat_close_input(&in);
+            av_packet_free(&pkt);
+            return fail("stream info: " + av_errstr(ret));
+        }
+        int v_idx = av_find_best_stream(in, AVMEDIA_TYPE_VIDEO, -1, -1, nullptr, 0);
+        if (v_idx < 0) {
+            avformat_close_input(&in);
+            av_packet_free(&pkt);
+            return fail(std::string(paths[i]) + ": no video stream");
+        }
+        AVStream* src = in->streams[v_idx];
+        if (i == 0) {
+            vs = avformat_new_stream(out, nullptr);
+            if (!vs || avcodec_parameters_copy(vs->codecpar, src->codecpar) < 0) {
+                avformat_close_input(&in);
+                av_packet_free(&pkt);
+                return fail("copy video params failed");
+            }
+            vs->codecpar->codec_tag = 0;
+            vs->time_base = src->time_base;
+            vs->avg_frame_rate = src->avg_frame_rate;
+            out_tb = src->time_base;
+            if (!(out->oformat->flags & AVFMT_NOFILE) &&
+                (ret = avio_open(&out->pb, out_path, AVIO_FLAG_WRITE)) < 0) {
+                avformat_close_input(&in);
+                av_packet_free(&pkt);
+                return fail(std::string(out_path) + ": " + av_errstr(ret));
+            }
+            if ((ret = avformat_write_header(out, nullptr)) < 0) {
+                avformat_close_input(&in);
+                av_packet_free(&pkt);
+                return fail("write header: " + av_errstr(ret));
+            }
+            // the muxer may have adjusted the stream time_base
+            out_tb = out->streams[0]->time_base;
+        }
+        // per-frame duration fallback when packets carry none
+        AVRational fr = src->avg_frame_rate.num ? src->avg_frame_rate
+                                                : src->r_frame_rate;
+        int64_t frame_dur = fr.num
+            ? av_rescale_q(1, AVRational{fr.den, fr.num}, out_tb)
+            : 0;
+        int64_t seg_end = offset;
+        while ((ret = av_read_frame(in, pkt)) >= 0) {
+            if (pkt->stream_index != v_idx) {
+                av_packet_unref(pkt);
+                continue;
+            }
+            av_packet_rescale_ts(pkt, src->time_base, out_tb);
+            int64_t dur = pkt->duration > 0 ? pkt->duration : frame_dur;
+            if (pkt->pts != AV_NOPTS_VALUE) pkt->pts += offset;
+            if (pkt->dts != AV_NOPTS_VALUE) pkt->dts += offset;
+            int64_t end = (pkt->pts != AV_NOPTS_VALUE ? pkt->pts
+                           : pkt->dts != AV_NOPTS_VALUE ? pkt->dts : seg_end)
+                          + dur;
+            if (end > seg_end) seg_end = end;
+            pkt->stream_index = 0;
+            pkt->pos = -1;
+            if ((ret = av_interleaved_write_frame(out, pkt)) < 0) {
+                avformat_close_input(&in);
+                av_packet_free(&pkt);
+                return fail("write packet: " + av_errstr(ret));
+            }
+        }
+        avformat_close_input(&in);
+        if (ret != AVERROR_EOF) {
+            av_packet_free(&pkt);
+            return fail("read packet: " + av_errstr(ret));
+        }
+        offset = seg_end;
+    }
+    av_packet_free(&pkt);
+    if ((ret = av_write_trailer(out)) < 0)
+        return fail("write trailer: " + av_errstr(ret));
+    if (!(out->oformat->flags & AVFMT_NOFILE) && out->pb) avio_closep(&out->pb);
+    avformat_free_context(out);
+    return 0;
+}
+
 EXPORT const char* mp_version() {
     static char buf[128];
     snprintf(buf, sizeof(buf), "lavf %d.%d lavc %d.%d sws %d.%d",

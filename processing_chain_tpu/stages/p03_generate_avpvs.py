@@ -56,13 +56,13 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
         # override the pin via explicit shardings, so the pin disables
         # batching.
         if (
-            test_config.is_short()
-            and not cli_args.dry_run
+            not cli_args.dry_run
             and gpu_loc < 0
             and device_count() > 1
         ):
-            # multi-device: batch the whole short-test PVS set through the
-            # (pvs × time) mesh instead of one device job per PVS. The
+            # multi-device: batch the PVS set through the (pvs × time)
+            # mesh instead of one device job per PVS (short: lane per PVS;
+            # long: lane per segment + native stream-copy concat). The
             # per-PVS skip-existing/--force decision stays with Job
             # semantics (should_run), then due PVSes run as one batch.
             per_pvs = {

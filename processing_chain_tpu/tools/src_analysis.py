@@ -151,6 +151,20 @@ def analyse_src(video_path: str, with_siti: bool = False) -> str:
     return sidecar
 
 
+def backfill_siti(video_path: str) -> str:
+    """Merge a SI/TI block into an existing, otherwise-intact sidecar —
+    one decode pass, no md5 re-hash, no re-probe."""
+    import yaml
+
+    sidecar = video_path + ".yaml"
+    with open(sidecar) as f:
+        data = yaml.safe_load(f) or {}
+    data["siti"] = src_siti_summary(video_path)
+    with open(sidecar, "w") as f:
+        yaml.safe_dump(data, f, default_flow_style=False)
+    return sidecar
+
+
 def collect_video_files(inputs: Sequence[str]) -> list[str]:
     """Expand files/directories into a sorted list of video files
     (reference :160-169)."""
@@ -177,26 +191,36 @@ def run(
 ) -> dict:
     """Analyse all SRCs; returns {"md5": [Md5Result…], "sidecars": [path…]}."""
     log = get_logger()
-    files = collect_video_files(inputs)
-    if not force:
-        def _needs_work(f: str) -> bool:
+    all_files = collect_video_files(inputs)
+    backfill: list[str] = []
+    if force:
+        files = all_files
+    else:
+        files = []
+        for f in all_files:
             sidecar = f + ".yaml"
             if not os.path.isfile(sidecar):
-                return True
+                files.append(f)
+                continue
             if not with_siti:
-                return False
+                continue
             # --siti over previously analysed SRCs must add the feature
-            # block, not silently no-op behind the existing-sidecar skip
+            # block, not silently no-op behind the existing-sidecar skip —
+            # and an intact sidecar only needs the ONE decode pass merged
+            # in, not a fresh md5 + re-probe
             import yaml
 
             try:
                 data = yaml.safe_load(open(sidecar)) or {}
             except Exception:
-                return True
-            return "siti" not in data
-
-        files = [f for f in files if _needs_work(f)]
-    log.info("%d files will be processed", len(files))
+                files.append(f)
+                continue
+            if "siti" not in data:
+                backfill.append(f)
+    log.info(
+        "%d files will be processed%s", len(files),
+        f" (+{len(backfill)} siti backfills)" if backfill else "",
+    )
 
     out: dict = {"md5": [], "sidecars": []}
     if not skip_md5 and files:
@@ -211,12 +235,14 @@ def run(
             with open(summary_path, "w") as fh:
                 fh.write("".join(r.summary() + "\n" for r in out["md5"]))
 
-    if not skip_src and files:
+    if not skip_src and (files or backfill):
         runner = ParallelRunner(max_parallel=concurrency, name="src-info")
         for f in files:
             runner.add(analyse_src, f, with_siti, label=f)
+        for f in backfill:
+            runner.add(backfill_siti, f, label=f)
         results = runner.run()
-        out["sidecars"] = [results[f] for f in files]
+        out["sidecars"] = [results[f] for f in files + backfill]
         for path in out["sidecars"]:
             log.info("wrote %s", path)
     return out

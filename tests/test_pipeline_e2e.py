@@ -524,3 +524,76 @@ def test_quality_metrics_consumes_sidecar(short_db):
     finally:
         with open(sc, "w") as f:
             f.write(original)
+
+
+def test_p03_long_batch_matches_single_device(tmp_path):
+    """Long tests on the multi-device route: lane-per-segment render +
+    native stream-copy concat must decode to IDENTICAL frames and audio as
+    the single-device streaming render (bytes differ: per-segment FFV1
+    contexts reset where the continuous encode adapts), with matching
+    stitched SI/TI sidecars."""
+    import jax
+
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.models import avpvs as av
+
+    assert len(jax.devices()) > 1
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2LTR01
+        syntaxVersion: 6
+        type: long
+        segmentDuration: 1
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24, audioCodec: aac, audioBitrate: 96}
+          Q1: {index: 1, videoCodec: h264, videoBitrate: 500, width: 320, height: 180, fps: 24, audioCodec: aac, audioBitrate: 96}
+        codingList:
+          VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+          AC01: {type: audio, encoder: aac}
+        srcList:
+          SRC001: SRC001.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            audioCodingId: AC01
+            eventList: [[Q0, 1], [Q1, 1]]
+        pvsList:
+          - P2LTR01_SRC001_HRC000
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+    """)
+    yaml_path = write_db(tmp_path, "P2LTR01", yaml_text,
+                         {"SRC001.avi": dict(n=48, audio=True)})
+    rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+    db = os.path.dirname(yaml_path)
+    tc = TestConfig(yaml_path)
+    pvs = tc.pvses["P2LTR01_SRC001_HRC000"]
+    av_path = os.path.join(db, "avpvs", "P2LTR01_SRC001_HRC000.avi")
+
+    # reference: single-device model job
+    av.create_avpvs_wo_buffer(pvs).run()
+    with VideoReader(av_path) as r:
+        ref_planes, _ = r.read_all()
+        ref_fps = r.fps
+    ref_audio, ref_rate = medialib.decode_audio_s16(av_path)
+    ref_sc = np.genfromtxt(av_path + ".siti.csv", delimiter=",", names=True)
+    os.unlink(av_path)
+    os.unlink(av_path + ".siti.csv")
+
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+    with VideoReader(av_path) as r:
+        got_planes, _ = r.read_all()
+        assert r.fps == ref_fps
+    for p in range(3):
+        np.testing.assert_array_equal(got_planes[p], ref_planes[p])
+    got_audio, got_rate = medialib.decode_audio_s16(av_path)
+    assert got_rate == ref_rate
+    np.testing.assert_array_equal(got_audio, ref_audio)
+    got_sc = np.genfromtxt(av_path + ".siti.csv", delimiter=",", names=True)
+    np.testing.assert_allclose(got_sc["si"], ref_sc["si"], rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(got_sc["ti"], ref_sc["ti"], rtol=1e-4, atol=1e-3)
+    # no tmp renders left behind
+    leftovers = [f for f in os.listdir(os.path.join(db, "avpvs"))
+                 if ".tmp." in f]
+    assert leftovers == []
