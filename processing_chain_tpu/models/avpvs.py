@@ -698,28 +698,74 @@ def apply_stalling(
             chunks = pf.stream_monotonic_gather(
                 reader, lambda k: int(plan.src_idx[k]), plan.n_out, CHUNK
             )
+            import jax
+
+            black_values = (
+                16.0 * depth_scale, 128.0 * depth_scale, 128.0 * depth_scale
+            )
+            devs = jax.devices()
+            sharded = None
+            if len(devs) > 1:
+                # the composite is frame-local: shard each chunk's frames
+                # across every visible device (ops/overlay sharded path)
+                from ..parallel.mesh import make_mesh
+
+                mesh = make_mesh(devs)
+                sharded = ov.make_sharded_stall_renderer(
+                    mesh,
+                    (None,) * 5 if skipping or sp_y is None
+                    else (jnp.asarray(sp_y), jnp.asarray(sa),
+                          jnp.asarray(sp_u), jnp.asarray(sp_v),
+                          jnp.asarray(sa_c)),
+                    black_values, ten_bit,
+                )
+                grain = mesh.shape["pvs"]
             with pf.Prefetcher(chunks, depth=2) as pre:
                 for chunk_no, gathered in enumerate(pre):
                     start = chunk_no * CHUNK
                     sel_len = gathered[0].shape[0]
-                    # batch-local plan over the pre-gathered frames
+                    stall = plan.stall_mask[start: start + sel_len]
+                    black = plan.black_mask[start: start + sel_len]
+                    phase = plan.phase[start: start + sel_len]
+                    if sharded is not None:
+                        pad = (-sel_len) % grain
+
+                        def padded(a, pad=pad):
+                            a = np.asarray(a)
+                            if pad:
+                                a = np.concatenate(
+                                    [a, np.repeat(a[-1:], pad, axis=0)]
+                                )
+                            return a
+
+                        outs = sharded(
+                            jnp.asarray(padded(gathered[0]), jnp.float32),
+                            jnp.asarray(padded(gathered[1]), jnp.float32),
+                            jnp.asarray(padded(gathered[2]), jnp.float32),
+                            jnp.asarray(padded(stall), jnp.float32),
+                            jnp.asarray(padded(black), jnp.float32),
+                            jnp.asarray(padded(phase), jnp.int32),
+                        )
+                        writer.put([o[:sel_len] for o in outs])
+                        continue
+                    # single device: host-planned composite
                     sub = ov.StallPlan(
                         src_idx=np.arange(sel_len, dtype=np.int32),
-                        stall_mask=plan.stall_mask[start : start + sel_len],
-                        black_mask=plan.black_mask[start : start + sel_len],
-                        phase=plan.phase[start : start + sel_len],
+                        stall_mask=stall,
+                        black_mask=black,
+                        phase=phase,
                     )
                     y = jnp.asarray(gathered[0], jnp.float32)
                     u = jnp.asarray(gathered[1], jnp.float32)
                     v = jnp.asarray(gathered[2], jnp.float32)
                     oy = ov.render_stalled_plane(
-                        y, sub, sp_y, sa, black_value=16.0 * depth_scale
+                        y, sub, sp_y, sa, black_value=black_values[0]
                     )
                     ou = ov.render_stalled_plane(
-                        u, sub, sp_u, sa_c, black_value=128.0 * depth_scale
+                        u, sub, sp_u, sa_c, black_value=black_values[1]
                     )
                     ovv = ov.render_stalled_plane(
-                        v, sub, sp_v, sa_c, black_value=128.0 * depth_scale
+                        v, sub, sp_v, sa_c, black_value=black_values[2]
                     )
                     writer.put(fr.quantize_device([oy, ou, ovv], ten_bit))
         return out_path

@@ -202,6 +202,37 @@ def _blend_plane(
     )
 
 
+def render_core(
+    frames: jnp.ndarray,
+    stall: jnp.ndarray,
+    black: jnp.ndarray,
+    phase: jnp.ndarray,
+    spinner: Optional[jnp.ndarray],
+    spinner_alpha: Optional[jnp.ndarray],
+    black_value: float,
+) -> jnp.ndarray:
+    """Traceable composite of pre-gathered frames [T, H, W] with per-frame
+    stall/black masks [T] and spinner phase indices [T] — the shared body
+    of the host-planned path (render_stalled_plane) and the mesh-sharded
+    batch path (make_sharded_stall_renderer)."""
+    h, w = frames.shape[-2], frames.shape[-1]
+    stall_b = stall.astype(jnp.float32)[:, None, None]
+    black_b = black.astype(jnp.float32)[:, None, None]
+    out = frames * (1.0 - black_b) + black_value * black_b
+    if spinner is not None:
+        # phases are modulo the actual rotation-bank size, so a plan built
+        # with a different n_rotations still indexes in range
+        phases = phase % spinner.shape[0]
+        sp = jnp.take(jnp.asarray(spinner), phases, axis=0)
+        sa = jnp.take(jnp.asarray(spinner_alpha), phases, axis=0)
+        sa = sa * stall_b  # only composite on stall frames
+        y0 = (h - spinner.shape[-2]) // 2
+        x0 = (w - spinner.shape[-1]) // 2
+        blend = jax.vmap(_blend_plane, in_axes=(0, 0, 0, None, None))
+        out = blend(out, sp, sa, y0, x0)
+    return out
+
+
 def render_stalled_plane(
     frames: jnp.ndarray,
     plan: StallPlan,
@@ -214,24 +245,53 @@ def render_stalled_plane(
     spinner: [R, h, w] rotation bank for THIS plane (chroma callers pass the
     subsampled bank), spinner_alpha likewise [R, h, w]. Returns [T_out, H, W].
     """
-    t_out = plan.n_out
-    h, w = frames.shape[-2], frames.shape[-1]
     gathered = jnp.take(frames, jnp.asarray(plan.src_idx), axis=0)
-    stall = jnp.asarray(plan.stall_mask, jnp.float32)[:, None, None]
-    black = jnp.asarray(plan.black_mask, jnp.float32)[:, None, None]
-    out = gathered * (1.0 - black) + black_value * black
-    if spinner is not None:
-        # phases are modulo the actual rotation-bank size, so a plan built
-        # with a different n_rotations still indexes in range
-        phases = jnp.asarray(plan.phase) % spinner.shape[0]
-        sp = jnp.take(jnp.asarray(spinner), phases, axis=0)
-        sa = jnp.take(jnp.asarray(spinner_alpha), phases, axis=0)
-        sa = sa * stall  # only composite on stall frames
-        y0 = (h - spinner.shape[-2]) // 2
-        x0 = (w - spinner.shape[-1]) // 2
-        blend = jax.vmap(_blend_plane, in_axes=(0, 0, 0, None, None))
-        out = blend(out, sp, sa, y0, x0)
-    return out
+    return render_core(
+        gathered,
+        jnp.asarray(plan.stall_mask, jnp.float32),
+        jnp.asarray(plan.black_mask, jnp.float32),
+        jnp.asarray(plan.phase),
+        spinner, spinner_alpha, black_value,
+    )
+
+
+def make_sharded_stall_renderer(
+    mesh, banks: tuple, black_values: tuple, ten_bit: bool
+):
+    """Jit the stall composite over a (pvs=N,) frame-parallel mesh: the
+    blend is frame-local, so the chunked stalling pass shards its frames
+    across every visible device (like tools/quality_metrics does for
+    PSNR/SSIM). `banks` = (sp_y, sa_y, sp_u, sp_v, sa_c) or Nones
+    (skipping mode) — U and V carry DISTINCT banks, a colored spinner has
+    different chroma per plane; `black_values` = per-plane background
+    levels. Inputs arrive padded to a multiple of the device count;
+    outputs are quantized to container depth on device."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    sp_y, sa_y, sp_u, sp_v, sa_c = banks
+    hi, dt = (1023.0, jnp.uint16) if ten_bit else (255.0, jnp.uint8)
+
+    def shard_fn(y, u, v, stall, black, phase):
+        outs = []
+        for p, sp, sa, bv in (
+            (y, sp_y, sa_y, black_values[0]),
+            (u, sp_u, sa_c, black_values[1]),
+            (v, sp_v, sa_c, black_values[2]),
+        ):
+            r = render_core(p, stall, black, phase, sp, sa, bv)
+            outs.append(jnp.clip(jnp.floor(r + 0.5), 0, hi).astype(dt))
+        return tuple(outs)
+
+    frame_spec = P("pvs", None, None)
+    mask_spec = P("pvs")
+    mapped = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(frame_spec, frame_spec, frame_spec,
+                  mask_spec, mask_spec, mask_spec),
+        out_specs=(frame_spec, frame_spec, frame_spec),
+    )
+    return jax.jit(mapped)
 
 
 def downsample_alpha(alpha: np.ndarray) -> np.ndarray:

@@ -597,3 +597,29 @@ def test_p03_long_batch_matches_single_device(tmp_path):
     leftovers = [f for f in os.listdir(os.path.join(db, "avpvs"))
                  if ".tmp." in f]
     assert leftovers == []
+
+
+def test_stalling_sharded_matches_single_device(short_db, monkeypatch):
+    """The frame-parallel sharded stall composite must produce a
+    byte-identical stalled AVPVS to the single-device render (shared
+    render_core; gather and quantize identical)."""
+    import jax
+
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.models import avpvs as av
+
+    db = os.path.dirname(short_db)
+    tc = TestConfig(short_db, filter_pvses="P2SXM90_SRC000_HRC002")
+    pvs = tc.pvses["P2SXM90_SRC000_HRC002"]
+    out = pvs.get_avpvs_file_path()
+
+    assert len(jax.devices()) > 1
+    av.apply_stalling(pvs).run()  # sharded (8 visible devices)
+    sharded_bytes = open(out, "rb").read()
+    os.unlink(out)
+
+    one_dev = jax.devices()[:1]
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: one_dev)
+    av.apply_stalling(pvs).run()  # single-device path
+    single_bytes = open(out, "rb").read()
+    assert sharded_bytes == single_bytes
