@@ -263,3 +263,29 @@ def test_p03_batch_padding_and_exhaustion(devices8):
             jnp.asarray(srcs[i][1]), dh // 2, dw // 2, "bicubic"
         ))
         np.testing.assert_array_equal(got[1], want_u)
+
+
+def test_sharded_stall_renderer_skipping_mode(devices8):
+    """Skipping (frame-freeze) mode: no spinner banks — the sharded
+    renderer must match render_core with None spinner per plane."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import overlay as ov
+
+    mesh = make_mesh(None)
+    rng = np.random.default_rng(3)
+    t = 16
+    y = jnp.asarray(rng.integers(0, 255, (t, 32, 48)).astype(np.float32))
+    u = jnp.asarray(rng.integers(0, 255, (t, 16, 24)).astype(np.float32))
+    v = jnp.asarray(rng.integers(0, 255, (t, 16, 24)).astype(np.float32))
+    stall = jnp.asarray((np.arange(t) % 3 == 0).astype(np.float32))
+    black = jnp.asarray((np.arange(t) % 5 == 0).astype(np.float32))
+    phase = jnp.zeros((t,), jnp.int32)
+    step = ov.make_sharded_stall_renderer(
+        mesh, (None,) * 5, (16.0, 128.0, 128.0), ten_bit=False
+    )
+    oy, ou, ovv = step(y, u, v, stall, black, phase)
+    ref = ov.render_core(y, stall, black, phase, None, None, 16.0)
+    ref = np.clip(np.floor(np.asarray(ref) + 0.5), 0, 255).astype(np.uint8)
+    np.testing.assert_array_equal(np.asarray(oy), ref)
+    assert ou.dtype == np.uint8 and ovv.shape == (t, 16, 24)
