@@ -272,7 +272,7 @@ def test_sharded_stall_renderer_skipping_mode(devices8):
 
     from processing_chain_tpu.ops import overlay as ov
 
-    mesh = make_mesh(None)
+    mesh = make_mesh(devices8)
     rng = np.random.default_rng(3)
     t = 16
     y = jnp.asarray(rng.integers(0, 255, (t, 32, 48)).astype(np.float32))
@@ -285,7 +285,7 @@ def test_sharded_stall_renderer_skipping_mode(devices8):
         mesh, (None,) * 5, (16.0, 128.0, 128.0), ten_bit=False
     )
     oy, ou, ovv = step(y, u, v, stall, black, phase)
-    ref = ov.render_core(y, stall, black, phase, None, None, 16.0)
-    ref = np.clip(np.floor(np.asarray(ref) + 0.5), 0, 255).astype(np.uint8)
-    np.testing.assert_array_equal(np.asarray(oy), ref)
-    assert ou.dtype == np.uint8 and ovv.shape == (t, 16, 24)
+    for got, plane, bv in ((oy, y, 16.0), (ou, u, 128.0), (ovv, v, 128.0)):
+        ref = ov.render_core(plane, stall, black, phase, None, None, bv)
+        ref = np.clip(np.floor(np.asarray(ref) + 0.5), 0, 255).astype(np.uint8)
+        np.testing.assert_array_equal(np.asarray(got), ref)

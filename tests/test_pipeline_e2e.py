@@ -377,9 +377,14 @@ def test_short_chain_audio_flac_parity(tmp_path):
     """)
     yaml_path = write_db(tmp_path, "P2SXM95", yaml_text,
                          {"SRC000.avi": dict(n=48, audio=True)})
-    rc = cli_main(["p00", "-c", yaml_path, "-str", "13", "--skip-requirements"])
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "123", "--skip-requirements"])
     assert rc == 0
     db = os.path.dirname(yaml_path)
+
+    # p02 on an audio-bearing short segment: .afi must exist and be populated
+    afi = os.path.join(db, "audioFrameInformation", "P2SXM95_SRC000_HRC000.afi")
+    assert os.path.isfile(afi)
+    assert len(open(afi).read().splitlines()) > 10
 
     seg = os.path.join(db, "videoSegments", "P2SXM95_SRC000_Q0_VC01_0000_0-2.mp4")
     seg_streams = {s["codec_type"]: s for s in medialib.probe(seg)["streams"]}
