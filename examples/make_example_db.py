@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Generate a self-contained example database, ready for the full chain.
+
+The reference's quickstart depends on an external fixture corpus
+(github.com/pnats2avhd/example-databases, reference test/build_and_test.sh:5
+and README.md:87-92). This framework ships the equivalent as a generator:
+synthetic SRC videos are rendered through the framework's own io layer, so
+a complete, runnable database exists after one command with no downloads.
+
+    python examples/make_example_db.py /tmp/dbs                 # short DB
+    python examples/make_example_db.py /tmp/dbs --type long     # long DB
+    python -m processing_chain_tpu -c /tmp/dbs/P2SXM99/P2SXM99.yaml -v
+
+The short database exercises: bitrate-targeted 2-pass and CRF x264 coding,
+an fps-ladder downsample, a stalling HRC (spinner overlay in p03), and two
+viewing contexts (pc + mobile) in p04. The long database adds: multi-segment
+planning with quality switches, AAC audio coding, a mid-stream stall, and
+last-segment truncation against the SRC duration (reference
+lib/test_config.py:1216-1220 semantics).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import textwrap
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from processing_chain_tpu.io import VideoWriter  # noqa: E402
+
+SHORT_YAML = """\
+databaseId: {db_id}
+syntaxVersion: 6
+type: short
+qualityLevelList:
+  Q0: {{index: 0, videoCodec: h264, videoBitrate: 300, width: 320, height: 180, fps: 12}}
+  Q1: {{index: 1, videoCodec: h264, videoBitrate: 800, width: 640, height: 360, fps: 24}}
+  Q2: {{index: 2, videoCodec: h264, videoCrf: 26, width: 640, height: 360, fps: 24}}
+codingList:
+  VC01: {{type: video, encoder: libx264, passes: 2, iFrameInterval: 2, preset: veryfast}}
+  VC02: {{type: video, encoder: libx264, crf: yes, iFrameInterval: 2, preset: veryfast}}
+srcList:
+  SRC000: SRC000.avi
+  SRC001: SRC001.avi
+hrcList:
+  HRC000: {{videoCodingId: VC01, eventList: [[Q0, 4]]}}
+  HRC001: {{videoCodingId: VC01, eventList: [[Q1, 4]]}}
+  HRC002: {{videoCodingId: VC02, eventList: [[Q2, 4]]}}
+  HRC003: {{videoCodingId: VC01, eventList: [[Q1, 4], [stall, 1.0]]}}
+pvsList:
+  - {db_id}_SRC000_HRC000
+  - {db_id}_SRC000_HRC001
+  - {db_id}_SRC000_HRC002
+  - {db_id}_SRC000_HRC003
+  - {db_id}_SRC001_HRC001
+postProcessingList:
+  - {{type: pc, displayWidth: 640, displayHeight: 360, codingWidth: 640, codingHeight: 360, displayFrameRate: 24}}
+  - {{type: mobile, displayWidth: 640, displayHeight: 360, codingWidth: 640, codingHeight: 360, displayFrameRate: 24}}
+"""
+
+LONG_YAML = """\
+databaseId: {db_id}
+syntaxVersion: 6
+type: long
+segmentDuration: 4
+qualityLevelList:
+  Q0: {{index: 0, videoCodec: h264, videoBitrate: 300, width: 320, height: 180, fps: 24, audioCodec: aac, audioBitrate: 96}}
+  Q1: {{index: 1, videoCodec: h264, videoBitrate: 800, width: 640, height: 360, fps: 24, audioCodec: aac, audioBitrate: 128}}
+codingList:
+  VC01: {{type: video, encoder: libx264, passes: 1, iFrameInterval: 2, preset: veryfast}}
+  AC01: {{type: audio, encoder: aac}}
+srcList:
+  SRC000: SRC000.avi
+hrcList:
+  HRC000:
+    videoCodingId: VC01
+    audioCodingId: AC01
+    eventList:
+      - [Q0, 8]
+      - [stall, 2.0]
+      - [Q1, 4]
+pvsList:
+  - {db_id}_SRC000_HRC000
+postProcessingList:
+  - {{type: pc, displayWidth: 640, displayHeight: 360, codingWidth: 640, codingHeight: 360, displayFrameRate: 24}}
+"""
+
+
+def render_src(path: str, w: int, h: int, n: int, fps: int, seed: int,
+               audio: bool) -> None:
+    """Synthetic SRC with real spatial detail and motion (nonzero SI/TI):
+    a drifting sinusoid field plus an orbiting high-contrast block."""
+    rng = np.random.default_rng(seed)
+    phase = rng.uniform(0, 2 * np.pi, size=3)
+    aud = dict(audio_codec="flac", sample_rate=48000, channels=2) if audio else {}
+    with VideoWriter(path, "ffv1", w, h, "yuv420p", (fps, 1), **aud) as wr:
+        if audio:
+            t = np.arange(48000 * n // fps)
+            tone = (np.sin(2 * np.pi * 330 * t / 48000) * 8000).astype(np.int16)
+            wr.write_audio(np.stack([tone, tone], axis=1))
+        xx, yy = np.meshgrid(np.arange(w), np.arange(h))
+        for i in range(n):
+            y = (
+                (np.sin((xx + 3 * i) / 19 + phase[0])
+                 + np.cos((yy + 2 * i) / 13 + phase[1])) * 48 + 124
+            )
+            bx = int((np.cos(i / fps * 2 + phase[2]) * 0.3 + 0.5) * (w - 32))
+            by = int((np.sin(i / fps * 2 + phase[2]) * 0.3 + 0.5) * (h - 32))
+            y[by:by + 32, bx:bx + 32] = 235 if i % 2 else 16
+            u = np.full((h // 2, w // 2), 128, np.uint8)
+            v = np.full((h // 2, w // 2), 118, np.uint8)
+            u[by // 2:by // 2 + 16, bx // 2:bx // 2 + 16] = 180
+            wr.write(y.clip(16, 235).astype(np.uint8), u, v)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("out_dir", help="directory to create the database under")
+    ap.add_argument("--type", choices=("short", "long"), default="short")
+    ap.add_argument("--db-id", default=None,
+                    help="database id (default P2SXM99 short / P2LTR99 long)")
+    ap.add_argument("--src-seconds", type=int, default=None,
+                    help="SRC duration in seconds (default: 6 short, 10 long; "
+                    "the long event list totals 12 s, so the default "
+                    "exercises last-segment truncation)")
+    args = ap.parse_args(argv)
+
+    db_id = args.db_id or ("P2SXM99" if args.type == "short" else "P2LTR99")
+    if args.src_seconds is None:
+        secs = 6 if args.type == "short" else 10
+    elif args.src_seconds > 0:
+        secs = args.src_seconds
+    else:
+        ap.error(f"--src-seconds must be positive, got {args.src_seconds}")
+    fps = 24
+    db_dir = os.path.join(args.out_dir, db_id)
+    src_dir = os.path.join(db_dir, "srcVid")
+    os.makedirs(src_dir, exist_ok=True)
+
+    tmpl = SHORT_YAML if args.type == "short" else LONG_YAML
+    yaml_path = os.path.join(db_dir, f"{db_id}.yaml")
+    with open(yaml_path, "w") as f:
+        f.write(textwrap.dedent(tmpl).format(db_id=db_id))
+
+    n_srcs = 2 if args.type == "short" else 1
+    for s in range(n_srcs):
+        render_src(
+            os.path.join(src_dir, f"SRC{s:03d}.avi"),
+            w=640, h=360, n=secs * fps, fps=fps, seed=s,
+            audio=(args.type == "long"),
+        )
+
+    print(yaml_path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -74,6 +74,11 @@ class TestConfig:
         defaults_file: Optional[str] = None,
         complexity_csv_dir: Optional[str] = None,
     ) -> None:
+        # abspath first: a bare relative filename run from inside the
+        # database folder (`-c DB.yaml`) would otherwise see an empty
+        # dirname and fail the folder-name gate (the reference has the
+        # same flaw at :1080-1083; fixed here, outputs unaffected)
+        yaml_filename = os.path.abspath(yaml_filename)
         self.yaml_file = yaml_filename
         self.filter_srcs = filter_srcs.split("|") if filter_srcs else []
         self.filter_hrcs = filter_hrcs.split("|") if filter_hrcs else []

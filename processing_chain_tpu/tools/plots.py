@@ -185,10 +185,11 @@ def plot_long(config: Any, out_file: Optional[str] = None) -> list[str]:
     return all_warnings
 
 
-def _first_media_quality(data: dict, hrc_id: str) -> Optional[dict]:
+def _first_media_quality(data: dict, hrc_id: str) -> Optional[tuple[str, dict]]:
+    """(quality-level id, quality-level dict) of the HRC's first media event."""
     for event_id, _dur in data["hrcList"][hrc_id]["eventList"]:
         if event_id not in _STALL_IDS:
-            return data["qualityLevelList"][event_id]
+            return event_id, data["qualityLevelList"][event_id]
     return None
 
 
@@ -211,7 +212,20 @@ def plot_short(
     else:
         base = "config"
 
-    def first_bitrate(ql: dict) -> float:
+    warned_levels: set = set()
+
+    def first_bitrate(ql_id: str, ql: dict) -> Optional[float]:
+        # CRF/QP-coded quality levels have no videoBitrate; the reference
+        # hard-KeyErrors on them (test_config.py:1481 via plot_config_
+        # short.py:94) — here they are skipped, warned once per level
+        if "videoBitrate" not in ql:
+            if ql_id not in warned_levels:
+                warned_levels.add(ql_id)
+                log.warning(
+                    "quality level %s has no videoBitrate (CRF/QP-coded), "
+                    "skipping in bitrate plot", ql_id,
+                )
+            return None
         return float(str(ql["videoBitrate"]).split("/")[0])
 
     written: list[str] = []
@@ -219,15 +233,19 @@ def plot_short(
         codecs = ("vp9", "h264", "h265")
         by_codec: dict[str, tuple[list, list]] = {c: ([], []) for c in codecs}
         for hrc_id in data["hrcList"]:
-            ql = _first_media_quality(data, hrc_id)
-            if ql is None:
+            found = _first_media_quality(data, hrc_id)
+            if found is None:
                 continue
+            ql_id, ql = found
             codec = ql.get("videoCodec", "h264")
             if codec not in by_codec:
                 log.warning("unexpected video codec %s, ignoring", codec)
                 continue
+            rate = first_bitrate(ql_id, ql)
+            if rate is None:
+                continue
             by_codec[codec][0].append(ql["height"])
-            by_codec[codec][1].append(first_bitrate(ql))
+            by_codec[codec][1].append(rate)
         for codec in codecs:
             heights, bitrates = by_codec[codec]
             fig = plt.figure(figsize=(10, 10))
@@ -257,12 +275,14 @@ def plot_short(
     ax.set_xlim([math.sqrt(x_t[0]), math.sqrt(x_t[-1])])
     ax.set_ylim([math.log(y_t[0]), math.log(y_t[-1])])
     for hrc_id in data["hrcList"]:
-        ql = _first_media_quality(data, hrc_id)
-        if ql is None:
+        found = _first_media_quality(data, hrc_id)
+        if found is None:
             continue
-        ax.scatter(
-            [math.sqrt(ql["height"])], [math.log(first_bitrate(ql))], color="red"
-        )
+        ql_id, ql = found
+        rate = first_bitrate(ql_id, ql)
+        if rate is None:
+            continue
+        ax.scatter([math.sqrt(ql["height"])], [math.log(rate)], color="red")
     ax.set_xlabel("frame height")
     ax.set_ylabel("bitrate in kbit/s")
     path = out_file or base + ".svg"

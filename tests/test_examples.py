@@ -1,0 +1,56 @@
+"""The examples/ quickstart generator must produce runnable databases:
+the YAML parses against the real prober (the generated SRCs are probed,
+not faked) and the segment plan matches the documented design."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from processing_chain_tpu.config import TestConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "make_example_db.py")
+
+
+def _generate(tmp_path, *args):
+    out = subprocess.run(
+        [sys.executable, SCRIPT, str(tmp_path), "--src-seconds", "2", *args],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    yaml_path = out.stdout.strip().splitlines()[-1]
+    assert os.path.isfile(yaml_path)
+    return yaml_path
+
+
+def test_short_example_parses_and_plans(tmp_path):
+    yaml_path = _generate(tmp_path)
+    tc = TestConfig(yaml_path)
+    assert not tc.is_long()
+    assert sorted(tc.pvses)[:2] == [
+        "P2SXM99_SRC000_HRC000", "P2SXM99_SRC000_HRC001",
+    ]
+    # every short PVS is one segment; HRC001 and HRC003 share (Q1, VC01)
+    # so their segments dedup: 5 PVSes -> 4 unique encodes
+    segs = tc.get_required_segments()
+    assert len(segs) == 4
+    # the generated SRC really probes: 640x360, 24 fps, 2 s
+    src = tc.srcs["SRC000"]
+    info = src.stream_info  # probed from the generated file during parse
+    assert (info["width"], info["height"]) == (640, 360)
+    assert abs(src.get_duration() - 2.0) < 0.1
+
+
+def test_long_example_plans_truncation_and_audio(tmp_path):
+    yaml_path = _generate(tmp_path, "--type", "long")
+    tc = TestConfig(yaml_path)
+    assert tc.is_long()
+    pvs = next(iter(tc.pvses.values()))
+    # 2 s SRC against a 12 s event list: the plan truncates to SRC duration
+    # (reference lib/test_config.py:1216-1220 semantics)
+    total = sum(s.end_time - s.start_time for s in pvs.segments)
+    assert total == pytest.approx(2.0, abs=0.26)
+    assert all(s.audio_coding is not None for s in pvs.segments)

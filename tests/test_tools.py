@@ -181,6 +181,10 @@ def _design_yaml(tmp_path, event_lists):
                     "width": 960, "height": 540, "fps": 24},
             "Q1": {"index": 1, "videoCodec": "vp9", "videoBitrate": "2000/3000",
                     "width": 1920, "height": 1080, "fps": 24},
+            # CRF-coded: no videoBitrate (the short plotter must skip it,
+            # where the reference KeyErrors — plots.py first_bitrate)
+            "Q2": {"index": 2, "videoCodec": "h264", "videoCrf": 26,
+                    "width": 1280, "height": 720, "fps": 24},
         },
         "hrcList": {
             f"HRC{i:03d}": {"videoCodingId": "VC01", "eventList": ev}
@@ -225,6 +229,7 @@ def test_plot_short_scatter_and_codecwise(tmp_path):
         [["Q0", 10]],
         [["Q1", 10]],
         [["stall", 1], ["Q1", 10]],
+        [["Q2", 10]],   # CRF-only quality level: skipped, must not crash
     ])
     single = plots.plot_short(cfg, str(tmp_path / "short.svg"))
     assert single == [str(tmp_path / "short.svg")]
