@@ -24,7 +24,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import textwrap
 
 import numpy as np
 
@@ -144,7 +143,7 @@ def main(argv: list[str] | None = None) -> int:
     tmpl = SHORT_YAML if args.type == "short" else LONG_YAML
     yaml_path = os.path.join(db_dir, f"{db_id}.yaml")
     with open(yaml_path, "w") as f:
-        f.write(textwrap.dedent(tmpl).format(db_id=db_id))
+        f.write(tmpl.format(db_id=db_id))
 
     n_srcs = 2 if args.type == "short" else 1
     for s in range(n_srcs):

@@ -42,21 +42,24 @@ def _probe_backend(timeout_s: float) -> str:
     return proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
 
 
-def _force_cpu() -> None:
-    """Pin the cpu platform and deregister non-cpu PJRT plugin factories so
-    nothing can touch the wedged transport when backends initialize."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
+def _force_cpu(platforms: str = "cpu") -> None:
+    """Pin the platform list (default cpu-only) and deregister PJRT plugin
+    factories outside it, so nothing can touch a wedged transport when
+    backends initialize. Passing e.g. "cpu,axon" keeps the accelerator
+    registered as a secondary backend (used by __graft_entry__)."""
+    os.environ["JAX_PLATFORMS"] = platforms
+    keep = set(platforms.split(","))
     try:  # private API: harmless to skip if a jax upgrade moves it
         from jax._src import xla_bridge as xb
 
         for name in list(getattr(xb, "_backend_factories", {})):
-            if name != "cpu":
+            if name not in keep:
                 xb._backend_factories.pop(name, None)
     except Exception:
         pass
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", platforms)
 
 
 def ensure_backend(probe_timeout_s: float = PROBE_TIMEOUT_S) -> str:
