@@ -12,9 +12,10 @@ from processing_chain_tpu.cli import main as cli_main
 from processing_chain_tpu.io import VideoReader, VideoWriter, medialib, probe
 
 
-def make_src(path, w=320, h=180, n=48, fps=24, audio=False):
+def make_src(path, w=320, h=180, n=48, fps=24, audio=False, ten_bit=False):
     aud = dict(audio_codec="flac", sample_rate=48000, channels=2) if audio else {}
-    with VideoWriter(str(path), "ffv1", w, h, "yuv420p", (fps, 1), **aud) as wr:
+    pix_fmt = "yuv420p10le" if ten_bit else "yuv420p"
+    with VideoWriter(str(path), "ffv1", w, h, pix_fmt, (fps, 1), **aud) as wr:
         if audio:
             t = np.arange(48000 * n // fps)
             tone = (np.sin(2 * np.pi * 220 * t / 48000) * 6000).astype(np.int16)
@@ -22,8 +23,11 @@ def make_src(path, w=320, h=180, n=48, fps=24, audio=False):
         for i in range(n):
             xx, yy = np.meshgrid(np.arange(w), np.arange(h))
             y = ((np.sin((xx + 4 * i) / 23) + np.cos(yy / 17)) * 50 + 120).astype(np.uint8)
-            wr.write(y, np.full((h // 2, w // 2), 128, np.uint8),
-                     np.full((h // 2, w // 2), 118, np.uint8))
+            u = np.full((h // 2, w // 2), 128, np.uint8)
+            v = np.full((h // 2, w // 2), 118, np.uint8)
+            if ten_bit:
+                y, u, v = (p.astype(np.uint16) << 2 for p in (y, u, v))
+            wr.write(y, u, v)
 
 
 def write_db(tmp_path, db_id, yaml_text, src_specs):
@@ -473,6 +477,133 @@ def test_p01_x265_two_pass(tmp_path):
     # ...and nowhere else: without stats= inside x265-params, x265 used to
     # drop x265_2pass.log into the process cwd
     assert not [f for f in os.listdir(".") if f.startswith("x265_2pass")]
+
+
+def test_vp9_av1_segments_and_metadata(tmp_path):
+    """VP9 and AV1 through the real p01→p02 chain: local .mp4 segments,
+    exact frame sizes (IVF superframe merge for VP9, demuxer packet sizes
+    for AV1 — reference get_framesize.py:266-274 fallback), and qchanges
+    bitrate recomputation, for the two codecs the h264-only e2e skips."""
+    import pandas as pd
+
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2SXM95
+        syntaxVersion: 6
+        type: short
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: vp9, videoBitrate: 200, width: 160, height: 90, fps: 24}
+          Q1: {index: 1, videoCodec: av1, videoBitrate: 200, width: 160, height: 90, fps: 24}
+        codingList:
+          VC01: {type: video, encoder: libvpx-vp9, passes: 1, iFrameInterval: 2, speed: 4}
+          VC02: {type: video, encoder: libaom-av1, passes: 1, iFrameInterval: 2, cpuUsed: 8}
+        srcList:
+          SRC000: SRC000.avi
+        hrcList:
+          HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}
+          HRC001: {videoCodingId: VC02, eventList: [[Q1, 2]]}
+        pvsList:
+          - P2SXM95_SRC000_HRC000
+          - P2SXM95_SRC000_HRC001
+        postProcessingList:
+          - {type: pc, displayWidth: 160, displayHeight: 90, codingWidth: 160, codingHeight: 90, displayFrameRate: 24}
+    """)
+    yaml_path = write_db(tmp_path, "P2SXM95", yaml_text, {"SRC000.avi": dict(n=48)})
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "12", "--skip-requirements"])
+    assert rc == 0
+    db = os.path.dirname(yaml_path)
+
+    for seg_name, codec in (
+        ("P2SXM95_SRC000_Q0_VC01_0000_0-2.mp4", "vp9"),
+        ("P2SXM95_SRC000_Q1_VC02_0000_0-2.mp4", "av1"),
+    ):
+        seg = os.path.join(db, "videoSegments", seg_name)
+        assert os.path.isfile(seg), seg_name
+        info = [s for s in medialib.probe(seg)["streams"]
+                if s["codec_type"] == "video"][0]
+        assert info["codec_name"] == codec
+
+    for hrc, codec in (("HRC000", "vp9"), ("HRC001", "av1")):
+        qch = pd.read_csv(os.path.join(
+            db, "qualityChangeEventFiles", f"P2SXM95_SRC000_{hrc}.qchanges"
+        ))
+        assert qch["video_codec"].iloc[0] == codec
+        assert qch["video_bitrate"].iloc[0] > 0
+        vfi = pd.read_csv(os.path.join(
+            db, "videoFrameInformation", f"P2SXM95_SRC000_{hrc}.vfi"
+        ))
+        # display frames only: VP9 superframes (alt-ref + shown frame)
+        # merge into one row, AV1 temporal units are one packet each
+        assert len(vfi) == 48, (codec, len(vfi))
+        assert (vfi["size"] > 0).all()
+        assert vfi["frame_type"].iloc[0] == "I"
+
+
+def test_ten_bit_src_chain(tmp_path):
+    """A 10-bit SRC through p01+p03: the encode target inherits the
+    '10le' suffix (reference lib/ffmpeg.py:447-480 harmonization), x265
+    encodes Main 10, and the AVPVS keeps the 10-bit depth end to end."""
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2SXM94
+        syntaxVersion: 6
+        type: short
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h265, videoBitrate: 300, width: 320, height: 180, fps: 24}
+        codingList:
+          VC01: {type: video, encoder: libx265, passes: 1, iFrameInterval: 2, preset: ultrafast}
+        srcList:
+          SRC000: SRC000.avi
+        hrcList:
+          HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}
+        pvsList:
+          - P2SXM94_SRC000_HRC000
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+    """)
+    yaml_path = write_db(tmp_path, "P2SXM94", yaml_text,
+                         {"SRC000.avi": dict(n=48, ten_bit=True)})
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "13", "--skip-requirements"])
+    assert rc == 0
+    db = os.path.dirname(yaml_path)
+    seg = os.path.join(db, "videoSegments", "P2SXM94_SRC000_Q0_VC01_0000_0-2.mp4")
+    info = [s for s in medialib.probe(seg)["streams"]
+            if s["codec_type"] == "video"][0]
+    assert info["pix_fmt"] == "yuv420p10le"
+    av = os.path.join(db, "avpvs", "P2SXM94_SRC000_HRC000.avi")
+    with VideoReader(av) as r:
+        assert r.pix_fmt == "yuv420p10le"
+        planes, _ = r.read_all()
+    assert planes[0].dtype == np.uint16
+    assert planes[0].shape == (48, 180, 320)
+    # content really is 10-bit range (SRC luma ~120<<2), not 8-bit values
+    assert 300 < planes[0].mean() < 800
+
+
+def test_p04_rawvideo_preview_and_ccrf(short_db):
+    """p04's flag surface end to end: -a renders PC as rawvideo MKV with
+    the AVPVS pixel format passed through (reference test_config.py:
+    218-220; UYVY422 is the default non-raw pc mapping), -e adds the
+    ProRes preview (reference create_preview :1250-1259), -ccrf overrides
+    the mobile/preview x264 CRF (accepted on the pc-only DB; it just has
+    no mobile encode to apply to)."""
+    rc = cli_main([
+        "p04", "-c", short_db, "--skip-requirements", "--force",
+        "-a", "-e", "-ccrf", "30",
+    ])
+    assert rc == 0
+    db = os.path.dirname(short_db)
+    raw = os.path.join(db, "cpvs", "P2SXM90_SRC000_HRC000_PC.mkv")
+    info = [s for s in medialib.probe(raw)["streams"]
+            if s["codec_type"] == "video"][0]
+    assert info["codec_name"] == "rawvideo"
+    # -a passes the AVPVS pixel format through untouched (reference
+    # test_config.py:218-220); uyvy422 is the DEFAULT pc mapping, not -a's
+    assert info["pix_fmt"] == "yuv420p"
+    prev = os.path.join(db, "cpvs", "P2SXM90_SRC000_HRC000_preview.mov")
+    pinfo = [s for s in medialib.probe(prev)["streams"]
+             if s["codec_type"] == "video"][0]
+    assert pinfo["codec_name"] == "prores"
+    # leave the fixture as later tests expect it (avi from the -a-less run
+    # is untouched; the extra mkv/mov artifacts are additive)
 
 
 def test_p03_writes_siti_sidecar(short_db):
