@@ -39,6 +39,29 @@ def write_db(tmp_path, db_id, yaml_text, src_specs):
     return str(db / f"{db_id}.yaml")
 
 
+def minimal_short_yaml(db_id, *, codec="h264", encoder="libx264", passes=1,
+                       iframe=1, w=160, h=90, bitrate=200, pp_type="pc"):
+    """Single-SRC/single-HRC short DB boilerplate shared by the focused
+    e2e tests; schema changes need editing only here."""
+    return textwrap.dedent(f"""\
+        databaseId: {db_id}
+        syntaxVersion: 6
+        type: short
+        qualityLevelList:
+          Q0: {{index: 0, videoCodec: {codec}, videoBitrate: {bitrate}, width: {w}, height: {h}, fps: 24}}
+        codingList:
+          VC01: {{type: video, encoder: {encoder}, passes: {passes}, iFrameInterval: {iframe}, preset: ultrafast}}
+        srcList:
+          SRC000: SRC000.avi
+        hrcList:
+          HRC000: {{videoCodingId: VC01, eventList: [[Q0, 2]]}}
+        pvsList:
+          - {db_id}_SRC000_HRC000
+        postProcessingList:
+          - {{type: {pp_type}, displayWidth: {w}, displayHeight: {h}, codingWidth: {w}, codingHeight: {h}, displayFrameRate: 24}}
+    """)
+
+
 @pytest.fixture(scope="module")
 def short_db(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("shortdb")
@@ -446,24 +469,12 @@ def test_p01_x265_two_pass(tmp_path):
     """x265 2-pass: the multi-entry x265-params value (log-level + pass=N)
     must reach the encoder as ONE escaped option — unescaped it split at
     the ':' and the pass directive was silently dropped."""
-    yaml_text = textwrap.dedent("""\
-        databaseId: P2SXM97
-        syntaxVersion: 6
-        type: short
-        qualityLevelList:
-          Q0: {index: 0, videoCodec: h265, videoBitrate: 300, width: 320, height: 180, fps: 24}
-        codingList:
-          VC01: {type: video, encoder: libx265, passes: 2, iFrameInterval: 2, preset: ultrafast}
-        srcList:
-          SRC000: SRC000.avi
-        hrcList:
-          HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}
-        pvsList:
-          - P2SXM97_SRC000_HRC000
-        postProcessingList:
-          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
-    """)
-    yaml_path = write_db(tmp_path, "P2SXM97", yaml_text, {"SRC000.avi": dict(n=48)})
+    yaml_path = write_db(tmp_path, "P2SXM97",
+                         minimal_short_yaml("P2SXM97", codec="h265",
+                                            encoder="libx265", passes=2,
+                                            iframe=2, w=320, h=180,
+                                            bitrate=300),
+                         {"SRC000.avi": dict(n=48)})
     rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
     assert rc == 0
     db = os.path.dirname(yaml_path)
@@ -487,7 +498,7 @@ def test_vp9_av1_segments_and_metadata(tmp_path):
     import pandas as pd
 
     yaml_text = textwrap.dedent("""\
-        databaseId: P2SXM95
+        databaseId: P2SXM98
         syntaxVersion: 6
         type: short
         qualityLevelList:
@@ -502,19 +513,19 @@ def test_vp9_av1_segments_and_metadata(tmp_path):
           HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}
           HRC001: {videoCodingId: VC02, eventList: [[Q1, 2]]}
         pvsList:
-          - P2SXM95_SRC000_HRC000
-          - P2SXM95_SRC000_HRC001
+          - P2SXM98_SRC000_HRC000
+          - P2SXM98_SRC000_HRC001
         postProcessingList:
           - {type: pc, displayWidth: 160, displayHeight: 90, codingWidth: 160, codingHeight: 90, displayFrameRate: 24}
     """)
-    yaml_path = write_db(tmp_path, "P2SXM95", yaml_text, {"SRC000.avi": dict(n=48)})
+    yaml_path = write_db(tmp_path, "P2SXM98", yaml_text, {"SRC000.avi": dict(n=48)})
     rc = cli_main(["p00", "-c", yaml_path, "-str", "12", "--skip-requirements"])
     assert rc == 0
     db = os.path.dirname(yaml_path)
 
     for seg_name, codec in (
-        ("P2SXM95_SRC000_Q0_VC01_0000_0-2.mp4", "vp9"),
-        ("P2SXM95_SRC000_Q1_VC02_0000_0-2.mp4", "av1"),
+        ("P2SXM98_SRC000_Q0_VC01_0000_0-2.mp4", "vp9"),
+        ("P2SXM98_SRC000_Q1_VC02_0000_0-2.mp4", "av1"),
     ):
         seg = os.path.join(db, "videoSegments", seg_name)
         assert os.path.isfile(seg), seg_name
@@ -524,12 +535,12 @@ def test_vp9_av1_segments_and_metadata(tmp_path):
 
     for hrc, codec in (("HRC000", "vp9"), ("HRC001", "av1")):
         qch = pd.read_csv(os.path.join(
-            db, "qualityChangeEventFiles", f"P2SXM95_SRC000_{hrc}.qchanges"
+            db, "qualityChangeEventFiles", f"P2SXM98_SRC000_{hrc}.qchanges"
         ))
         assert qch["video_codec"].iloc[0] == codec
         assert qch["video_bitrate"].iloc[0] > 0
         vfi = pd.read_csv(os.path.join(
-            db, "videoFrameInformation", f"P2SXM95_SRC000_{hrc}.vfi"
+            db, "videoFrameInformation", f"P2SXM98_SRC000_{hrc}.vfi"
         ))
         # display frames only: VP9 superframes (alt-ref + shown frame)
         # merge into one row, AV1 temporal units are one packet each
@@ -542,24 +553,10 @@ def test_ten_bit_src_chain(tmp_path):
     """A 10-bit SRC through p01+p03: the encode target inherits the
     '10le' suffix (reference lib/ffmpeg.py:447-480 harmonization), x265
     encodes Main 10, and the AVPVS keeps the 10-bit depth end to end."""
-    yaml_text = textwrap.dedent("""\
-        databaseId: P2SXM94
-        syntaxVersion: 6
-        type: short
-        qualityLevelList:
-          Q0: {index: 0, videoCodec: h265, videoBitrate: 300, width: 320, height: 180, fps: 24}
-        codingList:
-          VC01: {type: video, encoder: libx265, passes: 1, iFrameInterval: 2, preset: ultrafast}
-        srcList:
-          SRC000: SRC000.avi
-        hrcList:
-          HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}
-        pvsList:
-          - P2SXM94_SRC000_HRC000
-        postProcessingList:
-          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
-    """)
-    yaml_path = write_db(tmp_path, "P2SXM94", yaml_text,
+    yaml_path = write_db(tmp_path, "P2SXM94",
+                         minimal_short_yaml("P2SXM94", codec="h265",
+                                            encoder="libx265", iframe=2,
+                                            w=320, h=180, bitrate=300),
                          {"SRC000.avi": dict(n=48, ten_bit=True)})
     rc = cli_main(["p00", "-c", yaml_path, "-str", "13", "--skip-requirements"])
     assert rc == 0
@@ -576,6 +573,36 @@ def test_ten_bit_src_chain(tmp_path):
     assert planes[0].shape == (48, 180, 320)
     # content really is 10-bit range (SRC luma ~120<<2), not 8-bit values
     assert 300 < planes[0].mean() < 800
+
+
+
+def test_dry_run_plans_without_writing(tmp_path, caplog):
+    """-n walks the full 4-stage plan (the reference prints the shell
+    commands it would run; here the job graph logs instead) and must
+    leave every artifact folder empty."""
+    import logging
+
+    yaml_path = write_db(tmp_path, "P2SXM93", minimal_short_yaml("P2SXM93"),
+                         {"SRC000.avi": dict(n=48)})
+    # the chain logger disables propagation once configured; route it
+    # through caplog's handler directly (same idiom as test_downloader)
+    logger = logging.getLogger("main")
+    logger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.INFO, logger="main"):
+            rc = cli_main(["p00", "-c", yaml_path, "-n", "--skip-requirements"])
+    finally:
+        logger.removeHandler(caplog.handler)
+    assert rc == 0
+    # the plan was actually walked: one [dry-run] line per job — p01
+    # segment, p02 metadata, p03 avpvs, p04 cpvs
+    dry = [r for r in caplog.records if "[dry-run]" in r.getMessage()]
+    assert len(dry) >= 4, caplog.text
+    db = os.path.dirname(yaml_path)
+    for d in ("videoSegments", "qualityChangeEventFiles",
+              "videoFrameInformation", "avpvs", "cpvs"):
+        folder = os.path.join(db, d)
+        assert not os.path.isdir(folder) or not os.listdir(folder), d
 
 
 def test_p04_rawvideo_preview_and_ccrf(short_db):
@@ -604,6 +631,26 @@ def test_p04_rawvideo_preview_and_ccrf(short_db):
     assert pinfo["codec_name"] == "prores"
     # leave the fixture as later tests expect it (avi from the -a-less run
     # is untouched; the extra mkv/mov artifacts are additive)
+
+
+def test_p04_mobile_ccrf_effect(tmp_path):
+    """-ccrf must actually reach the mobile x264 encode: the same AVPVS
+    rendered at CRF 10 vs CRF 45 differs drastically in size (reference
+    create_cpvs :1202-1231 mobile branch)."""
+    yaml_path = write_db(tmp_path, "P2SXM92",
+                         minimal_short_yaml("P2SXM92", pp_type="mobile"),
+                         {"SRC000.avi": dict(n=48)})
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "13", "--skip-requirements"])
+    assert rc == 0
+    db = os.path.dirname(yaml_path)
+    out = os.path.join(db, "cpvs", "P2SXM92_SRC000_HRC000_MO.mp4")
+    sizes = {}
+    for crf in (10, 45):
+        rc = cli_main(["p04", "-c", yaml_path, "--skip-requirements",
+                       "--force", "-ccrf", str(crf)])
+        assert rc == 0
+        sizes[crf] = os.path.getsize(out)
+    assert sizes[10] > 2 * sizes[45], sizes
 
 
 def test_p03_writes_siti_sidecar(short_db):
