@@ -308,3 +308,39 @@ def test_enc_options_escapes_colon_values():
 
     assert (enc_options_to_opts("-x264opts keyint=48:min-keyint=48")
             == "x264opts=keyint=48\\:min-keyint=48")
+
+
+def test_defaults_override_remaps_paths(tmp_path):
+    """processingchain_defaults.yaml overrides (reference :1089-1160):
+    artifact paths remap, srcVid accepts a multi-folder search list, and
+    the SRC is located in a later folder of that list."""
+    yaml_path, prober = write_short_db(tmp_path)
+    db_dir = os.path.dirname(yaml_path)
+
+    alt_avpvs = tmp_path / "alt_avpvs"
+    alt_avpvs.mkdir()
+    empty_srcs = tmp_path / "srcs_a"
+    empty_srcs.mkdir()
+    real_srcs = os.path.join(db_dir, "srcVid")  # the SRC actually lives here
+
+    import yaml as _yaml
+    defaults = tmp_path / "processingchain_defaults.yaml"
+    defaults.write_text(_yaml.safe_dump({
+        "avpvs": str(alt_avpvs),
+        "srcVid": [str(empty_srcs), real_srcs],
+    }))
+    tc = TestConfig(yaml_path, prober=prober, defaults_file=str(defaults))
+    assert tc.path_mapping["avpvs"] == str(alt_avpvs)
+    src = tc.srcs["SRC000"]
+    assert src.file_path == os.path.join(real_srcs, "SRC000.avi")
+    # AVPVS artifacts now target the remapped folder
+    pvs = next(iter(tc.pvses.values()))
+    assert pvs.get_avpvs_file_path().startswith(str(alt_avpvs))
+
+
+def test_defaults_override_rejects_missing_path(tmp_path):
+    yaml_path, prober = write_short_db(tmp_path)
+    defaults = tmp_path / "processingchain_defaults.yaml"
+    defaults.write_text("avpvs: /nonexistent/path\n")
+    with pytest.raises(ConfigError, match="does not exist"):
+        TestConfig(yaml_path, prober=prober, defaults_file=str(defaults))
