@@ -10,6 +10,20 @@ Per PVS:
     the exact parsed sizes, frame-count consistency enforced
     (reference :119-124 hard-exits on mismatch; here it raises);
   * VP9 superframe packets merged before size replacement (reference :100-104).
+
+Design note — why p02 parses files instead of consuming device tensors
+(BASELINE.json's north star routes "device-side feature tensors" to the
+stages that handle PIXELS: p03's SI/TI sidecars, tools/quality_metrics,
+src-analysis --siti): p02's artifacts are BITSTREAM metadata, and their
+value contract is the reference's exact annexb/IVF frame sizes
+(reference get_framesize.py). Those differ from what any in-memory
+shortcut could supply — encoder-mux packet sizes diverge from annexb
+sizes (start-code vs length-prefix framing, parameter-set placement on
+keyframes), which is the very discrepancy the reference built its parsers
+to avoid (vs ffprobe, :119-124). Re-parsing the written file is therefore
+load-bearing for parity; the hot loop is native demux + vectorized numpy
+NAL/IVF scanning (io/framesizes.py), not the reference's byte-at-a-time
+Python state machine.
 """
 
 from __future__ import annotations
