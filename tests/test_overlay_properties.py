@@ -1,0 +1,96 @@
+"""Property-based tests for the stalling plan (ops/overlay.plan_stalling)
+— the bufferer-replacement's scheduling core (reference contract:
+p03_generateAvPvs.py:242-243; .buff formats test_config.py:312-333).
+
+Invariants, for any frame count × fps × non-overlapping event list:
+  * stall mode inserts exactly round(d*fps) frames per event and plays
+    every source frame exactly once, in order;
+  * the spinner phase advances continuously across ALL stall frames
+    (one global spin clock, not per-event);
+  * skipping (freeze) mode preserves the frame count and only repeats
+    source frames, never drops or reorders the non-frozen ones.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from processing_chain_tpu.ops import overlay as ov
+
+
+@st.composite
+def stall_cases(draw):
+    fps = draw(st.sampled_from([24.0, 30.0, 60.0]))
+    n_frames = draw(st.integers(4, 120))
+    n_events = draw(st.integers(0, 3))
+    media_len = n_frames / fps
+    # non-overlapping, sorted event starts inside the media timeline
+    starts = sorted(
+        draw(st.lists(
+            st.floats(0.0, media_len, allow_nan=False),
+            min_size=n_events, max_size=n_events, unique=True,
+        ))
+    )
+    # truly non-overlapping (the planner's documented input domain —
+    # .buff events from the planner never overlap): drop any start whose
+    # gap to the next one cannot fit a minimum-length event
+    events = []
+    for i, t in enumerate(starts):
+        gap = (starts[i + 1] - t) if i + 1 < len(starts) else 1.5
+        if gap < 0.02:
+            continue
+        events.append([t, draw(st.floats(0.02, min(1.5, gap),
+                                         allow_nan=False))])
+    return n_frames, fps, events
+
+
+@given(stall_cases())
+@settings(max_examples=150, deadline=None)
+def test_stall_plan_properties(case):
+    n_frames, fps, events = case
+    plan = ov.plan_stalling(n_frames, fps, events, skipping=False)
+    inserted = sum(int(round(d * fps)) for _, d in events)
+    assert plan.n_out == n_frames + inserted
+    assert plan.stall_mask.sum() == inserted
+    # every source frame is played exactly once, in order
+    played = plan.src_idx[plan.stall_mask == 0]
+    np.testing.assert_array_equal(played, np.arange(n_frames))
+    # black frames are exactly the stall frames (black_frame=True default)
+    np.testing.assert_array_equal(plan.black_mask, plan.stall_mask)
+    # during a stall the background frame is the last played one
+    stall_pos = np.flatnonzero(plan.stall_mask)
+    for p in stall_pos:
+        before = plan.src_idx[:p][plan.stall_mask[:p] == 0]
+        want = before[-1] if before.size else 0
+        assert plan.src_idx[p] == want
+    # one global spin clock: k-th stall frame overall has phase
+    # floor(k * rps * n_rot / fps) % n_rot  (rps=1, n_rot=64 defaults)
+    ks = np.arange(inserted)
+    expect = (ks * 1.0 * 64 / fps).astype(np.int64) % 64
+    np.testing.assert_array_equal(plan.phase[stall_pos], expect)
+    # non-stall frames carry no spinner
+    assert (plan.phase[plan.stall_mask == 0] == 0).all()
+
+
+@given(stall_cases())
+@settings(max_examples=150, deadline=None)
+def test_freeze_plan_properties(case):
+    n_frames, fps, events = case
+    plan = ov.plan_stalling(n_frames, fps, events, skipping=True)
+    # frame count preserved; no black frames, no spinner in skipping mode
+    assert plan.n_out == n_frames
+    assert plan.black_mask.sum() == 0
+    assert (plan.phase == 0).all()
+    # src_idx only repeats (freezes), never reorders or skips backwards
+    assert (np.diff(plan.src_idx) >= 0).all()
+    # frames outside any freeze window map to themselves
+    frozen = plan.stall_mask == 1
+    np.testing.assert_array_equal(
+        plan.src_idx[~frozen], np.arange(n_frames)[~frozen]
+    )
+    # inside a freeze window the held frame is the window's FIRST frame
+    # (not e.g. start-1: pin the exact index, windows are non-overlapping)
+    for t, d in events:
+        start = int(round(t * fps))
+        end = min(n_frames, int(round((t + d) * fps)))
+        if start < n_frames and end > start:
+            assert (plan.src_idx[start:end] == start).all()
