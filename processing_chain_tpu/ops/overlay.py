@@ -223,11 +223,23 @@ def render_core(
         # phases are modulo the actual rotation-bank size, so a plan built
         # with a different n_rotations still indexes in range
         phases = phase % spinner.shape[0]
+        # a spinner larger than the frame is center-cropped to fit — the
+        # same pixels ffmpeg's overlay keeps when a centered overlay
+        # extends past the main frame (clipping); without this the
+        # dynamic_slice below is out of range for small renders (e.g. a
+        # 90-px-tall AVPVS under the default 128-px spinner). Static
+        # Python arithmetic: shapes are trace-time constants.
+        sh, sw = spinner.shape[-2], spinner.shape[-1]
+        ch, cw = min(sh, h), min(sw, w)
+        if (ch, cw) != (sh, sw):
+            cy, cx = (sh - ch) // 2, (sw - cw) // 2
+            spinner = spinner[..., cy:cy + ch, cx:cx + cw]
+            spinner_alpha = spinner_alpha[..., cy:cy + ch, cx:cx + cw]
         sp = jnp.take(jnp.asarray(spinner), phases, axis=0)
         sa = jnp.take(jnp.asarray(spinner_alpha), phases, axis=0)
         sa = sa * stall_b  # only composite on stall frames
-        y0 = (h - spinner.shape[-2]) // 2
-        x0 = (w - spinner.shape[-1]) // 2
+        y0 = (h - ch) // 2
+        x0 = (w - cw) // 2
         blend = jax.vmap(_blend_plane, in_axes=(0, 0, 0, None, None))
         out = blend(out, sp, sa, y0, x0)
     return out

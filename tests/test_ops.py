@@ -289,6 +289,31 @@ def test_render_stalled_black_and_spinner():
     assert out[0, 0, 0] == 200.0
 
 
+def test_render_spinner_larger_than_frame_clips():
+    """A spinner bigger than the frame center-crops to fit (ffmpeg
+    overlay clipping semantics) instead of crashing the dynamic_slice —
+    hit in production by 160x90 renders under the default 128px spinner."""
+    frames = np.full((6, 12, 20), 200, np.float32)  # 12x20 frame
+    plan = overlay.plan_stalling(
+        6, 10.0, [[0.2, 0.2]], black_frame=True, n_rotations=4
+    )
+    spinner_rgba = np.zeros((32, 32, 4), np.uint8)  # 32x32 spinner
+    spinner_rgba[..., 0:3] = 255
+    spinner_rgba[..., 3] = 255  # fully opaque: whole frame covered
+    yuv, alpha = overlay.prepare_spinner(spinner_rgba, n_rotations=4)
+    out = np.asarray(
+        overlay.render_stalled_plane(
+            frames, plan, spinner=yuv[:, 0], spinner_alpha=alpha
+        )
+    )
+    assert out.shape == (8, 12, 20)
+    # the stall frame is fully covered by the cropped opaque spinner
+    stall_idx = int(np.flatnonzero(np.asarray(plan.stall_mask))[0])
+    assert abs(out[stall_idx].mean() - 235.0) < 5  # white everywhere
+    # played frames untouched
+    assert out[0, 0, 0] == 200.0
+
+
 def test_downsample_alpha():
     a = np.zeros((2, 8, 8), np.float32)
     a[:, :4, :4] = 1.0

@@ -605,6 +605,38 @@ def test_dry_run_plans_without_writing(tmp_path, caplog):
         assert not os.path.isdir(folder) or not os.listdir(folder), d
 
 
+def test_trace_writes_timing_report(tmp_path):
+    """--trace drops a per-job timing report into the database's logs/
+    folder (the tracing side of the provenance story; MIGRATION.md)."""
+    yaml_path = write_db(tmp_path, "P2SXM91", minimal_short_yaml("P2SXM91"),
+                         {"SRC000.avi": dict(n=24)})
+    rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements", "--trace"])
+    assert rc == 0
+    logs = os.path.join(os.path.dirname(yaml_path), "logs")
+    reports = [f for f in os.listdir(logs) if "timing" in f or "trace" in f]
+    assert reports, os.listdir(logs)
+    body = open(os.path.join(logs, reports[0])).read()
+    assert "encode" in body  # the p01 job span is in the report
+
+
+def test_remove_intermediate_deletes_wo_buffer(tmp_path):
+    """p03 -r deletes the pre-stalling intermediate of stalling PVSes
+    (reference p03:262-265 — whose stale-loop-variable bug deleted one
+    file N times; here each PVS removes its own)."""
+    yaml_text = minimal_short_yaml("P2SXM89").replace(
+        "eventList: [[Q0, 2]]", "eventList: [[Q0, 2], [stall, 0.5]]"
+    )
+    yaml_path = write_db(tmp_path, "P2SXM89", yaml_text,
+                         {"SRC000.avi": dict(n=48)})
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "13", "-r",
+                   "--skip-requirements"])
+    assert rc == 0
+    avdir = os.path.join(os.path.dirname(yaml_path), "avpvs")
+    files = os.listdir(avdir)
+    assert "P2SXM89_SRC000_HRC000.avi" in files
+    assert not [f for f in files if "wo_buffer" in f], files
+
+
 def test_p04_rawvideo_preview_and_ccrf(short_db):
     """p04's flag surface end to end: -a renders PC as rawvideo MKV with
     the AVPVS pixel format passed through (reference test_config.py:
