@@ -19,7 +19,10 @@ from .medialib import MediaError, MPVideoDesc
 
 @dataclass
 class Frame:
-    """One decoded frame: planes in native bit depth (uint8 or uint16)."""
+    """One decoded frame: PLANAR planes in native bit depth (uint8 or
+    uint16), each [h, w] samples of one component. Packed container
+    formats (PACKED_FORMATS) are deinterleaved by VideoReader before a
+    Frame is built, so `.y` is always pure luma."""
 
     planes: tuple[np.ndarray, ...]
     pts: float
@@ -38,6 +41,17 @@ class Frame:
         return self.planes[2] if len(self.planes) > 2 else None
 
 
+#: single-plane interleaved formats the chain can encounter (the PC CPVS
+#: default is uyvy422) mapped to their (y, u, v) byte offsets within each
+#: 4-byte macropixel (y repeats every 2 bytes, u/v every 4); gray etc.
+#: are single-plane but planar. VideoReader deinterleaves these on read.
+PACKED_FORMATS = {
+    "uyvy422": (1, 0, 2),   # U Y V Y
+    "yuyv422": (0, 1, 3),   # Y U Y V
+    "yvyu422": (0, 3, 1),   # Y V Y U
+}
+
+
 class VideoReader:
     """Sequential decoder with [start, start+duration) trim — the native
     replacement for the reference's `ffmpeg -ss X -t D -i …` decode commands
@@ -51,18 +65,75 @@ class VideoReader:
         if not self._h:
             raise MediaError(f"open {path}: {err.value.decode()}")
         desc = MPVideoDesc()
-        lib.mp_decoder_desc(self._h, ct.byref(desc))
+        if lib.mp_decoder_desc(self._h, ct.byref(desc)) < 0:
+            lib.mp_decoder_close(self._h)
+            self._h = None
+            raise MediaError(f"{path}: could not probe decoder geometry")
         self.width = desc.width
         self.height = desc.height
-        self.pix_fmt = desc.pix_fmt.decode()
+        #: the container/decoder pixel format as probed (e.g. uyvy422)
+        self.container_pix_fmt = desc.pix_fmt.decode()
+        # packed formats deinterleave AT THIS BOUNDARY: every consumer
+        # downstream (resize, SI/TI, metrics, complexity, re-encode)
+        # holds a planar contract, exactly as the reference's consumers
+        # see planar frames because ffmpeg converts transparently. The
+        # reader therefore presents packed 422 as yuv422p planes and
+        # reports the PLANAR view as pix_fmt.
+        self._packed_offsets = PACKED_FORMATS.get(self.container_pix_fmt)
+        if self._packed_offsets is not None and self.width % 2:
+            # an odd-width packed row carries a ceil'd half macropixel;
+            # deinterleaving it would yield planes wider than reported
+            lib.mp_decoder_close(self._h)
+            self._h = None
+            raise MediaError(
+                f"{path}: odd-width packed {self.container_pix_fmt} is "
+                "unsupported (chain invariant: even dims)"
+            )
+        self.pix_fmt = (
+            "yuv422p" if self._packed_offsets is not None
+            else self.container_pix_fmt
+        )
         self.fps = desc.fps_num / max(1, desc.fps_den)
         self.fps_fraction = (desc.fps_num, desc.fps_den)
         self.duration = desc.duration
-        self.n_planes = desc.planes
-        self.plane_shapes = [
+        # raw (native) plane geometry used for the decode buffers; plane_w
+        # is SAMPLES per row (2x pixel width for packed 422 rows)
+        self._raw_plane_shapes = [
             (desc.plane_h[p], desc.plane_w[p]) for p in range(desc.planes)
         ]
+        if self._packed_offsets is not None:
+            self.n_planes = 3
+            self.plane_shapes = [
+                (self.height, self.width),
+                (self.height, self.width // 2),
+                (self.height, self.width // 2),
+            ]
+        elif desc.planes >= 3 or self.container_pix_fmt.startswith("gray"):
+            # fully planar (Y/U/V separate) or single-component
+            self.n_planes = desc.planes
+            self.plane_shapes = list(self._raw_plane_shapes)
+        else:
+            # 1-2 plane multi-component layouts (nv12 semi-planar, rgb24
+            # packed, ...) would silently violate the planar Frame
+            # contract downstream — fail loudly at the boundary
+            lib.mp_decoder_close(self._h)
+            self._h = None
+            raise MediaError(
+                f"{path}: unsupported non-planar pixel format "
+                f"{self.container_pix_fmt!r} (planar YUV/gray or packed "
+                f"422 expected)"
+            )
         self.dtype = np.uint16 if desc.bytes_per_sample == 2 else np.uint8
+
+    def _deinterleave(self, raw: np.ndarray) -> tuple[np.ndarray, ...]:
+        """Packed 422 row bytes [h, 2w] → planar (y, u, v) copies,
+        table-driven from PACKED_FORMATS."""
+        y_off, u_off, v_off = self._packed_offsets
+        return (
+            np.ascontiguousarray(raw[..., y_off::2]),
+            np.ascontiguousarray(raw[..., u_off::4]),
+            np.ascontiguousarray(raw[..., v_off::4]),
+        )
 
     def __iter__(self) -> Iterator[Frame]:
         lib = medialib.ensure_loaded()
@@ -72,7 +143,7 @@ class VideoReader:
             if not self._h:
                 raise MediaError(f"{self.path}: reader is closed")
             planes = tuple(
-                np.zeros(shape, self.dtype) for shape in self.plane_shapes
+                np.zeros(shape, self.dtype) for shape in self._raw_plane_shapes
             )
             ptrs = [p.ctypes.data_as(u8p) for p in planes] + [None] * (4 - len(planes))
             pts = ct.c_double()
@@ -84,6 +155,8 @@ class VideoReader:
                 return
             if ret < 0:
                 raise MediaError(f"decode {self.path}: {err.value.decode()}")
+            if self._packed_offsets is not None:
+                planes = self._deinterleave(planes[0])
             yield Frame(planes=planes, pts=pts.value, pix_fmt=self.pix_fmt)
 
     def read_all(self) -> tuple[list[np.ndarray], list[float]]:

@@ -717,7 +717,7 @@ def apply_stalling(
                     else (jnp.asarray(sp_y), jnp.asarray(sa),
                           jnp.asarray(sp_u), jnp.asarray(sp_v),
                           jnp.asarray(sa_c)),
-                    black_values, ten_bit,
+                    black_values, ten_bit, (sub_h, sub_w),
                 )
                 grain = mesh.shape["pvs"]
             with pf.Prefetcher(chunks, depth=2) as pre:
@@ -759,7 +759,8 @@ def apply_stalling(
                     u = jnp.asarray(gathered[1], jnp.float32)
                     v = jnp.asarray(gathered[2], jnp.float32)
                     oy = ov.render_stalled_plane(
-                        y, sub, sp_y, sa, black_value=black_values[0]
+                        y, sub, sp_y, sa, black_value=black_values[0],
+                        crop_align=(sub_h, sub_w),
                     )
                     ou = ov.render_stalled_plane(
                         u, sub, sp_u, sa_c, black_value=black_values[1]

@@ -225,6 +225,14 @@ struct MPDecoder {
     AVFrame* frame = nullptr;
     bool draining = false;
     double start_s = 0.0, end_s = -1.0;  // trim window; end < 0 = unbounded
+    // plane geometry the caller's buffers were sized with (captured at
+    // open time; the dec context's width/pix_fmt can change mid-stream
+    // on a parameter-set switch and must then never drive a memcpy past
+    // the open-time buffer size)
+    int buf_rows[4] = {0, 0, 0, 0};
+    int buf_row_bytes[4] = {0, 0, 0, 0};
+    int open_w = 0, open_h = 0;
+    int open_fmt = AV_PIX_FMT_NONE;
 };
 
 struct MPVideoDesc {
@@ -236,6 +244,19 @@ struct MPVideoDesc {
     int32_t plane_w[4], plane_h[4];
     int32_t bytes_per_sample;   // 1 or 2
 };
+
+// Exact byte width of one row of plane p at the given pixel width.
+// av_image_get_linesize handles packed formats (uyvy422 carries two
+// samples per pixel in one plane — per-plane pixel count undercounts
+// them by 2x); the pw*bps fallback covers formats it rejects.
+static int plane_row_bytes(AVPixelFormat pf, int width, int p,
+                           const AVPixFmtDescriptor* desc, int bps) {
+    int lsz = av_image_get_linesize(pf, width, p);
+    if (lsz > 0) return lsz;
+    int pw = (p == 1 || p == 2) ? AV_CEIL_RSHIFT(width, desc->log2_chroma_w)
+                                : width;
+    return pw * bps;
+}
 
 static int fill_video_desc(MPDecoder* d, MPVideoDesc* out) {
     memset(out, 0, sizeof(*out));
@@ -259,8 +280,11 @@ static int fill_video_desc(MPDecoder* d, MPVideoDesc* out) {
     out->bytes_per_sample = desc->comp[0].depth > 8 ? 2 : 1;
     for (int p = 0; p < planes && p < 4; p++) {
         int is_chroma = (p == 1 || p == 2);
-        out->plane_w[p] =
-            is_chroma ? AV_CEIL_RSHIFT(out->width, desc->log2_chroma_w) : out->width;
+        // row width exposed in SAMPLES so plane_w*plane_h*bytes_per_sample
+        // sizes the Python-side buffer exactly (packed formats included)
+        out->plane_w[p] = plane_row_bytes(pf, out->width, p, desc,
+                                          out->bytes_per_sample)
+                          / out->bytes_per_sample;
         out->plane_h[p] =
             is_chroma ? AV_CEIL_RSHIFT(out->height, desc->log2_chroma_h) : out->height;
     }
@@ -302,6 +326,20 @@ EXPORT MPDecoder* mp_decoder_open(const char* path, double start_s, double dur_s
     }
     d->pkt = av_packet_alloc();
     d->frame = av_frame_alloc();
+    {   // capture the open-time plane geometry from the SAME computation
+        // that sizes the caller's buffers (fill_video_desc), so the
+        // decoder clamp and the Python allocation can never drift apart
+        MPVideoDesc vd;
+        if (fill_video_desc(d, &vd) == 0) {
+            for (int p = 0; p < vd.planes && p < 4; p++) {
+                d->buf_rows[p] = vd.plane_h[p];
+                d->buf_row_bytes[p] = vd.plane_w[p] * vd.bytes_per_sample;
+            }
+        }
+        d->open_w = d->dec->width;
+        d->open_h = d->dec->height;
+        d->open_fmt = d->dec->pix_fmt;
+    }
     d->start_s = start_s > 0 ? start_s : 0.0;
     d->end_s = dur_s > 0 ? d->start_s + dur_s : -1.0;
     if (d->start_s > 0) {
@@ -343,22 +381,61 @@ EXPORT int mp_decoder_next(MPDecoder* d, uint8_t* p0, uint8_t* p1, uint8_t* p2,
                 av_frame_unref(d->frame);
                 return 0;  // past trim end
             }
-            int nplanes = av_pix_fmt_count_planes(d->dec->pix_fmt);
-            int bps = desc->comp[0].depth > 8 ? 2 : 1;
+            // a mid-stream parameter switch (resolution, bit depth,
+            // format) breaks the open-time buffer contract: fail loudly
+            // — a clamped copy would hand downstream partially-zeroed
+            // "valid" frames. Compared against the OPEN-time capture
+            // (the dec context's own fields track the stream and would
+            // mask the switch). The clamps below stay as the
+            // memory-safety backstop.
+            if (d->frame->width != d->open_w ||
+                d->frame->height != d->open_h ||
+                d->frame->format != d->open_fmt) {
+                set_err(err, errlen,
+                        "mid-stream parameter switch: frame " +
+                            std::to_string(d->frame->width) + "x" +
+                            std::to_string(d->frame->height) +
+                            " differs from open-time " +
+                            std::to_string(d->open_w) + "x" +
+                            std::to_string(d->open_h));
+                av_frame_unref(d->frame);
+                return -1;
+            }
+            const AVPixFmtDescriptor* fdesc =
+                av_pix_fmt_desc_get((AVPixelFormat)d->frame->format);
+            if (!fdesc) fdesc = desc;
+            int nplanes = av_pix_fmt_count_planes(
+                (AVPixelFormat)d->frame->format);
+            if (nplanes <= 0) nplanes = av_pix_fmt_count_planes(d->dec->pix_fmt);
             for (int p = 0; p < nplanes && p < 4; p++) {
                 if (!planes[p]) continue;
                 int is_chroma = (p == 1 || p == 2);
-                int pw = is_chroma
-                             ? AV_CEIL_RSHIFT(d->frame->width, desc->log2_chroma_w)
-                             : d->frame->width;
-                int ph = is_chroma
-                             ? AV_CEIL_RSHIFT(d->frame->height, desc->log2_chroma_h)
-                             : d->frame->height;
-                int row_bytes = pw * bps;
-                for (int y = 0; y < ph; y++) {
+                // the caller's buffers were sized from the OPEN-time
+                // geometry (buf_rows/buf_row_bytes); a mid-stream
+                // parameter switch (taller/wider frames, format change)
+                // must neither overrun them nor overread the AVFrame, so
+                // both the row count and the copy width clamp to the
+                // smaller of the two geometries
+                int fr_rows = is_chroma
+                                  ? AV_CEIL_RSHIFT(d->frame->height,
+                                                   fdesc->log2_chroma_h)
+                                  : d->frame->height;
+                int rows = d->buf_rows[p] < fr_rows ? d->buf_rows[p] : fr_rows;
+                int row_bytes = d->buf_row_bytes[p];
+                int ls = d->frame->linesize[p];
+                // copy width: the frame's REAL row bytes (not linesize —
+                // that includes alignment padding a narrower mid-stream
+                // frame would leak into the output), clamped to the
+                // open-time buffer width
+                int fr_bytes = plane_row_bytes(
+                    (AVPixelFormat)d->frame->format, d->frame->width, p,
+                    fdesc, (fdesc->comp[0].depth > 8 ? 2 : 1));
+                int copy = fr_bytes < row_bytes ? fr_bytes : row_bytes;
+                if (ls > 0 && ls < copy) copy = ls;
+                for (int y = 0; y < rows; y++) {
                     memcpy(planes[p] + (size_t)y * row_bytes,
-                           d->frame->data[p] + (size_t)y * d->frame->linesize[p],
-                           (size_t)row_bytes);
+                           d->frame->data[p] + (size_t)y * (size_t)ls,
+                           (size_t)copy);
                 }
             }
             if (pts_out) *pts_out = pts;
@@ -768,11 +845,10 @@ EXPORT int mp_encoder_write_video(MPEncoder* e, const uint8_t* p0,
     for (int p = 0; p < nplanes && p < 4; p++) {
         if (!planes[p]) continue;
         int is_chroma = (p == 1 || p == 2);
-        int pw = is_chroma ? AV_CEIL_RSHIFT(e->vframe->width, desc->log2_chroma_w)
-                           : e->vframe->width;
         int ph = is_chroma ? AV_CEIL_RSHIFT(e->vframe->height, desc->log2_chroma_h)
                            : e->vframe->height;
-        int row_bytes = pw * bps;
+        int row_bytes = plane_row_bytes(
+            (AVPixelFormat)e->vframe->format, e->vframe->width, p, desc, bps);
         for (int y = 0; y < ph; y++) {
             memcpy(e->vframe->data[p] + (size_t)y * e->vframe->linesize[p],
                    planes[p] + (size_t)y * row_bytes, (size_t)row_bytes);
@@ -933,14 +1009,54 @@ EXPORT int mp_sws_scale_yuv(const uint8_t* sy, const uint8_t* su,
     }
     const AVPixFmtDescriptor* sdesc = av_pix_fmt_desc_get(spf);
     const AVPixFmtDescriptor* ddesc = av_pix_fmt_desc_get(dpf);
+    // this entry point's contract is PLANAR YUV (or single-component)
+    // buffers on both sides — the Python wrapper sizes dst planes as
+    // [h, w]; a packed multi-component format would need 2x-wide rows
+    // and silently overrun them, so reject it loudly instead
+    auto planar_ok = [](const AVPixFmtDescriptor* de) {
+        // FULLY planar: one component per plane, checked by comparing
+        // the components' plane indices (deliberately NOT the PLANAR
+        // flag — nv12/p010 set it yet interleave UV in one plane, which
+        // would overrun [h, w]-sized chroma buffers like packed formats).
+        if (de->nb_components == 1) return true;
+        for (int i = 1; i < de->nb_components; i++)
+            if (de->comp[i].plane == de->comp[0].plane) return false;
+        return de->nb_components <= 3 &&
+               de->comp[1].plane != de->comp[2].plane;
+    };
+    if (!planar_ok(sdesc) || !planar_ok(ddesc)) {
+        sws_freeContext(ctx);
+        set_err(err, errlen,
+                "sws_scale_yuv supports planar formats only (packed rows "
+                "would overrun the caller's [h, w] plane buffers)");
+        return -1;
+    }
+    // odd dims on a chroma-subsampled axis: swscale uses ceil chroma
+    // widths while the Python wrapper allocates floor-sized planes — a
+    // 1-byte-per-row overrun. The chain's domain model enforces even
+    // dims (config/domain.py:51); reject loudly rather than corrupt.
+    if ((sdesc->log2_chroma_w && (sw & 1)) ||
+        (sdesc->log2_chroma_h && (sh & 1)) ||
+        (ddesc->log2_chroma_w && (dw & 1)) ||
+        (ddesc->log2_chroma_h && (dh & 1))) {
+        sws_freeContext(ctx);
+        set_err(err, errlen,
+                "sws_scale_yuv: odd dimension on a chroma-subsampled axis "
+                "(chain invariant: even dims)");
+        return -1;
+    }
     int sbps = sdesc->comp[0].depth > 8 ? 2 : 1;
     int dbps = ddesc->comp[0].depth > 8 ? 2 : 1;
-    int scw = AV_CEIL_RSHIFT(sw, sdesc->log2_chroma_w);
-    int dcw = AV_CEIL_RSHIFT(dw, ddesc->log2_chroma_w);
+    // plane_row_bytes == pw*bps for every planar format; keeping the
+    // shared helper here means one definition of row geometry repo-wide
     const uint8_t* src_planes[4] = {sy, su, sv, nullptr};
-    int src_stride[4] = {sw * sbps, scw * sbps, scw * sbps, 0};
+    int src_stride[4] = {plane_row_bytes(spf, sw, 0, sdesc, sbps),
+                         plane_row_bytes(spf, sw, 1, sdesc, sbps),
+                         plane_row_bytes(spf, sw, 2, sdesc, sbps), 0};
     uint8_t* dst_planes[4] = {dy, du, dv, nullptr};
-    int dst_stride[4] = {dw * dbps, dcw * dbps, dcw * dbps, 0};
+    int dst_stride[4] = {plane_row_bytes(dpf, dw, 0, ddesc, dbps),
+                         plane_row_bytes(dpf, dw, 1, ddesc, dbps),
+                         plane_row_bytes(dpf, dw, 2, ddesc, dbps), 0};
     sws_scale(ctx, src_planes, src_stride, 0, sh, dst_planes, dst_stride);
     sws_freeContext(ctx);
     return 0;

@@ -165,6 +165,14 @@ def prepare_spinner(
     """
     import scipy.ndimage as ndi
 
+    # even dimensions are an invariant downstream: the chroma bank is the
+    # ::2 decimation of this bank, and render_core's chroma-grid crop
+    # alignment (crop_align) relies on bank dims dividing evenly — trim a
+    # stray odd row/column from user-supplied PNGs here, at the single
+    # bank entry point
+    h, w = spinner_rgba.shape[:2]
+    spinner_rgba = spinner_rgba[: h - (h % 2), : w - (w % 2)]
+
     r, g, b = (spinner_rgba[..., c].astype(np.float32) for c in range(3))
     a = spinner_rgba[..., 3].astype(np.float32) / 255.0
     # BT.601 limited-range YUV (matches ffmpeg overlay of RGBA onto yuv420p)
@@ -210,6 +218,7 @@ def render_core(
     spinner: Optional[jnp.ndarray],
     spinner_alpha: Optional[jnp.ndarray],
     black_value: float,
+    crop_align: tuple[int, int] = (1, 1),
 ) -> jnp.ndarray:
     """Traceable composite of pre-gathered frames [T, H, W] with per-frame
     stall/black masks [T] and spinner phase indices [T] — the shared body
@@ -229,17 +238,36 @@ def render_core(
         # dynamic_slice below is out of range for small renders (e.g. a
         # 90-px-tall AVPVS under the default 128-px spinner). Static
         # Python arithmetic: shapes are trace-time constants.
+        # crop_align: LUMA callers pass their content's per-axis chroma
+        # subsampling ((2,2) for 420, (1,2) for 422) so the luma crop
+        # offset stays on the chroma grid — the chroma plane's own
+        # natural offset ((sh_c-ch_c)//2) is then exactly offset/sub and
+        # the composited color stays locked to its luma (ffmpeg's
+        # overlay aligns placement the same way via hsub/vsub).
+        align_h, align_w = crop_align
+        if h % align_h or w % align_w:
+            # the chroma-lock arithmetic needs the luma dims on the
+            # chroma grid; the domain model guarantees even dims
+            # (config/domain.py:51) — fail loudly instead of fringing
+            raise ValueError(
+                f"render_core: plane {h}x{w} not divisible by "
+                f"crop_align {crop_align}"
+            )
         sh, sw = spinner.shape[-2], spinner.shape[-1]
         ch, cw = min(sh, h), min(sw, w)
         if (ch, cw) != (sh, sw):
-            cy, cx = (sh - ch) // 2, (sw - cw) // 2
+            cy = ((sh - ch) // 2 // align_h) * align_h
+            cx = ((sw - cw) // 2 // align_w) * align_w
             spinner = spinner[..., cy:cy + ch, cx:cx + cw]
             spinner_alpha = spinner_alpha[..., cy:cy + ch, cx:cx + cw]
         sp = jnp.take(jnp.asarray(spinner), phases, axis=0)
         sa = jnp.take(jnp.asarray(spinner_alpha), phases, axis=0)
         sa = sa * stall_b  # only composite on stall frames
-        y0 = (h - ch) // 2
-        x0 = (w - cw) // 2
+        # placement offsets align to the chroma grid the same way the
+        # crop offsets do (ffmpeg overlay masks x/y via hsub/vsub): the
+        # chroma plane's natural (h_c-ch_c)//2 is then exactly offset/sub
+        y0 = ((h - ch) // 2 // align_h) * align_h
+        x0 = ((w - cw) // 2 // align_w) * align_w
         blend = jax.vmap(_blend_plane, in_axes=(0, 0, 0, None, None))
         out = blend(out, sp, sa, y0, x0)
     return out
@@ -251,24 +279,27 @@ def render_stalled_plane(
     spinner: Optional[jnp.ndarray] = None,
     spinner_alpha: Optional[jnp.ndarray] = None,
     black_value: float = 16.0,
+    crop_align: tuple[int, int] = (1, 1),
 ) -> jnp.ndarray:
     """Apply a StallPlan to one plane tensor [T, H, W] (float32 0-255).
 
     spinner: [R, h, w] rotation bank for THIS plane (chroma callers pass the
-    subsampled bank), spinner_alpha likewise [R, h, w]. Returns [T_out, H, W].
-    """
+    subsampled bank), spinner_alpha likewise [R, h, w]. Luma callers of
+    subsampled content pass crop_align=(sub_h, sub_w) (see render_core).
+    Returns [T_out, H, W]."""
     gathered = jnp.take(frames, jnp.asarray(plan.src_idx), axis=0)
     return render_core(
         gathered,
         jnp.asarray(plan.stall_mask, jnp.float32),
         jnp.asarray(plan.black_mask, jnp.float32),
         jnp.asarray(plan.phase),
-        spinner, spinner_alpha, black_value,
+        spinner, spinner_alpha, black_value, crop_align,
     )
 
 
 def make_sharded_stall_renderer(
-    mesh, banks: tuple, black_values: tuple, ten_bit: bool
+    mesh, banks: tuple, black_values: tuple, ten_bit: bool,
+    chroma_sub: tuple[int, int] = (1, 1),
 ):
     """Jit the stall composite over a (pvs=N,) frame-parallel mesh: the
     blend is frame-local, so the chunked stalling pass shards its frames
@@ -286,12 +317,12 @@ def make_sharded_stall_renderer(
 
     def shard_fn(y, u, v, stall, black, phase):
         outs = []
-        for p, sp, sa, bv in (
-            (y, sp_y, sa_y, black_values[0]),
-            (u, sp_u, sa_c, black_values[1]),
-            (v, sp_v, sa_c, black_values[2]),
+        for p, sp, sa, bv, align in (
+            (y, sp_y, sa_y, black_values[0], chroma_sub),  # luma: align
+            (u, sp_u, sa_c, black_values[1], (1, 1)),      # to chroma grid
+            (v, sp_v, sa_c, black_values[2], (1, 1)),
         ):
-            r = render_core(p, stall, black, phase, sp, sa, bv)
+            r = render_core(p, stall, black, phase, sp, sa, bv, align)
             outs.append(jnp.clip(jnp.floor(r + 0.5), 0, hi).astype(dt))
         return tuple(outs)
 

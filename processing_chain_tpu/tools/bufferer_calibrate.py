@@ -61,7 +61,7 @@ def calibrate(
     with VideoReader(rendered_path) as r:
         fps = r.fps
         planes, _ = r.read_all()
-    luma = planes[0]
+    luma = planes[0]  # always planar: the reader deinterleaves packed clips
     n_out = luma.shape[0]
     expected_inserted = sum(int(round(float(d) * fps)) for _, d in events)
     report: dict = {

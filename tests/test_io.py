@@ -187,3 +187,33 @@ def test_missing_file_error():
         VideoReader("/nonexistent/nope.mp4")
     with pytest.raises(MediaError):
         medialib.probe("/nonexistent/nope.mp4")
+
+
+def test_reader_deinterleaves_packed_uyvy(tmp_path):
+    """Packed containers present as planar at the reader boundary: a
+    uyvy422 rawvideo file reads back as yuv422p planes whose luma equals
+    the packed Y bytes that were written (every consumer downstream holds
+    a planar contract, like the reference's ffmpeg-converted frames)."""
+    import numpy as np
+
+    from processing_chain_tpu.io import VideoReader, VideoWriter
+    from processing_chain_tpu.ops import pixfmt as pf
+
+    rng = np.random.default_rng(3)
+    h, w, n = 32, 64, 4
+    ys = rng.integers(16, 235, (n, h, w), np.uint8)
+    us = rng.integers(16, 240, (n, h, w // 2), np.uint8)
+    vs = rng.integers(16, 240, (n, h, w // 2), np.uint8)
+    path = str(tmp_path / "packed.avi")
+    with VideoWriter(path, "rawvideo", w, h, "uyvy422", (24, 1)) as wr:
+        for i in range(n):
+            packed = np.asarray(pf.pack_uyvy422(ys[i], us[i], vs[i]))
+            wr.write(packed)
+    with VideoReader(path) as r:
+        assert r.container_pix_fmt == "uyvy422"
+        assert r.pix_fmt == "yuv422p"  # the planar view consumers see
+        assert r.plane_shapes == [(h, w), (h, w // 2), (h, w // 2)]
+        planes, _ = r.read_all()
+    np.testing.assert_array_equal(planes[0], ys)
+    np.testing.assert_array_equal(planes[1], us)
+    np.testing.assert_array_equal(planes[2], vs)

@@ -314,6 +314,64 @@ def test_render_spinner_larger_than_frame_clips():
     assert out[0, 0, 0] == 200.0
 
 
+def test_spinner_crop_keeps_chroma_locked_to_luma():
+    """When the bank crop offset would be odd on the luma grid, luma
+    callers align it down to even (crop_align=(2,2)) so the chroma plane's
+    natural floor-div offset is exactly half of it — composited color
+    stays locked to its luma (no one-row fringe)."""
+    import jax.numpy as jnp
+
+    h_l, w_l = 90, 160          # frame luma grid (odd natural offset case)
+    sh_l, sw_l = 128, 128       # bank luma grid
+    # luma bank encodes its own row index; chroma bank likewise
+    bank_l = jnp.broadcast_to(
+        jnp.arange(sh_l, dtype=jnp.float32)[:, None], (1, sh_l, sw_l)
+    )
+    bank_c = jnp.broadcast_to(
+        jnp.arange(sh_l // 2, dtype=jnp.float32)[:, None],
+        (1, sh_l // 2, sw_l // 2),
+    )
+    ones_l = jnp.ones((1, sh_l, sw_l), jnp.float32)
+    ones_c = jnp.ones((1, sh_l // 2, sw_l // 2), jnp.float32)
+    stall = jnp.ones((1,), jnp.float32)
+    black = jnp.ones((1,), jnp.float32)
+    phase = jnp.zeros((1,), jnp.int32)
+    oy = np.asarray(overlay.render_core(
+        jnp.zeros((1, h_l, w_l), jnp.float32), stall, black, phase,
+        bank_l, ones_l, 16.0, crop_align=(2, 2),
+    ))
+    oc = np.asarray(overlay.render_core(
+        jnp.zeros((1, h_l // 2, w_l // 2), jnp.float32), stall, black,
+        phase, bank_c, ones_c, 128.0,
+    ))
+    # luma crop offset: (128-90)//2=19 -> aligned to 18; chroma natural:
+    # (64-45)//2=9 == 18/2 — locked. Sample inside the width-centered
+    # spinner (x0=16 luma / 8 chroma); outside is black background.
+    assert oy[0, 0, 0] == 16.0 and oc[0, 0, 0] == 128.0  # background
+    assert oy[0, 0, 20] == 18.0 and oy[0, -1, 20] == 18.0 + h_l - 1
+    assert oc[0, 0, 10] == 9.0 and oc[0, -1, 10] == 9.0 + h_l // 2 - 1
+    assert oc[0, 0, 10] * 2 == oy[0, 0, 20]
+
+    # placement case (spinner FITS; odd natural luma offset): frame 70
+    # tall, bank 32 -> luma y0 19 aligned to 18; chroma (35-16)//2=9=18/2
+    h2 = 70
+    oy2 = np.asarray(overlay.render_core(
+        jnp.zeros((1, h2, w_l), jnp.float32), stall, black, phase,
+        jnp.full((1, 32, 32), 99.0), jnp.ones((1, 32, 32), jnp.float32),
+        16.0, crop_align=(2, 2),
+    ))
+    oc2 = np.asarray(overlay.render_core(
+        jnp.zeros((1, h2 // 2, w_l // 2), jnp.float32), stall, black,
+        phase, jnp.full((1, 16, 16), 77.0),
+        jnp.ones((1, 16, 16), jnp.float32), 128.0,
+    ))
+    y_rows = np.flatnonzero(oy2[0, :, w_l // 2] == 99.0)
+    c_rows = np.flatnonzero(oc2[0, :, w_l // 4] == 77.0)
+    assert y_rows[0] == 18 and len(y_rows) == 32
+    assert c_rows[0] == 9 and len(c_rows) == 16
+    assert c_rows[0] * 2 == y_rows[0]
+
+
 def test_downsample_alpha():
     a = np.zeros((2, 8, 8), np.float32)
     a[:, :4, :4] = 1.0

@@ -175,6 +175,17 @@ def test_p04_cpvs(short_db):
     assert v["codec_name"] == "rawvideo"
     assert v["pix_fmt"] == "uyvy422"
     assert (v["width"], v["height"]) == (320, 180)
+    # content round-trip: the CPVS luma must equal the AVPVS luma exactly
+    # — regression for the packed row-width bug that scrambled every
+    # rawvideo CPVS (native row_bytes = pw*bps undercounts packed rows by
+    # 2x). The reader presents the packed container as planar yuv422p.
+    with VideoReader(cp) as r:
+        assert r.container_pix_fmt == "uyvy422" and r.pix_fmt == "yuv422p"
+        cp_planes, _ = r.read_all()
+    with VideoReader(os.path.join(db, "avpvs", "P2SXM90_SRC000_HRC000.avi")) as r:
+        av_planes, _ = r.read_all()
+    assert cp_planes[1].shape[-1] * 2 == cp_planes[0].shape[-1]  # 422 chroma
+    np.testing.assert_array_equal(cp_planes[0], av_planes[0])
 
 
 def test_memoization_skips_existing(short_db, caplog):
@@ -635,6 +646,34 @@ def test_remove_intermediate_deletes_wo_buffer(tmp_path):
     files = os.listdir(avdir)
     assert "P2SXM89_SRC000_HRC000.avi" in files
     assert not [f for f in files if "wo_buffer" in f], files
+
+
+def test_p04_pads_small_avpvs_to_display(tmp_path):
+    """A 16:9 SRC under a 4:3 pc context: the AVPVS keeps the SRC height
+    (reference calculate_avpvs_video_dimensions :54-55, aspect mismatch)
+    and p04 letterboxes it to the display size (create_cpvs :1183-1186):
+    output is display-sized, borders black, content centered."""
+    yaml_text = minimal_short_yaml("P2SXM87").replace(
+        "displayWidth: 160, displayHeight: 90, codingWidth: 160, "
+        "codingHeight: 90",
+        "displayWidth: 320, displayHeight: 240, codingWidth: 320, "
+        "codingHeight: 240",
+    )
+    assert "codingHeight: 240" in yaml_text  # replace() really matched
+    yaml_path = write_db(tmp_path, "P2SXM87", yaml_text,
+                         {"SRC000.avi": dict(n=24)})
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "134",
+                   "--skip-requirements"])
+    assert rc == 0
+    cp = os.path.join(os.path.dirname(yaml_path), "cpvs",
+                      "P2SXM87_SRC000_HRC000_PC.avi")
+    with VideoReader(cp) as r:
+        assert (r.width, r.height) == (320, 240)
+        planes, _ = r.read_all()
+    luma = planes[0][0].astype(float)  # reader deinterleaves packed uyvy
+    assert luma[10, :].mean() < 24      # top letterbox band (30 px): black
+    assert luma[230, :].mean() < 24     # bottom band: black
+    assert luma[120, :].mean() > 60     # centered content band
 
 
 def test_p04_rawvideo_preview_and_ccrf(short_db):
