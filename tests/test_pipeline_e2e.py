@@ -561,9 +561,10 @@ def test_vp9_av1_segments_and_metadata(tmp_path):
 
 
 def test_ten_bit_src_chain(tmp_path):
-    """A 10-bit SRC through p01+p03: the encode target inherits the
+    """A 10-bit SRC through p01+p03+p04: the encode target inherits the
     '10le' suffix (reference lib/ffmpeg.py:447-480 harmonization), x265
-    encodes Main 10, and the AVPVS keeps the 10-bit depth end to end."""
+    encodes Main 10, the AVPVS keeps the 10-bit depth, and the PC CPVS
+    encodes v210 whose decoded luma is byte-exact vs the AVPVS."""
     yaml_path = write_db(tmp_path, "P2SXM94",
                          minimal_short_yaml("P2SXM94", codec="h265",
                                             encoder="libx265", iframe=2,
@@ -585,6 +586,21 @@ def test_ten_bit_src_chain(tmp_path):
     # content really is 10-bit range (SRC luma ~120<<2), not 8-bit values
     assert 300 < planes[0].mean() < 800
 
+    # p04: the 10-bit PC context encodes v210 from planar yuv422p10le
+    # (reference create_cpvs :1177-1201 via the format map); the decoded
+    # CPVS luma must match the AVPVS luma exactly (10-bit 422 lift keeps
+    # luma untouched)
+    rc = cli_main(["p04", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+    cp = os.path.join(db, "cpvs", "P2SXM94_SRC000_HRC000_PC.avi")
+    cinfo = [s for s in medialib.probe(cp)["streams"]
+             if s["codec_type"] == "video"][0]
+    assert cinfo["codec_name"] == "v210"
+    with VideoReader(cp) as r:
+        # the v210 decoder emits planar 10-bit 422
+        assert "422" in r.pix_fmt and "10" in r.pix_fmt
+        cp_planes, _ = r.read_all()
+    np.testing.assert_array_equal(cp_planes[0], planes[0])
 
 
 def test_dry_run_plans_without_writing(tmp_path, caplog):
