@@ -39,6 +39,14 @@ def write_db(tmp_path, db_id, yaml_text, src_specs):
     return str(db / f"{db_id}.yaml")
 
 
+def luma_psnr(deg: np.ndarray, ref: np.ndarray) -> float:
+    """Global luma PSNR (dB) on the 8-bit scale for content-sanity
+    asserts; shapes must match exactly (catches frame-count drift)."""
+    assert deg.shape == ref.shape, (deg.shape, ref.shape)
+    mse = np.mean((deg.astype(float) - ref.astype(float)) ** 2)
+    return 10 * np.log10(255.0 ** 2 / max(mse, 1e-9))
+
+
 def minimal_short_yaml(db_id, *, codec="h264", encoder="libx264", passes=1,
                        iframe=1, w=160, h=90, bitrate=200, pp_type="pc"):
     """Single-SRC/single-HRC short DB boilerplate shared by the focused
@@ -716,6 +724,14 @@ def test_p04_rawvideo_preview_and_ccrf(short_db):
     pinfo = [s for s in medialib.probe(prev)["streams"]
              if s["codec_type"] == "video"][0]
     assert pinfo["codec_name"] == "prores"
+    # content sanity: ProRes is visually lossless — preview luma (10-bit)
+    # must track the AVPVS luma closely after depth normalization
+    with VideoReader(prev) as r:
+        pv, _ = r.read_all()
+    with VideoReader(os.path.join(db, "avpvs",
+                                  "P2SXM90_SRC000_HRC000.avi")) as r:
+        av, _ = r.read_all()
+    assert luma_psnr(pv[0].astype(float) / 4.0, av[0]) > 40.0
     # leave the fixture as later tests expect it (avi from the -a-less run
     # is untouched; the extra mkv/mov artifacts are additive)
 
@@ -737,6 +753,17 @@ def test_p04_mobile_ccrf_effect(tmp_path):
                        "--force", "-ccrf", str(crf)])
         assert rc == 0
         sizes[crf] = os.path.getsize(out)
+        if crf == 10:
+            # content sanity at high quality: mobile luma tracks the
+            # AVPVS closely (catches scrambled/shifted writes the way
+            # the byte-exact pins do for the lossless contexts)
+            with VideoReader(out) as r:
+                mo, _ = r.read_all()
+            with VideoReader(os.path.join(
+                db, "avpvs", "P2SXM92_SRC000_HRC000.avi"
+            )) as r:
+                av, _ = r.read_all()
+            assert luma_psnr(mo[0], av[0]) > 35.0
     assert sizes[10] > 2 * sizes[45], sizes
 
 
