@@ -145,6 +145,13 @@ def test_p02_metadata(short_db):
     assert len(vfi) == 48
     assert vfi["frame_type"].iloc[0] == "I"
     assert (vfi["size"] > 0).all()
+    # internal consistency (reference p02:112-116): the recomputed
+    # qchanges video_bitrate IS round(sum(exact sizes)/1024*8/duration, 2)
+    want = round(
+        vfi["size"].sum() / 1024 * 8 / qch["video_duration"].iloc[0], 2
+    )
+    # approx: the CSV round-trip of video_duration is not ulp-exact
+    assert qch["video_bitrate"].iloc[0] == pytest.approx(want, abs=0.011)
 
     buff = open(os.path.join(db, "buffEventFiles", "P2SXM90_SRC000_HRC002.buff")).read()
     assert buff.strip() == "[2, 0.5]"
