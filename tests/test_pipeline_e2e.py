@@ -743,6 +743,33 @@ def test_p04_rawvideo_preview_and_ccrf(short_db):
     # is untouched; the extra mkv/mov artifacts are additive)
 
 
+def test_p03_avpvs_src_fps_flag(tmp_path):
+    """-z pins the short-test AVPVS rate to the SRC fps instead of the
+    segment's (reference create_avpvs_short :940-1000): a 12 fps quality
+    level under a 24 fps SRC renders 24 frames by default and 48 with -z
+    (the reference's -z has the {src_framerate} literal bug — SURVEY §7
+    do-not-copy list — so the fixed behavior is pinned here)."""
+    yaml_text = minimal_short_yaml("P2SXM83").replace("fps: 24}", "fps: 12}")
+    yaml_path = write_db(tmp_path, "P2SXM83", yaml_text,
+                         {"SRC000.avi": dict(n=48)})
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "13", "--skip-requirements"])
+    assert rc == 0
+    av = os.path.join(os.path.dirname(yaml_path), "avpvs",
+                      "P2SXM83_SRC000_HRC000.avi")
+    with VideoReader(av) as r:
+        assert r.fps == pytest.approx(12.0)
+        planes, _ = r.read_all()
+    assert planes[0].shape[0] == 24  # 2 s at the segment's 12 fps
+
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements",
+                   "--force", "-z"])
+    assert rc == 0
+    with VideoReader(av) as r:
+        assert r.fps == pytest.approx(24.0)  # SRC fps
+        planes, _ = r.read_all()
+    assert planes[0].shape[0] == 48  # frames duplicated up to SRC rate
+
+
 def test_p04_mobile_ccrf_effect(tmp_path):
     """-ccrf must actually reach the mobile x264 encode: the same AVPVS
     rendered at CRF 10 vs CRF 45 differs drastically in size (reference
