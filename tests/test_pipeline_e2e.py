@@ -743,6 +743,42 @@ def test_p04_rawvideo_preview_and_ccrf(short_db):
     # is untouched; the extra mkv/mov artifacts are additive)
 
 
+def test_p03_custom_spinner_path(tmp_path):
+    """-s feeds a user spinner PNG into the stall composite (reference
+    p03 -s/--spinner-path, parse_args.py:96-111): a solid green spinner
+    makes the stall frames green-tinted (default is white/neutral)."""
+    from PIL import Image
+
+    yaml_text = minimal_short_yaml("P2SXM82").replace(
+        "eventList: [[Q0, 2]]", "eventList: [[Q0, 2], [stall, 0.5]]"
+    )
+    yaml_path = write_db(tmp_path, "P2SXM82", yaml_text,
+                         {"SRC000.avi": dict(n=48)})
+    spinner = np.zeros((64, 64, 4), np.uint8)
+    spinner[..., 1] = 255      # pure green
+    spinner[16:48, 16:48, 3] = 255  # opaque square core
+    sp_path = str(tmp_path / "green.png")
+    Image.fromarray(spinner, "RGBA").save(sp_path)
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "1", "--skip-requirements"])
+    assert rc == 0
+    # -s is a p03-only flag, as in the reference's per-script CLIs
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements",
+                   "-s", sp_path])
+    assert rc == 0
+    av = os.path.join(os.path.dirname(yaml_path), "avpvs",
+                      "P2SXM82_SRC000_HRC000.avi")
+    with VideoReader(av) as r:
+        planes, _ = r.read_all()
+    stall_idx = 55  # stall frames appended after the 48 played ones
+    # green in BT.601: high luma, LOW V (red-difference) vs neutral 128;
+    # sample around the V plane's own center, where the spinner sits
+    vc_h = planes[2].shape[1] // 2
+    vc_w = planes[2].shape[2] // 2
+    core_v = planes[2][stall_idx, vc_h - 6:vc_h + 6, vc_w - 6:vc_w + 6]
+    assert core_v.mean() < 100, core_v.mean()
+    assert planes[0][stall_idx].max() > 100  # spinner core visible
+
+
 def test_p03_avpvs_src_fps_flag(tmp_path):
     """-z pins the short-test AVPVS rate to the SRC fps instead of the
     segment's (reference create_avpvs_short :940-1000): a 12 fps quality
