@@ -344,3 +344,25 @@ def test_defaults_override_rejects_missing_path(tmp_path):
     defaults.write_text("avpvs: /nonexistent/path\n")
     with pytest.raises(ConfigError, match="does not exist"):
         TestConfig(yaml_path, prober=prober, defaults_file=str(defaults))
+
+
+def test_codec_encoder_mismatch_rejected(tmp_path):
+    """A quality level's codec must match its coding's encoder family
+    (reference :255-263 cross-check)."""
+    yaml_path, prober = write_short_db(tmp_path)
+    import yaml as _yaml
+    data = _yaml.safe_load(open(yaml_path))
+    data["qualityLevelList"]["Q0"]["videoCodec"] = "vp9"  # encoder libx264
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    with pytest.raises(ConfigError, match="different codecs"):
+        TestConfig(yaml_path, prober=prober)
+
+
+def test_unknown_filter_matches_nothing(tmp_path):
+    """A typo'd filter silently selects zero PVSes (reference behavior:
+    filters subset; nothing matches -> empty plan, no crash)."""
+    yaml_path, prober = write_short_db(tmp_path)
+    tc = TestConfig(yaml_path, prober=prober, filter_pvses="P2SXM00_TYPO_XX")
+    assert len(tc.pvses) == 0
+    assert len(tc.get_required_segments()) == 0
