@@ -37,3 +37,18 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+@pytest.fixture
+def chain_log(caplog):
+    """caplog wired to the chain's non-propagating 'main' logger (INFO+):
+    the single home of the attach/detach idiom."""
+    import logging
+
+    logger = logging.getLogger("main")
+    logger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(logging.INFO, logger="main"):
+            yield caplog
+    finally:
+        logger.removeHandler(caplog.handler)

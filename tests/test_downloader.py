@@ -348,22 +348,12 @@ def test_load_bitmovin_settings_empty_key(tmp_path):
         dl.load_bitmovin_settings(str(d))
 
 
-def test_make_chunk_store_non_sftp_warns(caplog):
-    import logging
-
+def test_make_chunk_store_non_sftp_warns(chain_log):
     from processing_chain_tpu.services import downloader as dl
 
-    # the chain logger disables propagation once configured; route it
-    # through caplog's handler directly for the assertion
-    logger = logging.getLogger("main")
-    logger.addHandler(caplog.handler)
-    try:
-        with caplog.at_level(logging.WARNING, logger="main"):
-            s = dl.BitmovinSettings("k", {}, {"type": "azure"})
-            assert dl.make_chunk_store(s) is None
-    finally:
-        logger.removeHandler(caplog.handler)
-    assert any("no chunk-fetch support" in r.message for r in caplog.records)
+    s = dl.BitmovinSettings("k", {}, {"type": "azure"})
+    assert dl.make_chunk_store(s) is None
+    assert any("no chunk-fetch support" in r.message for r in chain_log.records)
 
 
 def test_downloader_from_settings_without_dir(tmp_path):

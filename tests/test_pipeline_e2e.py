@@ -203,10 +203,18 @@ def test_p04_cpvs(short_db):
     np.testing.assert_array_equal(cp_planes[0], av_planes[0])
 
 
-def test_memoization_skips_existing(short_db, caplog):
-    # re-run p01: everything exists, nothing should be re-encoded
+def test_memoization_skips_existing(short_db, chain_log):
+    """Re-running p01 with everything present must skip (not re-encode):
+    the filesystem is the checkpoint system (reference ffmpeg.py:786-788
+    skip-existing semantics)."""
+    seg = os.path.join(os.path.dirname(short_db), "videoSegments",
+                       "P2SXM90_SRC000_Q0_VC01_0000_0-2.mp4")
+    mtime_before = os.path.getmtime(seg)
     rc = cli_main(["p01", "-c", short_db, "--skip-requirements"])
     assert rc == 0
+    # the artifact was not rewritten, and the skip was announced
+    assert os.path.getmtime(seg) == mtime_before
+    assert any("exist" in r.getMessage() for r in chain_log.records), chain_log.text
 
 
 def test_filters_subset(short_db):
@@ -618,28 +626,18 @@ def test_ten_bit_src_chain(tmp_path):
     np.testing.assert_array_equal(cp_planes[0], planes[0])
 
 
-def test_dry_run_plans_without_writing(tmp_path, caplog):
+def test_dry_run_plans_without_writing(tmp_path, chain_log):
     """-n walks the full 4-stage plan (the reference prints the shell
     commands it would run; here the job graph logs instead) and must
     leave every artifact folder empty."""
-    import logging
-
     yaml_path = write_db(tmp_path, "P2SXM93", minimal_short_yaml("P2SXM93"),
                          {"SRC000.avi": dict(n=48)})
-    # the chain logger disables propagation once configured; route it
-    # through caplog's handler directly (same idiom as test_downloader)
-    logger = logging.getLogger("main")
-    logger.addHandler(caplog.handler)
-    try:
-        with caplog.at_level(logging.INFO, logger="main"):
-            rc = cli_main(["p00", "-c", yaml_path, "-n", "--skip-requirements"])
-    finally:
-        logger.removeHandler(caplog.handler)
+    rc = cli_main(["p00", "-c", yaml_path, "-n", "--skip-requirements"])
     assert rc == 0
     # the plan was actually walked: one [dry-run] line per job — p01
     # segment, p02 metadata, p03 avpvs, p04 cpvs
-    dry = [r for r in caplog.records if "[dry-run]" in r.getMessage()]
-    assert len(dry) >= 4, caplog.text
+    dry = [r for r in chain_log.records if "[dry-run]" in r.getMessage()]
+    assert len(dry) >= 4, chain_log.text
     db = os.path.dirname(yaml_path)
     for d in ("videoSegments", "qualityChangeEventFiles",
               "videoFrameInformation", "avpvs", "cpvs"):
