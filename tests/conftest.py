@@ -42,13 +42,19 @@ def devices8():
 @pytest.fixture
 def chain_log(caplog):
     """caplog wired to the chain's non-propagating 'main' logger (INFO+):
-    the single home of the attach/detach idiom."""
+    the single home of the attach/detach idiom. Propagation is pinned off
+    for the duration — before the first cli_main configures the logger it
+    still propagates to root, where caplog's handler would capture every
+    record a second time (order-dependent double counts)."""
     import logging
 
     logger = logging.getLogger("main")
+    was_propagating = logger.propagate
+    logger.propagate = False
     logger.addHandler(caplog.handler)
     try:
         with caplog.at_level(logging.INFO, logger="main"):
             yield caplog
     finally:
         logger.removeHandler(caplog.handler)
+        logger.propagate = was_propagating

@@ -741,6 +741,51 @@ def test_p04_rawvideo_preview_and_ccrf(short_db):
     # is untouched; the extra mkv/mov artifacts are additive)
 
 
+def test_multihost_p01_shards_are_disjoint_and_complete(tmp_path, monkeypatch):
+    """Two-'host' CLI runs (JAX_NUM_PROCESSES/JAX_PROCESS_ID, barriers
+    disabled via single-stage p01) must each encode a disjoint shard of
+    the segment list whose union is every required segment — the
+    multi-host replacement for the reference's single-host pool."""
+    yaml_text = minimal_short_yaml("P2SXM81").replace(
+        "HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}",
+        "\n  ".join(
+            f"HRC00{i}: {{videoCodingId: VC01, eventList: [[Q0, {d}]]}}"
+            for i, d in enumerate((1, 2, 3))
+        ),
+    ).replace(
+        "- P2SXM81_SRC000_HRC000",
+        "\n  ".join(f"- P2SXM81_SRC000_HRC00{i}" for i in range(3))
+    )
+    yaml_path = write_db(tmp_path, "P2SXM81", yaml_text,
+                         {"SRC000.avi": dict(n=72)})
+    segdir = os.path.join(os.path.dirname(yaml_path), "videoSegments")
+
+    shards = []
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("PC_RUN_ID", "t-multihost")
+    mtimes_after_p0: dict = {}
+    for pid in (0, 1):
+        monkeypatch.setenv("JAX_PROCESS_ID", str(pid))
+        # --force on host 1: skip-existing would otherwise mask a
+        # broken shard (a host iterating the FULL list silently skips
+        # the other's outputs); with force, any overreach rewrites
+        # host 0's files and trips the mtime check below
+        rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"]
+                      + (["--force"] if pid else []))
+        assert rc == 0
+        done = {f for f in os.listdir(segdir) if f.endswith(".mp4")}
+        shards.append(done - (shards[0] if shards else set()))
+        if pid == 0:
+            mtimes_after_p0 = {
+                f: os.path.getmtime(os.path.join(segdir, f)) for f in done
+            }
+    assert shards[0] and shards[1], shards          # both hosts got work
+    assert len(shards[0] | shards[1]) == 3          # complete: 3 segments
+    # truly disjoint: host 1 never re-encoded host 0's shard
+    for f, t in mtimes_after_p0.items():
+        assert os.path.getmtime(os.path.join(segdir, f)) == t, f
+
+
 def test_p03_custom_spinner_path(tmp_path):
     """-s feeds a user spinner PNG into the stall composite (reference
     p03 -s/--spinner-path, parse_args.py:96-111): a solid green spinner
