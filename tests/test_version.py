@@ -1,0 +1,13 @@
+"""Runtime version/requirements checks (reference check_requirements.py)."""
+def test_version_and_requirements():
+    """get_processing_chain_version resolves (git describe or VERSION
+    fallback, reference check_requirements.py:34-40) and the requirements
+    check passes in this environment without touching the device."""
+    from processing_chain_tpu.utils.version import (
+        check_requirements,
+        get_processing_chain_version,
+    )
+
+    v = get_processing_chain_version()
+    assert isinstance(v, str) and v
+    assert check_requirements(need_device=False) is True
