@@ -366,4 +366,3 @@ def test_unknown_filter_matches_nothing(tmp_path):
     tc = TestConfig(yaml_path, prober=prober, filter_pvses="P2SXM00_TYPO_XX")
     assert len(tc.pvses) == 0
     assert len(tc.get_required_segments()) == 0
-

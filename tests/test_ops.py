@@ -738,3 +738,25 @@ def test_resize_fused_10bit_matches_banded():
     banded = np.asarray(resize.resize_frames(x, 180, 320, "bicubic", method="banded"))
     assert fused.dtype == np.uint16
     np.testing.assert_array_equal(fused, banded)
+
+
+def test_resize_golden_random_geometries():
+    """Seeded random-geometry golden fuzz vs libswscale: the fixed-case
+    goldens cover the headline ratios; this sweeps arbitrary even up/down
+    scale pairs so a tap-window or plan regression off those ratios
+    cannot hide. (Plain loop, not hypothesis: each example costs an sws
+    oracle call + a fresh jit, so the budget is a fixed 12 cases.)"""
+    rng = np.random.default_rng(20260730)
+    src = smooth_image(202, 358)
+    for _ in range(12):
+        dh = int(rng.integers(32, 500)) & ~1
+        dw = int(rng.integers(32, 900)) & ~1
+        kernel, flag = (
+            ("lanczos", medialib.SWS_LANCZOS)
+            if rng.integers(2) else ("bicubic", medialib.SWS_BICUBIC)
+        )
+        ref = medialib.sws_scale_plane(src, dw, dh, flag)
+        ours = np.asarray(resize.resize_plane(src, dh, dw, kernel))
+        diff = np.abs(ref.astype(int) - ours.astype(int))
+        assert diff.max() <= 1, (kernel, dh, dw, diff.max())
+        assert diff.mean() < 0.3, (kernel, dh, dw, diff.mean())
