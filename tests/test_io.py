@@ -125,19 +125,23 @@ def test_audio_roundtrip(tmp_path):
     assert len(afi) > 0
 
 
-def test_framesize_h264_exact(tmp_path):
-    path = str(tmp_path / "t.mp4")
-    write_test_video(path, codec="libx264", gop=12, n=24)
+def assert_h264_sizes_track_packets(path, n):
+    """Shared oracle: one exact size per frame, tracking container packet
+    sizes up to start-code vs length-prefix accounting (non-slice NALs
+    are not attributed to any frame, reference get_framesize.py:144-201;
+    the first frame additionally carries SPS/PPS/SEI slack)."""
     sizes = framesizes.get_framesize_h264(path)
-    assert len(sizes) == 24
+    assert len(sizes) == n, len(sizes)
     pk = medialib.scan_packets(path, "video")
-    # Annex-B slice sizes track container packet sizes up to start-code vs
-    # length-prefix accounting (±small constant); the first frame also
-    # excludes SPS/PPS/SEI bytes, matching reference semantics (non-slice
-    # NALs are not attributed to any frame, get_framesize.py:144-201)
     diffs = np.abs(np.array(sizes) - pk["size"])
     assert np.all(diffs[1:] < 16)
     assert diffs[0] < 1500
+
+
+def test_framesize_h264_exact(tmp_path):
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx264", gop=12, n=24)
+    assert_h264_sizes_track_packets(path, 24)
 
 
 def test_framesize_h265_exact(tmp_path):
@@ -217,3 +221,17 @@ def test_reader_deinterleaves_packed_uyvy(tmp_path):
     np.testing.assert_array_equal(planes[0], ys)
     np.testing.assert_array_equal(planes[1], us)
     np.testing.assert_array_equal(planes[2], vs)
+
+
+def test_framesize_h264_random_gop_bframes(tmp_path):
+    """Seeded sweep over GOP/B-frame structures: the NAL scan must count
+    exactly one size per frame and track container packet sizes for every
+    reordering pattern, not just the fixed-case goldens."""
+    rng = np.random.default_rng(42)
+    for i in range(4):
+        gop = int(rng.integers(1, 13))
+        bframes = int(rng.integers(0, 4))
+        path = str(tmp_path / f"g{gop}b{bframes}.mp4")
+        write_test_video(path, codec="libx264", n=24, gop=gop,
+                         bframes=bframes)
+        assert_h264_sizes_track_packets(path, 24)
