@@ -125,17 +125,22 @@ def test_audio_roundtrip(tmp_path):
     assert len(afi) > 0
 
 
-def assert_h264_sizes_track_packets(path, n):
-    """Shared oracle: one exact size per frame, tracking container packet
-    sizes up to start-code vs length-prefix accounting (non-slice NALs
-    are not attributed to any frame, reference get_framesize.py:144-201;
-    the first frame additionally carries SPS/PPS/SEI slack)."""
-    sizes = framesizes.get_framesize_h264(path)
+def _assert_sizes_track_packets(getter, path, n):
+    """Shared Annex-B size oracle: one exact size per frame, tracking the
+    container packet sizes up to start-code vs length-prefix accounting
+    (non-slice NALs are not attributed to any frame, reference
+    get_framesize.py:144-263; the first frame additionally carries
+    parameter-set/SEI slack)."""
+    sizes = getter(path)
     assert len(sizes) == n, len(sizes)
     pk = medialib.scan_packets(path, "video")
     diffs = np.abs(np.array(sizes) - pk["size"])
     assert np.all(diffs[1:] < 16)
     assert diffs[0] < 1500
+
+
+def assert_h264_sizes_track_packets(path, n):
+    _assert_sizes_track_packets(framesizes.get_framesize_h264, path, n)
 
 
 def test_framesize_h264_exact(tmp_path):
@@ -144,13 +149,18 @@ def test_framesize_h264_exact(tmp_path):
     assert_h264_sizes_track_packets(path, 24)
 
 
+X265_TEST_OPTS = "crf=30:preset=ultrafast:x265-params=log-level=error"
+
+
+def assert_h265_sizes_track_packets(path, n):
+    _assert_sizes_track_packets(framesizes.get_framesize_h265, path, n)
+
+
 def test_framesize_h265_exact(tmp_path):
     path = str(tmp_path / "t.mp4")
     write_test_video(path, codec="libx265", n=24, gop=12,
-                     opts="crf=30:preset=ultrafast:x265-params=log-level=error")
-    sizes = framesizes.get_framesize_h265(path)
-    assert len(sizes) == 24
-    assert all(s > 0 for s in sizes)
+                     opts=X265_TEST_OPTS)
+    assert_h265_sizes_track_packets(path, 24)
 
 
 def test_framesize_vp9(tmp_path):
@@ -235,3 +245,16 @@ def test_framesize_h264_random_gop_bframes(tmp_path):
         write_test_video(path, codec="libx264", n=24, gop=gop,
                          bframes=bframes)
         assert_h264_sizes_track_packets(path, 24)
+
+
+def test_framesize_h265_random_gop_bframes(tmp_path):
+    """Seeded sweep over GOP/B-frame structures for the H.265 NAL scan:
+    exactly one size per frame under every reordering pattern."""
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        gop = int(rng.integers(1, 13))
+        bframes = int(rng.integers(0, 4))
+        path = str(tmp_path / f"g{gop}b{bframes}.mp4")
+        write_test_video(path, codec="libx265", n=24, gop=gop,
+                         bframes=bframes, opts=X265_TEST_OPTS)
+        assert_h265_sizes_track_packets(path, 24)
