@@ -44,7 +44,6 @@ import json
 import os
 import subprocess
 import sys
-import tempfile
 import time
 
 import numpy as np
@@ -56,9 +55,182 @@ ITERS = int(os.environ.get("BENCH_ITERS", "20"))
 DEADLINE = float(os.environ.get("BENCH_DEADLINE", "240"))
 _T0 = time.monotonic()
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+#: pinned single-core baseline (committed artifact; see --pin-baseline)
+BASELINE_FILE = os.path.join(_HERE, "BASELINE_MEASURED.json")
+#: best live TPU measurement persisted across runs, so a harvest whose TPU
+#: attempts hit a wedged tunnel can still report the round's real number
+LIVE_FILE = os.path.join(_HERE, "BENCH_LIVE.json")
+
 
 def _remaining() -> float:
     return DEADLINE - (time.monotonic() - _T0)
+
+
+def _host_fingerprint() -> dict:
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    import platform as _plat
+
+    return {"cpu_model": model, "cpu_count": os.cpu_count(),
+            "machine": _plat.machine()}
+
+
+def _measure_baseline(n_frames: int, deadline_at: float | None = None) -> tuple[float, int]:
+    """One single-core baseline run: swscale Lanczos 1080p->4K (luma +
+    2 chroma planes) + numpy Sobel SI / frame-diff TI per frame — the
+    reference's workload done the reference's way (single-threaded ffmpeg
+    workers: lib/cmd_utils.py:60-129, -threads 1 at lib/ffmpeg.py:790).
+    Returns (fps, frames_done)."""
+    from processing_chain_tpu.io import medialib
+    from scipy.ndimage import convolve
+
+    rng = np.random.default_rng(0)  # pinned content
+    ys = rng.integers(0, 255, size=(H, W), dtype=np.uint8)
+    kx = np.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], float)
+    t0 = time.perf_counter()
+    prev = None
+    done = 0
+    for _ in range(n_frames):
+        up = medialib.sws_scale_plane(ys, DW, DH, medialib.SWS_LANCZOS)
+        for _chroma in range(2):  # U and V, matching the device step
+            _ = medialib.sws_scale_plane(
+                np.ascontiguousarray(ys[::2, ::2]), DW // 2, DH // 2,
+                medialib.SWS_LANCZOS,
+            )
+        upf = up.astype(np.float64)
+        gx = convolve(upf, kx)[1:-1, 1:-1]
+        gy = convolve(upf, kx.T)[1:-1, 1:-1]
+        _si = np.std(np.sqrt(gx * gx + gy * gy))
+        if prev is not None:
+            _ti = np.std(upf - prev)
+        prev = upf
+        done += 1
+        if done >= 2 and deadline_at and time.perf_counter() > deadline_at:
+            break
+    return done / (time.perf_counter() - t0), done
+
+
+def pin_baseline(runs: int = 5, frames: int = 8) -> dict:
+    """Measure the pinned CPU baseline: median of `runs` independent
+    single-core runs over `frames` pinned-content frames each, plus the
+    host fingerprint. Writes BASELINE_MEASURED.json (VERDICT r3 #2)."""
+    fps_runs = []
+    for i in range(runs):
+        fps, done = _measure_baseline(frames)
+        fps_runs.append(round(fps, 4))
+        print(f"run {i + 1}/{runs}: {fps:.3f} f/s/core ({done} frames)",
+              file=sys.stderr, flush=True)
+    med = sorted(fps_runs)[len(fps_runs) // 2]
+    art = {
+        "protocol": {
+            "content": "rng PCG64 seed 0, 1080x1920 uint8 luma + 540x960 "
+                       "chroma pair, identical every frame",
+            "work": "swscale SWS_LANCZOS 1080p->4K (3 planes) + float64 "
+                    "Sobel SI + frame-diff TI per frame",
+            "frames_per_run": frames,
+            "runs": runs,
+            "stat": "median of per-run fps",
+            "threads": 1,
+        },
+        "runs_fps": fps_runs,
+        "cpu_core_fps": med,
+        "baseline_8core_fps": round(8.0 * med, 4),
+        "host": _host_fingerprint(),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    _dump_json_atomic(art, BASELINE_FILE)
+    return art
+
+
+def _load_json(path: str) -> dict | None:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _dump_json_atomic(obj: dict, path: str) -> None:
+    """Write via temp + os.replace so a concurrent reader (watcher vs
+    harvest) never sees a truncated file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(obj, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def _compute_code_hash() -> str:
+    """Hash of the device-path sources the measurement depends on; a live
+    cache recorded under a different hash is rejected (it measured other
+    code). Deliberately NOT the git rev: the driver's end-of-round
+    snapshot commit must not invalidate a cache whose compute path is
+    unchanged."""
+    import glob
+    import hashlib
+
+    h = hashlib.sha256()
+    for path in sorted(
+        [os.path.abspath(__file__)]
+        + glob.glob(os.path.join(_HERE, "processing_chain_tpu", "ops", "*.py"))
+        + glob.glob(os.path.join(_HERE, "processing_chain_tpu", "parallel", "*.py"))
+    ):
+        try:
+            with open(path, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            pass
+    return h.hexdigest()[:16]
+
+
+class _DeviceLock:
+    """flock-based mutual exclusion for ALL axon-tunnel clients (bench
+    harvest, tools/tpu_watch.sh) — concurrent clients are what wedge the
+    tunnel (see memory/VERDICT r3). Lockfile lives under the 0700 cache
+    dir, not /tmp."""
+
+    def __init__(self) -> None:
+        d = os.path.join(os.path.expanduser("~"), ".cache")
+        try:
+            os.makedirs(d, mode=0o700, exist_ok=True)
+        except OSError:
+            d = _HERE
+        self.path = os.path.join(d, f"pc_tpu_device_{os.getuid()}.lock")
+        self._fh = None
+
+    def acquire(self, timeout_s: float) -> bool:
+        import fcntl
+
+        self._fh = open(self.path, "w")
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                fcntl.flock(self._fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return True
+            except OSError:
+                if time.monotonic() >= deadline:
+                    self._fh.close()
+                    self._fh = None
+                    return False
+                time.sleep(2.0)
+
+    def release(self) -> None:
+        if self._fh is not None:
+            import fcntl
+
+            try:
+                fcntl.flock(self._fh, fcntl.LOCK_UN)
+            finally:
+                self._fh.close()
+                self._fh = None
 
 
 def _child() -> None:
@@ -215,11 +387,16 @@ def _run_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
     # compile. The banded child traces a DIFFERENT program, so it gains
     # nothing within a single run. Best-effort: measured no-op on this
     # image's CPU backend, and the axon tunnel may compile remotely —
-    # harmless in both cases.
-    env.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(tempfile.gettempdir(), "pc_bench_jax_cache"),
+    # harmless in both cases. Per-user + 0700 so another tenant can
+    # neither pre-create nor tamper with deserialized executables.
+    cache_dir = os.path.join(
+        os.path.expanduser("~"), ".cache", f"pc_bench_jax_cache_{os.getuid()}"
     )
+    try:
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        env.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    except OSError:
+        pass  # unwritable home: run without a persistent cache
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"],
@@ -275,54 +452,91 @@ def main() -> None:
     # tunnel takes 20-40 s and a warm full child run ~15 s.
     errors: list[str] = []
     res = None
-    for attempt in (1, 2, 3):
-        budget = _remaining() - 75  # reserve: CPU-fallback child + baseline
-        if budget < 20:
-            break
-        res, err = _run_child({}, min(budget, 100))
-        if res is not None:
-            break
-        errors.append(f"tpu attempt {attempt}: {err}")
+    lock = _DeviceLock()
+    # a watcher probe holds the lock <=150 s; waiting is cheaper than
+    # wedging the tunnel with a second concurrent client
+    if lock.acquire(timeout_s=min(160.0, max(_remaining() - 80, 0))):
+        try:
+            for attempt in (1, 2, 3):
+                budget = _remaining() - 55  # reserve: CPU-fallback child
+                if budget < 20:
+                    break
+                res, err = _run_child({}, min(budget, 100))
+                if res is not None:
+                    break
+                errors.append(f"tpu attempt {attempt}: {err}")
+        finally:
+            lock.release()
+    else:
+        errors.append("device lock busy: another tunnel client held it")
+
+    code_hash = _compute_code_hash()
+    host_model = _host_fingerprint()["cpu_model"]
+    live_used = None
+    if res is not None and res.get("platform") == "tpu":
+        # persist the newest live result (latest, not best-ever: a cached
+        # number must be one the CURRENT code can reproduce) so a future
+        # harvest whose attempts hit a wedged tunnel still reports a
+        # measured-on-TPU number
+        rec = dict(res, measured_at=time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            code_hash=code_hash, host_cpu_model=host_model)
+        try:
+            _dump_json_atomic(rec, LIVE_FILE)
+        except OSError:
+            pass
     if res is None:
-        res, err = _run_child(cpu_env, min(max(_remaining() - 30, 20), 120))
+        cached = _load_json(LIVE_FILE)
+        if cached is not None and cached.get("platform") == "tpu":
+            if (cached.get("code_hash") == code_hash
+                    and cached.get("host_cpu_model") == host_model):
+                res = cached
+                live_used = cached.get("measured_at", "unknown")
+            else:
+                errors.append(
+                    "live cache rejected: code_hash/host mismatch "
+                    f"({cached.get('code_hash')} vs {code_hash})"
+                )
+    if res is None:
+        res, err = _run_child(cpu_env, min(max(_remaining() - 10, 20), 150))
         if res is None:
             errors.append(f"cpu fallback: {err}")
     if res is None:  # last resort: never exit without the JSON line
         res = {"per_step": float("inf"), "platform": "none", "iters": 0, "t": T}
     device_fps = res.get("t", T) / res["per_step"]
 
-    # CPU single-core baseline: swscale Lanczos + numpy Sobel SI / diff TI.
-    # ≥20 frames for a stable denominator (round-1 used 2), deadline-guarded.
-    from processing_chain_tpu.io import medialib
-    from scipy.ndimage import convolve
-
-    rng = np.random.default_rng(0)
-    ys = rng.integers(0, 255, size=(H, W), dtype=np.uint8)
-    kx = np.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], float)
-    n_base = max(1, int(os.environ.get("BENCH_BASE_FRAMES", "20")))
-    base_deadline = time.perf_counter() + max(10.0, _remaining() - 20)
-    t0 = time.perf_counter()
-    prev = None
-    done = 0
-    for i in range(n_base):
-        up = medialib.sws_scale_plane(ys, DW, DH, medialib.SWS_LANCZOS)
-        for _chroma in range(2):  # U and V, matching the device step
-            _ = medialib.sws_scale_plane(
-                np.ascontiguousarray(ys[::2, ::2]), DW // 2, DH // 2,
-                medialib.SWS_LANCZOS,
-            )
-        upf = up.astype(np.float64)
-        gx = convolve(upf, kx)[1:-1, 1:-1]
-        gy = convolve(upf, kx.T)[1:-1, 1:-1]
-        _si = np.std(np.sqrt(gx * gx + gy * gy))
-        if prev is not None:
-            _ti = np.std(upf - prev)
-        prev = upf
-        done += 1
-        if done >= 4 and time.perf_counter() > base_deadline:
-            break
-    cpu_core_fps = done / (time.perf_counter() - t0)
-    baseline_8core = 8.0 * cpu_core_fps
+    # CPU single-core baseline: pinned protocol artifact when available
+    # (BASELINE_MEASURED.json, --pin-baseline), so every bench run reports
+    # vs_baseline against the SAME median-of-N denominator instead of a
+    # noisy per-run remeasurement (VERDICT r3 #2). Re-measured only when
+    # the artifact is missing (and then persisted).
+    pinned = _load_json(BASELINE_FILE)
+    if pinned and "baseline_8core_fps" in pinned:
+        baseline_8core = float(pinned["baseline_8core_fps"])
+        done = int(pinned.get("protocol", {}).get("frames_per_run", 0))
+        base_src = "pinned"
+        if pinned.get("host", {}).get("cpu_model") != _host_fingerprint()["cpu_model"]:
+            base_src = "pinned(foreign-host)"
+    else:
+        cpu_core_fps, done = _measure_baseline(
+            max(1, int(os.environ.get("BENCH_BASE_FRAMES", "20"))),
+            deadline_at=time.perf_counter() + max(10.0, _remaining() - 5),
+        )
+        baseline_8core = 8.0 * cpu_core_fps
+        base_src = "measured"
+        try:
+            pin_art = {
+                "cpu_core_fps": round(cpu_core_fps, 4),
+                "baseline_8core_fps": round(baseline_8core, 4),
+                "protocol": {"frames_per_run": done, "runs": 1,
+                             "stat": "single run (harvest fallback)"},
+                "host": _host_fingerprint(),
+                "measured_at": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            }
+            _dump_json_atomic(pin_art, BASELINE_FILE)
+        except OSError:
+            pass
 
     out = {
         "metric": "AVPVS frames/sec/chip (1080p->4K Lanczos + SI/TI)",
@@ -331,8 +545,14 @@ def main() -> None:
         "vs_baseline": round(device_fps / baseline_8core, 2),
         "platform": res["platform"],
         "baseline_8core_fps": round(baseline_8core, 2),
+        "baseline_source": base_src,
         "baseline_frames": done,
     }
+    if live_used:
+        # this run's own TPU attempts failed; the number is the best live
+        # measurement this bench persisted earlier (same host, same code)
+        out["source"] = "cached_live_run"
+        out["live_measured_at"] = live_used
     if errors:
         # env-down must be provable from the artifact alone
         out["tpu_error"] = " | ".join(errors)[-600:]
@@ -356,6 +576,7 @@ def main() -> None:
     # pair banded-vs-fused would be wrong).
     if (
         res["platform"] == "tpu"
+        and live_used is None  # a wedged tunnel would only burn the budget
         and _remaining() > 75  # cold client 20-40s + banded compile + measure
         and not os.environ.get("PC_RESIZE_METHOD")
     ):
@@ -377,5 +598,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child()
+    elif "--pin-baseline" in sys.argv:
+        print(json.dumps(pin_baseline(), indent=1))
     else:
         main()

@@ -124,6 +124,17 @@ class VideoReader:
                 f"422 expected)"
             )
         self.dtype = np.uint16 if desc.bytes_per_sample == 2 else np.uint8
+        if self._packed_offsets is not None and self.dtype != np.uint8:
+            # _deinterleave's ::2/::4 offsets are BYTE positions within a
+            # 4-byte macropixel; a 16-bit packed format (e.g. y210) would
+            # silently shear planes instead of deinterleaving them
+            lib.mp_decoder_close(self._h)
+            self._h = None
+            raise MediaError(
+                f"{path}: packed format {self.container_pix_fmt!r} with "
+                f"{desc.bytes_per_sample} bytes/sample unsupported (packed "
+                f"deinterleave is 8-bit only)"
+            )
 
     def _deinterleave(self, raw: np.ndarray) -> tuple[np.ndarray, ...]:
         """Packed 422 row bytes [h, 2w] → planar (y, u, v) copies,

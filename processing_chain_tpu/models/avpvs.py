@@ -763,10 +763,12 @@ def apply_stalling(
                         crop_align=(sub_h, sub_w),
                     )
                     ou = ov.render_stalled_plane(
-                        u, sub, sp_u, sa_c, black_value=black_values[1]
+                        u, sub, sp_u, sa_c, black_value=black_values[1],
+                        crop_align=(sub_h, sub_w), grid_scale=(sub_h, sub_w),
                     )
                     ovv = ov.render_stalled_plane(
-                        v, sub, sp_v, sa_c, black_value=black_values[2]
+                        v, sub, sp_v, sa_c, black_value=black_values[2],
+                        crop_align=(sub_h, sub_w), grid_scale=(sub_h, sub_w),
                     )
                     writer.put(fr.quantize_device([oy, ou, ovv], ten_bit))
         return out_path

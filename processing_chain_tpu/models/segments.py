@@ -35,6 +35,9 @@ _ENCODERS = {
     "libaom-av1": "libaom-av1",
 }
 
+#: requested encoders already warned about this run (warn once, not per job)
+_warned_substitutions: set = set()
+
 
 def _encoder_opts(
     segment: Segment, current_pass: int, total_passes: int,
@@ -175,9 +178,14 @@ def encode_segment(segment: Segment) -> Optional[Job]:
     encoder = _ENCODERS.get(coding.encoder)
     if encoder is None:
         raise ValueError(f"wrong encoder: {coding.encoder}")
-    if encoder != coding.encoder:
+    if encoder != coding.encoder and coding.encoder not in _warned_substitutions:
+        # once per requested encoder per run; the per-segment record lives
+        # in provenance below (reference asks nvenc via -gpu N splice,
+        # lib/parse_args.py:88-94, p01:64-68 — no NVENC on this host)
+        _warned_substitutions.add(coding.encoder)
         log.warning(
-            "encoder %s unavailable on this host; using %s",
+            "encoder %s unavailable on this host; substituting %s "
+            "(recorded in segment provenance)",
             coding.encoder, encoder,
         )
 
@@ -333,6 +341,12 @@ def encode_segment(segment: Segment) -> Optional[Job]:
                 "scale": [target_w, target_h, "bicubic"],
                 "fps": out_fps,
                 "encoder": encoder,
+                # present exactly when a requested encoder was unavailable
+                # and substituted — the provenance record of the
+                # nvenc→libx264/x265 fallback; grep for this key to find
+                # substituted segments
+                **({"encoder_requested": coding.encoder}
+                   if encoder != coding.encoder else {}),
                 "passes": passes,
                 "rate_control": (
                     {"crf": segment.quality_level.video_crf}

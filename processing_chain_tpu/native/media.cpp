@@ -431,7 +431,19 @@ EXPORT int mp_decoder_next(MPDecoder* d, uint8_t* p0, uint8_t* p1, uint8_t* p2,
                     (AVPixelFormat)d->frame->format, d->frame->width, p,
                     fdesc, (fdesc->comp[0].depth > 8 ? 2 : 1));
                 int copy = fr_bytes < row_bytes ? fr_bytes : row_bytes;
-                if (ls > 0 && ls < copy) copy = ls;
+                if (ls <= 0) {
+                    // negative linesize (vertically flipped layout) is
+                    // legal FFmpeg but the row arithmetic below would
+                    // wrap (size_t)y * ls into an out-of-bounds read;
+                    // fail loudly like the other geometry rejections
+                    set_err(err, errlen,
+                            "decoder produced non-positive linesize " +
+                                std::to_string(ls) + " on plane " +
+                                std::to_string(p));
+                    av_frame_unref(d->frame);
+                    return -1;
+                }
+                if (ls < copy) copy = ls;
                 for (int y = 0; y < rows; y++) {
                     memcpy(planes[p] + (size_t)y * row_bytes,
                            d->frame->data[p] + (size_t)y * (size_t)ls,

@@ -210,6 +210,29 @@ def _blend_plane(
     )
 
 
+def _clip_crop_origin(
+    frame_dim: int, spinner_dim: int, align: int, grid_scale: int = 1
+) -> int:
+    """Crop origin for a spinner larger than the frame, matching ffmpeg's
+    overlay clipping exactly. ffmpeg computes the placement coordinate on
+    the LUMA grid — (luma_frame - luma_spinner)/2 truncated toward zero (C
+    integer division), then masked toward -inf on the chroma grid
+    (normalize_xy: x &= ~((1<<hsub)-1)) — and shifts it down by hsub/vsub
+    for chroma planes; the crop keeps the pixels at -placement. Callers on
+    a subsampled plane pass grid_scale=sub so the SAME luma coordinate is
+    reconstructed and divided back (exact: the mask makes it a multiple of
+    sub), keeping chroma locked to luma. E.g. luma frame 90, spinner 128,
+    align 2: trunc(-19) & ~1 = -20 -> crop origin 20 (not 18, which a
+    positive floor-to-grid would give); the 420 chroma plane (45 under 64,
+    grid_scale 2) lands on 10 == 20/2."""
+    if spinner_dim <= frame_dim:  # fits on this axis: nothing to crop
+        return 0
+    lf, ls = frame_dim * grid_scale, spinner_dim * grid_scale
+    place = -((ls - lf) // 2)  # trunc toward 0: place <= 0
+    place &= ~(align - 1)  # Python & on negatives == two's-complement mask
+    return -place // grid_scale
+
+
 def render_core(
     frames: jnp.ndarray,
     stall: jnp.ndarray,
@@ -219,11 +242,17 @@ def render_core(
     spinner_alpha: Optional[jnp.ndarray],
     black_value: float,
     crop_align: tuple[int, int] = (1, 1),
+    grid_scale: tuple[int, int] = (1, 1),
 ) -> jnp.ndarray:
     """Traceable composite of pre-gathered frames [T, H, W] with per-frame
     stall/black masks [T] and spinner phase indices [T] — the shared body
     of the host-planned path (render_stalled_plane) and the mesh-sharded
-    batch path (make_sharded_stall_renderer)."""
+    batch path (make_sharded_stall_renderer).
+
+    crop_align is the ffmpeg normalize_xy mask on the LUMA grid (the
+    content's chroma subsampling); grid_scale relates THIS plane's grid to
+    the luma grid (1 for luma, sub for chroma planes), so all planes
+    derive their crop/placement from the same masked luma coordinate."""
     h, w = frames.shape[-2], frames.shape[-1]
     stall_b = stall.astype(jnp.float32)[:, None, None]
     black_b = black.astype(jnp.float32)[:, None, None]
@@ -245,29 +274,30 @@ def render_core(
         # the composited color stays locked to its luma (ffmpeg's
         # overlay aligns placement the same way via hsub/vsub).
         align_h, align_w = crop_align
-        if h % align_h or w % align_w:
+        gs_h, gs_w = grid_scale
+        if (h * gs_h) % align_h or (w * gs_w) % align_w:
             # the chroma-lock arithmetic needs the luma dims on the
             # chroma grid; the domain model guarantees even dims
             # (config/domain.py:51) — fail loudly instead of fringing
             raise ValueError(
-                f"render_core: plane {h}x{w} not divisible by "
-                f"crop_align {crop_align}"
+                f"render_core: luma-grid plane {h * gs_h}x{w * gs_w} not "
+                f"divisible by crop_align {crop_align}"
             )
         sh, sw = spinner.shape[-2], spinner.shape[-1]
         ch, cw = min(sh, h), min(sw, w)
         if (ch, cw) != (sh, sw):
-            cy = ((sh - ch) // 2 // align_h) * align_h
-            cx = ((sw - cw) // 2 // align_w) * align_w
+            cy = _clip_crop_origin(h, sh, align_h, gs_h)
+            cx = _clip_crop_origin(w, sw, align_w, gs_w)
             spinner = spinner[..., cy:cy + ch, cx:cx + cw]
             spinner_alpha = spinner_alpha[..., cy:cy + ch, cx:cx + cw]
         sp = jnp.take(jnp.asarray(spinner), phases, axis=0)
         sa = jnp.take(jnp.asarray(spinner_alpha), phases, axis=0)
         sa = sa * stall_b  # only composite on stall frames
-        # placement offsets align to the chroma grid the same way the
-        # crop offsets do (ffmpeg overlay masks x/y via hsub/vsub): the
-        # chroma plane's natural (h_c-ch_c)//2 is then exactly offset/sub
-        y0 = ((h - ch) // 2 // align_h) * align_h
-        x0 = ((w - cw) // 2 // align_w) * align_w
+        # placement offsets come off the same masked luma coordinate as
+        # the crop (ffmpeg overlay masks x/y via hsub/vsub then shifts by
+        # the plane's subsampling); positive mask == floor-to-grid
+        y0 = (((h - ch) * gs_h // 2) & ~(align_h - 1)) // gs_h
+        x0 = (((w - cw) * gs_w // 2) & ~(align_w - 1)) // gs_w
         blend = jax.vmap(_blend_plane, in_axes=(0, 0, 0, None, None))
         out = blend(out, sp, sa, y0, x0)
     return out
@@ -280,12 +310,14 @@ def render_stalled_plane(
     spinner_alpha: Optional[jnp.ndarray] = None,
     black_value: float = 16.0,
     crop_align: tuple[int, int] = (1, 1),
+    grid_scale: tuple[int, int] = (1, 1),
 ) -> jnp.ndarray:
     """Apply a StallPlan to one plane tensor [T, H, W] (float32 0-255).
 
     spinner: [R, h, w] rotation bank for THIS plane (chroma callers pass the
-    subsampled bank), spinner_alpha likewise [R, h, w]. Luma callers of
-    subsampled content pass crop_align=(sub_h, sub_w) (see render_core).
+    subsampled bank), spinner_alpha likewise [R, h, w]. All callers of
+    subsampled content pass crop_align=(sub_h, sub_w); chroma callers
+    additionally pass grid_scale=(sub_h, sub_w) (see render_core).
     Returns [T_out, H, W]."""
     gathered = jnp.take(frames, jnp.asarray(plan.src_idx), axis=0)
     return render_core(
@@ -293,7 +325,7 @@ def render_stalled_plane(
         jnp.asarray(plan.stall_mask, jnp.float32),
         jnp.asarray(plan.black_mask, jnp.float32),
         jnp.asarray(plan.phase),
-        spinner, spinner_alpha, black_value, crop_align,
+        spinner, spinner_alpha, black_value, crop_align, grid_scale,
     )
 
 
@@ -317,12 +349,16 @@ def make_sharded_stall_renderer(
 
     def shard_fn(y, u, v, stall, black, phase):
         outs = []
-        for p, sp, sa, bv, align in (
-            (y, sp_y, sa_y, black_values[0], chroma_sub),  # luma: align
-            (u, sp_u, sa_c, black_values[1], (1, 1)),      # to chroma grid
-            (v, sp_v, sa_c, black_values[2], (1, 1)),
+        for p, sp, sa, bv, gs in (
+            (y, sp_y, sa_y, black_values[0], (1, 1)),   # luma grid
+            (u, sp_u, sa_c, black_values[1], chroma_sub),
+            (v, sp_v, sa_c, black_values[2], chroma_sub),
         ):
-            r = render_core(p, stall, black, phase, sp, sa, bv, align)
+            # all planes mask on the luma grid (crop_align=chroma_sub)
+            # and divide back by their own grid scale — chroma stays
+            # locked to luma even in the oversized-spinner clip case
+            r = render_core(p, stall, black, phase, sp, sa, bv,
+                            chroma_sub, gs)
             outs.append(jnp.clip(jnp.floor(r + 0.5), 0, hi).astype(dt))
         return tuple(outs)
 

@@ -315,10 +315,12 @@ def test_render_spinner_larger_than_frame_clips():
 
 
 def test_spinner_crop_keeps_chroma_locked_to_luma():
-    """When the bank crop offset would be odd on the luma grid, luma
-    callers align it down to even (crop_align=(2,2)) so the chroma plane's
-    natural floor-div offset is exactly half of it — composited color
-    stays locked to its luma (no one-row fringe)."""
+    """ffmpeg computes the (negative) placement of an oversized overlay on
+    the luma grid — trunc toward zero, then normalize_xy masks toward -inf
+    on the chroma grid — and shifts it down per plane: luma frame 90 under
+    a 128 bank places at (int)(-19) & ~1 = -20, i.e. crop 20 (NOT 18, a
+    positive floor-to-grid). Chroma callers pass grid_scale so they derive
+    10 == 20/2 from the same coordinate — locked, no one-row fringe."""
     import jax.numpy as jnp
 
     h_l, w_l = 90, 160          # frame luma grid (odd natural offset case)
@@ -342,14 +344,15 @@ def test_spinner_crop_keeps_chroma_locked_to_luma():
     ))
     oc = np.asarray(overlay.render_core(
         jnp.zeros((1, h_l // 2, w_l // 2), jnp.float32), stall, black,
-        phase, bank_c, ones_c, 128.0,
+        phase, bank_c, ones_c, 128.0, crop_align=(2, 2),
+        grid_scale=(2, 2),
     ))
-    # luma crop offset: (128-90)//2=19 -> aligned to 18; chroma natural:
-    # (64-45)//2=9 == 18/2 — locked. Sample inside the width-centered
-    # spinner (x0=16 luma / 8 chroma); outside is black background.
+    # luma crop origin: -((int)(-19) & ~1) = 20; chroma: 20 >> 1 = 10 —
+    # locked. Sample inside the width-centered spinner (x0=16 luma /
+    # 8 chroma); outside is black background.
     assert oy[0, 0, 0] == 16.0 and oc[0, 0, 0] == 128.0  # background
-    assert oy[0, 0, 20] == 18.0 and oy[0, -1, 20] == 18.0 + h_l - 1
-    assert oc[0, 0, 10] == 9.0 and oc[0, -1, 10] == 9.0 + h_l // 2 - 1
+    assert oy[0, 0, 20] == 20.0 and oy[0, -1, 20] == 20.0 + h_l - 1
+    assert oc[0, 0, 10] == 10.0 and oc[0, -1, 10] == 10.0 + h_l // 2 - 1
     assert oc[0, 0, 10] * 2 == oy[0, 0, 20]
 
     # placement case (spinner FITS; odd natural luma offset): frame 70
@@ -363,13 +366,41 @@ def test_spinner_crop_keeps_chroma_locked_to_luma():
     oc2 = np.asarray(overlay.render_core(
         jnp.zeros((1, h2 // 2, w_l // 2), jnp.float32), stall, black,
         phase, jnp.full((1, 16, 16), 77.0),
-        jnp.ones((1, 16, 16), jnp.float32), 128.0,
+        jnp.ones((1, 16, 16), jnp.float32), 128.0, crop_align=(2, 2),
+        grid_scale=(2, 2),
     ))
     y_rows = np.flatnonzero(oy2[0, :, w_l // 2] == 99.0)
     c_rows = np.flatnonzero(oc2[0, :, w_l // 4] == 77.0)
     assert y_rows[0] == 18 and len(y_rows) == 32
     assert c_rows[0] == 9 and len(c_rows) == 16
     assert c_rows[0] * 2 == y_rows[0]
+
+
+def test_clip_crop_origin_matches_ffmpeg_normalize_xy():
+    """Sweep oversized-spinner geometries against a literal replica of
+    ffmpeg's overlay placement: x = (int)((W-w)/2) (C trunc toward zero),
+    normalize_xy masks x &= ~((1<<hsub)-1) (toward -inf), the blend clips
+    the overlay rows at -x, and chroma planes use x >> hsub."""
+
+    def ffmpeg_crop(frame_luma, spinner_luma, sub):
+        diff = frame_luma - spinner_luma
+        place = -((-diff) // 2) if diff < 0 else diff // 2  # C trunc
+        place &= ~(sub - 1)
+        luma_origin = max(0, -place)
+        return luma_origin, luma_origin // sub
+
+    for sub in (1, 2):
+        for frame in range(2, 200, 2):
+            for spinner in range(frame + 2, frame + 80, 2):
+                want_l, want_c = ffmpeg_crop(frame, spinner, sub)
+                got_l = overlay._clip_crop_origin(frame, spinner, sub, 1)
+                got_c = overlay._clip_crop_origin(
+                    frame // sub, spinner // sub, sub, sub
+                )
+                assert got_l == want_l, (frame, spinner, sub, got_l, want_l)
+                assert got_c == want_c, (frame, spinner, sub, got_c, want_c)
+                # crop stays in range: origin + kept <= spinner
+                assert got_l + min(frame, spinner) <= spinner
 
 
 def test_downsample_alpha():

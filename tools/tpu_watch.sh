@@ -1,0 +1,37 @@
+#!/bin/bash
+# Retry the TPU tunnel until it answers, then run the full benchmark so
+# bench.py persists BENCH_LIVE.json (the artifact a later harvest falls
+# back to when its own TPU attempts hit a wedged tunnel — VERDICT r3 #1).
+#
+# The axon tunnel wedges under CONCURRENT clients and ignores SIGTERM, so
+# every attempt runs under `timeout -s KILL` AND holds the same flock
+# bench.py's harvest path takes (~/.cache/pc_tpu_device_<uid>.lock) —
+# watcher and harvest can never open two tunnel clients at once.
+#
+# Usage: tools/tpu_watch.sh [interval_s] [log]
+set -u
+INTERVAL="${1:-900}"
+STATE_DIR="$HOME/.cache/pc_tpu_watch"
+mkdir -p -m 700 "$STATE_DIR" 2>/dev/null || mkdir -p "$STATE_DIR"
+LOG="${2:-$STATE_DIR/watch.log}"
+LOCK="$HOME/.cache/pc_tpu_device_$(id -u).lock"
+CHILD_JSON="$STATE_DIR/child.json"
+cd "$(dirname "$0")/.." || exit 1
+
+while :; do
+    echo "[$(date -u +%H:%M:%S)] probing tunnel" >> "$LOG"
+    # -n: if another client (a harvest) holds the device, skip this round
+    if flock -n "$LOCK" -c \
+        "timeout -s KILL 150 python bench.py --child > '$CHILD_JSON' 2>> '$LOG'" \
+        && grep -q '"platform": "tpu"' "$CHILD_JSON"; then
+        echo "[$(date -u +%H:%M:%S)] tunnel LIVE; running full bench" >> "$LOG"
+        # full bench takes the same lock itself (bench.py _DeviceLock)
+        timeout -s KILL 400 python bench.py >> "$LOG" 2>&1
+        echo "[$(date -u +%H:%M:%S)] bench done; continuing to watch" >> "$LOG"
+        # keep refreshing (latest result wins) but back off: the number is in
+        sleep $((INTERVAL * 4))
+    else
+        echo "[$(date -u +%H:%M:%S)] tunnel down (or device busy)" >> "$LOG"
+        sleep "$INTERVAL"
+    fi
+done
