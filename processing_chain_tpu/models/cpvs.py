@@ -32,15 +32,29 @@ CHUNK = 64
 
 
 def normalize_rms(samples: np.ndarray, target_dbfs: float = -23.0) -> np.ndarray:
-    """RMS loudness normalization (ffmpeg-normalize `-nt rms` equivalent)."""
+    """RMS loudness normalization — ffmpeg-normalize 1.28.3 `-nt rms`
+    semantics, reproduced step for step (reference lib/ffmpeg.py:1233-1245
+    shells out to the tool; oracle-pinned by tests/test_ops.py):
+
+    1. measure: ffmpeg volumedetect accumulates an exact power sum over
+       every sample of every channel (s16 values / 32768) and PRINTS
+       mean_volume at 0.1 dB; ffmpeg-normalize parses that printed value,
+       so the measured level is quantized to 0.1 dB before use.
+    2. gain: adjustment_db = target - mean_volume; no limiter — the tool
+       only warns when the gain would clip.
+    3. apply: the volume filter's s16 path is
+       av_clip_int16(lrintf(x * gain)) — round to nearest (ties to even),
+       clamp to [-32768, 32767].
+    """
     if samples.size == 0:
         return samples
-    x = samples.astype(np.float64) / 32768.0
-    rms = np.sqrt(np.mean(x * x))
-    if rms <= 0:
+    x = samples.astype(np.float64)
+    power = np.mean((x / 32768.0) ** 2)
+    if power <= 0:
         return samples
-    gain = 10.0 ** ((target_dbfs - 20.0 * np.log10(rms)) / 20.0)
-    return np.clip(x * gain * 32768.0, -32768, 32767).astype(np.int16)
+    mean_volume_db = round(10.0 * np.log10(power), 1)  # volumedetect print
+    gain = 10.0 ** ((target_dbfs - mean_volume_db) / 20.0)
+    return np.clip(np.rint(x * gain), -32768, 32767).astype(np.int16)
 
 
 def _avpvs_chunks(reader: VideoReader, dst_rate: Optional[float] = None):

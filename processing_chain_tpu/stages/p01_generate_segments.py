@@ -19,6 +19,10 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             cli_args.filter_pvs,
         )
 
+    # "warn once per run": a run is one p01 invocation, not the process
+    # lifetime (a long-lived caller processing several databases must warn
+    # for each)
+    seg_model._warned_substitutions.clear()
     runner = JobRunner(
         force=cli_args.force,
         dry_run=cli_args.dry_run,

@@ -1,0 +1,69 @@
+"""Worker process for the multi-process jax.distributed test
+(tests/test_parallel.py::test_multiprocess_distributed_end_to_end).
+
+Run as: python tests/_dist_worker.py <coordinator> <num_procs> <pid>
+Prints one JSON line with this process's view of the global computation.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, num, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # same axon-plugin deregistration as tests/conftest: the tunnel plugin
+    # must not initialize inside distributed workers
+    from jax._src import xla_bridge as _xb
+
+    getattr(_xb, "_backend_factories", {}).pop("axon", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from processing_chain_tpu.parallel import distributed as dist
+
+    assert dist.initialize(coordinator, num, pid) is True
+    assert jax.process_count() == num, jax.process_count()
+    assert jax.device_count() == num  # 1 CPU device per process
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from processing_chain_tpu.parallel import make_mesh
+
+    # host-level work sharding (the pool-fan-out replacement)
+    shard = dist.shard_pvs_list([f"PVS{i:02d}" for i in range(10)], pid, num)
+
+    # a tiny sharded step over the GLOBAL mesh: each process contributes
+    # its local PVS lane, the jitted reduction crosses the process
+    # boundary (the DCN-side collective path on CPU transport)
+    mesh = make_mesh(jax.devices())  # global 2-device mesh (pvs=2, time=1)
+    local = np.full((1, 4, 8, 8), float(pid + 1), np.float32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("pvs", None, None, None)), local,
+        (num, 4, 8, 8),
+    )
+    total = float(jax.jit(jnp.sum)(garr))  # cross-process psum
+
+    # per-lane device compute stays local; fully_replicated gather crosses
+    per_lane = jax.jit(
+        lambda x: jnp.mean(x, axis=(1, 2, 3)),
+        out_shardings=NamedSharding(mesh, P(None)),
+    )(garr)
+    lanes = [float(v) for v in np.asarray(per_lane)]
+
+    print(json.dumps({
+        "pid": pid,
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "shard": shard,
+        "total": total,
+        "lanes": lanes,
+    }))
+
+
+if __name__ == "__main__":
+    main()

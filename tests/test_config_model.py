@@ -366,3 +366,51 @@ def test_unknown_filter_matches_nothing(tmp_path):
     tc = TestConfig(yaml_path, prober=prober, filter_pvses="P2SXM00_TYPO_XX")
     assert len(tc.pvses) == 0
     assert len(tc.get_required_segments()) == 0
+
+
+def test_real_corpus_yaml(tmp_path):
+    """Parse every real published example-database YAML vendored under
+    tests/fixtures_corpus/ (VERDICT r3 #5; the YAML dialect is the public
+    contract with existing databases, reference test_config.py:1162-1248,
+    :1259-1457). Auto-skips while the directory holds no .yaml — see
+    docs/OPERATOR_REQUESTS.md #1 for how to vendor one."""
+    import glob
+    import shutil
+
+    import yaml as _yaml
+
+    corpus_dir = os.path.join(os.path.dirname(__file__), "fixtures_corpus")
+    files = sorted(glob.glob(os.path.join(corpus_dir, "**", "*.yaml"),
+                             recursive=True))
+    if not files:
+        pytest.skip("no real corpus YAML vendored (docs/OPERATOR_REQUESTS.md)")
+
+    from processing_chain_tpu.config import StaticProber
+
+    for path in files:
+        raw = _yaml.safe_load(open(path))
+        db_id = raw["databaseId"]
+        db_dir = tmp_path / db_id
+        (db_dir / "srcVid").mkdir(parents=True)
+        shutil.copy(path, db_dir / f"{db_id}.yaml")
+        # fake SRC files + plausible probe info for every srcList entry
+        table = {}
+        for entry in raw.get("srcList", {}).values():
+            fname = entry["srcFile"] if isinstance(entry, dict) else entry
+            (db_dir / "srcVid" / fname).touch()
+            table[fname] = dict(
+                width=1920, height=1080, pix_fmt="yuv420p",
+                r_frame_rate="60/1", video_duration=600.0,
+                avg_frame_rate="60/1",
+            )
+        tc = TestConfig(str(db_dir / f"{db_id}.yaml"),
+                        prober=StaticProber(table))
+        # the plan must cover every PVS and every segment must carry
+        # coherent geometry/timing
+        assert len(tc.pvses) == len(raw["pvsList"])
+        segs = tc.get_required_segments()
+        assert segs, f"{db_id}: empty segment plan"
+        for s in segs:
+            assert s.duration > 0
+            assert s.quality_level.width > 0
+            assert s.filename.startswith(db_id)

@@ -791,3 +791,62 @@ def test_resize_golden_random_geometries():
         diff = np.abs(ref.astype(int) - ours.astype(int))
         assert diff.max() <= 1, (kernel, dh, dw, diff.max())
         assert diff.mean() < 0.3, (kernel, dh, dw, diff.mean())
+
+
+class TestNormalizeRmsOracle:
+    """Golden tests pinning models/cpvs.normalize_rms to ffmpeg-normalize
+    1.28.3 `-nt rms` (reference lib/ffmpeg.py:1233-1245): volumedetect
+    measures the exact power sum but PRINTS mean_volume at 0.1 dB (the
+    value the tool parses), gain = target - mean_volume with no limiter,
+    and the volume filter's s16 path rounds to nearest then clamps
+    (av_clip_int16(lrintf(x*gain)))."""
+
+    def test_hand_computed_gain_square_wave(self):
+        from processing_chain_tpu.models.cpvs import normalize_rms
+
+        # +/-8192 square wave: power = 0.0625 -> mean_volume
+        # 10*log10(0.0625) = -12.0412 -> printed -12.0; gain_db = -23 -
+        # (-12.0) = -11.0; 8192 * 10^(-11/20) = 2308.82 -> lrintf 2309
+        x = np.tile(np.array([8192, -8192], np.int16), 240)
+        out = normalize_rms(x.reshape(-1, 1))
+        assert out.dtype == np.int16
+        assert set(np.unique(out)) == {-2309, 2309}
+
+    def test_clipping_case_clamps_not_limits(self):
+        from processing_chain_tpu.models.cpvs import normalize_rms
+
+        # 9992 samples at +/-300 + 8 spikes at +/-32000:
+        # power = (9992*300^2 + 8*32000^2)/10000/32768^2 = 8.46688e-4
+        # mean_volume = 10*log10 = -30.7228 -> printed -30.7
+        # gain_db = +7.7 -> gain = 10^(7.7/20) = 2.42661 (amplification)
+        # 300*2.42661 = 727.98 -> 728; spikes 32000*2.42661 = 77651 ->
+        # CLAMPED to int16 (no limiter in ffmpeg-normalize rms mode):
+        # +32767 / -32768 (asymmetric, av_clip_int16 semantics)
+        body = np.tile(np.array([300, -300], np.int16), 4996)
+        spikes = np.tile(np.array([32000, -32000], np.int16), 4)
+        x = np.concatenate([body, spikes]).reshape(-1, 2)
+        out = normalize_rms(x)
+        vals = set(np.unique(out))
+        assert vals == {-32768, -728, 728, 32767}, vals
+
+    def test_attenuation_and_quantized_measure(self):
+        from processing_chain_tpu.models.cpvs import normalize_rms
+
+        # full-scale-ish square wave +/-30000: power = (30000/32768)^2 =
+        # 0.838190 -> mean_volume 10*log10 = -0.76649 -> printed -0.8
+        # (NOT -0.76649: the 0.1 dB print quantization is part of the
+        # oracle); gain_db = -22.2 -> gain = 0.0776247;
+        # 30000*0.0776247 = 2328.74 -> 2329.  Unquantized measure would
+        # give gain_db = -22.2335 and 2319.78 -> 2320: distinguishes the
+        # two implementations.
+        x = np.tile(np.array([30000, -30000], np.int16), 100)
+        out = normalize_rms(x.reshape(-1, 1))
+        assert set(np.unique(out)) == {-2329, 2329}
+
+    def test_silence_and_empty_passthrough(self):
+        from processing_chain_tpu.models.cpvs import normalize_rms
+
+        z = np.zeros((16, 2), np.int16)
+        np.testing.assert_array_equal(normalize_rms(z), z)
+        e = np.zeros((0, 2), np.int16)
+        assert normalize_rms(e).size == 0

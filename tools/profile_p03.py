@@ -1,0 +1,191 @@
+"""Profile the p03 AVPVS product path: where does wall time go?
+
+VERDICT r3 #3: quantify device idle vs host idle on the
+create_avpvs_wo_buffer hot path (reference p03_generateAvPvs.py:88-136)
+and close — or explain — the gap between the end-to-end rate and the pure
+device-kernel ceiling.
+
+Stages measured separately and together on the SAME content:
+  decode   — native libav H.264 decode to planar chunks (host, 1 core)
+  device   — bicubic/Lanczos resize + quantize + SI/TI update (chip)
+  encode   — FFV1 writeback (host, 1 core)
+  e2e      — the real pipeline (Prefetcher + AsyncWriter overlap)
+
+overlap_efficiency = sum(stage times) / e2e  (1.0 = no overlap,
+n_stages = perfect overlap); host_bound = e2e ≈ decode + encode means the
+single-core host is the bound, not the chip.
+
+Usage:
+  python tools/profile_p03.py [--frames N] [--res WxH] [--dst WxH]
+      [--trace DIR]     # also capture a jax.profiler trace into DIR
+Respects JAX_PLATFORMS=cpu; on TPU, takes the shared device flock
+(bench.py _DeviceLock) so it never runs beside another tunnel client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_src(path: str, n: int, w: int, h: int, fps: float = 24.0) -> None:
+    from processing_chain_tpu.io.video import VideoWriter
+
+    rng = np.random.default_rng(0)
+    base_y = rng.integers(0, 255, (h, w), np.uint8)
+    with VideoWriter(
+        path, codec="libx264", width=w, height=h, pix_fmt="yuv420p",
+        fps=(int(fps), 1), bitrate_kbps=8000, threads=1,
+        opts="preset=veryfast",
+    ) as wtr:
+        for i in range(n):
+            y = np.roll(base_y, i * 3, axis=1)
+            u = rng.integers(0, 255, (h // 2, w // 2), np.uint8)
+            v = rng.integers(0, 255, (h // 2, w // 2), np.uint8)
+            wtr.write(y, u, v)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frames", type=int, default=96)
+    ap.add_argument("--res", default="1920x1080")
+    ap.add_argument("--dst", default="3840x2160")
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--trace", default="")
+    args = ap.parse_args()
+    w, h = map(int, args.res.split("x"))
+    dw, dh = map(int, args.dst.split("x"))
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # the axon plugin's get_backend monkeypatch initializes the tunnel
+        # even under JAX_PLATFORMS=cpu; deregister it (as bench.py/conftest)
+        try:
+            from jax._src import xla_bridge as _xb
+
+            getattr(_xb, "_backend_factories", {}).pop("axon", None)
+        except Exception:
+            pass
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from bench import _DeviceLock
+    from processing_chain_tpu.engine import prefetch as pf
+    from processing_chain_tpu.io.video import VideoReader
+    from processing_chain_tpu.models import frames as fr
+    from processing_chain_tpu.models.avpvs import SiTiAccumulator, _ffv1_writer
+
+    platform = jax.devices()[0].platform
+    lock = _DeviceLock()
+    if platform not in ("cpu",) and not lock.acquire(300):
+        print(json.dumps({"error": "device lock busy"}))
+        return
+
+    tmp = tempfile.mkdtemp(prefix="pc_prof_")
+    src = os.path.join(tmp, "src.mp4")
+    t0 = time.perf_counter()
+    make_src(src, args.frames, w, h)
+    t_make = time.perf_counter() - t0
+
+    def decode_chunks():
+        with VideoReader(src) as reader:
+            yield from pf.iter_plane_chunks(reader, args.chunk)
+
+    report = {
+        "platform": platform, "frames": args.frames,
+        "src": f"{w}x{h}", "dst": f"{dw}x{dh}", "chunk": args.chunk,
+        "src_make_s": round(t_make, 2),
+    }
+
+    # --- stage 1: host decode only
+    t0 = time.perf_counter()
+    cached = [c for c in decode_chunks()]
+    report["decode_s"] = round(time.perf_counter() - t0, 3)
+
+    # --- stage 2: device compute only (on cached chunks; includes H2D)
+    def device_pass():
+        feat = SiTiAccumulator()
+        outs = []
+        for chunk in cached:
+            scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
+            quant = fr.quantize_device(scaled, False)
+            feat.update(quant[0])
+            outs.append(quant)
+        # materialize: the product path fetches every plane for the writer
+        for q in outs:
+            for p in q:
+                np.asarray(p)
+        return feat
+
+    device_pass()  # compile
+    t0 = time.perf_counter()
+    device_pass()
+    report["device_s"] = round(time.perf_counter() - t0, 3)
+
+    # --- stage 3: FFV1 encode only (pre-resized content, reused)
+    pre = []
+    for chunk in cached:
+        scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
+        pre.append([np.asarray(p) for p in fr.quantize_device(scaled, False)])
+    out1 = os.path.join(tmp, "enc.avi")
+    t0 = time.perf_counter()
+    with _ffv1_writer(out1, dw, dh, "yuv420p", 24.0, False) as wtr:
+        for q in pre:
+            for i in range(q[0].shape[0]):
+                wtr.write(q[0][i], q[1][i], q[2][i])
+    report["encode_s"] = round(time.perf_counter() - t0, 3)
+    del pre
+
+    # --- e2e: the real overlapped pipeline
+    def e2e():
+        out = os.path.join(tmp, "e2e.avi")
+        if os.path.exists(out):
+            os.unlink(out)
+        feat = SiTiAccumulator()
+        with pf.AsyncWriter(_ffv1_writer(out, dw, dh, "yuv420p", 24.0, False)) as aw:
+            with pf.Prefetcher(decode_chunks(), depth=2) as pre_it:
+                for chunk in pre_it:
+                    scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
+                    quant = fr.quantize_device(scaled, False)
+                    feat.update(quant[0])
+                    aw.put(quant)
+
+    trace_ctx = None
+    if args.trace:
+        trace_ctx = jax.profiler.trace(args.trace)
+        trace_ctx.__enter__()
+    t0 = time.perf_counter()
+    e2e()
+    report["e2e_s"] = round(time.perf_counter() - t0, 3)
+    if trace_ctx is not None:
+        trace_ctx.__exit__(None, None, None)
+        report["trace_dir"] = args.trace
+
+    if platform != "cpu":
+        lock.release()
+
+    ssum = report["decode_s"] + report["device_s"] + report["encode_s"]
+    report["stage_sum_s"] = round(ssum, 3)
+    report["overlap_efficiency"] = round(ssum / max(report["e2e_s"], 1e-9), 2)
+    report["e2e_fps"] = round(args.frames / report["e2e_s"], 1)
+    report["host_share"] = round(
+        (report["decode_s"] + report["encode_s"]) / ssum, 2
+    )
+    print(json.dumps(report))
+
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

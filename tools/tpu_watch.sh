@@ -20,9 +20,13 @@ cd "$(dirname "$0")/.." || exit 1
 
 while :; do
     echo "[$(date -u +%H:%M:%S)] probing tunnel" >> "$LOG"
-    # -n: if another client (a harvest) holds the device, skip this round
+    # -n: if another client (a harvest) holds the device, skip this round.
+    # The probe skips the optional extras and shares bench.py's per-user
+    # compile cache so it holds the device as briefly as possible (the
+    # full bench right after re-uses the cached compile).
     if flock -n "$LOCK" -c \
-        "timeout -s KILL 150 python bench.py --child > '$CHILD_JSON' 2>> '$LOG'" \
+        "PC_BENCH_NO_EXTRAS=1 JAX_COMPILATION_CACHE_DIR=$HOME/.cache/pc_bench_jax_cache_$(id -u) \
+         timeout -s KILL 150 python bench.py --child > '$CHILD_JSON' 2>> '$LOG'" \
         && grep -q '"platform": "tpu"' "$CHILD_JSON"; then
         echo "[$(date -u +%H:%M:%S)] tunnel LIVE; running full bench" >> "$LOG"
         # full bench takes the same lock itself (bench.py _DeviceLock)
