@@ -256,21 +256,17 @@ def pallas_available() -> bool:
 
 
 def _rows01(s1: jnp.ndarray, s2: jnp.ndarray) -> jnp.ndarray:
-    """[8, 128] with row 0 = s1, row 1 = s2, rest 0 — via broadcast+select
-    (Mosaic cannot lower a mixed-sublane-layout concatenate)."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
-    out = jnp.where(rows == 0, jnp.broadcast_to(s1[None], (8, 128)), 0.0)
-    return jnp.where(rows == 1, jnp.broadcast_to(s2[None], (8, 128)), out)
+    """[8, 128] with row 0 = s1, row 1 = s2, rest 0."""
+    return _rows0123((s1, s2))
 
 
-def _si_partial_kernel(a_ref, b_ref, out_ref, *, w: int):
-    """One (frame, column-stripe) step: a = cols [c0, c0+128), b = the next
-    stripe. Emits row-reduced Σ|∇| and Σ|∇|² per lane (masked past the
-    frame's valid gradient columns)."""
-    f = jnp.concatenate([a_ref[0], b_ref[0]], axis=1)[:, :136]
+def _sobel_stripe_stats(a, b, w: int):
+    """Shared SI stripe body: from stripe a (cols [c0, c0+128)) and its
+    right-halo stripe b, the row-reduced (Σ|∇|, Σ|∇|²) per lane, masked
+    past the frame's valid gradient columns. Integer luma casts in VMEM
+    (u8/u16 input quarters/halves the HBM traffic vs pre-cast f32)."""
+    f = jnp.concatenate([a, b], axis=1)[:, :136]
     if f.dtype != jnp.float32:
-        # integer luma streams at container depth: cast in VMEM (u8/u16
-        # input quarters/halves the HBM traffic vs a pre-cast f32 array)
         f = f.astype(jnp.int32).astype(jnp.float32)
     sv = f[:-2] + 2.0 * f[1:-1] + f[2:]          # vertical smooth  [H-2, 136]
     gx = sv[:, 2:130] - sv[:, :128]              # horizontal diff  [H-2, 128]
@@ -282,8 +278,13 @@ def _si_partial_kernel(a_ref, b_ref, out_ref, *, w: int):
     # gradient column kk maps to source col ci*128 + 1 + kk; valid < w-1
     col = ci * 128 + 1 + jax.lax.broadcasted_iota(jnp.int32, m.shape, 1)
     ok = (col < w - 1).astype(jnp.float32)
-    s1 = jnp.sum(m * ok, axis=0)
-    s2 = jnp.sum(m2 * ok, axis=0)
+    return jnp.sum(m * ok, axis=0), jnp.sum(m2 * ok, axis=0), f
+
+
+def _si_partial_kernel(a_ref, b_ref, out_ref, *, w: int):
+    """One (frame, column-stripe) step: a = cols [c0, c0+128), b = the next
+    stripe. Emits row-reduced Σ|∇| and Σ|∇|² per lane."""
+    s1, s2, _ = _sobel_stripe_stats(a_ref[0], b_ref[0], w)
     out_ref[0, 0] = _rows01(s1, s2)
 
 
@@ -311,6 +312,71 @@ def si_frames_fused(y: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
     s1 = jnp.sum(out[:, :, 0, :], axis=(1, 2)) / n
     s2 = jnp.sum(out[:, :, 1, :], axis=(1, 2)) / n
     return jnp.sqrt(jnp.maximum(s2 - s1 * s1, 0.0))
+
+
+def _rows0123(rows_vals) -> jnp.ndarray:
+    """[8, 128] with rows 0..3 = the four given [128] vectors, rest 0."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0)
+    out = jnp.zeros((8, 128), jnp.float32)
+    for i, v in enumerate(rows_vals):
+        out = jnp.where(rows == i, jnp.broadcast_to(v[None], (8, 128)), out)
+    return out
+
+
+def _siti_partial_kernel(a_ref, b_ref, p_ref, out_ref, *, w: int):
+    """One (frame, column-stripe) step of the COMBINED SI+TI pass: a = this
+    frame's stripe, b = the next stripe (horizontal Sobel halo), p = the
+    PREVIOUS frame's stripe (clamped to frame 0 at t=0, making d == 0 and
+    thus TI[0] == 0 with no special case). Emits per-lane row-reductions:
+    rows 0,1 = Σ|∇|, Σ|∇|² (SI, masked to valid gradient cols); rows 2,3 =
+    Σd, Σd² (TI; zero-padded width self-masks). One fused pass reads each
+    stripe ~3x total where the separate SI and TI kernels read ~4x, and
+    saves a kernel launch + a second u8->f32 cast of the whole batch."""
+    s1, s2, f = _sobel_stripe_stats(a_ref[0], b_ref[0], w)
+    prev = p_ref[0]
+    if prev.dtype != jnp.float32:
+        prev = prev.astype(jnp.int32).astype(jnp.float32)
+    d = f[:, :128] - prev
+    out_ref[0, 0] = _rows0123((
+        s1, s2, jnp.sum(d, axis=0), jnp.sum(d * d, axis=0),
+    ))
+
+
+def siti_frames_fused(
+    y: jnp.ndarray, interpret: bool = False
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(SI[T], TI[T]) for [T, H, W] luma in ONE fused pass — the Pallas
+    TPU path of ops.siti.siti. Same sufficient-stats math as the separate
+    si_frames_fused/ti_frames_fused, at ~3/4 the HBM traffic and half the
+    kernel launches."""
+    pl_, _ = _pallas()
+    t, h, w = y.shape
+    n_ct = -(-w // 128)
+    pad_w = (n_ct + 1) * 128
+    yp = jnp.pad(y, ((0, 0), (0, 0), (0, pad_w - w)))
+    out = pl_.pallas_call(
+        functools.partial(_siti_partial_kernel, w=w),
+        grid=(t, n_ct),
+        in_specs=[
+            pl_.BlockSpec((1, h, 128), lambda ti, ci: (ti, 0, ci)),
+            pl_.BlockSpec((1, h, 128), lambda ti, ci: (ti, 0, ci + 1)),
+            pl_.BlockSpec(
+                (1, h, 128), lambda ti, ci: (jnp.maximum(ti - 1, 0), 0, ci)
+            ),
+        ],
+        out_specs=pl_.BlockSpec((1, 1, 8, 128), lambda ti, ci: (ti, ci, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, n_ct, 8, 128), jnp.float32),
+        interpret=interpret,
+    )(yp, yp, yp)
+    n_si = (h - 2) * (w - 2)
+    s1 = jnp.sum(out[:, :, 0, :], axis=(1, 2)) / n_si
+    s2 = jnp.sum(out[:, :, 1, :], axis=(1, 2)) / n_si
+    si = jnp.sqrt(jnp.maximum(s2 - s1 * s1, 0.0))
+    n_ti = h * w
+    t1 = jnp.sum(out[:, :, 2, :], axis=(1, 2)) / n_ti
+    t2 = jnp.sum(out[:, :, 3, :], axis=(1, 2)) / n_ti
+    ti = jnp.sqrt(jnp.maximum(t2 - t1 * t1, 0.0))
+    return si, ti
 
 
 def _ti_partial_kernel(a_ref, b_ref, out_ref):

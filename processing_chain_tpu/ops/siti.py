@@ -103,7 +103,13 @@ def ti_frames_continued(y: jnp.ndarray, prev_last):
 @jax.jit
 def siti(y: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(SI[T], TI[T]) for a [T, H, W] luma tensor — the batched feature
-    extractor behind p02/complexity classification."""
+    extractor behind p02/complexity classification. On TPU both features
+    come from ONE fused Pallas pass (pallas_kernels.siti_frames_fused):
+    ~3/4 the HBM traffic and half the launches of the separate kernels."""
+    if _use_pallas():
+        from . import pallas_kernels as pk
+
+        return pk.siti_frames_fused(y)
     return si_frames(y), ti_frames(y)
 
 

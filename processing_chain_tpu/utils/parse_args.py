@@ -28,43 +28,45 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
     )
     parser.add_argument(
         "-c", "--test-config", required=True,
-        help="path to test config file at the root of the database folder",
+        help="database YAML (lives at the top of the database folder; its "
+        "folder layout is derived from this path)",
     )
     parser.add_argument(
         "-f", "--force", action="store_true",
-        help="force overwrite existing output files",
+        help="regenerate artifacts even when the output file already exists",
     )
     parser.add_argument(
-        "-v", "--verbose", action="store_true", help="print more verbose output"
+        "-v", "--verbose", action="store_true", help="log at DEBUG level"
     )
     parser.add_argument(
         "-n", "--dry-run", action="store_true",
-        help="only print planned jobs, do not run them",
+        help="plan everything but execute nothing (prints each planned job)",
     )
     parser.add_argument(
-        "--filter-src", help="Only create specified SRC-IDs ('|'-separated)"
+        "--filter-src", help="restrict the run to these SRC ids; separate several with '|'"
     )
     parser.add_argument(
-        "--filter-hrc", help="Only create specified HRC-IDs ('|'-separated)"
+        "--filter-hrc", help="restrict the run to these HRC ids; separate several with '|'"
     )
     parser.add_argument(
-        "--filter-pvs", help="Only create specified PVS-IDs ('|'-separated)"
+        "--filter-pvs", help="restrict the run to these PVS ids; separate several with '|'"
     )
     parser.add_argument(
         "-p", "--parallelism", default=4, type=int,
-        help="number of host workers to run in parallel",
+        help="host-side worker count for the job pool",
     )
     parser.add_argument(
         "-r", "--remove-intermediate", action="store_true",
-        help="remove/delete intermediate files",
+        help="delete intermediate artifacts once their consumers are written",
     )
     parser.add_argument(
         "-sos", "--skip-online-services", action="store_true",
-        help="skip videos coded by online services",
+        help="leave out segments whose coding runs on an online service",
     )
     parser.add_argument(
         "-str", "--scripts-to-run", default="1234",
-        help='which stages p00 shall execute (e.g. "all", "1234", "34")',
+        help='stage subset for the orchestrator: digits of the stages to run, '
+        'in order ("34" = p03 then p04; "all" = everything)',
     )
     if script in (None, 1, 3, 4):
         # reference exposes -g on p01 (nvenc placement); here the device
@@ -77,32 +79,32 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
     if script == 3:
         parser.add_argument(
             "-s", "--spinner-path", default=_DEFAULT_SPINNER,
-            help="path to the spinner image used for stalling events",
+            help="PNG composited (rotating) over stall frames",
         )
         parser.add_argument(
             "-z", "--avpvs-src-fps", action="store_true",
-            help="use the SRC fps for the avpvs (default: 60 fps canvas)",
+            help="render the AVPVS on the SRC frame-rate canvas instead of 60 fps",
         )
         parser.add_argument(
             "-f60", "--force-60-fps", action="store_true",
-            help="force avpvs framerate to 60 fps",
+            help="pin the AVPVS frame rate at 60 fps regardless of the SRC",
         )
     if script == 4:
         parser.add_argument(
             "-e", "--lightweight-preview", action="store_true",
-            help="create lightweight preview files",
+            help="also write a small preview encode per CPVS",
         )
         parser.add_argument(
             "-a", "--rawvideo", action="store_true",
-            help="use rawvideo codec and MKV output for PC",
+            help="PC context writes rawvideo in MKV instead of the default codec",
         )
         parser.add_argument(
             "-ccrf", "--nonraw-crf", default=17, type=int,
-            help="CRF level for libx264 CPVS encodes",
+            help="quality (CRF) for the non-raw CPVS encodes",
         )
     parser.add_argument(
         "--skip-requirements", action="store_true",
-        help="continue running even if requirements are not fulfilled",
+        help="do not abort when the requirements/version check fails",
     )
     parser.add_argument(
         "--trace", nargs="?", const="", default=None, metavar="DIR",

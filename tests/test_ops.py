@@ -755,6 +755,32 @@ def test_pallas_siti_matches_xla():
         np.testing.assert_allclose(ti, ti_ref, rtol=1e-4, atol=1e-3)
 
 
+def test_pallas_siti_combined_matches_separate():
+    """The single-pass combined SI+TI kernel agrees with the separate
+    fused kernels (same sufficient-stats math, one read of the batch) —
+    u8 and f32, ragged width, and the t=1 clip where TI must be all-zero
+    (the clamped prev-frame index makes d == 0 at t=0 by construction)."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(12)
+    y = rng.integers(0, 255, (5, 64, 200), np.uint8)
+    for inp in (jnp.asarray(y), jnp.asarray(y).astype(jnp.float32)):
+        si_c, ti_c = pk.siti_frames_fused(inp, interpret=True)
+        si_s = np.asarray(pk.si_frames_fused(inp, interpret=True))
+        ti_s = np.asarray(pk.ti_frames_fused(inp, interpret=True))
+        np.testing.assert_allclose(np.asarray(si_c), si_s, rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(ti_c), ti_s, rtol=1e-5, atol=1e-4)
+    one = jnp.asarray(y[:1])
+    si1, ti1 = pk.siti_frames_fused(one, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(si1), np.asarray(pk.si_frames_fused(one, interpret=True)),
+        rtol=1e-5, atol=1e-4,
+    )
+    assert np.asarray(ti1) == pytest.approx([0.0])
+
+
 def test_resize_fused_10bit_matches_banded():
     """The fused kernel's u16 path (10-bit AVPVS planes, maxval 1023)
     agrees with the banded formulation bit-for-bit in interpret mode."""
