@@ -260,11 +260,13 @@ def _rows01(s1: jnp.ndarray, s2: jnp.ndarray) -> jnp.ndarray:
     return _rows0123((s1, s2))
 
 
-def _sobel_stripe_stats(a, b, w: int):
+def _sobel_stripe_stats(a, b, w: int, ci_axis: int = 1):
     """Shared SI stripe body: from stripe a (cols [c0, c0+128)) and its
     right-halo stripe b, the row-reduced (Σ|∇|, Σ|∇|²) per lane, masked
     past the frame's valid gradient columns. Integer luma casts in VMEM
-    (u8/u16 input quarters/halves the HBM traffic vs pre-cast f32)."""
+    (u8/u16 input quarters/halves the HBM traffic vs pre-cast f32).
+    ci_axis: which grid axis walks the column stripes (1 for the [T]
+    kernels, 2 for the batched [B, T] kernel)."""
     f = jnp.concatenate([a, b], axis=1)[:, :136]
     if f.dtype != jnp.float32:
         f = f.astype(jnp.int32).astype(jnp.float32)
@@ -274,7 +276,7 @@ def _sobel_stripe_stats(a, b, w: int):
     gy = sh[2:, :128] - sh[:-2, :128]            # vertical diff    [H-2, 128]
     m2 = gx * gx + gy * gy
     m = jnp.sqrt(m2)
-    ci = pl.program_id(1)
+    ci = pl.program_id(ci_axis)
     # gradient column kk maps to source col ci*128 + 1 + kk; valid < w-1
     col = ci * 128 + 1 + jax.lax.broadcasted_iota(jnp.int32, m.shape, 1)
     ok = (col < w - 1).astype(jnp.float32)
@@ -308,10 +310,7 @@ def si_frames_fused(y: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
         out_shape=jax.ShapeDtypeStruct((t, n_ct, 8, 128), jnp.float32),
         interpret=interpret,
     )(yp, yp)
-    n = (h - 2) * (w - 2)
-    s1 = jnp.sum(out[:, :, 0, :], axis=(1, 2)) / n
-    s2 = jnp.sum(out[:, :, 1, :], axis=(1, 2)) / n
-    return jnp.sqrt(jnp.maximum(s2 - s1 * s1, 0.0))
+    return _std_from_partials(out, 0, 1, (h - 2) * (w - 2), (1, 2))
 
 
 def _rows0123(rows_vals) -> jnp.ndarray:
@@ -323,23 +322,34 @@ def _rows0123(rows_vals) -> jnp.ndarray:
     return out
 
 
+def _siti_stripe_rows(a, b, prev, w: int, ci_axis: int) -> jnp.ndarray:
+    """Shared body of the combined SI+TI kernels: the [8, 128] partials
+    block (rows 0,1 = Σ|∇|, Σ|∇|² masked to valid gradient cols; rows 2,3
+    = Σd, Σd² vs the prev stripe, zero-padded width self-masking)."""
+    s1, s2, f = _sobel_stripe_stats(a, b, w, ci_axis)
+    if prev.dtype != jnp.float32:
+        prev = prev.astype(jnp.int32).astype(jnp.float32)
+    d = f[:, :128] - prev
+    return _rows0123((s1, s2, jnp.sum(d, axis=0), jnp.sum(d * d, axis=0)))
+
+
+def _std_from_partials(out, s1_row: int, s2_row: int, n: int, axes):
+    """σ from per-stripe sufficient-stats partials: rows s1_row/s2_row of
+    the [..., 8, 128] blocks hold Σx and Σx²; reduce over `axes`,
+    normalize by n, σ = sqrt(max(E[x²] − E[x]², 0))."""
+    s1 = jnp.sum(out[..., s1_row, :], axis=axes) / n
+    s2 = jnp.sum(out[..., s2_row, :], axis=axes) / n
+    return jnp.sqrt(jnp.maximum(s2 - s1 * s1, 0.0))
+
+
 def _siti_partial_kernel(a_ref, b_ref, p_ref, out_ref, *, w: int):
     """One (frame, column-stripe) step of the COMBINED SI+TI pass: a = this
     frame's stripe, b = the next stripe (horizontal Sobel halo), p = the
     PREVIOUS frame's stripe (clamped to frame 0 at t=0, making d == 0 and
-    thus TI[0] == 0 with no special case). Emits per-lane row-reductions:
-    rows 0,1 = Σ|∇|, Σ|∇|² (SI, masked to valid gradient cols); rows 2,3 =
-    Σd, Σd² (TI; zero-padded width self-masks). One fused pass reads each
+    thus TI[0] == 0 with no special case). One fused pass reads each
     stripe ~3x total where the separate SI and TI kernels read ~4x, and
     saves a kernel launch + a second u8->f32 cast of the whole batch."""
-    s1, s2, f = _sobel_stripe_stats(a_ref[0], b_ref[0], w)
-    prev = p_ref[0]
-    if prev.dtype != jnp.float32:
-        prev = prev.astype(jnp.int32).astype(jnp.float32)
-    d = f[:, :128] - prev
-    out_ref[0, 0] = _rows0123((
-        s1, s2, jnp.sum(d, axis=0), jnp.sum(d * d, axis=0),
-    ))
+    out_ref[0, 0] = _siti_stripe_rows(a_ref[0], b_ref[0], p_ref[0], w, 1)
 
 
 def siti_frames_fused(
@@ -368,14 +378,53 @@ def siti_frames_fused(
         out_shape=jax.ShapeDtypeStruct((t, n_ct, 8, 128), jnp.float32),
         interpret=interpret,
     )(yp, yp, yp)
-    n_si = (h - 2) * (w - 2)
-    s1 = jnp.sum(out[:, :, 0, :], axis=(1, 2)) / n_si
-    s2 = jnp.sum(out[:, :, 1, :], axis=(1, 2)) / n_si
-    si = jnp.sqrt(jnp.maximum(s2 - s1 * s1, 0.0))
-    n_ti = h * w
-    t1 = jnp.sum(out[:, :, 2, :], axis=(1, 2)) / n_ti
-    t2 = jnp.sum(out[:, :, 3, :], axis=(1, 2)) / n_ti
-    ti = jnp.sqrt(jnp.maximum(t2 - t1 * t1, 0.0))
+    si = _std_from_partials(out, 0, 1, (h - 2) * (w - 2), (1, 2))
+    ti = _std_from_partials(out, 2, 3, h * w, (1, 2))
+    return si, ti
+
+
+def _siti_batch_kernel(a_ref, b_ref, p_ref, out_ref, *, w: int):
+    """Batched [B, T] variant of _siti_partial_kernel: refs are
+    [1, 1, h, 128] blocks of the prev-prepended [B, T+1, H, Wp] array;
+    grid (B, T, n_ct). a = frame (b, t+1), b = its right halo, p = frame
+    (b, t) — the per-lane predecessor, which for t=0 is the halo slot the
+    caller filled (previous time-shard's last frame, or the lane's own
+    first frame making TI[0] = 0)."""
+    out_ref[0, 0, 0] = _siti_stripe_rows(
+        a_ref[0, 0], b_ref[0, 0], p_ref[0, 0], w, 2
+    )
+
+
+def siti_frames_fused_batch(
+    y: jnp.ndarray, prev_last: jnp.ndarray, interpret: bool = False
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(SI[B, T], TI[B, T]) for [B, T, H, W] luma lanes with an explicit
+    per-lane predecessor frame prev_last [B, H, W] (same dtype) — the
+    sharded step's feature pass: TI[b, 0] diffs against prev_last[b] (a
+    time-shard halo, or the lane's own first frame for a global TI[0]=0).
+    One fused pass; nothing f32 ever materializes in HBM."""
+    pl_, _ = _pallas()
+    bsz, t, h, w = y.shape
+    n_ct = -(-w // 128)
+    pad_w = (n_ct + 1) * 128
+    seq = jnp.concatenate([prev_last[:, None], y], axis=1)
+    seq = jnp.pad(seq, ((0, 0), (0, 0), (0, 0), (0, pad_w - w)))
+    out = pl_.pallas_call(
+        functools.partial(_siti_batch_kernel, w=w),
+        grid=(bsz, t, n_ct),
+        in_specs=[
+            pl_.BlockSpec((1, 1, h, 128), lambda bi, ti, ci: (bi, ti + 1, 0, ci)),
+            pl_.BlockSpec((1, 1, h, 128), lambda bi, ti, ci: (bi, ti + 1, 0, ci + 1)),
+            pl_.BlockSpec((1, 1, h, 128), lambda bi, ti, ci: (bi, ti, 0, ci)),
+        ],
+        out_specs=pl_.BlockSpec(
+            (1, 1, 1, 8, 128), lambda bi, ti, ci: (bi, ti, ci, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((bsz, t, n_ct, 8, 128), jnp.float32),
+        interpret=interpret,
+    )(seq, seq, seq)
+    si = _std_from_partials(out, 0, 1, (h - 2) * (w - 2), (2, 3))
+    ti = _std_from_partials(out, 2, 3, h * w, (2, 3))
     return si, ti
 
 
@@ -412,8 +461,5 @@ def ti_frames_fused(y: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
         out_shape=jax.ShapeDtypeStruct((t - 1, n_ct, 8, 128), jnp.float32),
         interpret=interpret,
     )(yp, yp)
-    n = h * w
-    s1 = jnp.sum(out[:, :, 0, :], axis=(1, 2)) / n
-    s2 = jnp.sum(out[:, :, 1, :], axis=(1, 2)) / n
-    ti = jnp.sqrt(jnp.maximum(s2 - s1 * s1, 0.0))
+    ti = _std_from_partials(out, 0, 1, h * w, (1, 2))
     return jnp.concatenate([jnp.zeros((1,), jnp.float32), ti])

@@ -88,6 +88,28 @@ def ti_frames(y: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros((1,), ti.dtype), ti])
 
 
+def siti_batch(y: jnp.ndarray, prev_last: jnp.ndarray):
+    """(SI[B, T], TI[B, T]) for [B, T, H, W] luma lanes with an explicit
+    per-lane predecessor frame prev_last [B, H, W] (same dtype) — the
+    sharded step's feature pass (TI[b, 0] diffs against prev_last[b]).
+    TPU: one fused Pallas pass, nothing f32 in HBM; elsewhere the XLA
+    formulation. Dispatch lives HERE so parallel/ callers never touch the
+    kernel module directly."""
+    if _use_pallas():
+        from . import pallas_kernels as pk
+
+        return pk.siti_frames_fused_batch(y, prev_last)
+    b, t = y.shape[0], y.shape[1]
+    flat = y.reshape((-1,) + y.shape[2:])
+    si = si_frames(flat).reshape(b, t)
+    yf = y.astype(jnp.float32)
+    prev = jnp.concatenate(
+        [prev_last[:, None].astype(jnp.float32), yf[:, :-1]], axis=1
+    )
+    ti = jnp.std(yf - prev, axis=(2, 3))
+    return si, ti
+
+
 def ti_frames_continued(y: jnp.ndarray, prev_last):
     """(TI[T], new prev_last) for one chunk of a streamed clip: TI[0]
     diffs against the previous chunk's last luma frame (f32) when given,

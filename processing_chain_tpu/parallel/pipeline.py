@@ -93,18 +93,18 @@ def make_sharded_step(mesh: Mesh, dst_h: int, dst_w: int, kernel: str = "lanczos
         up_v = unflat(
             resize_ops.resize_plane(flat(v), dst_h // 2, dst_w // 2, kernel)
         )
-        si = siti_ops.si_frames(flat(up_y)).reshape(b, t)
 
-        # halo: previous time-shard's last upscaled luma frame
-        yf = up_y.astype(jnp.float32)
-        last = yf[:, -1]
+        # halo: previous time-shard's last upscaled luma frame, exchanged
+        # at CONTAINER depth (u8/u16 ppermute = 1/4 the ICI bytes of f32)
+        last = up_y[:, -1]
         perm = [(i, (i + 1) % n_time) for i in range(n_time)]
         prev_last = lax.ppermute(last, "time", perm)
         t_idx = lax.axis_index("time")
         # shard 0 has no predecessor: use its own first frame (diff -> 0)
-        prev_last = jnp.where(t_idx == 0, yf[:, 0], prev_last)
-        prev = jnp.concatenate([prev_last[:, None], yf[:, :-1]], axis=1)
-        ti = jnp.std(yf - prev, axis=(2, 3))
+        prev_last = jnp.where(t_idx == 0, up_y[:, 0], prev_last)
+
+        # both features in one pass (fused on TPU; see siti.siti_batch)
+        si, ti = siti_ops.siti_batch(up_y, prev_last)
         return up_y, up_u, up_v, si, ti
 
     mapped = jax.shard_map(

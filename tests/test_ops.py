@@ -781,6 +781,37 @@ def test_pallas_siti_combined_matches_separate():
     assert np.asarray(ti1) == pytest.approx([0.0])
 
 
+def test_pallas_siti_batch_with_halo_matches_xla():
+    """The batched [B, T] combined kernel (the sharded step's feature
+    pass): SI matches the XLA reference per lane; TI[b, 0] diffs against
+    the caller-provided predecessor frame (the time-shard halo) and
+    TI[b, t>0] against the lane's own previous frame."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import pallas_kernels as pk
+    from processing_chain_tpu.ops import siti
+
+    rng = np.random.default_rng(13)
+    y = rng.integers(0, 255, (3, 4, 48, 200), np.uint8)
+    prev = rng.integers(0, 255, (3, 48, 200), np.uint8)
+    si, ti = pk.siti_frames_fused_batch(
+        jnp.asarray(y), jnp.asarray(prev), interpret=True
+    )
+    si, ti = np.asarray(si), np.asarray(ti)
+    for bi in range(3):
+        lane = jnp.asarray(y[bi]).astype(jnp.float32)
+        si_ref = np.asarray(siti.si_frames(lane))
+        np.testing.assert_allclose(si[bi], si_ref, rtol=1e-4, atol=1e-3)
+        seq = np.concatenate([prev[bi][None], y[bi]]).astype(np.float64)
+        ti_ref = [np.std(seq[t + 1] - seq[t]) for t in range(4)]
+        np.testing.assert_allclose(ti[bi], ti_ref, rtol=1e-4, atol=1e-3)
+    # self-halo (prev = own first frame) gives the global TI[0] = 0
+    si0, ti0 = pk.siti_frames_fused_batch(
+        jnp.asarray(y), jnp.asarray(y[:, 0]), interpret=True
+    )
+    assert np.asarray(ti0)[:, 0] == pytest.approx([0.0, 0.0, 0.0])
+
+
 def test_resize_fused_10bit_matches_banded():
     """The fused kernel's u16 path (10-bit AVPVS planes, maxval 1023)
     agrees with the banded formulation bit-for-bit in interpret mode."""
