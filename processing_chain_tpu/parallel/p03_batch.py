@@ -114,19 +114,17 @@ def _sharded_resize_step(
         qy, qu, qv = (q.reshape((b, t) + q.shape[1:]) for q in quant)
 
         # device-side features on the quantized luma (what a decoder of
-        # the written AVPVS would see), matching SiTiAccumulator; flattened
-        # (no vmap: the fused Pallas SI kernel has no batching rule)
-        dy = qy.astype(jnp.float32)
-        si = siti_ops.si_frames(
-            qy.reshape((-1,) + qy.shape[2:])
-        ).reshape(b, t)
-        last = dy[:, -1]
+        # the written AVPVS would see), matching SiTiAccumulator. Both
+        # features in one pass (fused on TPU; siti.siti_batch) with the
+        # previous time-shard's last frame — or, on shard 0, the
+        # cross-block carry `prev` — as the halo lane. The halo rides
+        # ICI at container depth (1/4 the bytes of f32).
+        last = qy[:, -1]
         perm = [(i, (i + 1) % n_time) for i in range(n_time)]
         halo = lax.ppermute(last, "time", perm)
         t_idx = lax.axis_index("time")
         prev_first = jnp.where(t_idx == 0, prev, halo)
-        prevs = jnp.concatenate([prev_first[:, None], dy[:, :-1]], axis=1)
-        ti = jnp.std(dy - prevs, axis=(2, 3))
+        si, ti = siti_ops.siti_batch(qy, prev_first)
         # the lane's very first frame has no predecessor: TI[0] = 0
         ti = jnp.where(
             first & (t_idx == 0),
@@ -200,18 +198,22 @@ def run_bucket(
                 ))
                 for ln in wave
             ]
-            _drive_wave(wave, iters, n_pvs, step, sharding, mesh, dst_h, dst_w)
+            _drive_wave(wave, iters, n_pvs, step, sharding, mesh, dst_h,
+                        dst_w, ten_bit)
 
 
 def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
-                dst_h: int, dst_w: int) -> None:
+                dst_h: int, dst_w: int, ten_bit: bool) -> None:
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     prev_sharding = NamedSharding(mesh, P("pvs", None, None))
     done = [False] * len(wave)
     zero_block: Optional[list] = None
-    prev = np.zeros((n_pvs, dst_h, dst_w), np.float32)
+    # cross-block TI carry stays at container depth (the quantized luma a
+    # decoder of the artifact would see; u8/u16 device_put, not f32)
+    prev = np.zeros((n_pvs, dst_h, dst_w),
+                    np.uint16 if ten_bit else np.uint8)
     first = True
     while not all(done):
         blocks: list[Optional[list]] = []
@@ -250,7 +252,9 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
                     ln.emit_features(si_h[i][: valids[i]], ti_h[i][: valids[i]])
         # inter-block TI carry: the tail-repeat padding means [:, -1] is
         # the lane's last REAL frame even on a partial block
-        prev = host[0][:, -1].astype(np.float32)
+        # .copy(): a view would pin the whole previous output block in
+        # host memory across the next iteration
+        prev = host[0][:, -1].copy()
         first = False
 
 
