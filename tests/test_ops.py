@@ -812,6 +812,38 @@ def test_pallas_siti_batch_with_halo_matches_xla():
     assert np.asarray(ti0)[:, 0] == pytest.approx([0.0, 0.0, 0.0])
 
 
+def test_pallas_siti_10bit_container_depth():
+    """The combined kernels accept u16 (10-bit AVPVS) luma at container
+    depth — both the [T] and the [B, T]+halo variants — and agree with
+    the XLA math on f32-cast input."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import pallas_kernels as pk
+    from processing_chain_tpu.ops import siti
+
+    rng = np.random.default_rng(14)
+    y = rng.integers(0, 1023, (3, 40, 160), np.uint16)
+    si, ti = pk.siti_frames_fused(jnp.asarray(y), interpret=True)
+    yf = jnp.asarray(y).astype(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(si), np.asarray(siti.si_frames(yf)), rtol=1e-4, atol=1e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(ti), np.asarray(siti.ti_frames(yf)), rtol=1e-4, atol=1e-2
+    )
+    prev = rng.integers(0, 1023, (2, 40, 160), np.uint16)
+    yb = rng.integers(0, 1023, (2, 3, 40, 160), np.uint16)
+    sib, tib = pk.siti_frames_fused_batch(
+        jnp.asarray(yb), jnp.asarray(prev), interpret=True
+    )
+    for bi in range(2):
+        seq = np.concatenate([prev[bi][None], yb[bi]]).astype(np.float64)
+        ti_ref = [np.std(seq[t + 1] - seq[t]) for t in range(3)]
+        np.testing.assert_allclose(
+            np.asarray(tib)[bi], ti_ref, rtol=1e-4, atol=1e-2
+        )
+
+
 def test_resize_fused_10bit_matches_banded():
     """The fused kernel's u16 path (10-bit AVPVS planes, maxval 1023)
     agrees with the banded formulation bit-for-bit in interpret mode."""
