@@ -56,11 +56,17 @@ DEADLINE = float(os.environ.get("BENCH_DEADLINE", "240"))
 _T0 = time.monotonic()
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-#: pinned single-core baseline (committed artifact; see --pin-baseline)
-BASELINE_FILE = os.path.join(_HERE, "BASELINE_MEASURED.json")
-#: best live TPU measurement persisted across runs, so a harvest whose TPU
-#: attempts hit a wedged tunnel can still report the round's real number
-LIVE_FILE = os.path.join(_HERE, "BENCH_LIVE.json")
+#: pinned single-core baseline (committed artifact; see --pin-baseline);
+#: PC_BASELINE_FILE overrides for tests
+BASELINE_FILE = os.environ.get(
+    "PC_BASELINE_FILE", os.path.join(_HERE, "BASELINE_MEASURED.json")
+)
+#: latest live TPU measurement persisted across runs, so a harvest whose
+#: TPU attempts hit a wedged tunnel can still report the round's real
+#: number; PC_BENCH_LIVE_FILE overrides for tests
+LIVE_FILE = os.environ.get(
+    "PC_BENCH_LIVE_FILE", os.path.join(_HERE, "BENCH_LIVE.json")
+)
 
 
 def _remaining() -> float:
@@ -171,16 +177,17 @@ def _dump_json_atomic(obj: dict, path: str) -> None:
 def _compute_code_hash() -> str:
     """Hash of the device-path sources the measurement depends on; a live
     cache recorded under a different hash is rejected (it measured other
-    code). Deliberately NOT the git rev: the driver's end-of-round
+    code). Deliberately NOT the git rev (the driver's end-of-round
     snapshot commit must not invalidate a cache whose compute path is
-    unchanged."""
+    unchanged) and deliberately NOT bench.py itself (a comment or
+    harness-plumbing edit here must not either; the measured math lives
+    entirely in ops/ + parallel/)."""
     import glob
     import hashlib
 
     h = hashlib.sha256()
     for path in sorted(
-        [os.path.abspath(__file__)]
-        + glob.glob(os.path.join(_HERE, "processing_chain_tpu", "ops", "*.py"))
+        glob.glob(os.path.join(_HERE, "processing_chain_tpu", "ops", "*.py"))
         + glob.glob(os.path.join(_HERE, "processing_chain_tpu", "parallel", "*.py"))
     ):
         try:
@@ -198,6 +205,11 @@ class _DeviceLock:
     dir, not /tmp."""
 
     def __init__(self) -> None:
+        override = os.environ.get("PC_DEVICE_LOCK_FILE")
+        if override:
+            self.path = override  # tests: never contend with a live harvest
+            self._fh = None
+            return
         d = os.path.join(os.path.expanduser("~"), ".cache")
         try:
             os.makedirs(d, mode=0o700, exist_ok=True)

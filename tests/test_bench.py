@@ -1,0 +1,116 @@
+"""Tests for bench.py's persistence machinery (VERDICT r3 #1/#2): the
+pinned-baseline protocol and the live-TPU cache fallback that lets a
+harvest whose TPU attempts hit a wedged tunnel still report a
+measured-on-TPU number. Run the bench as a subprocess exactly like the
+driver does; artifact paths are redirected via env so the real
+BASELINE_MEASURED.json / BENCH_LIVE.json are never touched."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(tmp_path, extra_env, timeout=240):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PC_BASELINE_FILE=str(tmp_path / "baseline.json"),
+        PC_BENCH_LIVE_FILE=str(tmp_path / "live.json"),
+        PC_DEVICE_LOCK_FILE=str(tmp_path / "device.lock"),
+        BENCH_DEADLINE="150",
+        # tiny child workload: every asserted value comes from the
+        # synthetic cache/pinned artifacts, not the measurement
+        BENCH_FRAMES="2",
+        BENCH_ITERS="2",
+        **extra_env,
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+def _bench_module():
+    sys.path.insert(0, REPO)
+    import importlib
+
+    import bench
+
+    return importlib.reload(bench)
+
+
+def test_cached_live_tpu_fallback(tmp_path):
+    """A harvest whose own TPU attempts only yield the CPU backend must
+    fall back to a valid (same code-hash, same host) BENCH_LIVE.json and
+    report platform 'tpu' with source 'cached_live_run'."""
+    bench = _bench_module()
+    cache = {
+        "per_step": 0.005, "platform": "tpu", "iters": 20, "t": 8,
+        "overlay_per_step": 0.001, "overlay_frames": 10,
+        "measured_at": "2026-07-30T00:00:00Z",
+        "code_hash": bench._compute_code_hash(),
+        "host_cpu_model": bench._host_fingerprint()["cpu_model"],
+    }
+    (tmp_path / "live.json").write_text(json.dumps(cache))
+    # a pinned baseline skips the measurement loop (faster test, and the
+    # vs_baseline must divide by the pinned number)
+    (tmp_path / "baseline.json").write_text(json.dumps({
+        "baseline_8core_fps": 16.0,
+        "protocol": {"frames_per_run": 8, "runs": 5, "stat": "median"},
+        "host": bench._host_fingerprint(),
+    }))
+    out = _run_bench(tmp_path, {})
+    assert out["platform"] == "tpu"
+    assert out["source"] == "cached_live_run"
+    assert out["value"] == 1600.0  # 8 frames / 0.005 s
+    assert out["vs_baseline"] == 100.0
+    assert out["baseline_source"] == "pinned"
+    assert out["overlay_fps"] == 10000.0
+
+
+def test_cached_live_rejected_on_code_hash_mismatch(tmp_path):
+    """A live cache recorded under different device-path code must NOT be
+    reported: the harvest falls through to the CPU fallback and the
+    rejection is visible in tpu_error."""
+    bench = _bench_module()
+    cache = {
+        "per_step": 0.005, "platform": "tpu", "iters": 20, "t": 8,
+        "measured_at": "2026-07-30T00:00:00Z",
+        "code_hash": "stale-hash-0000",
+        "host_cpu_model": bench._host_fingerprint()["cpu_model"],
+    }
+    (tmp_path / "live.json").write_text(json.dumps(cache))
+    (tmp_path / "baseline.json").write_text(json.dumps({
+        "baseline_8core_fps": 16.0,
+        "protocol": {"frames_per_run": 8, "runs": 5, "stat": "median"},
+        "host": bench._host_fingerprint(),
+    }))
+    out = _run_bench(tmp_path, {})
+    assert out["platform"] == "cpu"
+    assert "source" not in out
+    assert "live cache rejected" in out.get("tpu_error", "")
+
+
+def test_pin_baseline_writes_protocol_artifact(tmp_path, monkeypatch):
+    """--pin-baseline records the full protocol: per-run fps list, median,
+    host fingerprint; the pinned artifact is then reused (baseline_source
+    'pinned') instead of re-measuring."""
+    bench = _bench_module()
+    monkeypatch.setenv("PC_BASELINE_FILE", str(tmp_path / "baseline.json"))
+    import importlib
+
+    bench = importlib.reload(bench)
+    art = bench.pin_baseline(runs=3, frames=2)
+    assert len(art["runs_fps"]) == 3
+    assert art["cpu_core_fps"] == sorted(art["runs_fps"])[1]
+    assert art["baseline_8core_fps"] == round(8 * art["cpu_core_fps"], 4)
+    assert art["host"]["cpu_count"] == os.cpu_count()
+    on_disk = json.loads((tmp_path / "baseline.json").read_text())
+    assert on_disk["protocol"]["runs"] == 3
