@@ -1107,3 +1107,73 @@ def test_native_encoder_has_no_substitution_record(short_db):
         if f.endswith(".log"):
             assert "encoder_requested" not in open(
                 os.path.join(logdir, f)).read(), f
+
+
+def test_multihost_concurrent_chain_two_processes(tmp_path):
+    """The real multi-host regime: TWO concurrent OS processes run the
+    p01-p03 chain on one shared database (JAX_NUM_PROCESSES=2, fresh
+    PC_RUN_ID). p01 shards by segment, p02/p03 by PVS, and the
+    filesystem barriers in p00 (stages/p00_process_all.py) keep a host
+    from consuming a segment the other host has not finished encoding.
+    Both processes must exit 0 and the union of their work must be the
+    complete artifact set."""
+    import subprocess
+    import sys
+
+    yaml_text = minimal_short_yaml("P2SXM79").replace(
+        "HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}",
+        "HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}\n"
+        "  HRC001: {videoCodingId: VC01, eventList: [[Q0, 1]]}",
+    ).replace(
+        "- P2SXM79_SRC000_HRC000",
+        "- P2SXM79_SRC000_HRC000\n  - P2SXM79_SRC000_HRC001",
+    )
+    yaml_path = write_db(tmp_path, "P2SXM79", yaml_text,
+                         {"SRC000.avi": dict(n=72)})
+    db = os.path.dirname(yaml_path)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def env_for(pid: int) -> dict:
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env.update(
+            JAX_PLATFORMS="cpu",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+            PC_RUN_ID="e2e-multihost-r4",
+            PYTHONPATH=os.pathsep.join(
+                p for p in (repo, env.get("PYTHONPATH")) if p
+            ),
+        )
+        return env
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "processing_chain_tpu", "-c", yaml_path,
+             "-str", "123", "--skip-requirements"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env_for(pid),
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=420)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    segs = set(os.listdir(os.path.join(db, "videoSegments")))
+    assert {f for f in segs if f.endswith(".mp4")} == {
+        "P2SXM79_SRC000_Q0_VC01_0000_0-2.mp4",
+        "P2SXM79_SRC000_Q0_VC01_0000_0-1.mp4",
+    }
+    for pvs in ("P2SXM79_SRC000_HRC000", "P2SXM79_SRC000_HRC001"):
+        assert os.path.isfile(os.path.join(db, "avpvs", pvs + ".avi")), pvs
+        assert os.path.isfile(
+            os.path.join(db, "qualityChangeEventFiles", pvs + ".qchanges")
+        ), pvs
+    # barriers of the shared run id were dropped by both hosts
+    markers = [f for f in os.listdir(os.path.join(db, "logs"))
+               if f.startswith(".barrier_e2e-multihost-r4")]
+    assert len(markers) == 6, markers  # 3 stages x 2 hosts
