@@ -31,7 +31,15 @@ while :; do
         echo "[$(date -u +%H:%M:%S)] tunnel LIVE; running full bench" >> "$LOG"
         # full bench takes the same lock itself (bench.py _DeviceLock)
         timeout -s KILL 400 python bench.py >> "$LOG" 2>&1
-        echo "[$(date -u +%H:%M:%S)] bench done; continuing to watch" >> "$LOG"
+        echo "[$(date -u +%H:%M:%S)] bench done" >> "$LOG"
+        # one stage-split profile per live window (VERDICT r3 #3):
+        # profile_p03 takes the same lock; skip once captured
+        if [ ! -s "$STATE_DIR/profile_tpu.json" ]; then
+            timeout -s KILL 600 python tools/profile_p03.py \
+                --frames 48 --chunk 16 > "$STATE_DIR/profile_tpu.json" \
+                2>> "$LOG" || echo "[profile failed]" >> "$LOG"
+            echo "[$(date -u +%H:%M:%S)] profile captured" >> "$LOG"
+        fi
         # keep refreshing (latest result wins) but back off: the number is in
         sleep $((INTERVAL * 4))
     else
