@@ -53,7 +53,7 @@ def _metrics_mesh_step(devs: tuple):
     return mesh, make_batch_metrics_step(mesh)
 
 
-def _metric_frames(ry, dy, ru, du, rv, dv):
+def _metric_frames(ry, dy, ru, du, rv, dv, with_ssim: bool = True):
     """Per-frame PSNR(Y/U/V) + SSIM(Y) of one chunk — on a multi-device
     mesh the frame axis is sharded through parallel.make_batch_metrics_step
     (frames are independent, so the mesh acts as pure frame parallelism
@@ -78,7 +78,9 @@ def _metric_frames(ry, dy, ru, du, rv, dv):
             return jax.device_put(p, batch_sharding(mesh))
 
         # Y (the expensive plane: SSIM windows) rides the mesh; chroma
-        # PSNR is cheap and frame-local, computed alongside
+        # PSNR is cheap and frame-local, computed alongside. (The mesh
+        # step computes SSIM fused with PSNR regardless of with_ssim; the
+        # flag only spares the single-device path.)
         psnr_y, ssim_y = step(shard(ry), shard(dy))
         return {
             "psnr_y": np.asarray(psnr_y).reshape(-1)[:t],
@@ -86,12 +88,14 @@ def _metric_frames(ry, dy, ru, du, rv, dv):
             "psnr_u": np.asarray(metrics_ops.psnr_frames(ru, du)),
             "psnr_v": np.asarray(metrics_ops.psnr_frames(rv, dv)),
         }
-    return {
+    out = {
         "psnr_y": np.asarray(metrics_ops.psnr_frames(ry, dy)),
         "psnr_u": np.asarray(metrics_ops.psnr_frames(ru, du)),
         "psnr_v": np.asarray(metrics_ops.psnr_frames(rv, dv)),
-        "ssim_y": np.asarray(metrics_ops.ssim_frames(ry, dy)),
     }
+    if with_ssim:
+        out["ssim_y"] = np.asarray(metrics_ops.ssim_frames(ry, dy))
+    return out
 
 
 def _src_index_map(pvs, rate: float, src_fps: float):
@@ -162,7 +166,7 @@ def _paired_chunks(
 
 def compute_pvs_metrics(
     pvs: Pvs, force: bool = False, out_dir: Optional[str] = None,
-    use_sidecar: bool = True,
+    use_sidecar: bool = True, msssim: bool = False,
 ) -> Optional[str]:
     """Write `<pvs_id>.metrics.csv`; returns the path (None if skipped).
 
@@ -226,7 +230,10 @@ def compute_pvs_metrics(
                         "reusing device features from %s", sc_path
                     )
 
-    rows = {k: [] for k in ("psnr_y", "psnr_u", "psnr_v", "ssim_y", "si", "ti")}
+    cols = ["psnr_y", "psnr_u", "psnr_v", "ssim_y", "si", "ti"]
+    if msssim:
+        cols.insert(4, "msssim_y")
+    rows = {k: [] for k in cols}
     prev_last = None  # last deg luma of the previous chunk (TI continuity)
     with tracing.span(f"metrics {pvs.pvs_id}"), VideoReader(
         avpvs_path
@@ -259,7 +266,17 @@ def compute_pvs_metrics(
                     dv.shape[-2], dv.shape[-1], "bicubic",
                 )
 
-                chunk_metrics = _metric_frames(ry, dy, ru, du, rv, dv)
+                chunk_metrics = _metric_frames(
+                    ry, dy, ru, du, rv, dv,
+                    with_ssim=not msssim,
+                )
+                if msssim:
+                    # opt-in (--msssim): frame-local, no mesh plumbing.
+                    # The combined kernel also yields plain SSIM from its
+                    # scale-1 pass, so the full-res filtering runs once.
+                    ms, s1 = metrics_ops.msssim_ssim_frames(ry, dy)
+                    chunk_metrics["msssim_y"] = np.asarray(ms)
+                    chunk_metrics.setdefault("ssim_y", np.asarray(s1))
                 for k, vals in chunk_metrics.items():
                     rows[k].append(vals)
                 if sidecar is None:
@@ -288,11 +305,12 @@ def run(
     filter_pvses: Optional[str] = None,
     force: bool = False,
     prober=None,
+    msssim: bool = False,
 ) -> list[str]:
     tc = TestConfig(config_path, filter_pvses=filter_pvses, prober=prober)
     written = []
     for pvs in tc.pvses.values():
-        path = compute_pvs_metrics(pvs, force=force)
+        path = compute_pvs_metrics(pvs, force=force, msssim=msssim)
         if path:
             written.append(path)
     return written
@@ -307,10 +325,16 @@ def build_parser(
     parser.add_argument("-c", "--test-config", required=True)
     parser.add_argument("-f", "--force", action="store_true")
     parser.add_argument("--filter-pvs", help="only these PVS-IDs ('|'-separated)")
+    parser.add_argument(
+        "--msssim", action="store_true",
+        help="add a per-frame multi-scale SSIM column (frames must be "
+        ">=176 px per side for the 5-scale pyramid)",
+    )
     return parser
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    run(args.test_config, filter_pvses=args.filter_pvs, force=args.force)
+    run(args.test_config, filter_pvses=args.filter_pvs, force=args.force,
+        msssim=args.msssim)
     return 0

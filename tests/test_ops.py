@@ -939,3 +939,64 @@ class TestNormalizeRmsOracle:
         np.testing.assert_array_equal(normalize_rms(z), z)
         e = np.zeros((0, 2), np.int16)
         assert normalize_rms(e).size == 0
+
+
+def test_msssim_against_numpy_reference():
+    """Device MS-SSIM vs an independent numpy implementation of
+    Wang/Simoncelli/Bovik 2003 (5 dyadic scales, cs at every scale,
+    luminance only at the coarsest, standard exponents)."""
+    from scipy.ndimage import convolve1d
+
+    from processing_chain_tpu.ops import metrics
+
+    def np_msssim(ref, deg, peak=255.0, k1=0.01, k2=0.03):
+        g = np.exp(-((np.arange(11) - 5.0) ** 2) / (2 * 1.5 ** 2))
+        g /= g.sum()
+        c1, c2 = (k1 * peak) ** 2, (k2 * peak) ** 2
+
+        def filt(x):
+            y = convolve1d(x, g, axis=0)[5:-5]
+            return convolve1d(y, g, axis=1)[:, 5:-5]
+
+        def cs_l(r, d):
+            mr, md = filt(r), filt(d)
+            vr = filt(r * r) - mr * mr
+            vd = filt(d * d) - md * md
+            cov = filt(r * d) - mr * md
+            cs = (2 * cov + c2) / (vr + vd + c2)
+            lum = (2 * mr * md + c1) / (mr * mr + md * md + c1)
+            return cs.mean(), (lum * cs).mean()
+
+        def pool(x):
+            h, w = x.shape
+            x = x[: h - h % 2, : w - w % 2]
+            return (x[0::2, 0::2] + x[1::2, 0::2]
+                    + x[0::2, 1::2] + x[1::2, 1::2]) / 4.0
+
+        weights = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333)
+        r, d = ref.astype(np.float64), deg.astype(np.float64)
+        out = 1.0
+        for i, w in enumerate(weights):
+            cs, full = cs_l(r, d)
+            out *= max(full if i == 4 else cs, 1e-6) ** w
+            if i != 4:
+                r, d = pool(r), pool(d)
+        return out
+
+    ref = smooth_image(240, 320)
+    rng = np.random.default_rng(5)
+    deg = np.clip(ref.astype(int) + rng.normal(0, 12, ref.shape), 0, 255
+                  ).astype(np.uint8)
+    got = float(metrics.msssim_frame(ref, deg))
+    want = np_msssim(ref, deg)
+    assert got == pytest.approx(want, abs=2e-4), (got, want)
+    # identity scores ~1; heavier degradation scores lower
+    assert float(metrics.msssim_frame(ref, ref)) > 0.9999
+    worse = np.clip(ref.astype(int) + rng.normal(0, 40, ref.shape), 0, 255
+                    ).astype(np.uint8)
+    assert float(metrics.msssim_frame(ref, worse)) < got
+    # batched form matches per-frame
+    batch = np.stack([deg, worse])
+    refs = np.stack([ref, ref])
+    pair = np.asarray(metrics.msssim_frames(refs, batch))
+    assert pair[0] == pytest.approx(got, abs=1e-5)

@@ -604,3 +604,64 @@ def test_src_analysis_siti_summary(tmp_path):
     small = src_analysis.src_siti_summary(path, chunk=4)
     assert abs(small["ti_mean"] - float(ti.mean())) < 1e-3
     assert abs(small["si_mean"] - float(si.mean())) < 1e-3
+
+
+def test_quality_metrics_msssim_column(tmp_path):
+    """--msssim adds a per-frame msssim_y column: 1.0 for an identical
+    pair, strictly lower for a degraded one (frames >=176 px per side for
+    the 5-scale pyramid)."""
+    from processing_chain_tpu.tools import quality_metrics as qm
+
+    rng = np.random.default_rng(4)
+    h, w, n = 192, 256, 4
+    frames = rng.integers(16, 235, size=(n, h, w), dtype=np.uint8)
+
+    def write(path, arr):
+        from processing_chain_tpu.io.video import VideoWriter
+
+        with VideoWriter(str(path), "ffv1", w, h, "yuv420p", (24, 1)) as wr:
+            for f in arr:
+                wr.write(
+                    f,
+                    np.full((h // 2, w // 2), 128, np.uint8),
+                    np.full((h // 2, w // 2), 128, np.uint8),
+                )
+
+    src = tmp_path / "src.avi"
+    write(src, frames)
+    clean = tmp_path / "clean.avi"
+    write(clean, frames)
+    noisy = tmp_path / "noisy.avi"
+    write(noisy, np.clip(
+        frames.astype(int) + rng.integers(-30, 30, frames.shape), 0, 255
+    ).astype(np.uint8))
+
+    class FakeTc:
+        def get_side_information_path(self):
+            return str(tmp_path / "sideInfo")
+
+    class FakeSrc:
+        file_path = str(src)
+
+    class FakePvs:
+        test_config = FakeTc()
+        src = FakeSrc()
+
+        def __init__(self, pvs_id, avpvs):
+            self.pvs_id = pvs_id
+            self._avpvs = str(avpvs)
+
+        def get_avpvs_file_path(self):
+            return self._avpvs
+
+    dfc = pd.read_csv(qm.compute_pvs_metrics(FakePvs("DB_S_H0", clean),
+                                             msssim=True))
+    dfn = pd.read_csv(qm.compute_pvs_metrics(FakePvs("DB_S_H1", noisy),
+                                             msssim=True))
+    assert list(dfc.columns) == [
+        "frame", "psnr_y", "psnr_u", "psnr_v", "ssim_y", "msssim_y",
+        "si", "ti",
+    ]
+    assert (dfc.msssim_y > 0.9999).all()
+    assert (dfn.msssim_y < 1.0).all() and (dfn.msssim_y > 0.0).all()
+    assert (dfn.msssim_y < dfc.msssim_y).all()
