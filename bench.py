@@ -245,24 +245,33 @@ class _DeviceLock:
                 self._fh = None
 
 
+def force_cpu_backend_if_requested() -> bool:
+    """Under JAX_PLATFORMS=cpu, deregister the axon plugin BEFORE jax is
+    used (its get_backend monkeypatch initializes the tunnel even when the
+    platform is pinned to cpu — same workaround as tests/conftest) and pin
+    the platform. Returns True when the cpu pin is active. Shared by the
+    bench child and the perf/profile tools."""
+    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return False
+    try:
+        from jax._src import xla_bridge as _xb
+
+        getattr(_xb, "_backend_factories", {}).pop("axon", None)
+    except Exception:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True
+
+
 def _child() -> None:
     """Device measurement; prints one JSON dict {"per_step", "platform"}.
 
     Run as a subprocess so the parent survives a mid-run tunnel wedge."""
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        # the axon plugin's get_backend monkeypatch initializes the tunnel
-        # even under JAX_PLATFORMS=cpu; deregister it (same as tests/conftest)
-        try:
-            from jax._src import xla_bridge as _xb
-
-            getattr(_xb, "_backend_factories", {}).pop("axon", None)
-        except Exception:
-            pass
+    force_cpu_backend_if_requested()
     import jax
     import jax.numpy as jnp
-
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     # CPU fallback exists only so the bench always emits a line: shrink the
     # problem (per-frame fps is what's reported, so T doesn't bias it)

@@ -1,0 +1,176 @@
+"""One-shot device perf sweep: time every hot-kernel variant in a single
+tunnel-live window and dump ONE JSON report.
+
+Run when the axon tunnel answers (takes the shared device flock; safe
+next to tools/tpu_watch.sh). Measures, per 8-frame 1080p->4K batch:
+
+  resize_fused      fused two-pass Pallas resize (luma)
+  resize_banded     XLA banded-matmul resize (luma)
+  resize_chroma     resize of the two chroma planes (fused on TPU)
+  siti_combined     single-pass fused SI+TI (round 4)
+  siti_separate     separate SI and TI fused kernels (round 3)
+  step_full         avpvs_siti_step (resize x3 + features)
+  overlay_4k        stall composite on 4K frames
+
+Timing method: same carry-fed lax.scan + min-of-N as bench.py (the
+tunnel's block_until_ready returns early, so each measurement subtracts
+an independently-minimized 1-step run). Usage:
+
+  python tools/perf_sweep.py [--iters 20] [--repeat 5] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import (  # noqa: E402 — path insert above
+    DH, DW, H, T, W, _DeviceLock, force_cpu_backend_if_requested,
+)
+
+
+def _measure(make_fn, iters: int, repeat: int) -> float:
+    """Seconds per step of fn via carry-fed scan, dispatch-corrected."""
+    assert iters >= 2
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def run(carry0, n):
+        def body(c, _):
+            out = make_fn(c)
+            # uint8 carry for every fn: tiny-scaled cast keeps the data
+            # dependency (no hoisting/CSE) without overflow concerns
+            nxt = (out.astype(jnp.float32) * 1e-20).astype(jnp.uint8)
+            return nxt, out.astype(jnp.float32)
+        c, s = jax.lax.scan(body, carry0, None, length=n)
+        return jnp.sum(s) + c.astype(jnp.float32)
+
+    carry0 = np.uint8(0)
+    float(run(carry0, 1))      # compile the 1-step variant
+    float(run(carry0, iters))  # and the scan variant (static n => own trace)
+    t_one = float("inf")
+    t_many = float("inf")
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        float(run(carry0, 1))
+        t_one = min(t_one, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        float(run(carry0, iters))
+        t_many = min(t_many, time.perf_counter() - t0)
+    return max((t_many - t_one) / (iters - 1), 1e-9)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20,
+                    help="scan length per measurement (min 2)")
+    ap.add_argument("--repeat", type=int, default=5)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    if args.iters < 2:
+        ap.error("--iters must be >= 2 (dispatch-overhead subtraction)")
+
+    # acquire the device flock BEFORE any jax call: jax.devices() itself
+    # performs PJRT client creation through the tunnel, which must never
+    # run beside another client (bench.py _DeviceLock; the wedge cause)
+    cpu_pinned = force_cpu_backend_if_requested()
+    lock = _DeviceLock()
+    if not cpu_pinned and not lock.acquire(300):
+        print(json.dumps({"error": "device lock busy"}))
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import overlay as ovl
+    from processing_chain_tpu.ops import pallas_kernels as pk
+    from processing_chain_tpu.ops import resize as resize_ops
+    from processing_chain_tpu.parallel import avpvs_siti_step
+
+    platform = jax.devices()[0].platform
+    try:
+        rng = np.random.default_rng(0)
+        y = jnp.asarray(rng.integers(0, 255, (T, H, W), np.uint8))
+        u = jnp.asarray(rng.integers(0, 255, (T, H // 2, W // 2), np.uint8))
+        v = jnp.asarray(rng.integers(0, 255, (T, H // 2, W // 2), np.uint8))
+        it, rp = args.iters, args.repeat
+        res: dict = {"platform": platform, "t_frames": T,
+                     "src": f"{W}x{H}", "dst": f"{DW}x{DH}"}
+
+        def tm(name, fn):
+            res[name] = round(_measure(fn, it, rp) * 1e3, 3)  # ms/step
+            print(f"{name}: {res[name]} ms", file=sys.stderr, flush=True)
+
+        use_pallas = platform == "tpu"
+        if use_pallas:
+            tm("resize_fused", lambda c: jnp.sum(
+                pk.resize_frames_fused(y ^ c, DH, DW, "lanczos"),
+                dtype=jnp.int32))
+        tm("resize_banded", lambda c: jnp.sum(
+            resize_ops.resize_frames(y ^ c, DH, DW, "lanczos",
+                                     method="banded"), dtype=jnp.int32))
+        tm("resize_chroma", lambda c: jnp.sum(
+            resize_ops.resize_frames(u ^ c, DH // 2, DW // 2, "lanczos"),
+            dtype=jnp.int32) + jnp.sum(
+            resize_ops.resize_frames(v ^ c, DH // 2, DW // 2, "lanczos"),
+            dtype=jnp.int32))
+        up_y = jnp.asarray(
+            rng.integers(0, 255, (T, DH, DW), np.uint8))
+        if use_pallas:
+            def combined(c):
+                si, ti = pk.siti_frames_fused(up_y ^ c)
+                return jnp.sum(si) + jnp.sum(ti)
+
+            def separate(c):
+                return (jnp.sum(pk.si_frames_fused(up_y ^ c))
+                        + jnp.sum(pk.ti_frames_fused(up_y ^ c)))
+
+            tm("siti_combined", combined)
+            tm("siti_separate", separate)
+
+        def full_step(c):
+            oy, ou, ov, si, ti = avpvs_siti_step(y ^ c, u ^ c, v ^ c, DH, DW)
+            return (jnp.sum(oy, dtype=jnp.int32)
+                    + jnp.sum(ou, dtype=jnp.int32)
+                    + jnp.sum(ov, dtype=jnp.int32)
+                    + jnp.sum(si + ti).astype(jnp.int32))
+
+        tm("step_full", full_step)
+
+        plan = ovl.plan_stalling(T, 60.0, [[0.0, T / 60.0]], skipping=False)
+        bank = rng.integers(0, 255, (128, 128, 4), dtype=np.uint8)
+        sp_yuv, sp_a = ovl.prepare_spinner(bank, n_rotations=16)
+        sp = jnp.asarray(sp_yuv[:, 0])
+        sa = jnp.asarray(sp_a)
+        f4k = jnp.asarray(
+            rng.integers(0, 255, (T, DH, DW), np.uint8)).astype(jnp.float32)
+        tm("overlay_4k", lambda c: jnp.sum(
+            ovl.render_stalled_plane(f4k + c, plan, sp, sa)))
+
+        res["ceiling_fps_from_parts"] = round(
+            T / ((res.get("resize_fused", res["resize_banded"])
+                  + res["resize_chroma"]
+                  + res.get("siti_combined", 0.0)) / 1e3), 1,
+        ) if use_pallas else None
+        res["step_full_fps"] = round(T / (res["step_full"] / 1e3), 1)
+    finally:
+        if not cpu_pinned:
+            lock.release()
+
+    line = json.dumps(res)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
