@@ -64,31 +64,23 @@ def main() -> None:
     w, h = map(int, args.res.split("x"))
     dw, dh = map(int, args.dst.split("x"))
 
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        # the axon plugin's get_backend monkeypatch initializes the tunnel
-        # even under JAX_PLATFORMS=cpu; deregister it (as bench.py/conftest)
-        try:
-            from jax._src import xla_bridge as _xb
+    from bench import _DeviceLock, force_cpu_backend_if_requested
 
-            getattr(_xb, "_backend_factories", {}).pop("axon", None)
-        except Exception:
-            pass
+    # lock BEFORE the first jax call: PJRT client creation is itself
+    # tunnel traffic and must never run beside another client
+    cpu_pinned = force_cpu_backend_if_requested()
+    lock = _DeviceLock()
+    if not cpu_pinned and not lock.acquire(300):
+        print(json.dumps({"error": "device lock busy"}))
+        return
     import jax
 
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-        jax.config.update("jax_platforms", "cpu")
-
-    from bench import _DeviceLock
     from processing_chain_tpu.engine import prefetch as pf
     from processing_chain_tpu.io.video import VideoReader
     from processing_chain_tpu.models import frames as fr
     from processing_chain_tpu.models.avpvs import SiTiAccumulator, _ffv1_writer
 
     platform = jax.devices()[0].platform
-    lock = _DeviceLock()
-    if platform not in ("cpu",) and not lock.acquire(300):
-        print(json.dumps({"error": "device lock busy"}))
-        return
 
     tmp = tempfile.mkdtemp(prefix="pc_prof_")
     src = os.path.join(tmp, "src.mp4")
@@ -170,7 +162,7 @@ def main() -> None:
         trace_ctx.__exit__(None, None, None)
         report["trace_dir"] = args.trace
 
-    if platform != "cpu":
+    if not cpu_pinned:
         lock.release()
 
     ssum = report["decode_s"] + report["device_s"] + report["encode_s"]
