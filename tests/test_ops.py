@@ -376,6 +376,34 @@ def test_spinner_crop_keeps_chroma_locked_to_luma():
     assert c_rows[0] * 2 == y_rows[0]
 
 
+def test_spinner_oversized_one_axis_only():
+    """A spinner taller than the frame but narrower (mixed case) crops
+    rows with the ffmpeg masking rule and places columns centered: crop
+    origin 0 on the fitting axis, masked-negative-placement on the
+    oversized one."""
+    import jax.numpy as jnp
+
+    h, w = 90, 160           # frame
+    sh, sw = 128, 64         # spinner: taller, narrower
+    bank = jnp.broadcast_to(
+        jnp.arange(sh, dtype=jnp.float32)[:, None], (1, sh, sw)
+    )
+    ones = jnp.ones((1, sh, sw), jnp.float32)
+    stall = jnp.ones((1,), jnp.float32)
+    black = jnp.ones((1,), jnp.float32)
+    phase = jnp.zeros((1,), jnp.int32)
+    out = np.asarray(overlay.render_core(
+        jnp.zeros((1, h, w), jnp.float32), stall, black, phase,
+        bank, ones, 16.0, crop_align=(2, 2),
+    ))
+    # rows: crop origin -((int)((90-128)/2) & ~1) = 20; cols: x0 =
+    # (160-64)//2 = 48, full spinner width kept
+    assert out[0, 0, 80] == 20.0          # top row inside spinner = bank row 20
+    assert out[0, -1, 80] == 20.0 + h - 1
+    assert out[0, 0, 47] == 16.0 and out[0, 0, 48 + sw] == 16.0  # bg outside
+    assert out[0, 0, 48] == 20.0          # left spinner edge at x0=48
+
+
 def test_clip_crop_origin_matches_ffmpeg_normalize_xy():
     """Sweep oversized-spinner geometries against a literal replica of
     ffmpeg's overlay placement: x = (int)((W-w)/2) (C trunc toward zero),
