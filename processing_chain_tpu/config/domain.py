@@ -127,6 +127,10 @@ class Coding:
         self.minrate_factor = _opt_float(data, "minrateFactor")
         self.maxrate_factor = _opt_float(data, "maxrateFactor")
         self.bufsize_factor = _opt_float(data, "bufsizeFactor")
+        # absolute minrate/maxrate/bufsize: parsed for dialect parity but
+        # consumed by NOTHING — faithful to the reference, which parses
+        # them (test_config.py:873-880) and never reads them anywhere
+        # (lib/ffmpeg.py uses only the *Factor variants, :135-140)
         self.minrate = _opt_float(data, "minrate")
         self.maxrate = _opt_float(data, "maxrate")
         self.bufsize = _opt_float(data, "bufsize")
