@@ -414,3 +414,35 @@ def test_real_corpus_yaml(tmp_path):
             assert s.duration > 0
             assert s.quality_level.width > 0
             assert s.filename.startswith(db_id)
+
+
+def test_database_id_must_match_yaml_filename(tmp_path):
+    """databaseId != YAML filename is rejected (reference _check_names,
+    test_config.py:1063-1087)."""
+    yaml_path, prober = write_short_db(tmp_path)
+    import yaml as _yaml
+
+    data = _yaml.safe_load(open(yaml_path))
+    data["databaseId"] = "P2SXM42"
+    with open(yaml_path, "w") as f:
+        _yaml.safe_dump(data, f)
+    with pytest.raises(ConfigError, match="do not match"):
+        TestConfig(yaml_path, prober=prober)
+
+
+def test_yaml_must_live_in_matching_folder(tmp_path):
+    """The YAML must sit inside a folder named like the database (the
+    folder IS the database root: every artifact path derives from it)."""
+    import shutil
+
+    yaml_path, prober = write_short_db(tmp_path)
+    wrong = tmp_path / "not-the-db"
+    wrong.mkdir()
+    moved = wrong / os.path.basename(yaml_path)
+    shutil.copy(yaml_path, moved)
+    (wrong / "srcVid").mkdir()
+    for f in os.listdir(os.path.dirname(yaml_path) + "/srcVid"):
+        shutil.copy(os.path.join(os.path.dirname(yaml_path), "srcVid", f),
+                    wrong / "srcVid" / f)
+    with pytest.raises(ConfigError, match="rename your database folder"):
+        TestConfig(str(moved), prober=prober)

@@ -258,3 +258,16 @@ def test_framesize_h265_random_gop_bframes(tmp_path):
         write_test_video(path, codec="libx265", n=24, gop=gop,
                          bframes=bframes, opts=X265_TEST_OPTS)
         assert_h265_sizes_track_packets(path, 24)
+
+
+def test_nv12_semi_planar_rejected_loudly(tmp_path):
+    """Semi-planar nv12 (interleaved chroma) must be rejected at open with
+    a clear message — silently deinterleaving it as planar would corrupt
+    every chroma plane downstream."""
+    from processing_chain_tpu.io.video import VideoReader, VideoWriter
+
+    path = str(tmp_path / "nv12.avi")
+    with VideoWriter(path, "rawvideo", 64, 48, "nv12", (24, 1)) as w:
+        w.write(np.zeros((48, 64), np.uint8), np.zeros((24, 64), np.uint8))
+    with pytest.raises(medialib.MediaError, match="non-planar"):
+        VideoReader(path)
