@@ -57,10 +57,13 @@ def avpvs_siti_step(
         # on TPU (no f32 materialization of the 4K batch)
         si, ti = siti_ops.siti(up_y)
     else:
-        si = siti_ops.si_frames(up_y)  # container depth: see above
-        yf = up_y.astype(jnp.float32)
-        prev = jnp.concatenate([prev_last[None], yf[:-1]], axis=0)
-        ti = jax.vmap(jnp.std)(yf - prev)
+        # same single-implementation path as the sharded steps: a 1-lane
+        # batch with prev_last (the previous shard's last QUANTIZED luma)
+        # as the halo frame
+        si_b, ti_b = siti_ops.siti_batch(
+            up_y[None], prev_last[None].astype(up_y.dtype)
+        )
+        si, ti = si_b[0], ti_b[0]
     return up_y, up_u, up_v, si, ti
 
 

@@ -349,3 +349,26 @@ def test_multiprocess_distributed_end_to_end():
         f"PVS{i:02d}" for i in range(10)
     ]
     assert not set(outs[0]["shard"]) & set(outs[1]["shard"])
+
+
+def test_avpvs_siti_step_prev_last_continuity():
+    """avpvs_siti_step with prev_last: TI[0] diffs against the previous
+    shard's last quantized luma (same math as the sharded halo path)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(21)
+    y = jnp.asarray(rng.integers(0, 255, (3, 36, 64), np.uint8))
+    u = jnp.asarray(rng.integers(0, 255, (3, 18, 32), np.uint8))
+    v = jnp.asarray(rng.integers(0, 255, (3, 18, 32), np.uint8))
+    up_y, _, _, si0, ti0 = avpvs_siti_step(y, u, v, 72, 128)
+    assert float(ti0[0]) == 0.0
+    prev = up_y[-1].astype(jnp.float32)
+    up_y2, _, _, si1, ti1 = avpvs_siti_step(y, u, v, 72, 128, prev_last=prev)
+    # SI is prev-independent; TI[0] now diffs against prev (== up_y[-1])
+    np.testing.assert_allclose(np.asarray(si0), np.asarray(si1), rtol=1e-5)
+    want = float(np.std(np.asarray(up_y)[0].astype(np.float64)
+                        - np.asarray(up_y)[-1].astype(np.float64)))
+    assert float(ti1[0]) == pytest.approx(want, abs=1e-2)
+    np.testing.assert_allclose(
+        np.asarray(ti0)[1:], np.asarray(ti1)[1:], rtol=1e-5, atol=1e-4
+    )
