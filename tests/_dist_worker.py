@@ -55,6 +55,46 @@ def main() -> None:
     )(garr)
     lanes = [float(v) for v in np.asarray(per_lane)]
 
+    # the REAL production step over the cross-process mesh with the TIME
+    # axis sharded across the two processes: the TI halo ppermute in
+    # make_sharded_step crosses the process boundary (the DCN analog of
+    # the ICI neighbor exchange). Both processes build the SAME full clip
+    # (seed 7) and supply their time-half; TI at the boundary frame must
+    # still equal the sequential single-device reference.
+    from processing_chain_tpu.parallel import avpvs_siti_step, make_sharded_step
+    from processing_chain_tpu.parallel.mesh import batch_sharding, make_mesh
+
+    rng = np.random.default_rng(7)
+    t_glob, h, w = 8, 36, 64
+    t_loc = t_glob // num
+    fy = rng.integers(0, 255, (1, t_glob, h, w), np.uint8)
+    fu = rng.integers(0, 255, (1, t_glob, h // 2, w // 2), np.uint8)
+    fv = rng.integers(0, 255, (1, t_glob, h // 2, w // 2), np.uint8)
+    tmesh = make_mesh(jax.devices(), time_parallel=num)  # pvs=1, time=num
+
+    def g(full):
+        local = full[:, pid * t_loc: (pid + 1) * t_loc]
+        return jax.make_array_from_process_local_data(
+            batch_sharding(tmesh), local, full.shape
+        )
+
+    step = make_sharded_step(tmesh, h * 2, w * 2, "lanczos")
+    _, _, _, si, ti = step(g(fy), g(fu), g(fv))
+    rep = NamedSharding(tmesh, P(None))
+    si_host = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(si))[0]
+    ti_host = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(ti))[0]
+    _, _, _, si_ref, ti_ref = avpvs_siti_step(
+        jnp.asarray(fy[0]), jnp.asarray(fu[0]), jnp.asarray(fv[0]),
+        h * 2, w * 2,
+    )
+    step_ok = bool(
+        np.allclose(si_host, np.asarray(si_ref), rtol=2e-5, atol=1e-4)
+        and np.allclose(ti_host, np.asarray(ti_ref), rtol=2e-5, atol=1e-4)
+        # the boundary frame's TI is nonzero and halo-derived: a broken
+        # ppermute would zero it or use the wrong neighbor
+        and ti_host[t_loc] > 0.0
+    )
+
     print(json.dumps({
         "pid": pid,
         "process_count": jax.process_count(),
@@ -62,6 +102,8 @@ def main() -> None:
         "shard": shard,
         "total": total,
         "lanes": lanes,
+        "sharded_step_ok": step_ok,
+        "si_all_lanes": [float(x) for x in si_host.reshape(-1)],
     }))
 
 

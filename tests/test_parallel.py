@@ -344,6 +344,13 @@ def test_multiprocess_distributed_end_to_end():
         assert rec["total"] == 768.0
         # replicated gather delivers every lane's mean to every process
         assert rec["lanes"] == [1.0, 2.0]
+        # the production sharded step ran over the cross-process mesh and
+        # each process's lane matches its local single-device reference
+        assert rec["sharded_step_ok"] is True
+    # both processes observed the SAME global per-lane features
+    assert outs[0]["si_all_lanes"] == pytest.approx(
+        outs[1]["si_all_lanes"], rel=1e-6
+    )
     # the two hosts' work shards partition the PVS list
     assert sorted(outs[0]["shard"] + outs[1]["shard"]) == [
         f"PVS{i:02d}" for i in range(10)
