@@ -111,3 +111,39 @@ def test_p01_runs_jobs_through_parallel_pool(monkeypatch, tmp_path):
     )
     p01.run(cli, test_config=tc)
     assert state["peak"] >= 2, f"p01 peak concurrency {state['peak']}"
+
+
+def test_failed_job_removes_partial_artifact_and_rerun_recovers(tmp_path):
+    """Failure detection + restart-recovery (SURVEY §5): a job that dies
+    mid-write must not leave a partial artifact for a later run's
+    skip-existing check to trust; the rerun then regenerates it."""
+    from processing_chain_tpu.engine.jobs import Job, JobRunner
+
+    out = tmp_path / "seg.mp4"
+
+    def bad():
+        out.write_bytes(b"partial garbage")
+        raise RuntimeError("decoder died mid-stream")
+
+    r = JobRunner(force=False, dry_run=False, parallelism=2, name="t")
+    r.add(Job(label="enc", output_path=str(out), fn=bad))
+    with pytest.raises(ChainError, match="mid-stream"):
+        r.run()
+    assert not out.exists()  # partial artifact unlinked
+
+    def good():
+        out.write_bytes(b"complete artifact")
+        return str(out)
+
+    r2 = JobRunner(force=False, dry_run=False, parallelism=2, name="t")
+    r2.add(Job(label="enc", output_path=str(out), fn=good))
+    r2.run()
+    assert out.read_bytes() == b"complete artifact"
+
+    # and skip-existing honors the now-complete artifact
+    ran = []
+    r3 = JobRunner(force=False, dry_run=False, parallelism=2, name="t")
+    r3.add(Job(label="enc", output_path=str(out),
+               fn=lambda: ran.append(1)))
+    r3.run()
+    assert not ran
