@@ -150,10 +150,14 @@ def pin_baseline(runs: int = 5, frames: int = 8) -> dict:
         "cpu_core_fps": med,
         "baseline_8core_fps": round(8.0 * med, 4),
         "host": _host_fingerprint(),
-        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "measured_at": _utcnow(),
     }
     _dump_json_atomic(art, BASELINE_FILE)
     return art
+
+
+def _utcnow() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
 def _load_json(path: str) -> dict | None:
@@ -499,9 +503,8 @@ def main() -> None:
         # number must be one the CURRENT code can reproduce) so a future
         # harvest whose attempts hit a wedged tunnel still reports a
         # measured-on-TPU number
-        rec = dict(res, measured_at=time.strftime(
-            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-            code_hash=code_hash, host_cpu_model=host_model)
+        rec = dict(res, measured_at=_utcnow(),
+                   code_hash=code_hash, host_cpu_model=host_model)
         try:
             _dump_json_atomic(rec, LIVE_FILE)
         except OSError:
@@ -558,8 +561,7 @@ def main() -> None:
                     "protocol": {"frames_per_run": done, "runs": 1,
                                  "stat": "single run (harvest fallback)"},
                     "host": _host_fingerprint(),
-                    "measured_at": time.strftime(
-                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    "measured_at": _utcnow(),
                 }
                 _dump_json_atomic(pin_art, BASELINE_FILE)
             except OSError:

@@ -39,6 +39,12 @@ _ENCODERS = {
 _warned_substitutions: set = set()
 
 
+def reset_run_state() -> None:
+    """Start-of-run reset (stage drivers call this): substitution warnings
+    fire once per RUN, not once per process lifetime."""
+    _warned_substitutions.clear()
+
+
 def _encoder_opts(
     segment: Segment, current_pass: int, total_passes: int,
     stats_path: str = "",

@@ -22,7 +22,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     # "warn once per run": a run is one p01 invocation, not the process
     # lifetime (a long-lived caller processing several databases must warn
     # for each)
-    seg_model._warned_substitutions.clear()
+    seg_model.reset_run_state()
     runner = JobRunner(
         force=cli_args.force,
         dry_run=cli_args.dry_run,

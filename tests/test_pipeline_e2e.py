@@ -1042,7 +1042,7 @@ def test_nvenc_substitution_warns_and_records(tmp_path, chain_log):
     both the requested and the substituted encoder (VERDICT r3 #4)."""
     from processing_chain_tpu.models import segments as seg_model
 
-    seg_model._warned_substitutions.clear()
+    seg_model.reset_run_state()
     yaml_text = minimal_short_yaml("P2SXM84", encoder="h264_nvenc")
     yaml_path = write_db(tmp_path, "P2SXM84", yaml_text, {"SRC000.avi": dict(n=48)})
     rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
@@ -1066,7 +1066,7 @@ def test_nvenc_substitution_warns_once_across_segments(tmp_path, chain_log):
     warning (once per run, not per job) but two provenance records."""
     from processing_chain_tpu.models import segments as seg_model
 
-    seg_model._warned_substitutions.clear()
+    seg_model.reset_run_state()
     yaml_text = textwrap.dedent("""\
         databaseId: P2SXM85
         syntaxVersion: 6

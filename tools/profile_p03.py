@@ -92,6 +92,12 @@ def main() -> None:
         with VideoReader(src) as reader:
             yield from pf.iter_plane_chunks(reader, args.chunk)
 
+    def scale_quant(chunk):
+        """The device work of the product path (models/avpvs._pump)."""
+        return fr.quantize_device(
+            fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2)), False
+        )
+
     report = {
         "platform": platform, "frames": args.frames,
         "src": f"{w}x{h}", "dst": f"{dw}x{dh}", "chunk": args.chunk,
@@ -108,8 +114,7 @@ def main() -> None:
         feat = SiTiAccumulator()
         outs = []
         for chunk in cached:
-            scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
-            quant = fr.quantize_device(scaled, False)
+            quant = scale_quant(chunk)
             feat.update(quant[0])
             outs.append(quant)
         # materialize: the product path fetches every plane for the writer
@@ -126,8 +131,7 @@ def main() -> None:
     # --- stage 3: FFV1 encode only (pre-resized content, reused)
     pre = []
     for chunk in cached:
-        scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
-        pre.append([np.asarray(p) for p in fr.quantize_device(scaled, False)])
+        pre.append([np.asarray(p) for p in scale_quant(chunk)])
     out1 = os.path.join(tmp, "enc.avi")
     t0 = time.perf_counter()
     with _ffv1_writer(out1, dw, dh, "yuv420p", 24.0, False) as wtr:
@@ -146,8 +150,7 @@ def main() -> None:
         with pf.AsyncWriter(_ffv1_writer(out, dw, dh, "yuv420p", 24.0, False)) as aw:
             with pf.Prefetcher(decode_chunks(), depth=2) as pre_it:
                 for chunk in pre_it:
-                    scaled = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
-                    quant = fr.quantize_device(scaled, False)
+                    quant = scale_quant(chunk)
                     feat.update(quant[0])
                     aw.put(quant)
 
