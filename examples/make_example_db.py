@@ -9,11 +9,14 @@ a complete, runnable database exists after one command with no downloads.
 
     python examples/make_example_db.py /tmp/dbs                 # short DB
     python examples/make_example_db.py /tmp/dbs --type long     # long DB
+    python examples/make_example_db.py /tmp/dbs --type mixed    # h265+vp9
     python -m processing_chain_tpu -c /tmp/dbs/P2SXM99/P2SXM99.yaml -v
 
 The short database exercises: bitrate-targeted 2-pass and CRF x264 coding,
 an fps-ladder downsample, a stalling HRC (spinner overlay in p03), and two
-viewing contexts (pc + mobile) in p04. The long database adds: multi-segment
+viewing contexts (pc + mobile) in p04. The mixed database is BASELINE.json
+config 3's shape: an H.265 + VP9 PVS mix whose stalling HRCs run the
+spinner-overlay composite during the AVPVS upscale. The long database adds: multi-segment
 planning with quality switches, AAC audio coding, a mid-stream stall, and
 last-segment truncation against the SRC duration (reference
 lib/test_config.py:1216-1220 semantics).
@@ -59,6 +62,29 @@ pvsList:
 postProcessingList:
   - {{type: pc, displayWidth: 640, displayHeight: 360, codingWidth: 640, codingHeight: 360, displayFrameRate: 24}}
   - {{type: mobile, displayWidth: 640, displayHeight: 360, codingWidth: 640, codingHeight: 360, displayFrameRate: 24}}
+"""
+
+MIXED_YAML = """\
+databaseId: {db_id}
+syntaxVersion: 6
+type: short
+qualityLevelList:
+  Q0: {{index: 0, videoCodec: h265, videoBitrate: 500, width: 640, height: 360, fps: 24}}
+  Q1: {{index: 1, videoCodec: vp9, videoBitrate: 500, width: 640, height: 360, fps: 24}}
+codingList:
+  VC01: {{type: video, encoder: libx265, passes: 1, iFrameInterval: 2, preset: ultrafast}}
+  VC02: {{type: video, encoder: libvpx-vp9, passes: 1, iFrameInterval: 2, speed: 4}}
+srcList:
+  SRC000: SRC000.avi
+  SRC001: SRC001.avi
+hrcList:
+  HRC000: {{videoCodingId: VC01, eventList: [[Q0, 4], [stall, 1.0]]}}
+  HRC001: {{videoCodingId: VC02, eventList: [[Q1, 4], [stall, 1.0]]}}
+pvsList:
+  - {db_id}_SRC000_HRC000
+  - {db_id}_SRC001_HRC001
+postProcessingList:
+  - {{type: pc, displayWidth: 1280, displayHeight: 720, codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}}
 """
 
 LONG_YAML = """\
@@ -119,18 +145,21 @@ def render_src(path: str, w: int, h: int, n: int, fps: int, seed: int,
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("out_dir", help="directory to create the database under")
-    ap.add_argument("--type", choices=("short", "long"), default="short")
+    ap.add_argument("--type", choices=("short", "long", "mixed"),
+                    default="short")
     ap.add_argument("--db-id", default=None,
-                    help="database id (default P2SXM99 short / P2LTR99 long)")
+                    help="database id (default P2SXM99 short / P2LTR99 long "
+                    "/ P2SXM98 mixed)")
     ap.add_argument("--src-seconds", type=int, default=None,
                     help="SRC duration in seconds (default: 6 short, 10 long; "
                     "the long event list totals 12 s, so the default "
                     "exercises last-segment truncation)")
     args = ap.parse_args(argv)
 
-    db_id = args.db_id or ("P2SXM99" if args.type == "short" else "P2LTR99")
+    db_id = args.db_id or {"short": "P2SXM99", "long": "P2LTR99",
+                           "mixed": "P2SXM98"}[args.type]
     if args.src_seconds is None:
-        secs = 6 if args.type == "short" else 10
+        secs = 10 if args.type == "long" else 6
     elif args.src_seconds > 0:
         secs = args.src_seconds
     else:
@@ -140,12 +169,13 @@ def main(argv: list[str] | None = None) -> int:
     src_dir = os.path.join(db_dir, "srcVid")
     os.makedirs(src_dir, exist_ok=True)
 
-    tmpl = SHORT_YAML if args.type == "short" else LONG_YAML
+    tmpl = {"short": SHORT_YAML, "long": LONG_YAML,
+            "mixed": MIXED_YAML}[args.type]
     yaml_path = os.path.join(db_dir, f"{db_id}.yaml")
     with open(yaml_path, "w") as f:
         f.write(tmpl.format(db_id=db_id))
 
-    n_srcs = 2 if args.type == "short" else 1
+    n_srcs = 1 if args.type == "long" else 2
     for s in range(n_srcs):
         render_src(
             os.path.join(src_dir, f"SRC{s:03d}.avi"),

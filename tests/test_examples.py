@@ -54,3 +54,18 @@ def test_long_example_plans_truncation_and_audio(tmp_path):
     total = sum(s.end_time - s.start_time for s in pvs.segments)
     assert total == pytest.approx(2.0, abs=0.26)
     assert all(s.audio_coding is not None for s in pvs.segments)
+
+
+def test_mixed_example_is_h265_vp9_with_stalls(tmp_path):
+    """--type mixed produces BASELINE config 3's shape: an H.265 + VP9
+    PVS mix whose HRCs all carry a stall event (spinner composite in
+    p03); both codecs plan one segment each."""
+    yaml_path = _generate(tmp_path, "--type", "mixed")
+    tc = TestConfig(yaml_path)
+    assert not tc.is_long()
+    encoders = sorted(
+        s.video_coding.encoder for s in tc.get_required_segments()
+    )
+    assert encoders == ["libvpx-vp9", "libx265"]
+    for pvs in tc.pvses.values():
+        assert pvs.get_buff_events_media_time(), pvs.pvs_id  # stalls planned
