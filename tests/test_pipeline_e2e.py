@@ -1177,3 +1177,19 @@ def test_multihost_concurrent_chain_two_processes(tmp_path):
     markers = [f for f in os.listdir(os.path.join(db, "logs"))
                if f.startswith(".barrier_e2e-multihost-r4")]
     assert len(markers) == 6, markers  # 3 stages x 2 hosts
+
+
+def test_trace_dir_captures_device_profile(tmp_path):
+    """--trace DIR additionally records a jax.profiler device trace into
+    DIR (viewable with xprof/perfetto) alongside the timing report."""
+    yaml_path = write_db(tmp_path, "P2SXM78", minimal_short_yaml("P2SXM78"),
+                         {"SRC000.avi": dict(n=48)})
+    assert cli_main(["p01", "-c", yaml_path, "--skip-requirements"]) == 0
+    trace_dir = str(tmp_path / "xprof")
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements",
+                   "--trace", trace_dir])
+    assert rc == 0
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found, f"no profiler artifacts under {trace_dir}"
