@@ -379,3 +379,54 @@ def test_avpvs_siti_step_prev_last_continuity():
     np.testing.assert_allclose(
         np.asarray(ti0)[1:], np.asarray(ti1)[1:], rtol=1e-5, atol=1e-4
     )
+
+
+def test_p03_batch_ten_bit_and_many_wave_lanes(devices8):
+    """Two remaining matrix cells of the batch path: (a) 10-bit lanes
+    (u16 planes, 0..1023) resize/quantize/feature identically to the
+    direct per-lane path; (b) 16 lanes on a 4-wide pvs mesh schedule as
+    4 waves with every lane's output and features intact."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.ops import resize, siti
+    from processing_chain_tpu.parallel import p03_batch
+
+    mesh = make_mesh(None, time_parallel=2)  # pvs=4, time=2
+    assert p03_batch.wave_count(16, mesh) == 4
+    rng = np.random.default_rng(8)
+    sh, sw, dh, dw = 36, 64, 72, 128
+    n_lanes = 16
+    outs = {i: [] for i in range(n_lanes)}
+    feats = {i: [] for i in range(n_lanes)}
+    lanes = []
+    srcs = []
+    for i in range(n_lanes):
+        n = 3 + (i % 5)
+        yuv = [
+            rng.integers(0, 1023, size=(n, sh, sw), dtype=np.uint16),
+            rng.integers(0, 1023, size=(n, sh // 2, sw // 2), dtype=np.uint16),
+            rng.integers(0, 1023, size=(n, sh // 2, sw // 2), dtype=np.uint16),
+        ]
+        srcs.append(yuv)
+        lanes.append(p03_batch.Lane(
+            chunks=iter([yuv]), emit=outs[i].append, n_frames_hint=n,
+            emit_features=lambda s, t, i=i: feats[i].append((s, t)),
+        ))
+    p03_batch.run_bucket(
+        lanes, mesh, dh, dw, "bicubic", (2, 2), True, chunk=4
+    )
+    for i in range(n_lanes):
+        n = srcs[i][0].shape[0]
+        got_y = np.concatenate([blk[0] for blk in outs[i]])
+        assert got_y.dtype == np.uint16 and got_y.shape == (n, dh, dw)
+        want_y = np.asarray(resize.resize_frames(
+            jnp.asarray(srcs[i][0]), dh, dw, "bicubic"
+        ))
+        np.testing.assert_array_equal(got_y, want_y)
+        # features: SI matches the direct computation on the quantized luma
+        si = np.concatenate([s for s, _ in feats[i]])
+        ti = np.concatenate([t for _, t in feats[i]])
+        assert si.shape == (n,) and ti.shape == (n,)
+        si_ref = np.asarray(siti.si_frames(jnp.asarray(want_y)))
+        np.testing.assert_allclose(si, si_ref, rtol=2e-5, atol=1e-3)
+        assert ti[0] == 0.0
