@@ -1,0 +1,50 @@
+"""Run the REFERENCE chain's config parser on a database and print its
+derived plan as JSON — the executable oracle for planner parity tests.
+
+Usage: python ref_plan.py /root/reference /path/to/DB/DB.yaml
+The caller must put tests/oracle (the ffprobe stub) on PATH and provide
+<file>.probe.json next to every media file the reference will probe.
+"""
+import json
+import logging
+import os
+import sys
+
+ref_root, yaml_path = sys.argv[1], sys.argv[2]
+sys.path.insert(0, ref_root)
+logging.basicConfig(level=logging.ERROR)
+os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(yaml_path))))
+rel = os.path.relpath(os.path.abspath(yaml_path))
+
+from lib.test_config import TestConfig  # noqa: E402
+
+try:
+    tc = TestConfig(rel)
+except SystemExit:
+    # the reference rejected the database (validation error): an explicit
+    # sentinel, so the caller can tell rejection from a harness crash
+    print(json.dumps({"rejected": True}))
+    sys.exit(0)
+except TypeError as exc:
+    # known reference quirk: a src_duration event that is not the FIRST
+    # event crashes _create_required_segments (test_config.py:1171-1173
+    # only special-cases event_list[0]; the sum at :1173 then adds int +
+    # "src_duration"). Treat as a rejection-by-crash: the input is
+    # refused either way (ours raises a clear ConfigError instead).
+    if "src_duration" in str(exc) or "int" in str(exc):
+        print(json.dumps({"rejected": True, "crash": str(exc)[:120]}))
+        sys.exit(0)
+    raise
+segs = tc.get_required_segments()
+print(json.dumps({
+    "segments": sorted(
+        [{
+            "filename": s.filename,
+            "start": s.start_time,
+            "duration": s.duration,
+            "target_bitrate": s.target_video_bitrate,
+        } for s in segs],
+        key=lambda d: d["filename"],
+    ),
+    "pvses": sorted(tc.pvses.keys()),
+}))
