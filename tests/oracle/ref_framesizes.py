@@ -1,0 +1,21 @@
+"""Run the REFERENCE's frame-size scanners on a segment file and print
+the per-frame sizes as JSON — the executable oracle for
+io/framesizes.py. The remux the reference shells out for is served by
+the stub ffmpeg in this directory (our native extract_annexb/extract_ivf).
+
+Usage: python ref_framesizes.py /root/reference <codec> <segment-file>
+"""
+import json
+import sys
+
+ref_root, codec, path = sys.argv[1], sys.argv[2], sys.argv[3]
+sys.path.insert(0, ref_root)
+
+from lib import get_framesize  # noqa: E402
+
+fn = {
+    "h264": get_framesize.get_framesize_h264,
+    "h265": get_framesize.get_framesize_h265,
+    "vp9": get_framesize.get_framesize_vp9,
+}[codec]
+print(json.dumps({"sizes": [int(x) for x in fn(path, True)]}))

@@ -241,3 +241,50 @@ def test_planner_matches_reference_oracle(tmp_path, seed):
             assert o["target_bitrate"] == pytest.approx(
                 float(r["target_bitrate"]), abs=1e-9
             ), name
+
+
+@pytest.mark.parametrize("codec,encoder,ext", [
+    ("h264", "libx264", "mp4"),
+    ("h265", "libx265", "mp4"),
+    ("vp9", "libvpx-vp9", "webm"),
+])
+def test_framesizes_match_reference_scanner(tmp_path, codec, encoder, ext):
+    """Frame-size parity with the REFERENCE's byte-at-a-time scanners
+    (lib/get_framesize.py): a segment encoded through OUR native boundary
+    is remuxed by OUR extract_annexb/extract_ivf (served to the reference
+    through the stub ffmpeg) and the reference's per-frame byte sizes
+    must equal our vectorized numpy scan exactly."""
+    import numpy as np
+
+    from processing_chain_tpu.io import framesizes
+    from processing_chain_tpu.io.video import VideoWriter
+
+    rng = np.random.default_rng(3)
+    path = str(tmp_path / f"seg.{ext}")
+    kw = {}
+    if encoder == "libvpx-vp9":
+        kw["opts"] = "deadline=realtime:cpu-used=8"
+    elif encoder == "libx265":
+        kw["opts"] = "preset=ultrafast"
+    else:
+        kw["opts"] = "preset=ultrafast"
+    with VideoWriter(path, encoder, 160, 96, "yuv420p", (24, 1),
+                     bitrate_kbps=150, gop=8, threads=1, **kw) as w:
+        base = rng.integers(0, 255, (96, 160), np.uint8)
+        for i in range(25):
+            y = np.roll(base, 5 * i, axis=1)
+            w.write(y, np.full((48, 80), 128, np.uint8),
+                    np.full((48, 80), 128, np.uint8))
+
+    ours = framesizes.get_framesizes(path, codec, force=True)
+    assert len(ours) == 25
+
+    env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
+    out = subprocess.run(
+        [sys.executable, os.path.join(ORACLE, "ref_framesizes.py"),
+         REF, codec, path],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    ref_sizes = json.loads(out.stdout.strip().splitlines()[-1])["sizes"]
+    assert ref_sizes == list(ours)
