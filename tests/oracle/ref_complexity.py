@@ -1,0 +1,30 @@
+"""Run the REFERENCE's complexity feature + classifier on a set of proxy
+files and print the records as JSON — the executable oracle for
+tools/complexity.py. The probing is served by the stub ffprobe.
+
+Usage: python ref_complexity.py /root/reference <file1> <file2> ...
+"""
+import json
+import sys
+
+ref_root = sys.argv[1]
+files = sys.argv[2:]
+sys.path.insert(0, ref_root)
+
+import pandas as pd  # noqa: E402
+
+from util.complexity_classification import (  # noqa: E402
+    classify_complexity, get_difficulty,
+)
+
+recs = [get_difficulty(f) for f in files]
+data = pd.DataFrame(recs)
+quantiles = {
+    "low": data[data["framerate"] <= 30]["complexity"].quantile([.25, .5, .75]),
+    "high": data[data["framerate"] > 30]["complexity"].quantile([.25, .5, .75]),
+}
+for r in recs:
+    r["complexity_class"] = classify_complexity(
+        r["complexity"], r["framerate"], quantiles
+    )
+print(json.dumps(recs))

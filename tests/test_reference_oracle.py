@@ -288,3 +288,73 @@ def test_framesizes_match_reference_scanner(tmp_path, codec, encoder, ext):
     assert out.returncode == 0, out.stderr[-1500:]
     ref_sizes = json.loads(out.stdout.strip().splitlines()[-1])["sizes"]
     assert ref_sizes == list(ours)
+
+
+def test_complexity_features_match_reference(tmp_path):
+    """Complexity-feature + classifier parity with the REFERENCE tool
+    (util/complexity_classification.py): identical norm_bitrate,
+    complexity and class 0-3 for a batch of synthetic proxy encodes
+    spanning both framerate bands (probing served by the stub ffprobe)."""
+    import numpy as np
+
+    from processing_chain_tpu.tools import complexity as our_cx
+
+    rng = np.random.default_rng(4)
+    files = []
+    for i in range(12):
+        size = int(rng.integers(30_000, 2_000_000))
+        dur = float(rng.integers(4, 12))
+        fps_v = [24, 25, 30, 50, 60][int(rng.integers(0, 5))]
+        w, h = [(640, 360), (1280, 720), (1920, 1080)][int(rng.integers(0, 3))]
+        f = tmp_path / f"SYN{i:02d}.avi"
+        f.write_bytes(b"\x00" * size)
+        (tmp_path / f"SYN{i:02d}.avi.probe.json").write_text(json.dumps({
+            "streams": [{
+                "codec_type": "video", "codec_name": "h264",
+                "width": w, "height": h, "pix_fmt": "yuv420p",
+                "duration": f"{dur:.6f}", "bit_rate": str(size * 8),
+                "r_frame_rate": f"{fps_v}/1", "avg_frame_rate": f"{fps_v}/1",
+                "profile": "High",
+            }],
+        }))
+        files.append(str(f))
+
+    env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
+    out = subprocess.run(
+        [sys.executable, os.path.join(ORACLE, "ref_complexity.py"), REF]
+        + files,
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    ref_recs = {r["file"]: r for r in json.loads(out.stdout.strip())}
+
+    import pandas as pd
+
+    # serve OUR probing from the same recorded JSON the stub ffprobe
+    # serves the reference (the synthetic proxies are not real media;
+    # the parity under test is the numeric pipeline, not the prober)
+    def fake_probe(path):
+        rec = json.loads(open(path + ".probe.json").read())["streams"][0]
+        from fractions import Fraction
+
+        return {
+            "file_size": os.path.getsize(path),
+            "video_duration": float(rec["duration"]),
+            "video_frame_rate": float(Fraction(rec["r_frame_rate"])),
+            "video_width": rec["width"],
+            "video_height": rec["height"],
+        }
+
+    orig = our_cx.get_segment_info
+    our_cx.get_segment_info = fake_probe
+    try:
+        ours = pd.DataFrame([our_cx.get_difficulty(f) for f in files])
+    finally:
+        our_cx.get_segment_info = orig
+    ours = our_cx.classify_dataframe(ours)
+    assert len(ours) == len(ref_recs)
+    for _, o in ours.iterrows():
+        r = ref_recs[o["file"]]
+        assert o["norm_bitrate"] == pytest.approx(r["norm_bitrate"], rel=1e-12)
+        assert o["complexity"] == pytest.approx(r["complexity"], rel=1e-12)
+        assert int(o["complexity_class"]) == int(r["complexity_class"]), o["file"]
