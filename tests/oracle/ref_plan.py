@@ -1,7 +1,9 @@
 """Run the REFERENCE chain's config parser on a database and print its
 derived plan as JSON — the executable oracle for planner parity tests.
 
-Usage: python ref_plan.py /root/reference /path/to/DB/DB.yaml
+Usage: python ref_plan.py /root/reference /path/to/DB/DB.yaml [--commands]
+With --commands, also emit each segment's full ffmpeg encode command
+string (lib/ffmpeg.encode_segment) for encode-parameter parity.
 The caller must put tests/oracle (the ffprobe stub) on PATH and provide
 <file>.probe.json next to every media file the reference will probe.
 """
@@ -36,6 +38,15 @@ except TypeError as exc:
         sys.exit(0)
     raise
 segs = tc.get_required_segments()
+commands = {}
+if "--commands" in sys.argv:
+    import lib.ffmpeg as ref_ffmpeg
+
+    for s_ in segs:
+        try:
+            commands[s_.filename] = ref_ffmpeg.encode_segment(s_, overwrite=True)
+        except SystemExit:
+            commands[s_.filename] = None
 print(json.dumps({
     "segments": sorted(
         [{
@@ -47,4 +58,5 @@ print(json.dumps({
         key=lambda d: d["filename"],
     ),
     "pvses": sorted(tc.pvses.keys()),
+    "commands": commands,
 }))

@@ -358,3 +358,89 @@ def test_complexity_features_match_reference(tmp_path):
         assert o["norm_bitrate"] == pytest.approx(r["norm_bitrate"], rel=1e-12)
         assert o["complexity"] == pytest.approx(r["complexity"], rel=1e-12)
         assert int(o["complexity_class"]) == int(r["complexity_class"]), o["file"]
+
+
+@pytest.mark.parametrize("seed", [0, 2, 4, 5])
+def test_encode_parameters_match_reference_commands(tmp_path, seed):
+    """Encode-parameter parity: the REFERENCE's full ffmpeg command
+    strings (lib/ffmpeg.encode_segment via the oracle's --commands mode)
+    are parsed field by field and must agree with OUR encode plan —
+    trim window, scale width, output fps, rate-control mode and value,
+    GOP/keyint, preset, pix_fmt, pass count."""
+    import re
+
+    import numpy as np
+
+    from processing_chain_tpu.models import segments as seg_model
+
+    rng = np.random.default_rng(1000 + seed)
+    long = bool(seed % 2)
+    db_id = f"P2{'L' if long else 'S'}XM{40 + seed}"
+    src_secs = float(rng.integers(8, 20))
+    yaml_text = _gen_db(rng, db_id, long)
+    yaml_path = _build_fixture(tmp_path, db_id, yaml_text, src_secs)
+
+    env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
+    out = subprocess.run(
+        [sys.executable, os.path.join(ORACLE, "ref_plan.py"), REF,
+         yaml_path, "--commands"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, (out.stdout[-300:], out.stderr[-1200:])
+    plan = json.loads(out.stdout.strip().splitlines()[-1])
+    if plan.get("rejected"):
+        pytest.skip("reference rejects this seed's database")
+    commands = plan["commands"]
+
+    from processing_chain_tpu.config import StaticProber, TestConfig
+
+    prober = StaticProber({}, default=dict(
+        width=SRC_W, height=SRC_H, pix_fmt="yuv420p",
+        r_frame_rate=str(SRC_FPS), avg_frame_rate=f"{SRC_FPS}/1",
+        video_duration=src_secs,
+    ))
+    tc = TestConfig(yaml_path, prober=prober)
+    segs = {s.filename: s for s in tc.get_required_segments()}
+    assert sorted(segs) == sorted(commands)
+
+    checked = 0
+    for name, cmd in commands.items():
+        assert cmd, name
+        seg = segs[name]
+        t_h, t_w, _tfps, out_fps = seg_model.plan_segment_frames(seg)
+
+        m = re.search(r"scale=(\d+):-2", cmd)
+        assert m and int(m.group(1)) == t_w, (name, cmd)
+        m = re.search(r"fps=fps=([\d.]+)", cmd)
+        assert m and float(m.group(1)) == pytest.approx(out_fps), name
+        m = re.search(r"-ss (\S+) .*?-t (\S+)", cmd)
+        assert m and float(m.group(1)) == pytest.approx(seg.start_time)
+        assert float(m.group(2)) == pytest.approx(seg.duration)
+        assert "-c:v libx264" in cmd
+        m = re.search(r"-crf (\d+)", cmd)
+        if m:
+            assert seg.video_coding.crf is not None
+            assert int(m.group(1)) == seg.quality_level.video_crf, name
+        m = re.search(r"-qp (\d+)", cmd)
+        if m:
+            assert seg.video_coding.qp is not None
+            assert int(m.group(1)) == seg.quality_level.video_qp, name
+        m = re.search(r"-b:v ([\d.]+)k", cmd)
+        if m:
+            assert float(m.group(1)) == pytest.approx(
+                float(seg.target_video_bitrate)
+            ), name
+        assert (seg.video_coding.crf is not None) == ("-crf" in cmd)
+        assert (seg.video_coding.qp is not None) == ("-qp " in cmd)
+        m = re.search(r"-g (\d+) -keyint_min (\d+)", cmd)
+        if seg.video_coding.iframe_interval:
+            want_g = int(out_fps * seg.video_coding.iframe_interval)
+            assert m and int(m.group(1)) == want_g == int(m.group(2)), name
+        m = re.search(r"-preset (\S+)", cmd)
+        assert m and m.group(1) == seg.video_coding.preset, name
+        m = re.search(r"-pix_fmt (\S+)", cmd)
+        assert m and m.group(1) == seg.target_pix_fmt, name
+        n_passes = 2 if seg.video_coding.passes == 2 else 1
+        assert cmd.count("-pass ") == (2 if n_passes == 2 else 0), name
+        checked += 1
+    assert checked == len(commands) and checked > 0
