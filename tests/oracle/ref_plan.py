@@ -38,6 +38,27 @@ except TypeError as exc:
         sys.exit(0)
     raise
 segs = tc.get_required_segments()
+# per-PVS buff events (test_config.py get_buff_events_media_time) and
+# AVPVS dimensions (lib/ffmpeg.calculate_avpvs_video_dimensions with the
+# first post-processing's coding dims)
+import lib.ffmpeg as _ff
+
+buff = {}
+avpvs_dims = {}
+for pvs_id, pvs in tc.pvses.items():
+    buff[pvs_id] = pvs.hrc.get_buff_events_media_time()
+    pp = tc.post_processings[0]
+    info = pvs.src.stream_info
+    dims = _ff.calculate_avpvs_video_dimensions(
+        int(info["width"]), int(info["height"]),
+        int(pp.coding_width), int(pp.coding_height),
+    )
+    # create_avpvs_short's quality-level override (lib/ffmpeg.py:980-986):
+    # the AVPVS never downscales below the encoded segment's height
+    ql = pvs.segments[0].quality_level  # event order, as in the reference
+    if ql.height > dims[1]:
+        dims = [ql.width, ql.height]
+    avpvs_dims[pvs_id] = dims
 commands = {}
 if "--commands" in sys.argv:
     import lib.ffmpeg as ref_ffmpeg
@@ -59,4 +80,6 @@ print(json.dumps({
     ),
     "pvses": sorted(tc.pvses.keys()),
     "commands": commands,
+    "buff_events": buff,
+    "avpvs_dims": avpvs_dims,
 }))

@@ -109,6 +109,11 @@ def _gen_db(rng, db_id: str, long: bool) -> str:
             f"type: {'long' if long else 'short'}"]
     if long:
         head.append(f"segmentDuration: {seg_dur}")
+    # vary the post-processing coding dims so the AVPVS dimension
+    # calculation's aspect-ratio branches (mobile-narrower, equal,
+    # wider/odd-aspect) are all exercised by the oracle comparison
+    ppw, pph = [(1280, 720), (640, 360), (960, 540),
+                (640, 480), (1920, 1080)][int(rng.integers(0, 5))]
     return "\n".join(
         head
         + ["qualityLevelList:"] + qls
@@ -117,8 +122,9 @@ def _gen_db(rng, db_id: str, long: bool) -> str:
         + ["hrcList:"] + hrcs
         + ["pvsList:"] + pvses
         + ["postProcessingList:",
-           "  - {type: pc, displayWidth: 1280, displayHeight: 720, "
-           "codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}"]
+           f"  - {{type: pc, displayWidth: {ppw}, displayHeight: {pph}, "
+           f"codingWidth: {ppw}, codingHeight: {pph}, "
+           "displayFrameRate: 24}"]
     ) + "\n"
 
 
@@ -444,3 +450,41 @@ def test_encode_parameters_match_reference_commands(tmp_path, seed):
         assert cmd.count("-pass ") == (2 if n_passes == 2 else 0), name
         checked += 1
     assert checked == len(commands) and checked > 0
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_buff_events_and_avpvs_dims_match_reference(tmp_path, seed):
+    """Two more pure reference surfaces oracled per PVS: the .buff event
+    list (stall [media_time, duration] pairs / sorted freeze durations,
+    test_config.py:312-333) and the AVPVS dimension calculation with its
+    aspect-ratio branches (lib/ffmpeg.py:33-58)."""
+    import numpy as np
+
+    rng = np.random.default_rng(3000 + seed)
+    long = bool(seed % 2)
+    db_id = f"P2{'L' if long else 'S'}XM{30 + seed}"
+    src_secs = float(rng.integers(8, 20))
+    yaml_text = _gen_db(rng, db_id, long)
+    yaml_path = _build_fixture(tmp_path, db_id, yaml_text, src_secs)
+    ref = _reference_plan(yaml_path)
+    if ref is None:
+        pytest.skip("reference rejects this seed's database")
+
+    from processing_chain_tpu.config import StaticProber, TestConfig
+    from processing_chain_tpu.models.avpvs import avpvs_dimensions
+
+    prober = StaticProber({}, default=dict(
+        width=SRC_W, height=SRC_H, pix_fmt="yuv420p",
+        r_frame_rate=str(SRC_FPS), avg_frame_rate=f"{SRC_FPS}/1",
+        video_duration=src_secs,
+    ))
+    tc = TestConfig(yaml_path, prober=prober)
+    assert sorted(tc.pvses) == ref["pvses"]
+    for pvs_id, pvs in tc.pvses.items():
+        ours_buff = pvs.get_buff_events_media_time()
+        # JSON round-trip: tuples become lists
+        norm = [list(e) if isinstance(e, (list, tuple)) else e
+                for e in ours_buff]
+        assert norm == ref["buff_events"][pvs_id], pvs_id
+        w, h = avpvs_dimensions(pvs)
+        assert [w, h] == ref["avpvs_dims"][pvs_id], pvs_id
