@@ -114,3 +114,43 @@ def test_pin_baseline_writes_protocol_artifact(tmp_path, monkeypatch):
     assert art["host"]["cpu_count"] == os.cpu_count()
     on_disk = json.loads((tmp_path / "baseline.json").read_text())
     assert on_disk["protocol"]["runs"] == 3
+
+
+def test_device_lock_mutual_exclusion(tmp_path):
+    """_DeviceLock serializes tunnel clients across processes: while one
+    process holds the flock, another's acquire(short timeout) fails; after
+    release it succeeds."""
+    import importlib
+    import subprocess
+    import textwrap
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    bench = importlib.reload(bench)
+    lock_file = str(tmp_path / "d.lock")
+    os.environ["PC_DEVICE_LOCK_FILE"] = lock_file
+    try:
+        holder = subprocess.Popen(
+            [sys.executable, "-c", textwrap.dedent(f"""
+                import fcntl, sys, time
+                fh = open({lock_file!r}, "w")
+                fcntl.flock(fh, fcntl.LOCK_EX)
+                print("held", flush=True)
+                time.sleep(20)
+            """)],
+            stdout=subprocess.PIPE, text=True,
+        )
+        try:
+            assert holder.stdout.readline().strip() == "held"
+            lock = bench._DeviceLock()
+            assert lock.path == lock_file
+            assert lock.acquire(timeout_s=0.1) is False
+        finally:
+            holder.kill()
+            holder.wait()
+        lock2 = bench._DeviceLock()
+        assert lock2.acquire(timeout_s=5) is True
+        lock2.release()
+    finally:
+        os.environ.pop("PC_DEVICE_LOCK_FILE", None)
