@@ -154,3 +154,21 @@ def test_device_lock_mutual_exclusion(tmp_path):
         lock2.release()
     finally:
         os.environ.pop("PC_DEVICE_LOCK_FILE", None)
+
+
+def test_committed_baseline_artifact_is_valid():
+    """The committed BASELINE_MEASURED.json is a full-protocol pin:
+    median-of-N>=5 runs, plausible fps, host fingerprint present — the
+    artifact every harvest divides by (VERDICT r3 #2)."""
+    art = json.loads(open(os.path.join(REPO, "BASELINE_MEASURED.json")).read())
+    assert art["protocol"]["runs"] >= 5
+    assert art["protocol"]["stat"].startswith("median")
+    assert len(art["runs_fps"]) == art["protocol"]["runs"]
+    med = sorted(art["runs_fps"])[len(art["runs_fps"]) // 2]
+    assert art["cpu_core_fps"] == med
+    assert art["baseline_8core_fps"] == round(8 * med, 4)
+    assert 0.05 < art["cpu_core_fps"] < 1000.0
+    assert art["host"]["cpu_model"]
+    # spread sanity: a pin whose runs vary wildly is not a pin
+    lo, hi = min(art["runs_fps"]), max(art["runs_fps"])
+    assert hi / lo < 1.5, art["runs_fps"]
