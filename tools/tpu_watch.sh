@@ -24,9 +24,13 @@ while :; do
     # The probe skips the optional extras and shares bench.py's per-user
     # compile cache so it holds the device as briefly as possible (the
     # full bench right after re-uses the cached compile).
+    # 100 s probe window: enough for cold client + compile + headline on a
+    # LIVE tunnel; a wedged one never answers anyway. Keeping the hold
+    # short matters — a harvest bench.py gives up on a busy lock after
+    # 60 s and falls back to the cached live number.
     if flock -n "$LOCK" -c \
         "PC_BENCH_NO_EXTRAS=1 JAX_COMPILATION_CACHE_DIR=$HOME/.cache/pc_bench_jax_cache_$(id -u) \
-         timeout -s KILL 150 python bench.py --child > '$CHILD_JSON' 2>> '$LOG'" \
+         timeout -s KILL 100 python bench.py --child > '$CHILD_JSON' 2>> '$LOG'" \
         && grep -q '"platform": "tpu"' "$CHILD_JSON"; then
         echo "[$(date -u +%H:%M:%S)] tunnel LIVE; running full bench" >> "$LOG"
         # full bench takes the same lock itself (bench.py _DeviceLock)
