@@ -172,3 +172,26 @@ def test_committed_baseline_artifact_is_valid():
     # spread sanity: a pin whose runs vary wildly is not a pin
     lo, hi = min(art["runs_fps"]), max(art["runs_fps"])
     assert hi / lo < 1.5, art["runs_fps"]
+
+
+def test_busy_lock_degrades_to_cpu_with_diagnostics(tmp_path):
+    """A harvest that cannot get the device lock (watcher mid-probe) and
+    has no live cache must still land a CPU number against the pinned
+    baseline with the lock-busy diagnostic — never platform 'none'."""
+    import fcntl
+
+    (tmp_path / "baseline.json").write_text(json.dumps({
+        "baseline_8core_fps": 16.0,
+        "protocol": {"frames_per_run": 8, "runs": 5, "stat": "median"},
+        "host": {"cpu_model": "any"},
+    }))
+    holder = open(tmp_path / "device.lock", "w")
+    fcntl.flock(holder, fcntl.LOCK_EX)
+    try:
+        out = _run_bench(tmp_path, {"BENCH_DEADLINE": "130"})
+    finally:
+        holder.close()
+    assert out["platform"] == "cpu"
+    assert out["value"] > 0
+    assert "device lock busy" in out.get("tpu_error", "")
+    assert out["baseline_source"].startswith("pinned")
