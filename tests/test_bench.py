@@ -21,13 +21,15 @@ def _run_bench(tmp_path, extra_env, timeout=240):
         PC_BASELINE_FILE=str(tmp_path / "baseline.json"),
         PC_BENCH_LIVE_FILE=str(tmp_path / "live.json"),
         PC_DEVICE_LOCK_FILE=str(tmp_path / "device.lock"),
-        BENCH_DEADLINE="150",
+    )
+    env.update({
+        "BENCH_DEADLINE": "150",
         # tiny child workload: every asserted value comes from the
         # synthetic cache/pinned artifacts, not the measurement
-        BENCH_FRAMES="2",
-        BENCH_ITERS="2",
-        **extra_env,
-    )
+        "BENCH_FRAMES": "2",
+        "BENCH_ITERS": "2",
+    })
+    env.update(extra_env)
     proc = subprocess.run(
         [sys.executable, BENCH], capture_output=True, text=True,
         timeout=timeout, env=env, cwd=REPO,
