@@ -665,3 +665,21 @@ def test_quality_metrics_msssim_column(tmp_path):
     assert (dfc.msssim_y > 0.9999).all()
     assert (dfn.msssim_y < 1.0).all() and (dfn.msssim_y > 0.0).all()
     assert (dfn.msssim_y < dfc.msssim_y).all()
+
+
+def test_tools_dispatch_src_analysis_and_unknown(tmp_path):
+    """CLI `tools` dispatch: src-analysis runs end-to-end on a directory
+    (md5 + info sidecars written); an unknown tool name errors cleanly."""
+    from processing_chain_tpu import cli
+    from processing_chain_tpu.io.video import VideoWriter
+
+    clip = tmp_path / "SRC0.avi"
+    with VideoWriter(str(clip), "ffv1", 64, 48, "yuv420p", (24, 1)) as w:
+        for _ in range(4):
+            w.write(np.full((48, 64), 100, np.uint8),
+                    np.full((24, 32), 128, np.uint8),
+                    np.full((24, 32), 128, np.uint8))
+    assert cli.main(["tools", "src-analysis", str(tmp_path)]) == 0
+    assert (tmp_path / "SRC0.avi.md5").is_file()
+    assert (tmp_path / "SRC0.avi.yaml").is_file()
+    assert cli.main(["tools", "definitely-not-a-tool"]) != 0
