@@ -667,18 +667,16 @@ def test_quality_metrics_msssim_column(tmp_path):
     assert (dfn.msssim_y < dfc.msssim_y).all()
 
 
-def test_tools_dispatch_src_analysis_and_unknown(tmp_path):
+def test_tools_dispatch_src_analysis_and_unknown(tmp_path, monkeypatch):
     """CLI `tools` dispatch: src-analysis runs end-to-end on a directory
     (md5 + info sidecars written); an unknown tool name errors cleanly."""
     from processing_chain_tpu import cli
-    from processing_chain_tpu.io.video import VideoWriter
 
     clip = tmp_path / "SRC0.avi"
-    with VideoWriter(str(clip), "ffv1", 64, 48, "yuv420p", (24, 1)) as w:
-        for _ in range(4):
-            w.write(np.full((48, 64), 100, np.uint8),
-                    np.full((24, 32), 128, np.uint8),
-                    np.full((24, 32), 128, np.uint8))
+    write_test_video(str(clip), n=4, w=64, h=48)
+    # the tool writes its ./outsummary_md5.txt summary into the cwd
+    # (reference SRC_analysis.py behavior): keep it inside tmp_path
+    monkeypatch.chdir(tmp_path)
     assert cli.main(["tools", "src-analysis", str(tmp_path)]) == 0
     assert (tmp_path / "SRC0.avi.md5").is_file()
     assert (tmp_path / "SRC0.avi.yaml").is_file()
