@@ -29,6 +29,35 @@ import numpy as np
 _SENTINEL = object()
 
 
+def _put_until_stop(q: queue.Queue, item: Any, stop: threading.Event) -> None:
+    """Blocking put that a concurrent close() can always interrupt: close()
+    sets `stop` and keeps the queue drained, so either the put lands or the
+    worker observes stop within one timeout tick — never a hung put."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return
+        except queue.Full:
+            continue
+
+
+def _drain_join(queues: list, threads: list) -> None:
+    """Shutdown tail shared by the prefetchers: with the stop flag already
+    set, keep every queue drained (so no worker put can block) until every
+    worker thread has exited. Blocking until exit matters — callers tear
+    down native sources (VideoReaders) right after, which must not race a
+    live decode thread."""
+    while any(t.is_alive() for t in threads):
+        for q in queues:
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        for t in threads:
+            t.join(timeout=0.1)
+
+
 class Prefetcher:
     """Iterate `source` on a background thread, keeping up to `depth`
     items ready. Exceptions raised by the source (or by `transform`,
@@ -52,21 +81,11 @@ class Prefetcher:
                         return
                     if transform is not None:
                         item = transform(item)
-                    while not self._stop.is_set():
-                        try:
-                            self._q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
+                    _put_until_stop(self._q, item, self._stop)
             except BaseException as exc:  # noqa: BLE001 - re-raised in consumer
                 self._err = exc
             finally:
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(_SENTINEL, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                _put_until_stop(self._q, _SENTINEL, self._stop)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -89,13 +108,7 @@ class Prefetcher:
         worker checks the stop flag between items, so the wait is bounded
         by one in-flight item."""
         self._stop.set()
-        while self._thread.is_alive():
-            try:
-                while True:  # keep the queue drained so puts can't block
-                    self._q.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=0.1)
+        _drain_join([self._q], [self._thread])
 
     def __enter__(self) -> "Prefetcher":
         return self
@@ -164,6 +177,88 @@ class AsyncWriter:
                 self.close()
             except Exception:
                 pass
+
+
+class MultiSegmentPrefetcher:
+    """Decode several segment chunk-streams concurrently, yielding chunks
+    strictly in stream order (stream 0's chunks, then stream 1's, ...).
+
+    The serial long path (reference p03:88-136 decodes tmp segments with a
+    process pool, then concatenates files) has a host-side analog here:
+    `factories[i]` is a zero-arg callable returning segment i's chunk
+    iterator; up to `workers` of them run on worker threads at once, each
+    buffering into its own bounded queue of `depth` chunks. The consumer
+    sees exactly the serially-chained stream, but decode overlaps segment
+    boundaries and runs `workers` wide — the "decode throughput feeding
+    the chips" knob (SURVEY §7 hard part #2) without files or processes:
+    native decode releases the GIL, so threads scale on a multi-core host.
+
+    Failure semantics match the serial chain: an error in stream k is
+    raised when the consumer reaches stream k's position (earlier streams'
+    chunks still flow), and `close()` tears all workers down promptly.
+    """
+
+    def __init__(self, factories, workers: int = 2, depth: int = 2) -> None:
+        self._n = len(factories)
+        self._factories = list(factories)
+        self._queues = [
+            queue.Queue(maxsize=max(1, depth)) for _ in range(self._n)
+        ]
+        self._errs: list[Optional[BaseException]] = [None] * self._n
+        self._stop = threading.Event()
+        self._next = 0  # next unclaimed stream index
+        self._claim_lock = threading.Lock()
+
+        def worker() -> None:
+            while not self._stop.is_set():
+                with self._claim_lock:
+                    idx = self._next
+                    if idx >= self._n:
+                        return
+                    self._next = idx + 1
+                q = self._queues[idx]
+                try:
+                    for item in self._factories[idx]():
+                        _put_until_stop(q, item, self._stop)
+                        if self._stop.is_set():
+                            return
+                except BaseException as exc:  # noqa: BLE001 - consumer re-raises
+                    self._errs[idx] = exc
+                _put_until_stop(q, _SENTINEL, self._stop)
+
+        self._threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(max(1, min(workers, self._n)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def __iter__(self) -> Iterator[Any]:
+        for idx in range(self._n):
+            q = self._queues[idx]
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    err = self._errs[idx]
+                    if err is not None:
+                        self._errs[idx] = None
+                        raise err
+                    break
+                yield item
+
+    def close(self) -> None:
+        """Abandon all streams; blocks until every worker has exited (they
+        own native readers whose teardown must not race the caller's)."""
+        self._stop.set()
+        with self._claim_lock:
+            self._next = self._n  # no new claims
+        _drain_join(self._queues, self._threads)
+
+    def __enter__(self) -> "MultiSegmentPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def iter_plane_chunks(reader, chunk: int = 64) -> Iterator[list[np.ndarray]]:

@@ -45,6 +45,18 @@ from . import frames as fr
 CHUNK = 64  # frames per device batch
 
 
+def _decode_workers() -> int:
+    """Concurrent segment decoders for the long path (engine/prefetch
+    MultiSegmentPrefetcher). Default 2: overlaps decode across segment
+    boundaries with bounded memory; raise on multi-core hosts where host
+    decode is the bottleneck feeding the chips (SURVEY §7 hard part #2).
+    1 restores strictly serial per-segment decode."""
+    try:
+        return max(1, int(os.environ.get("PC_DECODE_WORKERS", "2")))
+    except ValueError:
+        return 2
+
+
 def avpvs_dimensions(pvs: Pvs, post_proc_id: int = 0) -> tuple[int, int]:
     """(width, height) of the AVPVS canvas: aspect-aware dims vs the
     post-processing coding size, overridden upward when the encoded segment
@@ -251,17 +263,20 @@ def create_avpvs_wo_buffer(
     w, h = avpvs_dimensions(pvs)
     pix_fmt = pvs.get_pix_fmt_for_avpvs()
 
-    def _pump(chunks, writer: pf.AsyncWriter, feat: SiTiAccumulator) -> None:
-        """Decode-prefetched host chunks → device resize (+ on-device
+    def _pump_ready(ready, writer: pf.AsyncWriter, feat: SiTiAccumulator) -> None:
+        """Already-prefetched host chunks → device resize (+ on-device
         SI/TI features) → async encode."""
         sub = fr.chroma_subsampling(pix_fmt)
         ten_bit = "10" in pix_fmt
+        for chunk in ready:
+            scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
+            quant = fr.quantize_device(scaled, ten_bit)
+            feat.update(quant[0])
+            writer.put(quant)
+
+    def _pump(chunks, writer: pf.AsyncWriter, feat: SiTiAccumulator) -> None:
         with pf.Prefetcher(chunks, depth=2) as pre:
-            for chunk in pre:
-                scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
-                quant = fr.quantize_device(scaled, ten_bit)
-                feat.update(quant[0])
-                writer.put(quant)
+            _pump_ready(pre, writer, feat)
 
     def run() -> str:
         SiTiAccumulator.discard(out_path)  # never leave a stale sidecar
@@ -298,8 +313,14 @@ def create_avpvs_wo_buffer(
                 )
             ) as writer:
                 writer.write_audio(samples)
-                for seg in pvs.segments:
-                    _pump(_segment_canvas_chunks(seg, rate), writer, feat)
+                factories = [
+                    (lambda s=seg: _segment_canvas_chunks(s, rate))
+                    for seg in pvs.segments
+                ]
+                with pf.MultiSegmentPrefetcher(
+                    factories, workers=_decode_workers(), depth=2
+                ) as pre:
+                    _pump_ready(pre, writer, feat)
         feat.write(out_path)
         return out_path
 

@@ -1193,3 +1193,62 @@ def test_trace_dir_captures_device_profile(tmp_path):
     for root, _dirs, files in os.walk(trace_dir):
         found.extend(files)
     assert found, f"no profiler artifacts under {trace_dir}"
+
+
+def test_long_avpvs_multiworker_decode_identical(tmp_path, monkeypatch):
+    """PC_DECODE_WORKERS=3 (concurrent per-segment decode via
+    MultiSegmentPrefetcher) must produce a byte-identical AVPVS + SI/TI
+    sidecar to the strictly serial decode (=1): the prefetcher reorders
+    work, never output."""
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.models import avpvs as av
+
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2LTR02
+        syntaxVersion: 6
+        type: long
+        segmentDuration: 1
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24, audioCodec: aac, audioBitrate: 96}
+          Q1: {index: 1, videoCodec: h264, videoBitrate: 500, width: 320, height: 180, fps: 24, audioCodec: aac, audioBitrate: 96}
+        codingList:
+          VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+          AC01: {type: audio, encoder: aac}
+        srcList:
+          SRC001: SRC001.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            audioCodingId: AC01
+            eventList: [[Q0, 1], [Q1, 1], [Q0, 1], [Q1, 1]]
+        pvsList:
+          - P2LTR02_SRC001_HRC000
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+    """)
+    yaml_path = write_db(tmp_path, "P2LTR02", yaml_text,
+                         {"SRC001.avi": dict(n=96, audio=True)})
+    rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+    db = os.path.dirname(yaml_path)
+    tc = TestConfig(yaml_path)
+    pvs = tc.pvses["P2LTR02_SRC001_HRC000"]
+    av_path = os.path.join(db, "avpvs", "P2LTR02_SRC001_HRC000.avi")
+
+    monkeypatch.setenv("PC_DECODE_WORKERS", "1")
+    av.create_avpvs_wo_buffer(pvs).run()
+    with open(av_path, "rb") as fh:
+        ref_bytes = fh.read()
+    with open(av_path + ".siti.csv", "rb") as fh:
+        ref_sidecar = fh.read()
+    os.unlink(av_path)
+    os.unlink(av_path + ".siti.csv")
+
+    monkeypatch.setenv("PC_DECODE_WORKERS", "3")
+    av.create_avpvs_wo_buffer(pvs).run()
+    with open(av_path, "rb") as fh:
+        got_bytes = fh.read()
+    with open(av_path + ".siti.csv", "rb") as fh:
+        got_sidecar = fh.read()
+    assert got_bytes == ref_bytes
+    assert got_sidecar == ref_sidecar

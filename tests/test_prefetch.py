@@ -198,3 +198,88 @@ def test_tracer_report_file(tmp_path):
 def test_device_profiler_noops_without_dir():
     with tracing.DeviceProfiler(None):
         pass
+
+
+# ---------------------------------------------------------------------------
+# MultiSegmentPrefetcher (concurrent per-segment decode, ordered output)
+
+
+def _msp_streams(lengths, base=0):
+    """One factory per stream; stream i yields `lengths[i]` ints encoding
+    (stream, position) so ordering bugs are visible in the values."""
+    def make(i, n):
+        def factory():
+            for k in range(n):
+                yield (base + i, k)
+        return factory
+    return [make(i, n) for i, n in enumerate(lengths)]
+
+
+@pytest.mark.parametrize("workers", [1, 2, 5])
+def test_multi_prefetcher_matches_serial_chain(workers):
+    lengths = [3, 0, 7, 1, 4, 0, 2]
+    want = [(i, k) for i, n in enumerate(lengths) for k in range(n)]
+    with pf.MultiSegmentPrefetcher(
+        _msp_streams(lengths), workers=workers, depth=2
+    ) as pre:
+        assert list(pre) == want
+
+
+def test_multi_prefetcher_decodes_concurrently():
+    """With workers=2 the second stream starts before the first finishes:
+    stream 0 blocks until stream 1 has produced (which serial chaining
+    never would), so completion proves real concurrency."""
+    s1_started = threading.Event()
+
+    def s0():
+        yield 0
+        assert s1_started.wait(timeout=5.0)
+        yield 1
+
+    def s1():
+        s1_started.set()
+        yield 10
+
+    with pf.MultiSegmentPrefetcher([s0, s1], workers=2, depth=2) as pre:
+        assert list(pre) == [0, 1, 10]
+
+
+def test_multi_prefetcher_error_surfaces_at_failing_stream():
+    def bad():
+        yield (1, 0)
+        raise ValueError("decode failed mid-stream")
+
+    factories = _msp_streams([2]) + [bad] + _msp_streams([2], base=9)
+    pre = pf.MultiSegmentPrefetcher(factories, workers=2, depth=2)
+    it = iter(pre)
+    assert [next(it), next(it)] == [(0, 0), (0, 1)]  # stream 0 intact
+    assert next(it) == (1, 0)
+    with pytest.raises(ValueError, match="decode failed mid-stream"):
+        list(it)
+    pre.close()
+
+
+def test_multi_prefetcher_close_stops_workers():
+    produced = []
+
+    def slow():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    pre = pf.MultiSegmentPrefetcher([slow, slow], workers=2, depth=2)
+    next(iter(pre))
+    pre.close()
+    n = len(produced)
+    time.sleep(0.05)
+    assert len(produced) == n  # all workers stopped pulling
+    assert not any(t.is_alive() for t in pre._threads)
+
+
+def test_multi_prefetcher_more_streams_than_workers():
+    lengths = [2] * 9
+    want = [(i, k) for i in range(9) for k in range(2)]
+    with pf.MultiSegmentPrefetcher(
+        _msp_streams(lengths), workers=3, depth=1
+    ) as pre:
+        assert list(pre) == want
