@@ -56,6 +56,7 @@ class MPStreamInfo(ct.Structure):
         ("sample_rate", ct.c_int32),
         ("channels", ct.c_int32),
         ("sample_fmt", ct.c_char * 32),
+        ("profile", ct.c_char * 64),
     ]
 
 
@@ -103,16 +104,30 @@ def ensure_loaded() -> ct.CDLL:
     with _lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
-            _build()
+        # always run make: it no-ops when the .so is current and rebuilds
+        # when media.cpp changed, so a checkout carrying a prebuilt binary
+        # from before a struct change never loads at the wrong stride
         try:
+            _build()
             lib = ct.CDLL(_SO_PATH)
-        except OSError:
+        except (OSError, subprocess.CalledProcessError):
             # a stale or foreign-platform binary (e.g. a checkout moved
             # between architectures): force a rebuild for THIS host once
             # (-B: the broken .so may look up-to-date to make)
             _build(force=True)
             lib = ct.CDLL(_SO_PATH)
+        # ABI handshake: mtime-equal edge cases can survive the make; a
+        # layout mismatch must fail loudly, never probe at the wrong stride
+        try:
+            so_size = lib.mp_stream_info_size()
+        except AttributeError:
+            so_size = -1
+        if so_size != ct.sizeof(MPStreamInfo):
+            raise MediaError(
+                f"libpcmedia.so ABI mismatch (struct size {so_size} != "
+                f"{ct.sizeof(MPStreamInfo)}); rebuild with "
+                f"`make -B -C {_NATIVE_DIR}`"
+            )
 
         u8p = ct.POINTER(ct.c_uint8)
         i16p = ct.POINTER(ct.c_int16)
@@ -242,6 +257,7 @@ def probe(path: str) -> dict:
             "nb_frames": s.nb_frames,
             "bit_rate": s.bit_rate,
             "time_base": (s.tb_num, s.tb_den),
+            "profile": s.profile.decode(),
         }
         if s.codec_type == 0:
             d.update(

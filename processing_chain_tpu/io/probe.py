@@ -25,6 +25,22 @@ def _select(info: dict, codec_type: str) -> Optional[dict]:
     )
 
 
+def fix_video_profile_string(video_profile: str) -> str:
+    """Normalize codec profile names for the .qchanges column exactly as
+    the reference does (lib/ffmpeg.py:420-431): drop spaces/"Profile"/
+    colons, High->Hi, Predictive->P (e.g. "Constrained Baseline" ->
+    "ConstrainedBaseline", "High 4:4:4 Predictive" -> "Hi444P")."""
+    for old, new in (
+        (" ", ""),
+        ("Profile", ""),
+        ("High", "Hi"),
+        (":", ""),
+        ("Predictive", "P"),
+    ):
+        video_profile = video_profile.replace(old, new)
+    return video_profile
+
+
 class LibavProber:
     """The SrcProber implementation used outside tests (config/probe_api)."""
 
@@ -113,7 +129,7 @@ def get_segment_info(
             ("video_width", v["width"]),
             ("video_height", v["height"]),
             ("video_codec", v["codec_name"]),
-            ("video_profile", ""),
+            ("video_profile", fix_video_profile_string(v.get("profile", ""))),
         ]
     )
     if a is not None:

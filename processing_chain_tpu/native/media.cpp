@@ -80,6 +80,7 @@ struct MPStreamInfo {
     int32_t sample_rate;             // audio
     int32_t channels;                // audio
     char sample_fmt[32];             // audio
+    char profile[64];                // codec profile name ("" if unknown)
 };
 
 struct MPFormatInfo {
@@ -89,6 +90,11 @@ struct MPFormatInfo {
     int64_t file_size;
     int32_t nb_streams;
 };
+
+// ABI handshake: the ctypes side refuses a .so whose struct layout
+// differs from its own mirror (a stale binary would otherwise be read at
+// the wrong stride — silent garbage, not an error).
+EXPORT int mp_stream_info_size(void) { return (int)sizeof(MPStreamInfo); }
 
 EXPORT int mp_probe(const char* path, MPFormatInfo* fmt_out,
                     MPStreamInfo* streams_out, int max_streams,
@@ -156,6 +162,8 @@ EXPORT int mp_probe(const char* path, MPFormatInfo* fmt_out,
                                   : 0.0);
         si->nb_frames = st->nb_frames;
         si->bit_rate = par->bit_rate;
+        const char* prof = avcodec_profile_name(par->codec_id, par->profile);
+        snprintf(si->profile, sizeof(si->profile), "%s", prof ? prof : "");
     }
     avformat_close_input(&fmt);
     return n;

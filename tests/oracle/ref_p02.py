@@ -1,0 +1,70 @@
+"""Run the REFERENCE's p02 metadata derivation on real segment files and
+print it as JSON — the executable oracle for metadata parity tests.
+
+Covers the whole per-segment pipeline of p02_generateMetadata.py:33-152:
+`lib/ffmpeg.get_segment_info` (qchanges row), `get_video_frame_info` /
+`get_audio_frame_info` (vfi/afi rows), the exact frame-size scan
+(`lib/get_framesize`), the video_bitrate recompute from exact sizes
+(p02:112-116) and the vfi size replacement + count check (p02:119-124).
+
+Usage: python ref_p02.py /root/reference CODEC SEGMENT [SEGMENT...]
+The caller must put tests/oracle (the ffprobe/ffmpeg stubs) on PATH and
+provide <file>.probe.json next to every segment (streams + packets_v /
+packets_a in ffprobe JSON shape).
+"""
+import json
+import logging
+import os
+import sys
+
+ref_root, codec = sys.argv[1], sys.argv[2]
+paths = sys.argv[3:]
+sys.path.insert(0, ref_root)
+logging.basicConfig(level=logging.ERROR)
+
+import lib.ffmpeg as ff  # noqa: E402
+from lib import get_framesize  # noqa: E402
+
+
+class Seg:
+    """Duck-typed segment (the reference's own fake-segment pattern,
+    util/complexity_classification.py:40-47)."""
+
+    def __init__(self, p):
+        self.file_path = p
+        self.filename = os.path.basename(p)
+
+    def get_filename(self):
+        return self.filename
+
+    def __str__(self):
+        return self.filename
+
+
+scanners = {
+    "h264": get_framesize.get_framesize_h264,
+    "h265": get_framesize.get_framesize_h265,
+    "vp9": get_framesize.get_framesize_vp9,
+}
+
+out = []
+for p in paths:
+    seg = Seg(p)
+    q = ff.get_segment_info(seg)
+    vfi = ff.get_video_frame_info(seg)
+    afi = ff.get_audio_frame_info(seg)
+    sizes = scanners[codec](p, True)
+    if len(vfi) != len(sizes):
+        print(json.dumps({
+            "error": "frame count mismatch", "vfi": len(vfi),
+            "exact": len(sizes),
+        }))
+        sys.exit(1)
+    # p02:112-116 bitrate recompute + :119-124 size replacement
+    q["video_bitrate"] = round(
+        sum(sizes) / 1024 * 8 / q["video_duration"], 2
+    )
+    for i, s in enumerate(sizes):
+        vfi[i]["size"] = s
+    out.append({"qchanges": q, "vfi": vfi, "afi": afi})
+print(json.dumps(out))

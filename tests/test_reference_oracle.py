@@ -488,3 +488,166 @@ def test_buff_events_and_avpvs_dims_match_reference(tmp_path, seed):
         assert norm == ref["buff_events"][pvs_id], pvs_id
         w, h = avpvs_dimensions(pvs)
         assert [w, h] == ref["avpvs_dims"][pvs_id], pvs_id
+
+
+def _probe_sidecar_from_real_media(path: str) -> None:
+    """Record OUR native probe of a real media file as the ffprobe-JSON
+    sidecar the stub serves to the reference: both chains then derive
+    metadata from identical probe facts, so the parity under test is the
+    derivation (row building, recompute, replacement), not the prober."""
+    import numpy as np
+
+    from processing_chain_tpu.io import medialib
+
+    info = medialib.probe(path)
+    streams = []
+    for s in info["streams"]:
+        d = {
+            "codec_type": s["codec_type"],
+            "codec_name": s["codec_name"],
+            "duration": repr(float(s["duration"])),
+        }
+        if s["bit_rate"]:
+            d["bit_rate"] = str(int(s["bit_rate"]))
+        if s.get("profile"):
+            d["profile"] = s["profile"]
+        if s["codec_type"] == "video":
+            d.update(
+                width=s["width"], height=s["height"], pix_fmt=s["pix_fmt"],
+                r_frame_rate=s["r_frame_rate"],
+                avg_frame_rate=s["avg_frame_rate"],
+            )
+        else:
+            d.update(sample_rate=s["sample_rate"], channels=s["channels"])
+        streams.append(d)
+
+    def packets(kind):
+        try:
+            pk = medialib.scan_packets(path, kind)
+        except medialib.MediaError:
+            return [], []
+        rows = []
+        for i in range(len(pk["size"])):
+            r = {
+                "size": str(int(pk["size"][i])),
+                "flags": "K__" if pk["key"][i] else "___",
+            }
+            for key in ("pts_time", "dts_time", "duration_time"):
+                v = pk[key][i]
+                if not np.isnan(v):
+                    r[key] = repr(float(v))
+            rows.append(r)
+        return rows, [int(x) for x in pk["size"]]
+
+    pk_v, sizes_v = packets("video")
+    pk_a, sizes_a = packets("audio")
+    with open(path + ".probe.json", "w") as fh:
+        json.dump({
+            "streams": streams,
+            "packets_v": pk_v, "packets_a": pk_a,
+            "packet_sizes_v": sizes_v, "packet_sizes_a": sizes_a,
+        }, fh)
+
+
+@pytest.mark.parametrize("codec,encoder,ext", [
+    ("h264", "libx264", "mp4"),
+    ("h265", "libx265", "mp4"),
+])
+def test_p02_metadata_derivation_matches_reference(tmp_path, codec, encoder, ext):
+    """Full p02 metadata parity with the REFERENCE (p02_generateMetadata.py
+    :33-152 driven through tests/oracle/ref_p02.py): for real encoded
+    segments with audio, the reference's qchanges row (incl. the
+    video_bitrate recompute from exact frame sizes and the normalized
+    video_profile), vfi table (frame types, dts, replaced exact sizes,
+    durations) and afi table must match OUR probe/metadata derivation
+    field for field."""
+    import numpy as np
+    import pandas as pd
+
+    from processing_chain_tpu.io import framesizes, probe
+
+    rng = np.random.default_rng(7)
+    paths = []
+    for s in range(2):
+        path = str(tmp_path / f"seg{s}.{ext}")
+        from processing_chain_tpu.io.video import VideoWriter
+
+        with VideoWriter(
+            path, encoder, 160, 96, "yuv420p", (24, 1), bitrate_kbps=150,
+            gop=8, threads=1, opts="preset=ultrafast",
+            audio_codec="aac", sample_rate=48000, channels=2,
+            audio_bitrate_kbps=96,
+        ) as w:
+            base = rng.integers(0, 255, (96, 160), np.uint8)
+            for i in range(25):
+                w.write(np.roll(base, 3 * i + s, axis=1),
+                        np.full((48, 80), 128, np.uint8),
+                        np.full((48, 80), 128, np.uint8))
+            w.write_audio(
+                rng.integers(-2000, 2000, (48000, 2)).astype(np.int16)
+            )
+        _probe_sidecar_from_real_media(path)
+        paths.append(path)
+
+    env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
+    out = subprocess.run(
+        [sys.executable, os.path.join(ORACLE, "ref_p02.py"), REF, codec]
+        + paths,
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-1500:])
+    ref = json.loads(out.stdout.strip().splitlines()[-1])
+    assert len(ref) == len(paths)
+
+    for path, r in zip(paths, ref):
+        # ours: the same derivation models/metadata.generate_pvs_metadata
+        # performs, via the public io layer
+        q = dict(probe.get_segment_info(path))
+        vfi = probe.get_video_frame_info(path)
+        afi = probe.get_audio_frame_info(path)
+        sizes = framesizes.get_framesizes(path, codec, force=True)
+        q["video_bitrate"] = round(
+            sum(sizes) / 1024 * 8 / q["video_duration"], 2
+        )
+        assert len(vfi) == len(sizes)
+        vfi = vfi.assign(size=np.asarray(sizes, np.int64))
+
+        rq = r["qchanges"]
+        # same columns in the same order (the .qchanges public contract)
+        assert list(q.keys()) == list(rq.keys())
+        for k in q:
+            if k in ("video_duration", "audio_duration"):
+                assert q[k] == pytest.approx(float(rq[k]), abs=1e-6), k
+            elif k in ("video_bitrate", "audio_bitrate",
+                       "video_frame_rate"):
+                assert float(q[k]) == pytest.approx(float(rq[k]), abs=0.011), k
+            elif k in ("file_size", "video_width", "video_height",
+                       "audio_sample_rate", "video_target_bitrate"):
+                assert int(q[k]) == int(rq[k]), k
+            else:
+                assert str(q[k]) == str(rq[k]), k
+
+        rvfi = pd.DataFrame(r["vfi"])
+        assert len(vfi) == len(rvfi)
+        assert list(vfi["frame_type"]) == list(rvfi["frame_type"])
+        assert [int(x) for x in vfi["size"]] == [int(x) for x in rvfi["size"]]
+        np.testing.assert_allclose(
+            vfi["dts"].to_numpy(float), rvfi["dts"].to_numpy(float),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            vfi["duration"].to_numpy(float),
+            rvfi["duration"].to_numpy(float), atol=1e-6,
+        )
+
+        rafi = pd.DataFrame(r["afi"])
+        assert len(afi) == len(rafi) and len(afi) > 0
+        assert [int(x) for x in afi["size"]] == [int(x) for x in rafi["size"]]
+        np.testing.assert_allclose(
+            afi["dts"].to_numpy(float), rafi["dts"].to_numpy(float),
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            afi["duration"].to_numpy(float),
+            rafi["duration"].to_numpy(float), atol=1e-6,
+        )
