@@ -67,6 +67,20 @@ def _avpvs_chunks(reader: VideoReader, dst_rate: Optional[float] = None):
     return pfe.iter_plane_chunks(reader, CHUNK)
 
 
+def _limit_frames(chunks, n_max: int):
+    """Cap a plane-chunk stream at n_max frames (the reference's `-t`
+    output-duration trim, applied to the video stream)."""
+    left = n_max
+    if left <= 0:
+        return
+    for chunk in chunks:
+        t = chunk[0].shape[0]
+        yield [p[:left] for p in chunk] if t > left else chunk
+        left -= min(t, left)
+        if left <= 0:
+            return
+
+
 def _audio_for_long(pvs: Pvs, normalize: bool):
     try:
         samples, rate = medialib.decode_audio_s16(pvs.get_avpvs_file_path())
@@ -77,6 +91,73 @@ def _audio_for_long(pvs: Pvs, normalize: bool):
     if normalize:
         samples = normalize_rms(samples)
     return samples, rate
+
+
+def cpvs_plan(
+    pvs: Pvs,
+    post_processing: PostProcessing,
+    avpvs_height: int,
+    rawvideo: bool = False,
+    nonraw_crf: int = 17,
+    mobile_vprofile: str = "high",
+    mobile_preset: str = "fast",
+) -> dict:
+    """Pure decision record for one CPVS render — codec/pix_fmt, display
+    fps, pad-vs-scale geometry, audio handling, loudness step — matching
+    the reference's command construction (lib/ffmpeg.py:1149-1249: the
+    pc rawvideo/v210 branch with its smaller-height padding rule, the
+    mobile x264 CRF branch whose padding case applies NO scale, short
+    tests' -an, long tests' -t total duration + ffmpeg-normalize step).
+    `create_cpvs.run` executes exactly this plan; the reference-oracle
+    suite compares it against the reference's own command strings."""
+    tc = pvs.test_config
+    pp = post_processing
+    # the reference's pc branch matches only ["pc", "tv"] (create_cpvs
+    # :1177) and "tv" is not a legal post-processing type (:953), so
+    # hd-pc-home / uhd-pc-home take the x264 branch there — consistent
+    # with their .mp4 output name (get_cpvs_file_path :124-130)
+    is_pc = pp.processing_type == "pc"
+    plan: dict = {
+        "context": "pc" if is_pc else "mobile",
+        # the display-rate resample applies to the pc branch only: the
+        # reference's mobile branch carries NO fps filter (its fps line is
+        # commented out, lib/ffmpeg.py:1205), so mobile/tablet CPVS keep
+        # the AVPVS frame rate
+        "fps": float(pp.display_frame_rate) if is_pc else None,
+        "normalize": tc.is_long(),
+        "t": float(pvs.hrc.get_long_hrc_duration()) if tc.is_long() else None,
+    }
+    if is_pc:
+        vcodec, pix_fmt = pvs.get_vcodec_and_pix_fmt_for_cpvs(rawvideo)
+        need_pad = avpvs_height < pp.coding_height
+        plan.update(
+            vcodec=vcodec,
+            pix_fmt=pix_fmt,
+            pad=(pp.display_width, pp.display_height) if need_pad else None,
+            scale=None,
+            audio=(
+                dict(codec="pcm_s16le", channels=2) if tc.is_long() else None
+            ),
+        )
+    else:
+        need_pad = (
+            pp.display_height != pp.coding_height
+            or avpvs_height < pp.coding_height
+        )
+        plan.update(
+            vcodec="libx264",
+            pix_fmt="yuv420p",
+            crf=nonraw_crf,
+            preset=mobile_preset,
+            profile=mobile_vprofile,
+            pad=(pp.display_width, pp.display_height) if need_pad else None,
+            scale=None if need_pad else (pp.display_width, pp.display_height),
+            audio=(
+                dict(codec="aac", bitrate_kbps=512, channels=2)
+                if tc.is_long() else None
+            ),
+        )
+    return plan
 
 
 def create_cpvs(
@@ -90,30 +171,42 @@ def create_cpvs(
     tc = pvs.test_config
     pp = post_processing
     out_path = pvs.get_cpvs_file_path(context=pp.processing_type, rawvideo=rawvideo)
-    is_pc = pp.processing_type in ("pc", "hd-pc-home", "uhd-pc-home")
 
     def run() -> str:
         with VideoReader(pvs.get_avpvs_file_path()) as reader:
             pix_fmt = reader.pix_fmt
             w, h = reader.width, reader.height
+            plan = cpvs_plan(
+                pvs, pp, h, rawvideo, nonraw_crf, mobile_vprofile,
+                mobile_preset,
+            )
             # display frame rate resample, streaming (reference
-            # fps=displayFrameRate filter)
-            chunks = _avpvs_chunks(reader, float(pp.display_frame_rate))
-            out_rate = Fraction(pp.display_frame_rate).limit_denominator(1001)
+            # fps=displayFrameRate filter; pc branch only — mobile keeps
+            # the AVPVS rate, see cpvs_plan)
+            chunks = _avpvs_chunks(reader, plan["fps"])
+            out_rate = Fraction(
+                plan["fps"] if plan["fps"] is not None else reader.fps
+            ).limit_denominator(1001)
+            if plan["t"] is not None:
+                # the reference's long-test `-t total_duration` cap
+                chunks = _limit_frames(
+                    chunks, int(round(plan["t"] * float(out_rate)))
+                )
             ten_bit = "10" in pix_fmt
 
             audio = None
             srate = 48000
             if tc.is_long():
-                audio, srate = _audio_for_long(pvs, normalize=True)
+                audio, srate = _audio_for_long(pvs, normalize=plan["normalize"])
 
-            if is_pc:
-                vcodec, target_pix_fmt = pvs.get_vcodec_and_pix_fmt_for_cpvs(rawvideo)
-                need_pad = h < pp.coding_height
+            if plan["context"] == "pc":
+                vcodec, target_pix_fmt = plan["vcodec"], plan["pix_fmt"]
+                need_pad = plan["pad"] is not None
                 dw, dh = pp.display_width, pp.display_height
                 aud = (
-                    dict(audio_codec="pcm_s16le", sample_rate=srate, channels=2)
-                    if (tc.is_long() and audio is not None and audio.size)
+                    dict(audio_codec=plan["audio"]["codec"], sample_rate=srate,
+                         channels=plan["audio"]["channels"])
+                    if (plan["audio"] and audio is not None and audio.size)
                     else {}
                 )
 
@@ -158,16 +251,17 @@ def create_cpvs(
                 # chunks are depth-converted first
                 dw, dh = pp.display_width, pp.display_height
                 aud = (
-                    dict(audio_codec="aac", sample_rate=srate, channels=2,
-                         audio_bitrate_kbps=512)
-                    if (tc.is_long() and audio is not None and audio.size)
+                    dict(audio_codec=plan["audio"]["codec"], sample_rate=srate,
+                         channels=plan["audio"]["channels"],
+                         audio_bitrate_kbps=plan["audio"]["bitrate_kbps"])
+                    if (plan["audio"] and audio is not None and audio.size)
                     else {}
                 )
                 opts = (
-                    f"crf={nonraw_crf}:preset={mobile_preset}:"
-                    f"profile={mobile_vprofile}:movflags=+faststart"
+                    f"crf={plan['crf']}:preset={plan['preset']}:"
+                    f"profile={plan['profile']}:movflags=+faststart"
                 )
-                need_pad = (pp.display_height != pp.coding_height) or (h < pp.coding_height)
+                need_pad = plan["pad"] is not None
 
                 def mobile_chunk(chunk):
                     chunk = list(chunk[:3])

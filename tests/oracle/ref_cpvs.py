@@ -1,0 +1,45 @@
+"""Print the REFERENCE's CPVS (and preview) ffmpeg command strings for
+every PVS × post-processing of a database, as JSON — the executable
+oracle for CPVS-plan parity tests (lib/ffmpeg.py:1108-1259).
+
+Usage: python ref_cpvs.py /root/reference /path/to/DB/DB.yaml
+The caller must put tests/oracle (the ffprobe stub) on PATH and provide
+probe sidecars for the SRCs (same fixtures as ref_plan.py).
+"""
+import json
+import logging
+import os
+import sys
+
+ref_root, yaml_path = sys.argv[1], sys.argv[2]
+sys.path.insert(0, ref_root)
+logging.basicConfig(level=logging.ERROR)
+os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(yaml_path))))
+rel = os.path.relpath(os.path.abspath(yaml_path))
+
+from lib.test_config import TestConfig  # noqa: E402
+import lib.ffmpeg as ff  # noqa: E402
+
+try:
+    tc = TestConfig(rel)
+except SystemExit:
+    print(json.dumps({"rejected": True}))
+    sys.exit(0)
+
+out = []
+for pvs_id, pvs in tc.pvses.items():
+    for pp_idx, pp in enumerate(tc.post_processings):
+        variants = {}
+        # rawvideo only changes the pc branch (the x264 branch ignores it)
+        raw_opts = (False, True) if pp.processing_type == "pc" else (False,)
+        for rawvideo in raw_opts:
+            cmd = ff.create_cpvs(pvs, pp, rawvideo=rawvideo, overwrite=True)
+            variants["rawvideo" if rawvideo else "default"] = cmd
+        out.append({
+            "pvs": pvs_id,
+            "pp_index": pp_idx,
+            "pp_type": pp.processing_type,
+            "commands": variants,
+            "preview": ff.create_preview(pvs, overwrite=True),
+        })
+print(json.dumps(out))

@@ -1252,3 +1252,26 @@ def test_long_avpvs_multiworker_decode_identical(tmp_path, monkeypatch):
         got_sidecar = fh.read()
     assert got_bytes == ref_bytes
     assert got_sidecar == ref_sidecar
+
+
+def test_cpvs_limit_frames_cap():
+    """_limit_frames implements the reference's long-test `-t` video trim:
+    caps the chunk stream mid-chunk and stops pulling afterwards."""
+    import numpy as np
+
+    from processing_chain_tpu.models.cpvs import _limit_frames
+
+    pulled = []
+
+    def chunks():
+        for i in range(5):
+            pulled.append(i)
+            yield [np.full((4, 2, 2), i, np.uint8)]
+
+    out = list(_limit_frames(chunks(), 10))
+    assert [c[0].shape[0] for c in out] == [4, 4, 2]
+    assert sum(c[0].shape[0] for c in out) == 10
+    assert pulled == [0, 1, 2]  # the tail chunks are never decoded
+    # cap beyond the stream length is a no-op
+    out = list(_limit_frames(chunks(), 99))
+    assert sum(c[0].shape[0] for c in out) == 20

@@ -651,3 +651,196 @@ def test_p02_metadata_derivation_matches_reference(tmp_path, codec, encoder, ext
             afi["duration"].to_numpy(float),
             rafi["duration"].to_numpy(float), atol=1e-6,
         )
+
+
+# ---------------------------------------------------------------------------
+# CPVS plan parity (reference create_cpvs command strings vs our cpvs_plan)
+
+_CPVS_CASES = [
+    # (name, db_type, pp_yaml, expected branch exercised)
+    ("pc_nopad", "short",
+     "{type: pc, displayWidth: 1280, displayHeight: 720, "
+     "codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}"),
+    ("pc_pad", "short",
+     "{type: pc, displayWidth: 640, displayHeight: 480, "
+     "codingWidth: 640, codingHeight: 480, displayFrameRate: 30}"),
+    ("mobile_scale", "short",
+     "{type: mobile, displayWidth: 640, displayHeight: 360, "
+     "codingWidth: 640, codingHeight: 360, displayFrameRate: 60}"),
+    ("tablet_pad", "short",
+     "{type: tablet, displayWidth: 1280, displayHeight: 800, "
+     "codingWidth: 1280, codingHeight: 720, displayFrameRate: 60}"),
+    ("pc_long", "long",
+     "{type: pc, displayWidth: 1280, displayHeight: 720, "
+     "codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}"),
+    ("mobile_long", "long",
+     "{type: mobile, displayWidth: 640, displayHeight: 360, "
+     "codingWidth: 640, codingHeight: 360, displayFrameRate: 24}"),
+    ("hd_pc_home", "short",
+     "{type: hd-pc-home, displayWidth: 1280, displayHeight: 720, "
+     "codingWidth: 1280, codingHeight: 720, displayFrameRate: 50}"),
+]
+
+
+def _cpvs_db_yaml(db_id: str, db_type: str, pp_yaml: str) -> str:
+    long = db_type == "long"
+    audio_ql = ", audioCodec: aac, audioBitrate: 96" if long else ""
+    lines = [
+        f"databaseId: {db_id}",
+        "syntaxVersion: 6",
+        f"type: {db_type}",
+    ]
+    if long:
+        lines.append("segmentDuration: 2")
+    lines += [
+        "qualityLevelList:",
+        "  Q0: {index: 0, videoCodec: h264, videoBitrate: 500, "
+        f"width: 640, height: 360, fps: {SRC_FPS}{audio_ql}}}",
+        "codingList:",
+        "  VC01: {type: video, encoder: libx264, passes: 1, "
+        "iFrameInterval: 2, preset: ultrafast}",
+    ]
+    if long:
+        lines.append("  AC01: {type: audio, encoder: aac}")
+    audio_id = ", audioCodingId: AC01" if long else ""
+    ev = "[[Q0, 4]]" if long else "[[Q0, 6]]"
+    lines += [
+        "srcList:",
+        "  SRC000: SRC000.avi",
+        "hrcList:",
+        f"  HRC000: {{videoCodingId: VC01{audio_id}, eventList: {ev}}}",
+        "pvsList:",
+        f"  - {db_id}_SRC000_HRC000",
+        "postProcessingList:",
+        f"  - {pp_yaml}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def _build_cpvs_fixture(tmp_path, db_id: str, yaml_text: str) -> str:
+    """Like _build_fixture but the src probe carries coded_width/height
+    (reference create_cpvs reads them, lib/ffmpeg.py:1172-1174)."""
+    db = tmp_path / db_id
+    (db / "srcVid").mkdir(parents=True)
+    (db / f"{db_id}.yaml").write_text(yaml_text)
+    stream = {
+        "codec_type": "video", "codec_name": "ffv1",
+        "width": SRC_W, "height": SRC_H,
+        "coded_width": SRC_W, "coded_height": SRC_H,
+        "pix_fmt": "yuv420p", "duration": "10.000000",
+        "bit_rate": "8000000",
+        "r_frame_rate": f"{SRC_FPS}/1", "avg_frame_rate": f"{SRC_FPS}/1",
+        "profile": "",
+    }
+    (db / "srcVid" / "SRC000.avi").write_bytes(b"\x00" * 64)
+    (db / "srcVid" / "SRC000.avi.probe.json").write_text(
+        json.dumps({"streams": [stream]})
+    )
+    (db / "srcVid" / "SRC000.avi.yaml").write_text(_yaml.safe_dump({
+        "md5sum": "-",
+        "get_stream_size": {"v": 8_000_000, "a": 0},
+        "get_src_info": stream,
+    }))
+    return str(db / f"{db_id}.yaml")
+
+
+@pytest.mark.parametrize("name,db_type,pp_yaml",
+                         _CPVS_CASES, ids=[c[0] for c in _CPVS_CASES])
+def test_cpvs_plan_matches_reference_commands(tmp_path, name, db_type, pp_yaml):
+    """CPVS decision parity with the REFERENCE's create_cpvs command
+    strings (lib/ffmpeg.py:1108-1249) across every branch: pc pad/no-pad
+    (rawvideo and lossless), the mobile/tablet x264 branch's pad-without-
+    scale vs scale-without-pad split, hd-pc-home's routing through the
+    x264 branch, short -an vs long audio with -t and the ffmpeg-normalize
+    loudness step, and the pc-only display fps filter."""
+    import re
+
+    from processing_chain_tpu.config import StaticProber, TestConfig
+    from processing_chain_tpu.models import avpvs as av
+    from processing_chain_tpu.models.cpvs import cpvs_plan
+
+    db_id = "P2SXM55" if db_type == "short" else "P2LTR55"
+    yaml_path = _build_cpvs_fixture(
+        tmp_path, db_id, _cpvs_db_yaml(db_id, db_type, pp_yaml)
+    )
+
+    env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
+    out = subprocess.run(
+        [sys.executable, os.path.join(ORACLE, "ref_cpvs.py"), REF, yaml_path],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-1500:])
+    ref = json.loads(out.stdout.strip().splitlines()[-1])
+    assert isinstance(ref, list) and len(ref) == 1, ref
+    ref = ref[0]
+
+    prober = StaticProber({}, default=dict(
+        width=SRC_W, height=SRC_H, pix_fmt="yuv420p",
+        r_frame_rate=str(SRC_FPS), avg_frame_rate=f"{SRC_FPS}/1",
+        video_duration=10.0,
+    ))
+    tc = TestConfig(yaml_path, prober=prober)
+    pvs = tc.pvses[f"{db_id}_SRC000_HRC000"]
+    pp = tc.post_processings[0]
+    avpvs_w, avpvs_h = av.avpvs_dimensions(pvs)
+
+    for variant, cmd in ref["commands"].items():
+        rawvideo = variant == "rawvideo"
+        plan = cpvs_plan(pvs, pp, avpvs_h, rawvideo=rawvideo)
+        assert cmd is not None
+
+        # branch: pc = rawvideo/v210 AVI; else x264 mp4
+        if plan["context"] == "pc":
+            m = re.search(r"-c:v (\S+) -pix_fmt (\S+)", cmd)
+            assert m, cmd
+            assert plan["vcodec"] == m.group(1)
+            assert plan["pix_fmt"] == m.group(2)
+            # pc carries the display-rate fps filter
+            m = re.search(r"fps=fps=([\d.]+)", cmd)
+            assert m, cmd
+            assert plan["fps"] == pytest.approx(float(m.group(1)))
+        else:
+            assert "-c:v libx264" in cmd
+            m = re.search(r"-crf (\d+)", cmd)
+            assert int(m.group(1)) == plan["crf"]
+            m = re.search(r"-preset (\S+)", cmd)
+            assert m.group(1) == plan["preset"]
+            m = re.search(r"-profile:v (\S+)", cmd)
+            assert m.group(1) == plan["profile"]
+            assert "-movflags faststart" in cmd
+            # the reference's mobile branch has NO fps filter
+            assert "fps=" not in cmd
+            assert plan["fps"] is None
+
+        # geometry
+        m = re.search(r"pad=width=(\d+):height=(\d+)", cmd)
+        if plan["pad"] is not None:
+            assert m, cmd
+            assert (int(m.group(1)), int(m.group(2))) == plan["pad"]
+        else:
+            assert not m, cmd
+        m = re.search(r"scale=(\d+):(\d+):flags=bicubic", cmd)
+        if plan.get("scale") is not None:
+            assert m, cmd
+            assert (int(m.group(1)), int(m.group(2))) == plan["scale"]
+            assert "setsar=1/1" in cmd
+        else:
+            assert not m, cmd
+
+        # audio + loudness
+        if plan["audio"] is None:
+            assert "-an" in cmd
+            assert "ffmpeg-normalize" not in cmd
+            assert not plan["normalize"]
+        else:
+            mt = re.search(r"-t ([\d.]+)", cmd)
+            assert mt, cmd
+            assert plan["t"] == pytest.approx(float(mt.group(1)))
+            if plan["audio"]["codec"] == "pcm_s16le":
+                assert "-c:a pcm_s16le" in cmd and "-ac 2" in cmd
+                assert plan["audio"]["channels"] == 2
+            else:
+                assert "-c:a aac" in cmd
+                m = re.search(r"-b:a (\d+)k", cmd)
+                assert int(m.group(1)) == plan["audio"]["bitrate_kbps"]
+            assert ("ffmpeg-normalize" in cmd) == plan["normalize"]
