@@ -173,6 +173,32 @@ def plan_segment_frames(segment: Segment):
     return target_h, target_w, target_fps, out_fps
 
 
+def rate_control_kwargs(segment: Segment, out_fps: float | None = None) -> dict:
+    """Numeric rate-control/GOP writer arguments, shared by
+    encode_segment.run and the reference-oracle parity tests (reference
+    lib/ffmpeg.py: bitrate :414-445 via target_video_bitrate, vbv/min/max
+    rate factors :188-201/:249-259/:287-291, keyframe interval
+    :203-210/:260-266/:293-299, bframes :216-218). Pass `out_fps` when
+    plan_segment_frames was already run for this segment."""
+    coding = segment.video_coding
+    if out_fps is None:
+        _, _, _, out_fps = plan_segment_frames(segment)
+    bitrate = 0.0
+    if coding.crf is None and coding.qp is None:
+        bitrate = float(segment.target_video_bitrate or 0)
+    return dict(
+        bitrate_kbps=bitrate,
+        maxrate_kbps=(coding.maxrate_factor or 0) * bitrate,
+        minrate_kbps=(coding.minrate_factor or 0) * bitrate,
+        bufsize_kbps=(coding.bufsize_factor or 0) * bitrate,
+        gop=(
+            int(out_fps * coding.iframe_interval)
+            if coding.iframe_interval else -1
+        ),
+        bframes=coding.bframes if coding.bframes is not None else -1,
+    )
+
+
 def encode_segment(segment: Segment) -> Optional[Job]:
     """Build the encode Job for a segment; skip/--force semantics live in
     Job.should_run / JobRunner (engine/jobs.py)."""
@@ -197,9 +223,8 @@ def encode_segment(segment: Segment) -> Optional[Job]:
 
     target_h, target_w, target_fps, out_fps = plan_segment_frames(segment)
     passes = 2 if coding.passes == 2 else 1
-    bitrate = 0.0
-    if coding.crf is None and coding.qp is None:
-        bitrate = float(segment.target_video_bitrate or 0)
+    rc = rate_control_kwargs(segment, out_fps)
+    bitrate = rc["bitrate_kbps"]
 
     def run() -> str:
         src_fps = segment.src.get_fps()
@@ -234,10 +259,6 @@ def encode_segment(segment: Segment) -> Optional[Job]:
                 )
 
         fps_frac = Fraction(out_fps).limit_denominator(1001)
-        gop = -1
-        if coding.iframe_interval:
-            gop = int(out_fps * coding.iframe_interval)
-        bframes = coding.bframes if coding.bframes is not None else -1
 
         audio = {}
         if tc.is_long() and segment.audio_coding is not None:
@@ -299,12 +320,7 @@ def encode_segment(segment: Segment) -> Optional[Job]:
                 height=target_h,
                 pix_fmt=segment.target_pix_fmt,
                 fps=(fps_frac.numerator, fps_frac.denominator),
-                bitrate_kbps=bitrate,
-                maxrate_kbps=(coding.maxrate_factor or 0) * bitrate,
-                minrate_kbps=(coding.minrate_factor or 0) * bitrate,
-                bufsize_kbps=(coding.bufsize_factor or 0) * bitrate,
-                gop=gop,
-                bframes=bframes,
+                **rc,
                 threads=1,  # determinism (reference -threads 1, :790)
                 opts=_encoder_opts(segment, pass_num, passes, stats),
                 pass_num=pass_num if passes == 2 else 0,

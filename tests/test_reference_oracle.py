@@ -844,3 +844,188 @@ def test_cpvs_plan_matches_reference_commands(tmp_path, name, db_type, pp_yaml):
                 m = re.search(r"-b:a (\d+)k", cmd)
                 assert int(m.group(1)) == plan["audio"]["bitrate_kbps"]
             assert ("ffmpeg-normalize" in cmd) == plan["normalize"]
+
+
+def test_encode_parameters_x265_vp9_av1_match_reference(tmp_path):
+    """Per-codec encode-parameter parity beyond libx264: the REFERENCE's
+    x265 (vbv/keyint/bframes/pass inside -x265-params), libvpx-vp9
+    (quality/speed incl. the pass-1 speed-4 rule, float min/maxrate) and
+    libaom-av1 (cpu-used, -b:v 0 CRF form) command strings vs OUR
+    rate_control_kwargs + _encoder_opts. Also pins the reference's
+    INVERTED x265 scenecut quirk (scenecut: yes emits scenecut=0,
+    lib/ffmpeg.py:213-214) as a documented deviation: ours only disables
+    scene cuts when scenecut is false."""
+    import re
+
+    from processing_chain_tpu.config import StaticProber, TestConfig
+    from processing_chain_tpu.models import segments as seg_model
+
+    db_id = "P2SXM60"
+    yaml_text = "\n".join([
+        f"databaseId: {db_id}",
+        "syntaxVersion: 6",
+        "type: short",
+        "qualityLevelList:",
+        "  Q0: {index: 0, videoCodec: h265, videoCrf: 28, "
+        f"width: 640, height: 360, fps: {SRC_FPS}}}",
+        "  Q1: {index: 1, videoCodec: h265, videoBitrate: 400, "
+        f"width: 640, height: 360, fps: {SRC_FPS}}}",
+        "  Q2: {index: 2, videoCodec: vp9, videoBitrate: 400, "
+        f"width: 640, height: 360, fps: {SRC_FPS}}}",
+        "  Q3: {index: 3, videoCodec: av1, videoCrf: 40, "
+        f"width: 640, height: 360, fps: {SRC_FPS}}}",
+        "codingList:",
+        # crf/qp codings must omit `passes`: both parsers ignore crf/qp
+        # when passes is present (reference test_config.py:775-800).
+        # x265 param counts are chosen ODD where emission is asserted:
+        # the reference's `len(x265_params) & (encoder == 'libx265')`
+        # precedence quirk (ffmpeg.py:229, SURVEY do-not-copy list) drops
+        # the whole -x265-params block for EVEN counts — VC05 pins that.
+        "  VC01: {type: video, encoder: libx265, crf: yes, "
+        "preset: fast, scenecut: no, bframes: 3}",
+        "  VC02: {type: video, encoder: libx265, passes: 2, "
+        "iFrameInterval: 2, preset: fast, maxrateFactor: 1.5, "
+        "bufsizeFactor: 2}",
+        "  VC05: {type: video, encoder: libx265, crf: yes, "
+        "iFrameInterval: 2, preset: fast, scenecut: no}",
+        "  VC03: {type: video, encoder: libvpx-vp9, passes: 2, "
+        "iFrameInterval: 2, speed: 2, quality: good, "
+        "minrateFactor: 0.5, maxrateFactor: 1.5}",
+        "  VC04: {type: video, encoder: libaom-av1, crf: yes, "
+        "cpuUsed: 8}",
+        "srcList:",
+        "  SRC000: SRC000.avi",
+        "hrcList:",
+        "  HRC000: {videoCodingId: VC01, eventList: [[Q0, 6]]}",
+        "  HRC001: {videoCodingId: VC02, eventList: [[Q1, 6]]}",
+        "  HRC002: {videoCodingId: VC03, eventList: [[Q2, 6]]}",
+        "  HRC003: {videoCodingId: VC04, eventList: [[Q3, 6]]}",
+        "  HRC004: {videoCodingId: VC05, eventList: [[Q0, 6]]}",
+        "pvsList:",
+    ] + [f"  - {db_id}_SRC000_HRC{j:03d}" for j in range(5)] + [
+        "postProcessingList:",
+        "  - {type: pc, displayWidth: 1280, displayHeight: 720, "
+        "codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}",
+    ]) + "\n"
+    yaml_path = _build_fixture(tmp_path, db_id, yaml_text, 10.0)
+
+    env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
+    out = subprocess.run(
+        [sys.executable, os.path.join(ORACLE, "ref_plan.py"), REF,
+         yaml_path, "--commands"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, (out.stdout[-300:], out.stderr[-1200:])
+    plan = json.loads(out.stdout.strip().splitlines()[-1])
+    assert not plan.get("rejected"), plan
+    commands = plan["commands"]
+
+    prober = StaticProber({}, default=dict(
+        width=SRC_W, height=SRC_H, pix_fmt="yuv420p",
+        r_frame_rate=str(SRC_FPS), avg_frame_rate=f"{SRC_FPS}/1",
+        video_duration=10.0,
+    ))
+    tc = TestConfig(yaml_path, prober=prober)
+    segs = {s.filename: s for s in tc.get_required_segments()}
+    assert sorted(segs) == sorted(commands)
+    assert len(segs) == 5
+
+    def x265_params(cmd):
+        m = re.search(r"-x265-params (\S+)", cmd)
+        return dict(
+            kv.split("=", 1) for kv in m.group(1).split(":")
+        ) if m else {}
+
+    for name, cmd in commands.items():
+        seg = segs[name]
+        enc = seg.video_coding.encoder
+        rc = seg_model.rate_control_kwargs(seg)
+        # a 2-pass reference command is "cmd1 && cmd2"
+        passes = [c.strip() for c in cmd.split("&&")]
+        n_passes = 2 if seg.video_coding.passes == 2 else 1
+        assert len(passes) == n_passes, name
+
+        for pass_idx, pcmd in enumerate(passes, start=1):
+            ours = seg_model._encoder_opts(
+                seg, pass_idx, n_passes, "STATS"
+            )
+            if enc == "libx265":
+                assert "-c:v libx265" in pcmd
+                if seg.video_coding.crf is not None:
+                    m = re.search(r"-crf (\d+)", pcmd)
+                    assert int(m.group(1)) == seg.quality_level.video_crf
+                    assert f"crf={seg.quality_level.video_crf}" in ours
+                else:
+                    m = re.search(r"-b:v ([\d.]+)k", pcmd)
+                    assert float(m.group(1)) == rc["bitrate_kbps"]
+                m = re.search(r"-preset (\S+)", pcmd)
+                assert m.group(1) == seg.video_coding.preset
+                assert f"preset={seg.video_coding.preset}" in ours
+
+                # the reference's `&` precedence quirk (ffmpeg.py:229,
+                # do-not-copy list): -x265-params is emitted only for an
+                # ODD param count — VC05's even count loses its keyint
+                # entirely; OUR gop kwarg is unconditional
+                ref_param_count = (
+                    (1 if seg.video_coding.maxrate_factor else 0)
+                    + (1 if seg.video_coding.bufsize_factor else 0)
+                    + (2 if seg.video_coding.iframe_interval else 0)
+                    + (1 if seg.video_coding.scenecut else 0)
+                    + (1 if seg.video_coding.bframes is not None else 0)
+                    + (2 if n_passes == 2 else 0)
+                )
+                emitted = "-x265-params" in pcmd
+                assert emitted == (ref_param_count % 2 == 1), name
+                if seg.video_coding.iframe_interval:
+                    assert rc["gop"] > 0  # ours always carries the keyint
+                if not emitted:
+                    continue
+                px = x265_params(pcmd)
+                if seg.video_coding.maxrate_factor:
+                    assert int(px["vbv-maxrate"]) == int(rc["maxrate_kbps"])
+                    assert int(px["vbv-bufsize"]) == int(rc["bufsize_kbps"])
+                if seg.video_coding.iframe_interval:
+                    assert int(px["keyint"]) == rc["gop"]
+                    assert int(px["min-keyint"]) == rc["gop"]
+                if seg.video_coding.bframes is not None:
+                    assert int(px["bframes"]) == rc["bframes"]
+                if n_passes == 2:
+                    assert px["pass"] == str(pass_idx)
+                    assert f"pass={pass_idx}" in ours
+                    assert "stats=" in ours
+                # the documented deviation: reference's inverted quirk
+                # emits scenecut=0 exactly when scenecut is truthy; ours
+                # disables only when scenecut is false
+                assert ("scenecut" in px) == bool(seg.video_coding.scenecut)
+                assert ("scenecut=0" in ours) == (
+                    not seg.video_coding.scenecut
+                )
+            elif enc == "libvpx-vp9":
+                assert "-c:v libvpx-vp9" in pcmd
+                m = re.search(r"-b:v ([\d.]+)k", pcmd)
+                assert float(m.group(1)) == rc["bitrate_kbps"]
+                m = re.search(r"-maxrate ([\d.]+)k", pcmd)
+                assert float(m.group(1)) == pytest.approx(rc["maxrate_kbps"])
+                m = re.search(r"-minrate ([\d.]+)k", pcmd)
+                assert float(m.group(1)) == pytest.approx(rc["minrate_kbps"])
+                m = re.search(r"-g (\d+) -keyint_min (\d+)", pcmd)
+                assert int(m.group(1)) == rc["gop"] == int(m.group(2))
+                m = re.search(r"-quality (\S+)", pcmd)
+                assert f"quality={m.group(1)}" in ours
+                # pass 1 runs at speed 4 (reference :100-102)
+                m = re.search(r"-speed (\d+)", pcmd)
+                want_speed = 4 if (n_passes == 2 and pass_idx == 1) else \
+                    seg.video_coding.speed
+                assert int(m.group(1)) == want_speed
+                assert f"speed={want_speed}" in ours
+                if n_passes == 2:
+                    assert f"-pass {pass_idx}" in pcmd
+            elif enc == "libaom-av1":
+                assert "-c:v libaom-av1" in pcmd
+                assert "-b:v 0" in pcmd
+                m = re.search(r"-crf (\d+)", pcmd)
+                assert int(m.group(1)) == seg.quality_level.video_crf
+                assert f"crf={seg.quality_level.video_crf}" in ours
+                m = re.search(r"-cpu-used (\d+)", pcmd)
+                assert int(m.group(1)) == seg.video_coding.cpu_used
+                assert f"cpu-used={seg.video_coding.cpu_used}" in ours
