@@ -17,6 +17,7 @@ Skips when /root/reference is not present (portable checkouts).
 
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -128,7 +129,11 @@ def _gen_db(rng, db_id: str, long: bool) -> str:
     ) + "\n"
 
 
-def _build_fixture(tmp_path, db_id: str, yaml_text: str, src_secs: float):
+def _build_fixture(tmp_path, db_id: str, yaml_text: str, src_secs: float,
+                   fps_by_src: dict | None = None):
+    """Stub SRC files + probe.json + probe-cache .yaml sidecars for every
+    srcList entry. `fps_by_src` overrides the frame rate per SRC filename
+    (default SRC_FPS)."""
     db = tmp_path / db_id
     (db / "srcVid").mkdir(parents=True)
     (db / f"{db_id}.yaml").write_text(yaml_text)
@@ -137,14 +142,15 @@ def _build_fixture(tmp_path, db_id: str, yaml_text: str, src_secs: float):
         if not line.startswith("SRC") or ":" not in line:
             continue
         fname = line.split(":", 1)[1].strip()
+        fps = (fps_by_src or {}).get(fname, SRC_FPS)
         f = db / "srcVid" / fname
         f.write_bytes(b"\x00" * 64)
         streams = [{
             "codec_type": "video", "codec_name": "ffv1",
             "width": SRC_W, "height": SRC_H, "pix_fmt": "yuv420p",
             "duration": f"{src_secs:.6f}", "bit_rate": "8000000",
-            "r_frame_rate": f"{SRC_FPS}/1", "avg_frame_rate": f"{SRC_FPS}/1",
-            "profile": "", "nb_frames": str(int(src_secs * SRC_FPS)),
+            "r_frame_rate": f"{fps}/1", "avg_frame_rate": f"{fps}/1",
+            "profile": "", "nb_frames": str(int(src_secs * fps)),
         }, {
             "codec_type": "audio", "codec_name": "flac",
             "duration": f"{src_secs:.6f}", "sample_rate": "48000",
@@ -373,7 +379,7 @@ def test_encode_parameters_match_reference_commands(tmp_path, seed):
     are parsed field by field and must agree with OUR encode plan —
     trim window, scale width, output fps, rate-control mode and value,
     GOP/keyint, preset, pix_fmt, pass count."""
-    import re
+
 
     import numpy as np
 
@@ -753,7 +759,7 @@ def test_cpvs_plan_matches_reference_commands(tmp_path, name, db_type, pp_yaml):
     scale vs scale-without-pad split, hd-pc-home's routing through the
     x264 branch, short -an vs long audio with -t and the ffmpeg-normalize
     loudness step, and the pc-only display fps filter."""
-    import re
+
 
     from processing_chain_tpu.config import StaticProber, TestConfig
     from processing_chain_tpu.models import avpvs as av
@@ -855,7 +861,7 @@ def test_encode_parameters_x265_vp9_av1_match_reference(tmp_path):
     INVERTED x265 scenecut quirk (scenecut: yes emits scenecut=0,
     lib/ffmpeg.py:213-214) as a documented deviation: ours only disables
     scene cuts when scenecut is false."""
-    import re
+
 
     from processing_chain_tpu.config import StaticProber, TestConfig
     from processing_chain_tpu.models import segments as seg_model
@@ -1029,3 +1035,105 @@ def test_encode_parameters_x265_vp9_av1_match_reference(tmp_path):
                 m = re.search(r"-cpu-used (\d+)", pcmd)
                 assert int(m.group(1)) == seg.video_coding.cpu_used
                 assert f"cpu-used={seg.video_coding.cpu_used}" in ours
+
+
+def _eval_select_expr(expr: str, n: int) -> bool:
+    """Evaluate an ffmpeg `select=` expression of the reference's drop
+    tables (compositions of not(), mod(), +) for frame index n."""
+    e = expr.replace(" ", "").replace("\\", "").strip("'\"")
+    e = re.sub(r"not\(([^()]*\([^()]*\)[^()]*)\)", r"(0 if (\1) else 1)", e)
+    e = re.sub(r"mod\(([^,()]+),([^()]+)\)", r"((\1)%(\2))", e)
+    return eval(e, {"__builtins__": {}}, {"n": n}) != 0
+
+
+def test_fps_drop_tables_match_reference_select_expressions(tmp_path):
+    """Frame-drop parity for every supported fps ladder ratio
+    (reference lib/ffmpeg.py:806-832): the reference's emitted
+    select='...' expression, EXECUTED per frame index, must keep exactly
+    the frames of OUR select_indices gather plan, and the trailing
+    fps=fps= value must match our resolved target fps."""
+    from processing_chain_tpu.config import StaticProber, TestConfig
+    from processing_chain_tpu.models import segments as seg_model
+    from processing_chain_tpu.ops import fps as fps_ops
+
+    ratios = [  # (src_fps, dst_fps)
+        (60, 30), (60, 24), (60, 20), (60, 15),
+        (30, 24), (50, 15), (25, 15), (24, 15),
+    ]
+    db_id = "P2SXM61"
+    lines = [f"databaseId: {db_id}", "syntaxVersion: 6", "type: short",
+             "qualityLevelList:"]
+    for i, (_s, d) in enumerate(ratios):
+        lines.append(
+            f"  Q{i}: {{index: {i}, videoCodec: h264, videoBitrate: 300, "
+            f"width: 320, height: 180, fps: {d}}}"
+        )
+    lines += [
+        "codingList:",
+        "  VC01: {type: video, encoder: libx264, passes: 1, "
+        "iFrameInterval: 2, preset: ultrafast}",
+        "srcList:",
+    ]
+    for i in range(len(ratios)):
+        lines.append(f"  SRC{i:03d}: SRC{i:03d}.avi")
+    lines.append("hrcList:")
+    for i in range(len(ratios)):
+        lines.append(
+            f"  HRC{i:03d}: {{videoCodingId: VC01, eventList: [[Q{i}, 6]]}}"
+        )
+    lines.append("pvsList:")
+    for i in range(len(ratios)):
+        lines.append(f"  - {db_id}_SRC{i:03d}_HRC{i:03d}")
+    lines += [
+        "postProcessingList:",
+        "  - {type: pc, displayWidth: 1280, displayHeight: 720, "
+        "codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}",
+    ]
+    yaml_text = "\n".join(lines) + "\n"
+    fps_by_src = {
+        f"SRC{i:03d}.avi": s for i, (s, _d) in enumerate(ratios)
+    }
+    yaml_path = _build_fixture(tmp_path, db_id, yaml_text, 10.0, fps_by_src)
+
+    env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
+    out = subprocess.run(
+        [sys.executable, os.path.join(ORACLE, "ref_plan.py"), REF,
+         yaml_path, "--commands"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode == 0, (out.stdout[-300:], out.stderr[-1200:])
+    plan = json.loads(out.stdout.strip().splitlines()[-1])
+    assert not plan.get("rejected"), plan
+    commands = plan["commands"]
+
+    probes = {
+        f"SRC{i:03d}.avi": dict(
+            width=SRC_W, height=SRC_H, pix_fmt="yuv420p",
+            r_frame_rate=f"{s}/1", avg_frame_rate=f"{s}/1",
+            video_duration=10.0,
+        )
+        for i, (s, _d) in enumerate(ratios)
+    }
+    prober = StaticProber(probes)
+    tc = TestConfig(yaml_path, prober=prober)
+    segs = {s.filename: s for s in tc.get_required_segments()}
+    assert sorted(segs) == sorted(commands)
+    assert len(segs) == len(ratios)
+
+    for name, cmd in commands.items():
+        seg = segs[name]
+        src_fps = seg.src.get_fps()
+        _, _, target_fps, out_fps = seg_model.plan_segment_frames(seg)
+        assert target_fps is not None and target_fps != src_fps, name
+
+        m = re.search(r"fps=fps=([\d.]+)", cmd)
+        assert m and float(m.group(1)) == pytest.approx(out_fps), name
+
+        m = re.search(r"select=\\?'([^'\"]+)\\?'", cmd)
+        assert m, (name, cmd)
+        expr = m.group(1)
+        cycle, phases = fps_ops.select_table(src_fps, target_fps)
+        n_check = cycle * 4
+        ref_kept = [n for n in range(n_check) if _eval_select_expr(expr, n)]
+        ours_kept = list(fps_ops.select_indices(n_check, src_fps, target_fps))
+        assert ref_kept == ours_kept, (name, expr, cycle, phases)
