@@ -43,6 +43,8 @@ class MPStreamInfo(ct.Structure):
         ("codec_name", ct.c_char * 32),
         ("width", ct.c_int32),
         ("height", ct.c_int32),
+        ("coded_width", ct.c_int32),
+        ("coded_height", ct.c_int32),
         ("pix_fmt", ct.c_char * 32),
         ("fps_num", ct.c_int32),
         ("fps_den", ct.c_int32),
@@ -134,7 +136,7 @@ def ensure_loaded() -> ct.CDLL:
         lib.mp_probe.restype = ct.c_int
         lib.mp_probe.argtypes = [
             ct.c_char_p, ct.POINTER(MPFormatInfo), ct.POINTER(MPStreamInfo),
-            ct.c_int, ct.c_char_p, ct.c_int,
+            ct.c_int, ct.c_int, ct.c_char_p, ct.c_int,
         ]
         lib.mp_scan_packets.restype = ct.c_long
         lib.mp_scan_packets.argtypes = [
@@ -229,21 +231,27 @@ def version() -> str:
     return lib.mp_version().decode()
 
 
-def probe(path: str) -> dict:
+def probe(path: str, coded_dims: bool = False) -> dict:
     """Container + stream info (the ffprobe -show_streams/-show_format
-    replacement)."""
+    replacement). `coded_dims=True` additionally resolves the first video
+    stream's decoder coded_width/coded_height (costs a first-frame
+    decode — the SRC sidecar path wants it, per-segment probes don't);
+    otherwise coded dims mirror the display dims."""
     lib = ensure_loaded()
     fmt = MPFormatInfo()
     cap = 64
+    want = 1 if coded_dims else 0
     streams = (MPStreamInfo * cap)()
     err = _err_buf()
-    n = lib.mp_probe(path.encode(), ct.byref(fmt), streams, cap, err, 512)
+    n = lib.mp_probe(path.encode(), ct.byref(fmt), streams, cap, want, err, 512)
     if n < 0:
         raise MediaError(f"probe({path}): {err.value.decode()}")
     if fmt.nb_streams > cap:
         cap = int(fmt.nb_streams)
         streams = (MPStreamInfo * cap)()
-        n = lib.mp_probe(path.encode(), ct.byref(fmt), streams, cap, err, 512)
+        n = lib.mp_probe(
+            path.encode(), ct.byref(fmt), streams, cap, want, err, 512
+        )
         if n < 0:
             raise MediaError(f"probe({path}): {err.value.decode()}")
     out_streams = []
@@ -263,6 +271,8 @@ def probe(path: str) -> dict:
             d.update(
                 width=s.width,
                 height=s.height,
+                coded_width=s.coded_width,
+                coded_height=s.coded_height,
                 pix_fmt=s.pix_fmt.decode(),
                 r_frame_rate=f"{s.fps_num}/{s.fps_den}",
                 avg_frame_rate=f"{s.avg_fps_num}/{s.avg_fps_den}",

@@ -53,7 +53,7 @@ class LibavProber:
                 ydata = yaml.safe_load(f)
             if ydata and "get_src_info" in ydata:
                 return ydata["get_src_info"]
-        info = medialib.probe(file_path)
+        info = medialib.probe(file_path, coded_dims=True)
         v = _select(info, "video")
         if v is None:
             raise medialib.MediaError(f"no video stream in {file_path}")

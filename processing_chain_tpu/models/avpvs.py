@@ -60,7 +60,15 @@ def _decode_workers() -> int:
 def avpvs_dimensions(pvs: Pvs, post_proc_id: int = 0) -> tuple[int, int]:
     """(width, height) of the AVPVS canvas: aspect-aware dims vs the
     post-processing coding size, overridden upward when the encoded segment
-    is taller (reference create_avpvs_short :976-986)."""
+    is taller (reference create_avpvs_short :976-986).
+
+    Documented deviation: the reference feeds the SRC's CODED dims into
+    this math (stream_info['coded_width'/'coded_height'], :975-976) — for
+    a non-mod-16 h264 master (e.g. 1920x1080, coded 1920x1088) that
+    distorts the canvas aspect. We use the display dims; for the usual
+    lossless (FFV1/rawvideo) masters the two are identical. Interop of
+    the sidecars carrying both is oracle-tested
+    (tests/test_reference_oracle.py::test_src_sidecar_interop_with_reference)."""
     pp = pvs.test_config.post_processings[post_proc_id]
     w, h = fr.calculate_avpvs_video_dimensions(
         pvs.src.stream_info["width"],

@@ -70,6 +70,7 @@ struct MPStreamInfo {
     int32_t codec_type;  // 0 video, 1 audio
     char codec_name[32];
     int32_t width, height;
+    int32_t coded_width, coded_height;  // decoder coded dims (mb-aligned)
     char pix_fmt[32];
     int32_t fps_num, fps_den;        // r_frame_rate
     int32_t avg_fps_num, avg_fps_den;
@@ -98,7 +99,7 @@ EXPORT int mp_stream_info_size(void) { return (int)sizeof(MPStreamInfo); }
 
 EXPORT int mp_probe(const char* path, MPFormatInfo* fmt_out,
                     MPStreamInfo* streams_out, int max_streams,
-                    char* err, int errlen) {
+                    int want_coded_dims, char* err, int errlen) {
     AVFormatContext* fmt = nullptr;
     int ret = avformat_open_input(&fmt, path, nullptr, nullptr);
     if (ret < 0) {
@@ -141,6 +142,9 @@ EXPORT int mp_probe(const char* path, MPFormatInfo* fmt_out,
         if (par->codec_type == AVMEDIA_TYPE_VIDEO) {
             const char* pf = av_get_pix_fmt_name((AVPixelFormat)par->format);
             snprintf(si->pix_fmt, sizeof(si->pix_fmt), "%s", pf ? pf : "?");
+            // filled below by the coded-dims pass; default = display dims
+            si->coded_width = par->width;
+            si->coded_height = par->height;
             AVRational r = st->r_frame_rate;
             si->fps_num = r.num;
             si->fps_den = r.den;
@@ -164,6 +168,56 @@ EXPORT int mp_probe(const char* path, MPFormatInfo* fmt_out,
         si->bit_rate = par->bit_rate;
         const char* prof = avcodec_profile_name(par->codec_id, par->profile);
         snprintf(si->profile, sizeof(si->profile), "%s", prof ? prof : "");
+    }
+
+    // Coded-dims pass (opt-in: costs a decoder open + first-frame
+    // decode, so per-segment probes skip it): what ffprobe reports as
+    // coded_width/coded_height — mb-aligned for h264/h265, known only
+    // after the decoder has seen a frame. The reference's sidecar
+    // contract and its AVPVS dims math consume these
+    // (lib/ffmpeg.py:975-976/:1013-1014/:1173-1174). Sidecar caching
+    // makes this a once-per-SRC cost.
+    for (int k = 0; want_coded_dims && k < n; k++) {
+        if (streams_out[k].codec_type != 0) continue;
+        int si_idx = streams_out[k].stream_index;
+        AVStream* st = fmt->streams[si_idx];
+        const AVCodec* cdec = avcodec_find_decoder(st->codecpar->codec_id);
+        if (!cdec) break;
+        AVCodecContext* cctx = avcodec_alloc_context3(cdec);
+        if (!cctx) break;
+        if (avcodec_parameters_to_context(cctx, st->codecpar) < 0 ||
+            avcodec_open2(cctx, cdec, nullptr) < 0) {
+            avcodec_free_context(&cctx);
+            break;
+        }
+        AVPacket* pkt = av_packet_alloc();
+        AVFrame* frm = av_frame_alloc();
+        int fed = 0;
+        bool got = false;
+        while (pkt && frm && !got && fed < 64 &&
+               av_read_frame(fmt, pkt) >= 0) {
+            if (pkt->stream_index == si_idx) {
+                fed++;
+                if (avcodec_send_packet(cctx, pkt) >= 0 &&
+                    avcodec_receive_frame(cctx, frm) >= 0)
+                    got = true;
+            }
+            av_packet_unref(pkt);
+        }
+        if (pkt && frm && !got) {
+            // drain: short streams with reorder delay only emit their
+            // frames at EOF flush
+            avcodec_send_packet(cctx, nullptr);
+            if (avcodec_receive_frame(cctx, frm) >= 0) got = true;
+        }
+        if (got && cctx->coded_width > 0) {
+            streams_out[k].coded_width = cctx->coded_width;
+            streams_out[k].coded_height = cctx->coded_height;
+        }
+        av_frame_free(&frm);
+        av_packet_free(&pkt);
+        avcodec_free_context(&cctx);
+        break;  // first video stream only
     }
     avformat_close_input(&fmt);
     return n;

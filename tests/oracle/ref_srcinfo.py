@@ -1,0 +1,47 @@
+"""Feed OUR probe-cache sidecar to the REFERENCE's get_src_info and its
+AVPVS dimension math, printing the result as JSON — the executable
+oracle for sidecar interoperability (a user switching frameworks keeps
+their analyzed-SRC sidecars).
+
+Usage: python ref_srcinfo.py /root/reference /path/to/src.yaml CW CH
+The sidecar short-circuits probing (lib/ffmpeg.py:629-632), so no media
+file or ffprobe stub is needed.
+"""
+import json
+import logging
+import os
+import sys
+
+ref_root, sidecar = sys.argv[1], sys.argv[2]
+coding_w, coding_h = int(sys.argv[3]), int(sys.argv[4])
+sys.path.insert(0, ref_root)
+logging.basicConfig(level=logging.ERROR)
+
+import lib.ffmpeg as ff  # noqa: E402
+
+
+class Src:
+    """Duck-typed SRC (the reference's own pattern, downloader.py:33-42)."""
+
+    file_path = "/nonexistent.avi"
+    info_path = sidecar
+
+    def __str__(self):
+        return os.path.basename(self.file_path)
+
+
+info = ff.get_src_info(Src())
+from fractions import Fraction  # noqa: E402
+
+dims = ff.calculate_avpvs_video_dimensions(
+    int(info["coded_width"]), int(info["coded_height"]), coding_w, coding_h
+)
+print(json.dumps({
+    "coded_width": int(info["coded_width"]),
+    "coded_height": int(info["coded_height"]),
+    "width": int(info["width"]),
+    "height": int(info["height"]),
+    "fps": float(Fraction(str(info["r_frame_rate"]))),
+    "duration": float(info["duration"]),
+    "avpvs_dims": [int(dims[0]), int(dims[1])],
+}))

@@ -1137,3 +1137,48 @@ def test_fps_drop_tables_match_reference_select_expressions(tmp_path):
         ref_kept = [n for n in range(n_check) if _eval_select_expr(expr, n)]
         ours_kept = list(fps_ops.select_indices(n_check, src_fps, target_fps))
         assert ref_kept == ours_kept, (name, expr, cycle, phases)
+
+
+def test_src_sidecar_interop_with_reference(tmp_path):
+    """Sidecar interoperability: a probe-cache .yaml written by OUR
+    prober (tools src-analysis / LibavProber.src_info) must be consumable
+    by the REFERENCE's get_src_info sidecar short-circuit
+    (lib/ffmpeg.py:629-632) — including the coded_width/coded_height its
+    AVPVS dimension math reads (:975-976, :1013-1014, :1173-1174), which
+    for non-mod-16 h264 masters are the mb-aligned dims, NOT the display
+    dims. (Our own AVPVS canvas deliberately uses display dims — the
+    reference's coded-dims use distorts aspect for such masters; see
+    models/avpvs.avpvs_dimensions.)"""
+    import numpy as np
+
+    from processing_chain_tpu.io.probe import LibavProber
+    from processing_chain_tpu.io.video import VideoWriter
+
+    path = str(tmp_path / "master.mp4")
+    with VideoWriter(path, "libx264", 200, 100, "yuv420p", (30, 1),
+                     bitrate_kbps=200, gop=8, threads=1,
+                     opts="preset=ultrafast") as w:
+        for i in range(12):
+            w.write(np.full((100, 200), 10 * i, np.uint8),
+                    np.full((50, 100), 128, np.uint8),
+                    np.full((50, 100), 128, np.uint8))
+
+    sidecar = path + ".yaml"
+    LibavProber().src_info(path, sidecar_path=sidecar)
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(ORACLE, "ref_srcinfo.py"), REF,
+         sidecar, "1280", "720"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-1500:])
+    got = json.loads(out.stdout.strip().splitlines()[-1])
+    # the reference read our sidecar without probing: mb-aligned coded
+    # dims, display dims, fps and duration all parse to the real values
+    assert (got["coded_width"], got["coded_height"]) == (208, 112)
+    assert (got["width"], got["height"]) == (200, 100)
+    assert got["fps"] == 30.0
+    assert got["duration"] == pytest.approx(0.4, abs=0.01)
+    # and its dims math runs on them (16:9 coding, wider-aspect coded
+    # input 208x112 -> full width, height from aspect)
+    assert got["avpvs_dims"][0] == 1280
