@@ -44,6 +44,14 @@ while :; do
                 2>> "$LOG" || echo "[profile failed]" >> "$LOG"
             echo "[$(date -u +%H:%M:%S)] profile captured" >> "$LOG"
         fi
+        # one per-kernel variant sweep per live window: the data for the
+        # step-vs-kernel-sum gap analysis (docs/PERF.md headroom section)
+        if [ ! -s "$STATE_DIR/perf_sweep.json" ]; then
+            timeout -s KILL 600 python tools/perf_sweep.py \
+                > "$STATE_DIR/perf_sweep.json" \
+                2>> "$LOG" || echo "[sweep failed]" >> "$LOG"
+            echo "[$(date -u +%H:%M:%S)] sweep captured" >> "$LOG"
+        fi
         # keep refreshing (latest result wins) but back off: the number is in
         sleep $((INTERVAL * 4))
     else
