@@ -56,15 +56,34 @@ def scale_yuv_frames(
 ) -> list[jnp.ndarray]:
     """Device-resize stacked planar YUV [T, H, W] to a new luma size with
     chroma on its subsampled grid. chroma_sub = (sub_h, sub_w)."""
+    import jax
+
     sub_h, sub_w = chroma_sub
-    out = [resize.resize_frames(jnp.asarray(planes[0]), dst_h, dst_w, kernel)]
-    for p in planes[1:3]:
-        out.append(
-            resize.resize_frames(
-                jnp.asarray(p), dst_h // sub_h, dst_w // sub_w, kernel
-            )
+    y = resize.resize_frames(jnp.asarray(planes[0]), dst_h, dst_w, kernel)
+    u, v = (jnp.asarray(p) for p in planes[1:3])
+    if (
+        u.ndim == 3
+        and u.shape == v.shape
+        and isinstance(u, jax.core.Tracer)
+    ):
+        # Inside a trace (the sharded/jitted steps): one kernel call for
+        # both chroma planes, stacked on the FRAME axis — per-frame resize
+        # makes the outputs identical to two calls, and XLA owns the
+        # concat/split so the saving is a real launch. Eagerly (the
+        # streaming model paths) the concat + two slices would each be
+        # their own dispatch + chroma-sized copy, costing more than the
+        # saved call — keep per-plane calls there. 2-D [H, W] planes must
+        # also stay per-plane (stacking them would merge on HEIGHT).
+        uv = resize.resize_frames(
+            jnp.concatenate([u, v], axis=0),
+            dst_h // sub_h, dst_w // sub_w, kernel,
         )
-    return out
+        return [y, uv[: u.shape[0]], uv[u.shape[0]:]]
+    return [
+        y,
+        resize.resize_frames(u, dst_h // sub_h, dst_w // sub_w, kernel),
+        resize.resize_frames(v, dst_h // sub_h, dst_w // sub_w, kernel),
+    ]
 
 
 def chroma_subsampling(pix_fmt: str) -> tuple[int, int]:
