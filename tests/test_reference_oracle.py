@@ -851,6 +851,13 @@ def test_cpvs_plan_matches_reference_commands(tmp_path, name, db_type, pp_yaml):
                 assert int(m.group(1)) == plan["audio"]["bitrate_kbps"]
             assert ("ffmpeg-normalize" in cmd) == plan["normalize"]
 
+    # preview parity (reference create_preview :1250-1259): ProRes video
+    # + AAC audio, no filters. Ours encodes with prores_ks (a ProRes
+    # encoder; the reference's bare `-c:v prores` selects ffmpeg's other
+    # ProRes encoder — same codec family, documented in create_preview).
+    assert "-c:v prores" in ref["preview"]
+    assert "-c:a aac" in ref["preview"]
+
 
 def test_encode_parameters_x265_vp9_av1_match_reference(tmp_path):
     """Per-codec encode-parameter parity beyond libx264: the REFERENCE's
@@ -1056,9 +1063,12 @@ def test_fps_drop_tables_match_reference_select_expressions(tmp_path):
     from processing_chain_tpu.models import segments as seg_model
     from processing_chain_tpu.ops import fps as fps_ops
 
-    ratios = [  # (src_fps, dst_fps)
-        (60, 30), (60, 24), (60, 20), (60, 15),
-        (30, 24), (50, 15), (25, 15), (24, 15),
+    ratios = [  # (src_fps, fps_spec) — specs cover the whole grammar:
+        # plain numbers, the "24/25/30" / "50/60" SRC-dependent selectors,
+        # and fractions of the SRC rate (reference lib/ffmpeg.py:321-396)
+        (60, "30"), (60, "24"), (60, "20"), (60, "15"),
+        (30, "24"), (50, "15"), (25, "15"), (24, "15"),
+        (60, "24/25/30"), (120, "50/60"), (48, "1/2"),
     ]
     db_id = "P2SXM61"
     lines = [f"databaseId: {db_id}", "syntaxVersion: 6", "type: short",
