@@ -103,9 +103,27 @@ def select_format(
     """Choose the format nearest to `height` whose (video) bitrate is below
     `bitrate_kbps`, preferring the requested protocol; at equal resolution
     distance prefer the highest fps ('original'/'auto') or the fps nearest
-    to the requested number. Clean reimplementation of the reference's
-    stateful ladder walk (lib/downloader.py:225-293) with identical
-    selection semantics."""
+    to the requested number.
+
+    Clean reimplementation of the reference's stateful ladder walk
+    (lib/downloader.py:225-293): identical choices whenever the walk
+    behaves as documented, but four order-dependent artifacts of the
+    reference's shared mutable state are deliberately NOT replicated
+    (oracle-pinned in tests/test_downloader.py):
+    - equal (delta, fps) ties in 'original' mode pick the LAST list entry
+      (ours: first);
+    - a non-matching-protocol entry seen early can poison the shared
+      delta/fps state and permanently block a better protocol-matched
+      entry later (the reference can return a 1080p format for a 720p
+      request because of it; ours always prefers the matched minimum);
+    - plain-https entries unconditionally count as protocol-matched even
+      when dash/hls was requested (ours treats protocols outside the
+      requested family as neutral — same outcome, different mechanism);
+    - the protocol-matched latch flips even on entries REJECTED for codec
+      or bitrate, after which every non-matching-protocol candidate is
+      skipped — the reference then hard-errors ("not available") on
+      ladders where a usable format exists; ours returns that format
+      flagged protocol_matched=False."""
     vcodec = fix_codec(vcodec)
     fps_mode = str(fps).casefold()
 

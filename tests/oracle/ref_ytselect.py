@@ -1,0 +1,83 @@
+"""Run the REFERENCE's YouTube format-ladder selection
+(lib/downloader.py download_video, :153-349) on synthetic format lists
+and print the chosen format_id per case as JSON — the executable oracle
+for services/downloader.select_format parity.
+
+Usage: python ref_ytselect.py /root/reference cases.json
+cases.json: {"cases": [{"formats": [...], "width": W, "height": H,
+"bitrate": B, "vcodec": "...", "protocol": null|"dash"|"hls",
+"fps": "original"|number}, ...]}
+
+The reference's module-level third-party imports (youtube_dl,
+bitmovin_api_sdk, paramiko) are served by in-process stubs; the stub
+YoutubeDL records the format id the reference would download instead of
+downloading anything.
+"""
+import json
+import logging
+import os
+import sys
+import tempfile
+import types
+
+ref_root, cases_path = sys.argv[1], sys.argv[2]
+sys.path.insert(0, ref_root)
+logging.basicConfig(level=logging.CRITICAL)
+logging.getLogger("main").setLevel(logging.CRITICAL)
+
+state = {"formats": None, "chosen": None}
+
+
+class _StubYDL:
+    def __init__(self, opts):
+        self._opts = opts or {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def extract_info(self, url, download=False):
+        return {"ext": "mp4", "formats": state["formats"]}
+
+    def download(self, urls):
+        if "format" in self._opts:
+            state["chosen"] = self._opts["format"]
+
+
+def _stub_module(name, **attrs):
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    sys.modules[name] = mod
+
+
+_stub_module("youtube_dl", YoutubeDL=_StubYDL)
+_stub_module("bitmovin_api_sdk", BitmovinApi=object)
+_stub_module("paramiko")
+
+from lib.downloader import Downloader  # noqa: E402
+
+with open(cases_path) as fh:
+    cases = json.load(fh)["cases"]
+
+out = []
+with tempfile.TemporaryDirectory() as tmp:
+    dl = Downloader(tmp, "", "", "")
+    for case in cases:
+        state["formats"] = case["formats"]
+        state["chosen"] = None
+        try:
+            dl.download_video(
+                "https://example.invalid/v",
+                case["width"], case["height"], "SEG001",
+                case["vcodec"], case["bitrate"], case.get("protocol"),
+                str(case.get("fps", "original")),
+                force_overwriting=True,
+            )
+            # no-match cases log + return normally, leaving chosen None
+            out.append({"chosen": state["chosen"]})
+        except (Exception, SystemExit) as exc:  # noqa: BLE001 - report which case broke
+            out.append({"error": f"{type(exc).__name__}: {exc}"[:200]})
+print(json.dumps(out))

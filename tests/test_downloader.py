@@ -624,3 +624,225 @@ def test_bitmovin_wait_times_out_on_hung_encode():
     c = _wait_client(["RUNNING"])
     with pytest.raises(TimeoutError, match="did not finish.*RUNNING"):
         c.wait_until_finished("enc-3", poll_s=0.0, timeout_s=0.05)
+
+
+# ---------------------------------------------------------------------------
+# Reference-oracle parity for the YouTube format-ladder selection
+
+import json as _json
+import os as _os
+import subprocess as _subprocess
+import sys as _sys
+
+_REF = "/root/reference"
+_ORACLE = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "oracle")
+
+
+def _run_yt_oracle(tmp_path, cases):
+    """Run the reference ladder walk on `cases` via ref_ytselect.py and
+    return its per-case results."""
+    cases_file = tmp_path / "cases.json"
+    cases_file.write_text(_json.dumps({"cases": cases}))
+    out = _subprocess.run(
+        [_sys.executable, _os.path.join(_ORACLE, "ref_ytselect.py"),
+         _REF, str(cases_file)],
+        capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, (out.stdout[-500:], out.stderr[-1500:])
+    ref = _json.loads(out.stdout.strip().splitlines()[-1])
+    assert len(ref) == len(cases)
+    return ref
+
+
+def _protocol_family_matches(entry_protocol: str, requested) -> bool:
+    """Whether an entry's protocol belongs to the requested dash/hls
+    family (plain https and friends count as neutral/matched)."""
+    if requested is None:
+        return True
+    p = (entry_protocol or "").casefold()
+    if "m3u8" in p or "hls" in p:
+        return "m3u8" in requested or "hls" in requested
+    if "dash" in p or "mpd" in p:
+        return "dash" in requested or "mpd" in requested
+    return True
+
+
+@pytest.mark.skipif(
+    not _os.path.isdir(_os.path.join(_REF, "lib")),
+    reason="reference checkout not available",
+)
+def test_select_format_matches_reference_ladder_walk(tmp_path):
+    """select_format parity with the REFERENCE's stateful ladder walk
+    (lib/downloader.py:153-349, driven via tests/oracle/ref_ytselect.py
+    with a stub youtube_dl): randomized format lists over the
+    selection-relevant dimensions — audio-only rows, codec mismatches,
+    vbr/tbr fallbacks, over-bitrate rows, protocol preference
+    (dash/hls/None), resolution distance and fps tie-breaks."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    protocols = ["https", "m3u8_native", "http_dash_segments"]
+    vcodecs = ["avc1.4d401e", "vp09.00.31.08", "av01.0.08M.08"]
+    ladder = [144, 240, 360, 480, 720, 1080]
+    cases = []
+    for _ in range(60):
+        n = int(rng.integers(2, 7))
+        # distinct heights at or below the request height give every
+        # candidate a UNIQUE resolution delta: the reference's walk and
+        # our sort provably agree there, while equal-delta ties hit the
+        # reference's order-dependent artifacts (pinned separately in
+        # test_select_format_reference_quirks)
+        req_h = 1080
+        heights = list(rng.choice(ladder, size=min(n, len(ladder)),
+                                  replace=False))
+        formats = []
+        for i, h in enumerate(heights):
+            if rng.random() < 0.15:
+                formats.append({
+                    "format": f"{250+i} - audio only (tiny)",
+                    "format_id": f"a{i}",
+                    "protocol": "https",
+                    "vcodec": "none",
+                    "height": 0, "width": 0, "fps": 0,
+                    "tbr": 64,
+                })
+                continue
+            h = int(h)
+            entry = {
+                "format": f"{i} - {h}p",
+                "format_id": f"f{i}",
+                "protocol": str(rng.choice(protocols)),
+                "vcodec": str(rng.choice(vcodecs)),
+                "height": h,
+                "width": h * 16 // 9,
+                "fps": int(rng.choice([24, 25, 30, 50, 60])),
+                "ext": "mp4",
+            }
+            r = float(rng.integers(100, 4000))
+            if rng.random() < 0.7:
+                entry["vbr"] = r
+            else:
+                entry["tbr"] = r
+            formats.append(entry)
+        cases.append({
+            "formats": formats,
+            "width": req_h * 16 // 9, "height": req_h,
+            "bitrate": int(rng.integers(200, 4000)),
+            "vcodec": str(rng.choice(["h264", "vp9"])),
+            "protocol": [None, "dash", "hls"][int(rng.integers(0, 3))],
+            "fps": str(rng.choice(["original", "24", "30", "60"])),
+        })
+
+    ref = _run_yt_oracle(tmp_path, cases)
+
+    mismatches = []
+    for i, (case, r) in enumerate(zip(cases, ref)):
+        assert "error" not in r, (i, r)
+        ours = dl.select_format(
+            case["formats"], case["height"], case["bitrate"],
+            case["vcodec"], case["protocol"], case["fps"],
+        )
+        got = ours.format_id if ours is not None else None
+        if got != r["chosen"]:
+            # documented deviation (protocol-latch artifacts): once the
+            # reference's latch flips — including on entries rejected for
+            # codec/bitrate — its outcome among NON-matching-protocol
+            # candidates is order noise (it may pick a staler one or
+            # hard-error where a usable format exists). Tolerate exactly
+            # those: a difference confined to protocol-unmatched picks.
+            ref_entry = next(
+                (f for f in case["formats"]
+                 if f["format_id"] == r["chosen"]), None,
+            )
+            ref_unmatched = r["chosen"] is None or not _protocol_family_matches(
+                ref_entry["protocol"], case["protocol"]
+            )
+            ours_unmatched = ours is None or not ours.protocol_matched
+            if case["protocol"] is not None and ref_unmatched and ours_unmatched:
+                continue
+            mismatches.append((i, got, r["chosen"], case["fps"]))
+    assert mismatches == [], mismatches[:5]
+
+
+@pytest.mark.skipif(
+    not _os.path.isdir(_os.path.join(_REF, "lib")),
+    reason="reference checkout not available",
+)
+def test_select_format_reference_quirks(tmp_path):
+    """Pins the reference walk's order-dependent artifacts as documented
+    deviations (see select_format's docstring): equal-tie last-wins in
+    'original' mode, and the false-track delta poisoning that makes the
+    reference return a 1080p format for a 720p request."""
+    base = dict(vcodec="avc1.4d401e", width=1280, ext="mp4")
+    cases = [
+        {  # equal (delta, fps) tie, 'original': reference takes the LAST
+            "formats": [
+                dict(base, format="0 - 720p", format_id="f0",
+                     protocol="https", height=720, fps=30, vbr=800.0),
+                dict(base, format="1 - 720p", format_id="f1",
+                     protocol="https", height=720, fps=30, vbr=400.0),
+            ],
+            "width": 1280, "height": 720, "bitrate": 1000,
+            "vcodec": "h264", "protocol": None, "fps": "original",
+        },
+        {  # delta poisoning: the early m3u8 row (requested: dash) leaves
+            # delta 0 / fps 60 in the shared state, so the later
+            # perfectly-matched dash 720p30 row is rejected and the
+            # reference keeps the dash 1080p row
+            "formats": [
+                dict(base, format="0 - 720p", format_id="f0",
+                     protocol="m3u8_native", height=720, fps=60, vbr=400.0),
+                dict(base, format="1 - 1080p", format_id="f1",
+                     protocol="http_dash_segments", height=1080, fps=30,
+                     vbr=450.0),
+                dict(base, format="2 - 720p", format_id="f2",
+                     protocol="http_dash_segments", height=720, fps=30,
+                     vbr=220.0),
+            ],
+            "width": 1280, "height": 720, "bitrate": 1000,
+            "vcodec": "h264", "protocol": "dash", "fps": "60",
+        },
+    ]
+    ref = _run_yt_oracle(tmp_path, cases)
+
+    # quirk 1: reference picks the last tied entry; ours the first
+    assert ref[0]["chosen"] == "f1"
+    ours = dl.select_format(cases[0]["formats"], 720, 1000, "h264",
+                            None, "original")
+    assert ours.format_id == "f0"
+
+    # quirk 2: reference keeps the 1080p dash row; ours picks the
+    # protocol-matched exact-height row
+    assert ref[1]["chosen"] == "f1"
+    ours = dl.select_format(cases[1]["formats"], 720, 1000, "h264",
+                            "dash", "60")
+    assert ours.format_id == "f2"
+
+
+@pytest.mark.skipif(
+    not _os.path.isdir(_os.path.join(_REF, "lib")),
+    reason="reference checkout not available",
+)
+def test_select_format_reference_protocol_latch_lockout(tmp_path):
+    """Pins the 4th reference artifact: an https entry REJECTED for its
+    codec still latches right_protocol=True, locking out every later
+    non-dash candidate — the reference errors out where a usable format
+    exists; ours returns it flagged protocol_matched=False."""
+    cases = [{
+        "formats": [
+            {"format": "0 - 1080p", "format_id": "f0", "protocol": "https",
+             "vcodec": "av01.0.08M.08", "height": 1080, "width": 1920,
+             "fps": 30, "ext": "mp4", "tbr": 3894.0},
+            {"format": "1 - 480p", "format_id": "f1",
+             "protocol": "m3u8_native", "vcodec": "avc1.4d401e",
+             "height": 480, "width": 853, "fps": 30, "ext": "mp4",
+             "tbr": 241.0},
+        ],
+        "width": 1920, "height": 1080, "bitrate": 1374,
+        "vcodec": "h264", "protocol": "dash", "fps": "24",
+    }]
+    ref = _run_yt_oracle(tmp_path, cases)
+    assert ref[0]["chosen"] is None  # the reference finds nothing
+    ours = dl.select_format(cases[0]["formats"], 1080, 1374, "h264",
+                            "dash", "24")
+    assert ours.format_id == "f1" and not ours.protocol_matched
