@@ -164,9 +164,108 @@ def _paired_chunks(
         )
 
 
+@functools.lru_cache(maxsize=1)
+def _vif_windows() -> tuple:
+    """Normalized 1-D Gaussian windows per VIF scale (N = 17/9/5/3,
+    sd = N/5 — the pixel-domain VIF constants, Sheikh & Bovik 2006 /
+    VMAF's vif feature). Window construction shared with SSIM's
+    (ops/metrics._gaussian_kernel)."""
+    return tuple(
+        np.asarray(
+            metrics_ops._gaussian_kernel(n, n / 5.0), np.float32
+        )
+        for n in (17, 9, 5, 3)
+    )
+
+
+def _conv_valid(x, w):
+    """Separable VALID 2-D convolution of [T, H, W] frames with a 1-D
+    window (symmetric, so convolution == correlation)."""
+    import jax
+
+    k = w.shape[0]
+    nchw = ("NCHW", "OIHW", "NCHW")
+    y = jax.lax.conv_general_dilated(
+        x[:, None], w.reshape(1, 1, k, 1), (1, 1), "VALID",
+        dimension_numbers=nchw,
+    )
+    y = jax.lax.conv_general_dilated(
+        y, w.reshape(1, 1, 1, k), (1, 1), "VALID", dimension_numbers=nchw,
+    )
+    return y[:, 0]
+
+
+@functools.lru_cache(maxsize=1)
+def _vif_impl():
+    """Module-cached jitted VIF body: per-call jit would re-trace and
+    recompile the 4-scale conv pipeline every CHUNK frames (the hazard
+    _metrics_mesh_step documents)."""
+    import jax
+    import jax.numpy as jnp
+
+    wins = _vif_windows()  # built OUTSIDE the trace (concrete constants)
+
+    @jax.jit
+    def impl(r, d):
+        sigma_nsq = 2.0
+        eps = 1e-10
+        num = jnp.zeros(r.shape[0], jnp.float32)
+        den = jnp.zeros(r.shape[0], jnp.float32)
+        for scale, w_np in enumerate(wins, start=1):
+            w = jnp.asarray(w_np)
+            if scale > 1:
+                r = _conv_valid(r, w)[:, ::2, ::2]
+                d = _conv_valid(d, w)[:, ::2, ::2]
+            mu1 = _conv_valid(r, w)
+            mu2 = _conv_valid(d, w)
+            mu1_sq, mu2_sq, mu1_mu2 = mu1 * mu1, mu2 * mu2, mu1 * mu2
+            sigma1_sq = _conv_valid(r * r, w) - mu1_sq
+            sigma2_sq = _conv_valid(d * d, w) - mu2_sq
+            sigma12 = _conv_valid(r * d, w) - mu1_mu2
+            sigma1_sq = jnp.maximum(sigma1_sq, 0.0)
+            sigma2_sq = jnp.maximum(sigma2_sq, 0.0)
+
+            g = sigma12 / (sigma1_sq + eps)
+            sv_sq = sigma2_sq - g * sigma12
+            # reference implementation's edge fixups (vifp_mscale)
+            g = jnp.where(sigma1_sq < eps, 0.0, g)
+            sv_sq = jnp.where(sigma1_sq < eps, sigma2_sq, sv_sq)
+            sigma1_sq = jnp.where(sigma1_sq < eps, 0.0, sigma1_sq)
+            g = jnp.where(sigma2_sq < eps, 0.0, g)
+            sv_sq = jnp.where(sigma2_sq < eps, 0.0, sv_sq)
+            sv_sq = jnp.where(g < 0.0, sigma2_sq, sv_sq)
+            g = jnp.maximum(g, 0.0)
+            sv_sq = jnp.maximum(sv_sq, eps)
+
+            num = num + jnp.sum(
+                jnp.log10(1.0 + g * g * sigma1_sq / (sv_sq + sigma_nsq)),
+                axis=(1, 2),
+            )
+            den = den + jnp.sum(
+                jnp.log10(1.0 + sigma1_sq / sigma_nsq), axis=(1, 2)
+            )
+        return num / jnp.maximum(den, eps)
+
+    return impl
+
+
+def _vif_frames(ref, deg):
+    """Per-frame pixel-domain VIF (vifp multi-scale) of [T, H, W] luma on
+    the 8-bit scale — the VMAF-family fidelity feature the reference's
+    libvmaf build would supply if anything invoked it. Frames must be
+    >= 41 px per side for the 4-scale pyramid (VALID convs + ::2
+    decimation per scale).
+
+    NOTE: device kernel placed in this tool (not ops/metrics) so it can
+    land while ops/ is frozen by the live-bench code-hash guard
+    (BENCH_LIVE.json); migrate next to msssim_frames at the next safe
+    ops/ change."""
+    return _vif_impl()(ref, deg)
+
+
 def compute_pvs_metrics(
     pvs: Pvs, force: bool = False, out_dir: Optional[str] = None,
-    use_sidecar: bool = True, msssim: bool = False,
+    use_sidecar: bool = True, msssim: bool = False, vif: bool = False,
 ) -> Optional[str]:
     """Write `<pvs_id>.metrics.csv`; returns the path (None if skipped).
 
@@ -233,6 +332,8 @@ def compute_pvs_metrics(
     cols = ["psnr_y", "psnr_u", "psnr_v", "ssim_y", "si", "ti"]
     if msssim:
         cols.insert(4, "msssim_y")
+    if vif:
+        cols.insert(4, "vif_y")
     rows = {k: [] for k in cols}
     prev_last = None  # last deg luma of the previous chunk (TI continuity)
     with tracing.span(f"metrics {pvs.pvs_id}"), VideoReader(
@@ -277,6 +378,8 @@ def compute_pvs_metrics(
                     ms, s1 = metrics_ops.msssim_ssim_frames(ry, dy)
                     chunk_metrics["msssim_y"] = np.asarray(ms)
                     chunk_metrics.setdefault("ssim_y", np.asarray(s1))
+                if vif:
+                    chunk_metrics["vif_y"] = np.asarray(_vif_frames(ry, dy))
                 for k, vals in chunk_metrics.items():
                     rows[k].append(vals)
                 if sidecar is None:
@@ -306,11 +409,12 @@ def run(
     force: bool = False,
     prober=None,
     msssim: bool = False,
+    vif: bool = False,
 ) -> list[str]:
     tc = TestConfig(config_path, filter_pvses=filter_pvses, prober=prober)
     written = []
     for pvs in tc.pvses.values():
-        path = compute_pvs_metrics(pvs, force=force, msssim=msssim)
+        path = compute_pvs_metrics(pvs, force=force, msssim=msssim, vif=vif)
         if path:
             written.append(path)
     return written
@@ -330,11 +434,17 @@ def build_parser(
         help="add a per-frame multi-scale SSIM column (frames must be "
         ">=176 px per side for the 5-scale pyramid)",
     )
+    parser.add_argument(
+        "--vif", action="store_true",
+        help="add a per-frame pixel-domain VIF column (the VMAF-family "
+        "fidelity feature; frames must be >=41 px per side for the "
+        "4-scale pyramid)",
+    )
     return parser
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     run(args.test_config, filter_pvses=args.filter_pvs, force=args.force,
-        msssim=args.msssim)
+        msssim=args.msssim, vif=args.vif)
     return 0

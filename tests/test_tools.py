@@ -681,3 +681,133 @@ def test_tools_dispatch_src_analysis_and_unknown(tmp_path, monkeypatch):
     assert (tmp_path / "SRC0.avi.md5").is_file()
     assert (tmp_path / "SRC0.avi.yaml").is_file()
     assert cli.main(["tools", "definitely-not-a-tool"]) != 0
+
+
+def _np_vifp(ref, deg):
+    """Independent numpy pixel-domain VIF (vifp multi-scale, Sheikh &
+    Bovik 2006): explicit 2-D 'valid' correlation per scale."""
+    def gauss2d(n, sd):
+        x = np.arange(n) - (n - 1) / 2.0
+        g = np.exp(-(x * x) / (2.0 * sd * sd))
+        k = np.outer(g, g)
+        return k / k.sum()
+
+    def filter2_valid(img, k):
+        kh, kw = k.shape
+        h, w = img.shape
+        out = np.zeros((h - kh + 1, w - kw + 1))
+        for i in range(kh):
+            for j in range(kw):
+                out += k[i, j] * img[i: i + h - kh + 1, j: j + w - kw + 1]
+        return out
+
+    sigma_nsq, eps = 2.0, 1e-10
+    num = den = 0.0
+    r, d = ref.astype(np.float64), deg.astype(np.float64)
+    for scale in range(1, 5):
+        n = 2 ** (4 - scale + 1) + 1
+        win = gauss2d(n, n / 5.0)
+        if scale > 1:
+            r = filter2_valid(r, win)[::2, ::2]
+            d = filter2_valid(d, win)[::2, ::2]
+        mu1, mu2 = filter2_valid(r, win), filter2_valid(d, win)
+        s1 = np.maximum(filter2_valid(r * r, win) - mu1 * mu1, 0)
+        s2 = np.maximum(filter2_valid(d * d, win) - mu2 * mu2, 0)
+        s12 = filter2_valid(r * d, win) - mu1 * mu2
+        g = s12 / (s1 + eps)
+        sv = s2 - g * s12
+        g[s1 < eps] = 0
+        sv[s1 < eps] = s2[s1 < eps]
+        s1 = np.where(s1 < eps, 0, s1)
+        g[s2 < eps] = 0
+        sv[s2 < eps] = 0
+        sv[g < 0] = s2[g < 0]
+        g = np.maximum(g, 0)
+        sv = np.maximum(sv, eps)
+        num += np.sum(np.log10(1 + g * g * s1 / (sv + sigma_nsq)))
+        den += np.sum(np.log10(1 + s1 / sigma_nsq))
+    return num / den
+
+
+def test_vif_against_numpy_reference():
+    """Device VIF vs the independent numpy vifp implementation, plus the
+    boundary behaviors: identical pair -> 1.0, noisier -> lower."""
+    import jax.numpy as jnp
+
+    from processing_chain_tpu.tools.quality_metrics import _vif_frames
+
+    rng = np.random.default_rng(9)
+    base = rng.integers(16, 235, size=(64, 80)).astype(np.float32)
+    # smooth it a bit so local stats aren't pure noise
+    base = (base + np.roll(base, 1, 0) + np.roll(base, 1, 1)) / 3.0
+    noisy1 = base + rng.normal(0, 4.0, base.shape).astype(np.float32)
+    noisy2 = base + rng.normal(0, 12.0, base.shape).astype(np.float32)
+
+    got = np.asarray(_vif_frames(
+        jnp.asarray(np.stack([base, base, base])),
+        jnp.asarray(np.stack([base, noisy1, noisy2])),
+    ))
+    want = [1.0, _np_vifp(base, noisy1), _np_vifp(base, noisy2)]
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+    assert got[0] > 0.999
+    assert got[2] < got[1] < got[0]
+
+
+def test_quality_metrics_vif_column(tmp_path):
+    """--vif adds a per-frame vif_y column: ~1.0 for an identical pair,
+    strictly lower for a degraded one."""
+    from processing_chain_tpu.tools import quality_metrics as qm
+
+    rng = np.random.default_rng(6)
+    h, w, n = 96, 128, 3
+    frames = rng.integers(16, 235, size=(n, h, w), dtype=np.uint8)
+
+    def write(path, arr):
+        from processing_chain_tpu.io.video import VideoWriter
+
+        with VideoWriter(str(path), "ffv1", w, h, "yuv420p", (24, 1)) as wr:
+            for f in arr:
+                wr.write(
+                    f,
+                    np.full((h // 2, w // 2), 128, np.uint8),
+                    np.full((h // 2, w // 2), 128, np.uint8),
+                )
+
+    src = tmp_path / "src.avi"
+    write(src, frames)
+    clean = tmp_path / "clean.avi"
+    write(clean, frames)
+    noisy = tmp_path / "noisy.avi"
+    write(noisy, np.clip(
+        frames.astype(int) + rng.integers(-25, 25, frames.shape), 0, 255
+    ).astype(np.uint8))
+
+    class FakeTc:
+        def get_side_information_path(self):
+            return str(tmp_path / "sideInfo")
+
+    class FakeSrc:
+        file_path = str(src)
+
+    class FakePvs:
+        test_config = FakeTc()
+        src = FakeSrc()
+
+        def __init__(self, pvs_id, avpvs):
+            self.pvs_id = pvs_id
+            self._avpvs = str(avpvs)
+
+        def get_avpvs_file_path(self):
+            return self._avpvs
+
+    dfc = pd.read_csv(qm.compute_pvs_metrics(FakePvs("DB_S_H0", clean),
+                                             vif=True))
+    dfn = pd.read_csv(qm.compute_pvs_metrics(FakePvs("DB_S_H1", noisy),
+                                             vif=True))
+    assert list(dfc.columns) == [
+        "frame", "psnr_y", "psnr_u", "psnr_v", "ssim_y", "vif_y",
+        "si", "ti",
+    ]
+    assert (dfc.vif_y > 0.999).all()
+    assert (dfn.vif_y < 1.0).all() and (dfn.vif_y > 0.0).all()
+    assert (dfn.vif_y < dfc.vif_y).all()
