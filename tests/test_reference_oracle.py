@@ -1192,3 +1192,45 @@ def test_src_sidecar_interop_with_reference(tmp_path):
     # and its dims math runs on them (16:9 coding, wider-aspect coded
     # input 208x112 -> full width, height from aspect)
     assert got["avpvs_dims"][0] == 1280
+
+
+def test_planner_dedups_cross_hrc_shared_segments(tmp_path):
+    """Two HRCs that need the identical segment (same QL/coding/window):
+    the REFERENCE's plan carries it once per HRC — its exec-time
+    ParallelRunner set-dedup absorbs the duplicate encode (the
+    cmd_utils.py:73-79 quirk) — while OUR planner dedups at plan time
+    (engine/jobs also write-write-checks). Effective plans are equal;
+    this pins both multiplicities so a regression on either side shows."""
+    import collections
+
+    db_id = "P2SXM70"
+    yaml_text = "\n".join([
+        f"databaseId: {db_id}", "syntaxVersion: 6", "type: short",
+        "qualityLevelList:",
+        "  Q0: {index: 0, videoCodec: h264, videoCrf: 29, width: 1280, "
+        f"height: 720, fps: {SRC_FPS}}}",
+        "codingList:",
+        "  VC01: {type: video, encoder: libx264, passes: 1, "
+        "iFrameInterval: 2, preset: ultrafast}",
+        "srcList:", "  SRC000: SRC000.avi",
+        "hrcList:",
+        "  HRC000: {videoCodingId: VC01, eventList: [[Q0, 5], [stall, 1.0]]}",
+        "  HRC001: {videoCodingId: VC01, eventList: [[Q0, 5]]}",
+        "pvsList:",
+        f"  - {db_id}_SRC000_HRC000",
+        f"  - {db_id}_SRC000_HRC001",
+        "postProcessingList:",
+        "  - {type: pc, displayWidth: 1280, displayHeight: 720, "
+        "codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}",
+    ]) + "\n"
+    yaml_path = _build_fixture(tmp_path, db_id, yaml_text, 10.0)
+
+    ref = _reference_plan(yaml_path)
+    assert ref is not None
+    ours = _our_plan(yaml_path, 10.0)
+    shared = f"{db_id}_SRC000_Q0_VC01_0000_0-5.mp4"
+    ref_counts = collections.Counter(s["filename"] for s in ref["segments"])
+    our_counts = collections.Counter(s["filename"] for s in ours["segments"])
+    assert ref_counts[shared] == 2      # one per HRC in the reference plan
+    assert our_counts[shared] == 1      # plan-time dedup here
+    assert set(ref_counts) == set(our_counts)
