@@ -887,6 +887,15 @@ EXPORT MPEncoder* mp_encoder_open(
             return nullptr;
         }
     }
+    // mp4 only: fixed video track timescale, like the reference's
+    // `-video_track_timescale 90000` on every SEGMENT encode (its pass
+    // commands, lib/ffmpeg.py:851-877). Deliberately NOT applied to the
+    // mov muxer: the reference's .mov previews (create_preview) carry no
+    // timescale flag. Explicit vopts still override.
+    if (e->fmt->oformat && e->fmt->oformat->name &&
+        strstr(e->fmt->oformat->name, "mp4") &&
+        !av_dict_get(opts, "video_track_timescale", nullptr, 0))
+        av_dict_set(&opts, "video_track_timescale", "90000", 0);
     ret = avformat_write_header(e->fmt, &opts);
     if (ret < 0) {
         set_err(err, errlen, "write_header: " + av_errstr(ret));
