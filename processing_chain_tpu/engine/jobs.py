@@ -32,10 +32,26 @@ class Job:
     provenance: dict = field(default_factory=dict)
     logfile_path: Optional[str] = None
 
+    @property
+    def _sentinel_path(self) -> str:
+        return self.output_path + ".inprogress"
+
     def should_run(self, force: bool) -> bool:
         if force or not self.output_path:
             return True
         if os.path.isfile(self.output_path):
+            if os.path.isfile(self._sentinel_path):
+                # crash consistency: a SIGKILLed/power-lost run leaves a
+                # possibly-truncated output that plain skip-existing (the
+                # reference's idiom — it shares this hole) would wrongly
+                # accept. The sentinel marks an unfinished run; databases
+                # produced elsewhere carry no sentinels and are untouched.
+                get_logger().warning(
+                    "output %s exists but its producing run never "
+                    "completed (crashed?); re-running",
+                    self.output_path,
+                )
+                return True
             get_logger().warning(
                 "output %s already exists, will not convert. Use --force to "
                 "force overwriting.",
@@ -58,7 +74,27 @@ class Job:
             for key, value in record.items():
                 f.write(f"{key}: {json.dumps(value) if not isinstance(value, str) else value}\n")
 
+    def _mark_inprogress(self) -> bool:
+        """Best-effort crash sentinel next to the output (see should_run).
+        Returns whether it was created (a missing parent dir — fn creates
+        it later — just degrades to the reference's behavior)."""
+        if not self.output_path:
+            return False
+        try:
+            with open(self._sentinel_path, "w"):
+                pass
+            return True
+        except OSError:
+            return False
+
+    def _clear_sentinel(self) -> None:
+        try:
+            os.unlink(self._sentinel_path)
+        except FileNotFoundError:
+            pass
+
     def run(self) -> Any:
+        marked = self._mark_inprogress()
         with tracing.span(self.label, output=os.path.basename(self.output_path)):
             try:
                 result = self.fn()
@@ -68,8 +104,15 @@ class Job:
                 # skip-existing check (enforced here once, for every job)
                 if self.output_path and os.path.isfile(self.output_path):
                     os.unlink(self.output_path)
+                if marked:
+                    self._clear_sentinel()
                 raise
         self.write_provenance()
+        # removed only after the output (and its provenance) are complete:
+        # a crash anywhere above leaves the sentinel and the next run redoes
+        # the job instead of trusting a possibly-truncated artifact
+        if marked:
+            self._clear_sentinel()
         return result
 
 

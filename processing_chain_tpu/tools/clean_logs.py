@@ -17,6 +17,9 @@ from typing import Optional, Sequence
 
 from ..utils.log import get_logger
 
+# NOTE: *.inprogress crash sentinels (engine/jobs.Job) are deliberately
+# NOT purged here — deleting one would make the next run trust a
+# possibly-truncated artifact; Job completion removes them itself.
 TRANSIENT_PATTERNS = (
     "*.mbtree", "*.temp", "*.stats", "*.stats.cutree", "*.stats.mbtree",
 )

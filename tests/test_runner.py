@@ -1,3 +1,5 @@
+import os
+
 import pytest
 
 from processing_chain_tpu.utils import ChainError, ParallelRunner, run_task
@@ -147,3 +149,75 @@ def test_failed_job_removes_partial_artifact_and_rerun_recovers(tmp_path):
                fn=lambda: ran.append(1)))
     r3.run()
     assert not ran
+
+
+def test_crash_sentinel_rerun_and_cleanup(tmp_path):
+    """Crash consistency (engine/jobs.Job .inprogress sentinel): a
+    SIGKILLed run leaves output + sentinel -> should_run re-runs despite
+    the existing file; a completed run leaves no sentinel and skips as
+    before; a failing run removes both output and sentinel; databases
+    without sentinels (reference-produced) keep plain skip-existing."""
+    from processing_chain_tpu.engine.jobs import Job
+
+    out = tmp_path / "artifact.bin"
+
+    def produce():
+        out.write_bytes(b"full artifact")
+        return str(out)
+
+    # normal completion: output kept, sentinel gone, later run skips
+    job = Job(label="j", output_path=str(out), fn=produce)
+    assert job.should_run(force=False)
+    job.run()
+    assert out.read_bytes() == b"full artifact"
+    assert not os.path.exists(str(out) + ".inprogress")
+    assert not Job(label="j", output_path=str(out), fn=produce).should_run(False)
+
+    # crashed run: partial output + leftover sentinel -> re-run + recover
+    out.write_bytes(b"trunc")
+    open(str(out) + ".inprogress", "w").close()
+    job2 = Job(label="j", output_path=str(out), fn=produce)
+    assert job2.should_run(force=False)
+    job2.run()
+    assert out.read_bytes() == b"full artifact"
+    assert not os.path.exists(str(out) + ".inprogress")
+
+    # failing run: neither partial output nor sentinel survives
+    def boom():
+        out.write_bytes(b"partial")
+        raise RuntimeError("mid-write failure")
+
+    job3 = Job(label="j", output_path=str(out) , fn=boom)
+    out.unlink()
+    with pytest.raises(RuntimeError):
+        job3.run()
+    assert not out.exists()
+    assert not os.path.exists(str(out) + ".inprogress")
+
+
+def test_crash_sentinel_survives_chain_kill(tmp_path):
+    """Whole-process SIGKILL mid-job: the sentinel survives, and the next
+    run's planning re-runs the job (subprocess-level, the real crash
+    shape)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    out = tmp_path / "x.bin"
+    code = textwrap.dedent(f"""
+        import os, signal
+        from processing_chain_tpu.engine.jobs import Job
+
+        def fn():
+            open({str(out)!r}, "wb").write(b"partial")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+        Job(label="k", output_path={str(out)!r}, fn=fn).run()
+    """)
+    proc = subprocess.run([sys.executable, "-c", code], capture_output=True)
+    assert proc.returncode == -9
+    assert out.read_bytes() == b"partial"
+    assert os.path.exists(str(out) + ".inprogress")
+    from processing_chain_tpu.engine.jobs import Job
+
+    assert Job(label="k", output_path=str(out), fn=lambda: None).should_run(False)
