@@ -22,6 +22,31 @@ from ..utils.runner import ChainError, ParallelRunner
 from ..utils.version import get_processing_chain_version
 
 
+def mark_inprogress(output_path: str) -> bool:
+    """Best-effort crash sentinel next to an output file: a run killed
+    mid-write leaves it behind, and should_run then redoes the artifact
+    instead of trusting a possibly-truncated file. Returns whether the
+    sentinel was created (a missing parent dir degrades to the
+    reference's plain skip-existing behavior)."""
+    if not output_path:
+        return False
+    try:
+        with open(output_path + ".inprogress", "w"):
+            pass
+        return True
+    except OSError:
+        return False
+
+
+def clear_inprogress(output_path: str) -> None:
+    if not output_path:
+        return
+    try:
+        os.unlink(output_path + ".inprogress")
+    except FileNotFoundError:
+        pass
+
+
 @dataclass
 class Job:
     """One unit of work producing `output_path`."""
@@ -74,27 +99,8 @@ class Job:
             for key, value in record.items():
                 f.write(f"{key}: {json.dumps(value) if not isinstance(value, str) else value}\n")
 
-    def _mark_inprogress(self) -> bool:
-        """Best-effort crash sentinel next to the output (see should_run).
-        Returns whether it was created (a missing parent dir — fn creates
-        it later — just degrades to the reference's behavior)."""
-        if not self.output_path:
-            return False
-        try:
-            with open(self._sentinel_path, "w"):
-                pass
-            return True
-        except OSError:
-            return False
-
-    def _clear_sentinel(self) -> None:
-        try:
-            os.unlink(self._sentinel_path)
-        except FileNotFoundError:
-            pass
-
     def run(self) -> Any:
-        marked = self._mark_inprogress()
+        marked = mark_inprogress(self.output_path)
         with tracing.span(self.label, output=os.path.basename(self.output_path)):
             try:
                 result = self.fn()
@@ -105,14 +111,14 @@ class Job:
                 if self.output_path and os.path.isfile(self.output_path):
                     os.unlink(self.output_path)
                 if marked:
-                    self._clear_sentinel()
+                    clear_inprogress(self.output_path)
                 raise
         self.write_provenance()
         # removed only after the output (and its provenance) are complete:
         # a crash anywhere above leaves the sentinel and the next run redoes
         # the job instead of trusting a possibly-truncated artifact
         if marked:
-            self._clear_sentinel()
+            clear_inprogress(self.output_path)
         return result
 
 

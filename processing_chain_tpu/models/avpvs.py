@@ -437,12 +437,17 @@ def create_avpvs_wo_buffer_batch(
         # 300-PVS database never holds 300 open codec contexts at once.
         # (specs/assembly are the caller's lists so the outer failure
         # sweep sees everything planned so far.)
+        from ..engine.jobs import clear_inprogress, mark_inprogress
+
         for pvs in pvses:
             tc = pvs.test_config
             w, h = avpvs_dimensions(pvs)
             pix_fmt = pvs.get_pix_fmt_for_avpvs()
             out_path = _wo_buffer_out_path(pvs)
             SiTiAccumulator.discard(out_path)
+            # batch finals are written outside Job.run: same crash
+            # sentinel discipline as single-device jobs (engine/jobs)
+            mark_inprogress(out_path)
             if tc.is_short():
                 seg = pvs.segments[0]
                 info = probe.get_segment_info(seg.file_path)
@@ -542,6 +547,7 @@ def create_avpvs_wo_buffer_batch(
                         for p in (spec["out"], spec["final"]):
                             if os.path.isfile(p):
                                 os.unlink(p)
+                        clear_inprogress(spec["final"])
                         SiTiAccumulator.discard(spec["final"])
                     raise
                 # short lanes are final the moment their wave drains
@@ -558,6 +564,7 @@ def create_avpvs_wo_buffer_batch(
                                 spec["pix_fmt"],
                             ),
                         ).write_provenance()
+                        clear_inprogress(spec["out"])
 
         # long-test assembly: native stream-copy concat of the tmp
         # renders + SRC audio remux + stitched feature sidecar
@@ -613,9 +620,11 @@ def create_avpvs_wo_buffer_batch(
                         pvs_specs[0]["pix_fmt"],
                     ),
                 ).write_provenance()
+                clear_inprogress(out_path)
             except BaseException:
                 if os.path.isfile(out_path):
                     os.unlink(out_path)
+                clear_inprogress(out_path)
                 SiTiAccumulator.discard(out_path)
                 raise
             finally:
