@@ -39,6 +39,7 @@ from ..engine.jobs import Job
 from ..io import medialib
 from ..io.video import VideoReader, VideoWriter
 from ..ops import overlay as ov
+from ..utils import fsio
 from ..utils.log import get_logger
 from . import frames as fr
 
@@ -219,14 +220,15 @@ class SiTiAccumulator:
         path = siti_sidecar_path(avpvs_path)
         si = np.concatenate([np.asarray(s) for s in self.si])
         ti = np.concatenate([np.asarray(t) for t in self.ti])
-        # temp + rename: an interrupted write must never leave a truncated
+        # atomic: an interrupted write must never leave a truncated
         # sidecar next to a complete AVPVS
-        tmp = path + ".part"
-        with open(tmp, "w") as f:
-            f.write("frame,si,ti\n")
-            for k, (s, t) in enumerate(zip(si, ti)):
-                f.write(f"{k},{s:.6f},{t:.6f}\n")
-        os.replace(tmp, path)
+        def _write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                f.write("frame,si,ti\n")
+                for k, (s, t) in enumerate(zip(si, ti)):
+                    f.write(f"{k},{s:.6f},{t:.6f}\n")
+
+        fsio.atomic_write(path, _write)
         return path
 
     @staticmethod

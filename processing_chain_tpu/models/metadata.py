@@ -36,6 +36,7 @@ import pandas as pd
 from ..config.domain import Pvs
 from ..io import framesizes, probe
 from ..io.medialib import MediaError
+from ..utils.fsio import atomic_write
 from ..utils.log import get_logger
 
 
@@ -52,7 +53,10 @@ def _maybe_write(path: str, force: bool, write_fn) -> None:
         )
         return
     log.info("writing %s", path)
-    write_fn(path)
+    # atomic: a run killed mid-write must never leave a truncated table
+    # (the sibling of engine/jobs' .inprogress discipline, for these
+    # small multi-file outputs)
+    atomic_write(path, write_fn)
 
 
 def generate_pvs_metadata(pvs: Pvs, force: bool = False) -> dict:

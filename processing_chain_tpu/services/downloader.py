@@ -30,6 +30,7 @@ import re
 from dataclasses import dataclass
 from typing import Any, Optional, Protocol, Sequence
 
+from ..utils.fsio import atomic_write
 from ..utils.log import get_logger
 
 #: segment length sanity window, seconds (reference :118-126)
@@ -740,16 +741,9 @@ class Downloader:
         if not self.store.exists(rel):
             return None
         final = os.path.join(self.video_segments_folder, filename)
-        # download to a temp name and rename into place: an interrupted
-        # transfer must never leave a truncated file at the final segment
-        # path, where every later run's isfile pre-check would treat it
-        # as a finished encode
-        tmp = final + ".part"
-        try:
-            self.store.download(rel, tmp)
-            os.replace(tmp, final)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        # atomic download-then-rename: an interrupted transfer must never
+        # leave a truncated file at the final segment path, where every
+        # later run's isfile pre-check would treat it as a finished encode
+        atomic_write(final, lambda tmp: self.store.download(rel, tmp))
         get_logger().info("downloaded finished cloud encode %s", filename)
         return final

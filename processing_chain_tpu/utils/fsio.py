@@ -1,0 +1,21 @@
+"""Filesystem write discipline shared across the chain."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+
+def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
+    """Write `path` via temp-then-os.replace so an interrupted run never
+    leaves a truncated file that a later run's exists-check would trust
+    (same-directory temp keeps the replace atomic). `write_fn` receives
+    the temp path; the temp is removed on failure."""
+    tmp = f"{path}.part.{os.getpid()}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.isfile(tmp):
+            os.unlink(tmp)
+        raise
