@@ -1234,3 +1234,43 @@ def test_planner_dedups_cross_hrc_shared_segments(tmp_path):
     assert ref_counts[shared] == 2      # one per HRC in the reference plan
     assert our_counts[shared] == 1      # plan-time dedup here
     assert set(ref_counts) == set(our_counts)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PC_SLOW_TESTS"),
+    reason="extended sweep: set PC_SLOW_TESTS=1 (minutes of runtime)",
+)
+def test_planner_extended_seed_sweep(tmp_path):
+    """Deep randomized planner parity (seeds beyond the fast set):
+    multiplicity-aware — the reference's duplicate segments for
+    cross-HRC shares are deduplicated before comparison (see
+    test_planner_dedups_cross_hrc_shared_segments)."""
+    import numpy as np
+
+    failures = []
+    for seed in range(14, 40):
+        sub = tmp_path / f"s{seed}"
+        sub.mkdir()
+        rng = np.random.default_rng(seed)
+        long = bool(seed % 2)
+        db_id = f"P2{'L' if long else 'S'}XM{seed:02d}"
+        src_secs = float(rng.integers(8, 20))
+        yaml_path = _build_fixture(
+            sub, db_id, _gen_db(rng, db_id, long), src_secs
+        )
+        ref = _reference_plan(yaml_path)
+        if ref is None:
+            from processing_chain_tpu.config import ConfigError
+
+            try:
+                _our_plan(yaml_path, src_secs)
+            except ConfigError:
+                continue
+            failures.append((seed, "ref rejected, ours accepted"))
+            continue
+        ours = _our_plan(yaml_path, src_secs)
+        ref_names = {s["filename"] for s in ref["segments"]}
+        our_names = {s["filename"] for s in ours["segments"]}
+        if ref_names != our_names:
+            failures.append((seed, sorted(ref_names ^ our_names)[:4]))
+    assert failures == [], failures
