@@ -750,17 +750,10 @@ def _build_cpvs_fixture(tmp_path, db_id: str, yaml_text: str) -> str:
     return str(db / f"{db_id}.yaml")
 
 
-@pytest.mark.parametrize("name,db_type,pp_yaml",
-                         _CPVS_CASES, ids=[c[0] for c in _CPVS_CASES])
-def test_cpvs_plan_matches_reference_commands(tmp_path, name, db_type, pp_yaml):
-    """CPVS decision parity with the REFERENCE's create_cpvs command
-    strings (lib/ffmpeg.py:1108-1249) across every branch: pc pad/no-pad
-    (rawvideo and lossless), the mobile/tablet x264 branch's pad-without-
-    scale vs scale-without-pad split, hd-pc-home's routing through the
-    x264 branch, short -an vs long audio with -t and the ffmpeg-normalize
-    loudness step, and the pc-only display fps filter."""
-
-
+def _check_cpvs_case(tmp_path, db_type, pp_yaml):
+    """Fixture + oracle + field-by-field plan assertions for one CPVS
+    post-processing case (shared by the deterministic branch cases and
+    the gated randomized sweep)."""
     from processing_chain_tpu.config import StaticProber, TestConfig
     from processing_chain_tpu.models import avpvs as av
     from processing_chain_tpu.models.cpvs import cpvs_plan
@@ -857,6 +850,51 @@ def test_cpvs_plan_matches_reference_commands(tmp_path, name, db_type, pp_yaml):
     # ProRes encoder — same codec family, documented in create_preview).
     assert "-c:v prores" in ref["preview"]
     assert "-c:a aac" in ref["preview"]
+
+
+@pytest.mark.parametrize("name,db_type,pp_yaml",
+                         _CPVS_CASES, ids=[c[0] for c in _CPVS_CASES])
+def test_cpvs_plan_matches_reference_commands(tmp_path, name, db_type, pp_yaml):
+    """CPVS decision parity with the REFERENCE's create_cpvs command
+    strings (lib/ffmpeg.py:1108-1249) across every branch: pc pad/no-pad
+    (rawvideo and lossless), the mobile/tablet x264 branch's pad-without-
+    scale vs scale-without-pad split, hd-pc-home's routing through the
+    x264 branch, short -an vs long audio with -t and the ffmpeg-normalize
+    loudness step, and the pc-only display fps filter."""
+    _check_cpvs_case(tmp_path, db_type, pp_yaml)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PC_SLOW_TESTS"),
+    reason="randomized sweep: set PC_SLOW_TESTS=1 (minutes of runtime)",
+)
+def test_cpvs_plan_randomized_sweep(tmp_path):
+    """Randomized post-processing geometries/types against the reference
+    create_cpvs commands (the deterministic cases each pin one branch;
+    this sweeps the space)."""
+    import numpy as np
+
+    rng = np.random.default_rng(23)
+    dims = [(640, 360), (640, 480), (960, 540), (1280, 720), (1280, 800),
+            (1920, 1080)]
+    for i in range(12):
+        pp_type = str(rng.choice(["pc", "mobile", "tablet", "hd-pc-home"]))
+        cw, ch = dims[int(rng.integers(0, len(dims)))]
+        if pp_type == "pc":
+            dw, dh = cw, ch        # validator: pc display == coding
+        else:
+            dw = cw                # validator: widths always equal
+            dh = int(rng.choice([ch, ch + 80, 1080]))
+        fps_v = int(rng.choice([24, 30, 50, 60]))
+        pp_yaml = (
+            f"{{type: {pp_type}, displayWidth: {dw}, displayHeight: {dh}, "
+            f"codingWidth: {cw}, codingHeight: {ch}, "
+            f"displayFrameRate: {fps_v}}}"
+        )
+        db_type = "long" if i % 3 == 0 else "short"
+        sub = tmp_path / f"case{i}"
+        sub.mkdir()
+        _check_cpvs_case(sub, db_type, pp_yaml)
 
 
 def test_encode_parameters_x265_vp9_av1_match_reference(tmp_path):
