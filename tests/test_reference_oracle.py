@@ -897,6 +897,141 @@ def test_cpvs_plan_randomized_sweep(tmp_path):
         _check_cpvs_case(sub, db_type, pp_yaml)
 
 
+def _x265_params_of(cmd):
+    m = re.search(r"-x265-params (\S+)", cmd)
+    return dict(
+        kv.split("=", 1) for kv in m.group(1).split(":")
+    ) if m else {}
+
+
+def _check_encode_command(seg, cmd):
+    """Field-by-field encode-parameter assertions for one segment's
+    reference command vs OUR rate_control_kwargs/_encoder_opts (shared by
+    the deterministic multi-codec test and the gated randomized sweep)."""
+    from processing_chain_tpu.models import segments as seg_model
+
+    def x265_params(c):
+        return _x265_params_of(c)
+
+    enc = seg.video_coding.encoder
+    rc = seg_model.rate_control_kwargs(seg)
+    # a 2-pass reference command is "cmd1 && cmd2"
+    passes = [c.strip() for c in cmd.split("&&")]
+    n_passes = 2 if seg.video_coding.passes == 2 else 1
+    assert len(passes) == n_passes, seg.filename
+
+    for pass_idx, pcmd in enumerate(passes, start=1):
+        ours = seg_model._encoder_opts(
+            seg, pass_idx, n_passes, "STATS"
+        )
+        if enc == "libx265":
+            assert "-c:v libx265" in pcmd
+            if seg.video_coding.crf is not None:
+                m = re.search(r"-crf (\d+)", pcmd)
+                assert int(m.group(1)) == seg.quality_level.video_crf
+                assert f"crf={seg.quality_level.video_crf}" in ours
+            elif seg.video_coding.qp is not None:
+                m = re.search(r"-qp (\d+)", pcmd)
+                assert int(m.group(1)) == seg.quality_level.video_qp
+                assert f"qp={seg.quality_level.video_qp}" in ours
+            else:
+                m = re.search(r"-b:v ([\d.]+)k", pcmd)
+                assert float(m.group(1)) == rc["bitrate_kbps"]
+            if seg.video_coding.preset:
+                m = re.search(r"-preset (\S+)", pcmd)
+                assert m.group(1) == seg.video_coding.preset
+                assert f"preset={seg.video_coding.preset}" in ours
+
+            # the reference's `&` precedence quirk (ffmpeg.py:229,
+            # do-not-copy list): -x265-params is emitted only for an
+            # ODD param count — VC05's even count loses its keyint
+            # entirely; OUR gop kwarg is unconditional
+            ref_param_count = (
+                (1 if seg.video_coding.maxrate_factor else 0)
+                + (1 if seg.video_coding.bufsize_factor else 0)
+                + (2 if seg.video_coding.iframe_interval else 0)
+                + (1 if seg.video_coding.scenecut else 0)
+                + (1 if seg.video_coding.bframes is not None else 0)
+                + (2 if n_passes == 2 else 0)
+            )
+            emitted = "-x265-params" in pcmd
+            assert emitted == (ref_param_count % 2 == 1), seg.filename
+            if seg.video_coding.iframe_interval:
+                assert rc["gop"] > 0  # ours always carries the keyint
+            if not emitted:
+                continue
+            px = x265_params(pcmd)
+            if seg.video_coding.maxrate_factor:
+                assert int(px["vbv-maxrate"]) == int(rc["maxrate_kbps"])
+                assert int(px["vbv-bufsize"]) == int(rc["bufsize_kbps"])
+            if seg.video_coding.iframe_interval:
+                assert int(px["keyint"]) == rc["gop"]
+                assert int(px["min-keyint"]) == rc["gop"]
+            if seg.video_coding.bframes is not None:
+                assert int(px["bframes"]) == rc["bframes"]
+            if n_passes == 2:
+                assert px["pass"] == str(pass_idx)
+                assert f"pass={pass_idx}" in ours
+                assert "stats=" in ours
+            # the documented deviation: reference's inverted quirk
+            # emits scenecut=0 exactly when scenecut is truthy; ours
+            # disables only when scenecut is false
+            assert ("scenecut" in px) == bool(seg.video_coding.scenecut)
+            assert ("scenecut=0" in ours) == (
+                not seg.video_coding.scenecut
+            )
+        elif enc == "libvpx-vp9":
+            assert "-c:v libvpx-vp9" in pcmd
+            if seg.video_coding.crf is not None:
+                # vp9 CRF form: literal "-b:v 0" (no k), then -crf
+                assert "-b:v 0 " in pcmd
+                m = re.search(r"-crf (\d+)", pcmd)
+                assert int(m.group(1)) == seg.quality_level.video_crf
+                assert f"crf={seg.quality_level.video_crf}" in ours
+            else:
+                m = re.search(r"-b:v ([\d.]+)k", pcmd)
+                assert float(m.group(1)) == rc["bitrate_kbps"]
+            if seg.video_coding.maxrate_factor:
+                m = re.search(r"-maxrate ([\d.]+)k", pcmd)
+                assert float(m.group(1)) == pytest.approx(rc["maxrate_kbps"])
+            if seg.video_coding.minrate_factor:
+                m = re.search(r"-minrate ([\d.]+)k", pcmd)
+                assert float(m.group(1)) == pytest.approx(rc["minrate_kbps"])
+            if seg.video_coding.iframe_interval:
+                m = re.search(r"-g (\d+) -keyint_min (\d+)", pcmd)
+                assert int(m.group(1)) == rc["gop"] == int(m.group(2))
+            m = re.search(r"-quality (\S+)", pcmd)
+            assert f"quality={m.group(1)}" in ours
+            # pass 1 runs at speed 4 (reference :100-102)
+            m = re.search(r"-speed (\d+)", pcmd)
+            want_speed = 4 if (n_passes == 2 and pass_idx == 1) else \
+                seg.video_coding.speed
+            assert int(m.group(1)) == want_speed
+            assert f"speed={want_speed}" in ours
+            if n_passes == 2:
+                assert f"-pass {pass_idx}" in pcmd
+        elif enc == "libaom-av1":
+            assert "-c:v libaom-av1" in pcmd
+            if seg.video_coding.crf is not None:
+                assert "-b:v 0" in pcmd
+                m = re.search(r"-crf (\d+)", pcmd)
+                assert int(m.group(1)) == seg.quality_level.video_crf
+                assert f"crf={seg.quality_level.video_crf}" in ours
+            elif seg.video_coding.qp is not None:
+                assert "-b:v 0" in pcmd
+                m = re.search(r"-qp (\d+)", pcmd)
+                assert int(m.group(1)) == seg.quality_level.video_qp
+                assert f"qp={seg.quality_level.video_qp}" in ours
+            else:
+                m = re.search(r"-b:v ([\d.]+)k", pcmd)
+                assert float(m.group(1)) == rc["bitrate_kbps"]
+            if seg.video_coding.iframe_interval:
+                m = re.search(r"-g (\d+) -keyint_min (\d+)", pcmd)
+                assert int(m.group(1)) == rc["gop"] == int(m.group(2))
+            m = re.search(r"-cpu-used (\d+)", pcmd)
+            assert int(m.group(1)) == seg.video_coding.cpu_used
+            assert f"cpu-used={seg.video_coding.cpu_used}" in ours
+
 def test_encode_parameters_x265_vp9_av1_match_reference(tmp_path):
     """Per-codec encode-parameter parity beyond libx264: the REFERENCE's
     x265 (vbv/keyint/bframes/pass inside -x265-params), libvpx-vp9
@@ -981,105 +1116,8 @@ def test_encode_parameters_x265_vp9_av1_match_reference(tmp_path):
     assert sorted(segs) == sorted(commands)
     assert len(segs) == 5
 
-    def x265_params(cmd):
-        m = re.search(r"-x265-params (\S+)", cmd)
-        return dict(
-            kv.split("=", 1) for kv in m.group(1).split(":")
-        ) if m else {}
-
     for name, cmd in commands.items():
-        seg = segs[name]
-        enc = seg.video_coding.encoder
-        rc = seg_model.rate_control_kwargs(seg)
-        # a 2-pass reference command is "cmd1 && cmd2"
-        passes = [c.strip() for c in cmd.split("&&")]
-        n_passes = 2 if seg.video_coding.passes == 2 else 1
-        assert len(passes) == n_passes, name
-
-        for pass_idx, pcmd in enumerate(passes, start=1):
-            ours = seg_model._encoder_opts(
-                seg, pass_idx, n_passes, "STATS"
-            )
-            if enc == "libx265":
-                assert "-c:v libx265" in pcmd
-                if seg.video_coding.crf is not None:
-                    m = re.search(r"-crf (\d+)", pcmd)
-                    assert int(m.group(1)) == seg.quality_level.video_crf
-                    assert f"crf={seg.quality_level.video_crf}" in ours
-                else:
-                    m = re.search(r"-b:v ([\d.]+)k", pcmd)
-                    assert float(m.group(1)) == rc["bitrate_kbps"]
-                m = re.search(r"-preset (\S+)", pcmd)
-                assert m.group(1) == seg.video_coding.preset
-                assert f"preset={seg.video_coding.preset}" in ours
-
-                # the reference's `&` precedence quirk (ffmpeg.py:229,
-                # do-not-copy list): -x265-params is emitted only for an
-                # ODD param count — VC05's even count loses its keyint
-                # entirely; OUR gop kwarg is unconditional
-                ref_param_count = (
-                    (1 if seg.video_coding.maxrate_factor else 0)
-                    + (1 if seg.video_coding.bufsize_factor else 0)
-                    + (2 if seg.video_coding.iframe_interval else 0)
-                    + (1 if seg.video_coding.scenecut else 0)
-                    + (1 if seg.video_coding.bframes is not None else 0)
-                    + (2 if n_passes == 2 else 0)
-                )
-                emitted = "-x265-params" in pcmd
-                assert emitted == (ref_param_count % 2 == 1), name
-                if seg.video_coding.iframe_interval:
-                    assert rc["gop"] > 0  # ours always carries the keyint
-                if not emitted:
-                    continue
-                px = x265_params(pcmd)
-                if seg.video_coding.maxrate_factor:
-                    assert int(px["vbv-maxrate"]) == int(rc["maxrate_kbps"])
-                    assert int(px["vbv-bufsize"]) == int(rc["bufsize_kbps"])
-                if seg.video_coding.iframe_interval:
-                    assert int(px["keyint"]) == rc["gop"]
-                    assert int(px["min-keyint"]) == rc["gop"]
-                if seg.video_coding.bframes is not None:
-                    assert int(px["bframes"]) == rc["bframes"]
-                if n_passes == 2:
-                    assert px["pass"] == str(pass_idx)
-                    assert f"pass={pass_idx}" in ours
-                    assert "stats=" in ours
-                # the documented deviation: reference's inverted quirk
-                # emits scenecut=0 exactly when scenecut is truthy; ours
-                # disables only when scenecut is false
-                assert ("scenecut" in px) == bool(seg.video_coding.scenecut)
-                assert ("scenecut=0" in ours) == (
-                    not seg.video_coding.scenecut
-                )
-            elif enc == "libvpx-vp9":
-                assert "-c:v libvpx-vp9" in pcmd
-                m = re.search(r"-b:v ([\d.]+)k", pcmd)
-                assert float(m.group(1)) == rc["bitrate_kbps"]
-                m = re.search(r"-maxrate ([\d.]+)k", pcmd)
-                assert float(m.group(1)) == pytest.approx(rc["maxrate_kbps"])
-                m = re.search(r"-minrate ([\d.]+)k", pcmd)
-                assert float(m.group(1)) == pytest.approx(rc["minrate_kbps"])
-                m = re.search(r"-g (\d+) -keyint_min (\d+)", pcmd)
-                assert int(m.group(1)) == rc["gop"] == int(m.group(2))
-                m = re.search(r"-quality (\S+)", pcmd)
-                assert f"quality={m.group(1)}" in ours
-                # pass 1 runs at speed 4 (reference :100-102)
-                m = re.search(r"-speed (\d+)", pcmd)
-                want_speed = 4 if (n_passes == 2 and pass_idx == 1) else \
-                    seg.video_coding.speed
-                assert int(m.group(1)) == want_speed
-                assert f"speed={want_speed}" in ours
-                if n_passes == 2:
-                    assert f"-pass {pass_idx}" in pcmd
-            elif enc == "libaom-av1":
-                assert "-c:v libaom-av1" in pcmd
-                assert "-b:v 0" in pcmd
-                m = re.search(r"-crf (\d+)", pcmd)
-                assert int(m.group(1)) == seg.quality_level.video_crf
-                assert f"crf={seg.quality_level.video_crf}" in ours
-                m = re.search(r"-cpu-used (\d+)", pcmd)
-                assert int(m.group(1)) == seg.video_coding.cpu_used
-                assert f"cpu-used={seg.video_coding.cpu_used}" in ours
+        _check_encode_command(segs[name], cmd)
 
 
 def _eval_select_expr(expr: str, n: int) -> bool:
@@ -1312,3 +1350,107 @@ def test_planner_extended_seed_sweep(tmp_path):
         if ref_names != our_names:
             failures.append((seed, sorted(ref_names ^ our_names)[:4]))
     assert failures == [], failures
+
+
+@pytest.mark.skipif(
+    not os.environ.get("PC_SLOW_TESTS"),
+    reason="randomized sweep: set PC_SLOW_TESTS=1 (minutes of runtime)",
+)
+def test_encode_parameters_randomized_sweep(tmp_path):
+    """Randomized coding-field combinations per codec against the
+    reference's command strings — in particular every x265 param-count
+    combination must agree with the pinned odd/even emission model."""
+    import numpy as np
+
+    from processing_chain_tpu.config import StaticProber, TestConfig
+
+    rng = np.random.default_rng(31)
+    for case in range(10):
+        db_id = f"P2SXM{80 + case}"
+        encoder = str(rng.choice(["libx264", "libx265", "libvpx-vp9",
+                                  "libaom-av1"]))
+        codec, ext_ok = {
+            "libx264": ("h264", True), "libx265": ("h265", True),
+            "libvpx-vp9": ("vp9", True), "libaom-av1": ("av1", True),
+        }[encoder]
+        rc_mode = str(rng.choice(["bitrate", "crf", "qp"]))
+        if encoder == "libvpx-vp9" and rc_mode == "qp":
+            rc_mode = "crf"  # the reference's vp9 branch has no qp form
+        coding = [f"type: video, encoder: {encoder}"]
+        ql_rate = f"videoBitrate: {int(rng.integers(150, 900))}"
+        if rc_mode == "bitrate":
+            coding.append(f"passes: {int(rng.choice([1, 2]))}")
+        elif rc_mode == "crf":
+            coding.append("crf: yes")
+            ql_rate = f"videoCrf: {int(rng.integers(20, 36))}"
+        else:
+            coding.append("qp: yes")
+            ql_rate = f"videoQp: {int(rng.integers(20, 36))}"
+        if rng.random() < 0.7:
+            coding.append(f"iFrameInterval: {int(rng.choice([1, 2]))}")
+        if rng.random() < 0.5:
+            coding.append(f"scenecut: {str(bool(rng.random() < 0.5)).lower()}")
+        if encoder in ("libx264", "libx265"):
+            coding.append(f"preset: {str(rng.choice(['ultrafast', 'fast']))}")
+            if rng.random() < 0.4 and encoder != "libvpx-vp9":
+                coding.append(f"bframes: {int(rng.integers(0, 4))}")
+        if rc_mode == "bitrate" and rng.random() < 0.5:
+            coding.append(f"maxrateFactor: {float(rng.choice([1.5, 2.0]))}")
+            coding.append(f"bufsizeFactor: {float(rng.choice([2.0, 3.0]))}")
+        if encoder == "libvpx-vp9":
+            coding.append(f"speed: {int(rng.integers(0, 5))}")
+            coding.append(f"quality: {str(rng.choice(['good', 'best']))}")
+        if encoder == "libaom-av1":
+            coding.append(f"cpuUsed: {int(rng.integers(4, 9))}")
+
+        yaml_text = "\n".join([
+            f"databaseId: {db_id}", "syntaxVersion: 6", "type: short",
+            "qualityLevelList:",
+            f"  Q0: {{index: 0, videoCodec: {codec}, {ql_rate}, "
+            f"width: 640, height: 360, fps: {SRC_FPS}}}",
+            "codingList:",
+            f"  VC01: {{{', '.join(coding)}}}",
+            "srcList:", "  SRC000: SRC000.avi",
+            "hrcList:",
+            "  HRC000: {videoCodingId: VC01, eventList: [[Q0, 6]]}",
+            "pvsList:", f"  - {db_id}_SRC000_HRC000",
+            "postProcessingList:",
+            "  - {type: pc, displayWidth: 1280, displayHeight: 720, "
+            "codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}",
+        ]) + "\n"
+        sub = tmp_path / f"case{case}"
+        sub.mkdir()
+        yaml_path = _build_fixture(sub, db_id, yaml_text, 10.0)
+
+        env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
+        out = subprocess.run(
+            [sys.executable, os.path.join(ORACLE, "ref_plan.py"), REF,
+             yaml_path, "--commands"],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        if (out.returncode != 0
+                and "KeyError: 'iframe_interval_cmd'" in out.stderr):
+            # reference quirk found by this sweep: an h264-family coding
+            # WITHOUT iFrameInterval crashes _get_video_encoder_command
+            # (iframe_interval_cmd only bound inside `if iframe_interval:`
+            # before the format(**locals()) at lib/ffmpeg.py:162-171).
+            # Ours encodes fine with the encoder's default keyint.
+            assert encoder == "libx264", (case, yaml_text)
+            assert "iFrameInterval" not in yaml_text, (case, yaml_text)
+            continue
+        assert out.returncode == 0, (case, out.stderr[-800:])
+        plan = json.loads(out.stdout.strip().splitlines()[-1])
+        assert not plan.get("rejected"), (case, yaml_text)
+
+        prober = StaticProber({}, default=dict(
+            width=SRC_W, height=SRC_H, pix_fmt="yuv420p",
+            r_frame_rate=str(SRC_FPS), avg_frame_rate=f"{SRC_FPS}/1",
+            video_duration=10.0,
+        ))
+        tc = TestConfig(yaml_path, prober=prober)
+        segs = {s.filename: s for s in tc.get_required_segments()}
+        assert sorted(segs) == sorted(plan["commands"]), case
+        for nm, cmd in plan["commands"].items():
+            if segs[nm].video_coding.encoder == "libx264":
+                continue  # the libx264 fields are covered by the fast test
+            _check_encode_command(segs[nm], cmd)
