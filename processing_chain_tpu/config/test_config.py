@@ -284,8 +284,23 @@ class TestConfig:
 
     def _parse_hrc(self, hrc_id: str, data: dict) -> Hrc:
         """One hrcList entry → Hrc (reference :1333-1408)."""
-        video_coding = self.codings[data["videoCodingId"]]
-        audio_coding = self.codings[data["audioCodingId"]] if self.type == "long" else None
+        def _coding(field: str):
+            try:
+                coding_id = data[field]
+            except KeyError as exc:
+                raise ConfigError(
+                    f"HRC {hrc_id} is missing {field}"
+                ) from exc
+            try:
+                return self.codings[coding_id]
+            except KeyError as exc:
+                # clean error where the reference crashes with a raw KeyError
+                raise ConfigError(
+                    f"HRC {hrc_id} references unknown coding {coding_id!r}"
+                ) from exc
+
+        video_coding = _coding("videoCodingId")
+        audio_coding = _coding("audioCodingId") if self.type == "long" else None
 
         if "segmentDuration" in data:
             if "src_duration" in [e[1] for e in data["eventList"]]:
@@ -313,7 +328,13 @@ class TestConfig:
                 name = str(event_data[0])
                 if "Q" in name:
                     event_type = "quality_level"
-                    quality_level = self.quality_levels[name]
+                    try:
+                        quality_level = self.quality_levels[name]
+                    except KeyError as exc:
+                        raise ConfigError(
+                            f"HRC {hrc_id} event references unknown "
+                            f"quality level {name!r}"
+                        ) from exc
                 elif "stall" in name:
                     event_type, quality_level = "stall", None
                 elif "freeze" in name:

@@ -173,14 +173,18 @@ def _build_fixture(tmp_path, db_id: str, yaml_text: str, src_secs: float,
     return str(db / f"{db_id}.yaml")
 
 
-def _reference_plan(yaml_path: str) -> dict | None:
+def _reference_plan(yaml_path: str, allow_crash: bool = False) -> dict | None:
     """The reference's plan, or None when the reference REJECTS the
-    database (sys.exit(1) from a validation error)."""
+    database (sys.exit(1) from a validation error). With allow_crash, a
+    reference CRASH (unhandled exception) also counts as rejection —
+    several invalid-input classes crash it instead of exiting cleanly."""
     env = dict(os.environ, PATH=ORACLE + os.pathsep + os.environ["PATH"])
     out = subprocess.run(
         [sys.executable, os.path.join(ORACLE, "ref_plan.py"), REF, yaml_path],
         capture_output=True, text=True, timeout=120, env=env,
     )
+    if allow_crash and out.returncode != 0:
+        return None
     assert out.returncode == 0, (out.stdout[-500:], out.stderr[-1500:])
     plan = json.loads(out.stdout.strip().splitlines()[-1])
     if plan.get("rejected"):
@@ -1454,3 +1458,64 @@ def test_encode_parameters_randomized_sweep(tmp_path):
             if segs[nm].video_coding.encoder == "libx264":
                 continue  # the libx264 fields are covered by the fast test
             _check_encode_command(segs[nm], cmd)
+
+
+_INVALID_MUTATIONS = [
+    ("syntax_version_5", "syntaxVersion: 6", "syntaxVersion: 5"),
+    ("bad_type", "type: short", "type: medium"),
+    ("codec_encoder_mismatch",
+     "videoCodec: h264", "videoCodec: vp9"),
+    ("unknown_ql_in_event", "eventList: [[Q0, 6]]",
+     "eventList: [[Q9, 6]]"),
+    ("bad_pvs_id", "  - P2SXM71_SRC000_HRC000",
+     "  - P2SXM71_HRC000_SRC000"),
+    ("unknown_coding", "videoCodingId: VC01", "videoCodingId: VC99"),
+    ("missing_video_coding", "videoCodingId: VC01, ", ""),
+    ("pc_display_ne_coding",
+     "displayHeight: 720, codingWidth: 1280, codingHeight: 720",
+     "displayHeight: 800, codingWidth: 1280, codingHeight: 720"),
+    ("bad_pp_type", "{type: pc,", "{type: tv,"),
+    ("negative_bframes", "preset: ultrafast}",
+     "preset: ultrafast, bframes: -2}"),
+    ("three_passes", "passes: 1,", "passes: 3,"),
+]
+
+
+@pytest.mark.parametrize(
+    "name,old,new", _INVALID_MUTATIONS, ids=[m[0] for m in _INVALID_MUTATIONS]
+)
+def test_invalid_database_rejection_parity(tmp_path, name, old, new):
+    """Error parity on invalid databases: every mutation the REFERENCE
+    rejects (sys.exit or crash), OUR parser must reject with a clean
+    ConfigError — never accept, never crash with an unrelated error."""
+    from processing_chain_tpu.config import ConfigError, StaticProber, TestConfig
+
+    db_id = "P2SXM71"
+    base = "\n".join([
+        f"databaseId: {db_id}", "syntaxVersion: 6", "type: short",
+        "qualityLevelList:",
+        "  Q0: {index: 0, videoCodec: h264, videoBitrate: 500, "
+        f"width: 640, height: 360, fps: {SRC_FPS}}}",
+        "codingList:",
+        "  VC01: {type: video, encoder: libx264, passes: 1, "
+        "iFrameInterval: 2, preset: ultrafast}",
+        "srcList:", "  SRC000: SRC000.avi",
+        "hrcList:",
+        "  HRC000: {videoCodingId: VC01, eventList: [[Q0, 6]]}",
+        "pvsList:", f"  - {db_id}_SRC000_HRC000",
+        "postProcessingList:",
+        "  - {type: pc, displayWidth: 1280, displayHeight: 720, "
+        "codingWidth: 1280, codingHeight: 720, displayFrameRate: 24}",
+    ]) + "\n"
+    assert old in base, name
+    yaml_text = base.replace(old, new)
+    yaml_path = _build_fixture(tmp_path, db_id, yaml_text, 10.0)
+
+    ref = _reference_plan(yaml_path, allow_crash=True)
+    assert ref is None, (name, "reference unexpectedly accepted")
+    with pytest.raises(ConfigError):
+        TestConfig(yaml_path, prober=StaticProber({}, default=dict(
+            width=SRC_W, height=SRC_H, pix_fmt="yuv420p",
+            r_frame_rate=str(SRC_FPS), avg_frame_rate=f"{SRC_FPS}/1",
+            video_duration=10.0,
+        )))
