@@ -1349,11 +1349,19 @@ def test_planner_extended_seed_sweep(tmp_path):
             failures.append((seed, "ref rejected, ours accepted"))
             continue
         ours = _our_plan(yaml_path, src_secs)
-        ref_names = {s["filename"] for s in ref["segments"]}
-        our_names = {s["filename"] for s in ours["segments"]}
-        if ref_names != our_names:
-            failures.append((seed, sorted(ref_names ^ our_names)[:4]))
-    assert failures == [], failures
+        ref_by = {s["filename"]: s for s in ref["segments"]}
+        our_by = {s["filename"]: s for s in ours["segments"]}
+        if set(ref_by) != set(our_by):
+            failures.append((seed, sorted(set(ref_by) ^ set(our_by))[:4]))
+            continue
+        for nm, r in ref_by.items():
+            o = our_by[nm]
+            if (abs(o["start"] - r["start"]) > 1e-9
+                    or abs(o["duration"] - r["duration"]) > 1e-9
+                    or (o["target_bitrate"] is None)
+                    != (r["target_bitrate"] is None)):
+                failures.append((seed, nm, o, r))
+    assert failures == [], failures[:3]
 
 
 @pytest.mark.skipif(
