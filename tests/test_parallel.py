@@ -1,5 +1,7 @@
 """Mesh/sharding tests on the virtual 8-device CPU mesh."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -291,13 +293,21 @@ def test_sharded_stall_renderer_skipping_mode(devices8):
         np.testing.assert_array_equal(np.asarray(got), ref)
 
 
-def test_multiprocess_distributed_end_to_end():
-    """Two real OS processes form a jax.distributed cluster (CPU
-    transport) and run a sharded reduction whose result crosses the
-    process boundary — the automated multi-*process* test VERDICT r3 #7
-    asked for: distributed.initialize itself executes (not just the
-    single-process shard helpers), and a jitted global-mesh computation
-    communicates over the inter-process backend (ICI/DCN analog)."""
+@pytest.mark.parametrize("n_procs", [
+    2,
+    pytest.param(4, marks=pytest.mark.skipif(
+        not os.environ.get("PC_SLOW_TESTS"),
+        reason="4-process cluster: set PC_SLOW_TESTS=1")),
+])
+def test_multiprocess_distributed_end_to_end(n_procs):
+    """Real OS processes form a jax.distributed cluster (CPU transport)
+    and run a sharded reduction whose result crosses process boundaries —
+    the automated multi-*process* test VERDICT r3 #7 asked for:
+    distributed.initialize itself executes (not just the single-process
+    shard helpers), a jitted global-mesh computation communicates over
+    the inter-process backend (ICI/DCN analog), and the production
+    sharded step's TI halo crosses every process boundary. The gated
+    4-process variant exercises a >2-hop ring."""
     import json
     import os
     import socket
@@ -317,11 +327,11 @@ def test_multiprocess_distributed_end_to_end():
     )
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, coordinator, "2", str(pid)],
+            [sys.executable, worker, coordinator, str(n_procs), str(pid)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,
         )
-        for pid in (0, 1)
+        for pid in range(n_procs)
     ]
     outs = []
     try:
@@ -336,14 +346,15 @@ def test_multiprocess_distributed_end_to_end():
             if q.poll() is None:
                 q.kill()
 
+    want_total = sum(range(1, n_procs + 1)) * 4 * 8 * 8.0
     for pid, rec in enumerate(outs):
         assert rec["pid"] == pid
-        assert rec["process_count"] == 2
-        assert rec["device_count"] == 2
-        # global reduction saw BOTH lanes: (1+2) * 4*8*8 = 768
-        assert rec["total"] == 768.0
+        assert rec["process_count"] == n_procs
+        assert rec["device_count"] == n_procs
+        # global reduction saw EVERY lane: sum(1..n) * 4*8*8
+        assert rec["total"] == want_total
         # replicated gather delivers every lane's mean to every process
-        assert rec["lanes"] == [1.0, 2.0]
+        assert rec["lanes"] == [float(i + 1) for i in range(n_procs)]
         # the production sharded step ran over the cross-process mesh and
         # each process's lane matches its local single-device reference
         assert rec["sharded_step_ok"] is True
@@ -351,8 +362,8 @@ def test_multiprocess_distributed_end_to_end():
     assert outs[0]["si_all_lanes"] == pytest.approx(
         outs[1]["si_all_lanes"], rel=1e-6
     )
-    # the two hosts' work shards partition the PVS list
-    assert sorted(outs[0]["shard"] + outs[1]["shard"]) == [
+    # the hosts' work shards partition the PVS list
+    assert sorted(sum((o["shard"] for o in outs), [])) == [
         f"PVS{i:02d}" for i in range(10)
     ]
     assert not set(outs[0]["shard"]) & set(outs[1]["shard"])
