@@ -103,9 +103,11 @@ def get_framesize_vp9(filename: str, force: bool = False) -> list[int]:
     return sizes
 
 
-def get_framesize_av1(filename: str, force: bool = True) -> list[int]:
+def get_framesize_av1(filename: str, force: bool = False) -> list[int]:
     """AV1: packet sizes from the demuxer (reference :266-274 falls back to
-    ffprobe pkt_size)."""
+    ffprobe pkt_size). `force` is unused (the demuxer scan is always exact);
+    the default matches the three sibling parsers so a keyword caller sees
+    uniform behavior."""
     return [int(s) for s in medialib.scan_packets(filename, "video")["size"]]
 
 

@@ -109,15 +109,41 @@ def ensure_loaded() -> ct.CDLL:
         # always run make: it no-ops when the .so is current and rebuilds
         # when media.cpp changed, so a checkout carrying a prebuilt binary
         # from before a struct change never loads at the wrong stride
+        lib = None
         try:
             _build()
-            lib = ct.CDLL(_SO_PATH)
-        except (OSError, subprocess.CalledProcessError):
-            # a stale or foreign-platform binary (e.g. a checkout moved
-            # between architectures): force a rebuild for THIS host once
-            # (-B: the broken .so may look up-to-date to make)
-            _build(force=True)
-            lib = ct.CDLL(_SO_PATH)
+        except subprocess.CalledProcessError as exc:
+            # make RAN and failed: the sources are newer than the .so (an
+            # up-to-date tree no-ops even without a compiler), so loading
+            # a prebuilt binary here would silently run pre-edit native
+            # code while the compile error never surfaces. Fail loudly
+            # WITH the compiler's message (make ran output-captured).
+            raise MediaError(
+                f"native build failed:\n{(exc.stderr or str(exc))[-800:]}"
+            ) from exc
+        except OSError:
+            # make itself is missing (a deploy host without a toolchain):
+            # a prebuilt .so is still loadable — the ABI handshake below
+            # rejects a stale layout, which is the hazard the always-make
+            # policy targets.
+            if os.path.isfile(_SO_PATH):
+                try:
+                    lib = ct.CDLL(_SO_PATH)
+                except OSError:
+                    pass
+            if lib is None:
+                # nothing loadable: force a rebuild so the REAL build
+                # error (missing toolchain, compile failure) surfaces
+                _build(force=True)
+        if lib is None:
+            try:
+                lib = ct.CDLL(_SO_PATH)
+            except OSError:
+                # a stale or foreign-platform binary (e.g. a checkout moved
+                # between architectures): force a rebuild for THIS host once
+                # (-B: the broken .so may look up-to-date to make)
+                _build(force=True)
+                lib = ct.CDLL(_SO_PATH)
         # ABI handshake: mtime-equal edge cases can survive the make; a
         # layout mismatch must fail loudly, never probe at the wrong stride
         try:

@@ -12,6 +12,7 @@ ffmpeg-normalize step, lib/ffmpeg.py:1233-1245) applied in-process.
 
 from __future__ import annotations
 
+import math
 import os
 from fractions import Fraction
 from typing import Optional
@@ -160,6 +161,21 @@ def cpvs_plan(
     return plan
 
 
+def t_cap_frames(t: float, rate: Fraction) -> int:
+    """Frame count of ffmpeg's `-t <t>` output cap: every frame with
+    pts < t, i.e. frames k with k/fps < t — ceil(t*fps) for fractional
+    rates (29.97 fps, t=60 -> 1799, not round(1798.2)=1798) and exactly
+    t*fps when the product lands on an integer.
+
+    `t` is quantized the way the value reaches ffmpeg in the reference
+    (`-t str(t)` at lib/ffmpeg.py:1203-1213): Python's shortest-repr
+    decimal, parsed by ffmpeg at microsecond precision — NOT the raw
+    binary float (Fraction(0.1+0.2) would carry the 4e-17 fuzz across
+    the ceil and emit one extra frame when t*fps lands on an integer)."""
+    t_us = round(Fraction(str(t)) * 1_000_000)
+    return math.ceil(Fraction(t_us, 1_000_000) * rate)
+
+
 def create_cpvs(
     pvs: Pvs,
     post_processing: PostProcessing,
@@ -189,9 +205,7 @@ def create_cpvs(
             ).limit_denominator(1001)
             if plan["t"] is not None:
                 # the reference's long-test `-t total_duration` cap
-                chunks = _limit_frames(
-                    chunks, int(round(plan["t"] * float(out_rate)))
-                )
+                chunks = _limit_frames(chunks, t_cap_frames(plan["t"], out_rate))
             ten_bit = "10" in pix_fmt
 
             audio = None

@@ -329,11 +329,14 @@ def compute_pvs_metrics(
                         "reusing device features from %s", sc_path
                     )
 
-    cols = ["psnr_y", "psnr_u", "psnr_v", "ssim_y", "si", "ti"]
-    if msssim:
-        cols.insert(4, "msssim_y")
-    if vif:
-        cols.insert(4, "vif_y")
+    # declarative column order so it is stable across flag combinations
+    # (msssim_y always before vif_y, both between ssim_y and si)
+    cols = (
+        ["psnr_y", "psnr_u", "psnr_v", "ssim_y"]
+        + (["msssim_y"] if msssim else [])
+        + (["vif_y"] if vif else [])
+        + ["si", "ti"]
+    )
     rows = {k: [] for k in cols}
     prev_last = None  # last deg luma of the previous chunk (TI continuity)
     with tracing.span(f"metrics {pvs.pvs_id}"), VideoReader(

@@ -45,6 +45,7 @@ import lib.ffmpeg as _ff
 
 buff = {}
 avpvs_dims = {}
+avpvs_dims_coded = {}
 for pvs_id, pvs in tc.pvses.items():
     buff[pvs_id] = pvs.hrc.get_buff_events_media_time()
     pp = tc.post_processings[0]
@@ -59,6 +60,20 @@ for pvs_id, pvs in tc.pvses.items():
     if ql.height > dims[1]:
         dims = [ql.width, ql.height]
     avpvs_dims[pvs_id] = dims
+    # what create_avpvs_short ACTUALLY feeds the math: the CODED dims
+    # (lib/ffmpeg.py:975-976) — emitted separately so the repo's
+    # documented display-dims deviation can be pinned against the
+    # reference's real behavior on coded != display masters
+    if info.get("coded_width") and info.get("coded_height"):
+        cd = _ff.calculate_avpvs_video_dimensions(
+            int(info["coded_width"]), int(info["coded_height"]),
+            int(pp.coding_width), int(pp.coding_height),
+        )
+        if ql.height > cd[1]:
+            cd = [ql.width, ql.height]
+        avpvs_dims_coded[pvs_id] = cd
+    else:
+        avpvs_dims_coded[pvs_id] = None
 commands = {}
 if "--commands" in sys.argv:
     import lib.ffmpeg as ref_ffmpeg
@@ -82,4 +97,5 @@ print(json.dumps({
     "commands": commands,
     "buff_events": buff,
     "avpvs_dims": avpvs_dims,
+    "avpvs_dims_coded": avpvs_dims_coded,
 }))

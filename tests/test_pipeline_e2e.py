@@ -1275,3 +1275,27 @@ def test_cpvs_limit_frames_cap():
     # cap beyond the stream length is a no-op
     out = list(_limit_frames(chunks(), 99))
     assert sum(c[0].shape[0] for c in out) == 20
+
+
+def test_cpvs_t_cap_frames_ffmpeg_semantics():
+    """The `-t` cap counts frames with pts < t (ffmpeg semantics): ceil
+    for fractional rates, exact for integer products — pinned for the
+    NTSC case the round-4 advisor flagged (29.97 fps, t=60 -> 1799, one
+    MORE than round(1798.2))."""
+    from fractions import Fraction
+
+    from processing_chain_tpu.models.cpvs import t_cap_frames
+
+    ntsc = Fraction(30000, 1001)
+    assert t_cap_frames(60.0, ntsc) == 1799          # round() would say 1798
+    assert t_cap_frames(60.0, Fraction(60)) == 3600  # exact: no off-by-one up
+    assert t_cap_frames(10.0, Fraction(24)) == 240
+    assert t_cap_frames(1.0, ntsc) == 30             # ceil(29.97)
+    # pts = k/fps < t includes frame k=1798 at t=59.993...s for NTSC 60s
+    assert (1798 / ntsc) < 60 <= (1799 / ntsc)
+    # binary-float fuzz from summed segment durations must NOT leak into
+    # the ceil: the reference ships str(t) to ffmpeg, which parses the
+    # shortest-repr decimal — 0.1+0.2 at 10 fps is exactly 3 frames,
+    # not ceil(3.0000000000000004) = 4
+    assert t_cap_frames(0.1 + 0.2, Fraction(10)) == 3
+    assert t_cap_frames(sum([1.1] * 2), Fraction(25)) == 55

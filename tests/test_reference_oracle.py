@@ -500,6 +500,80 @@ def test_buff_events_and_avpvs_dims_match_reference(tmp_path, seed):
         assert [w, h] == ref["avpvs_dims"][pvs_id], pvs_id
 
 
+def test_avpvs_dims_display_vs_coded_divergence_pinned(tmp_path):
+    """The repo's DOCUMENTED deviation (models/avpvs.avpvs_dimensions):
+    the reference feeds CODED dims into the canvas math
+    (lib/ffmpeg.py:975-976), we feed DISPLAY dims. For a non-mod-16
+    lossy master (h264 1080p: display 1920x1080, coded 1920x1088) the
+    two genuinely diverge — this case pins BOTH sides via the oracle so
+    the divergence is explicit and cannot silently widen (round-4
+    advisor)."""
+    db_id = "P2SXM77"
+    yaml_text = "\n".join([
+        f"databaseId: {db_id}",
+        "syntaxVersion: 6",
+        "type: short",
+        "qualityLevelList:",
+        "  Q0: {index: 0, videoCodec: h264, videoBitrate: 800, "
+        "width: 1280, height: 720, fps: 24}",
+        "codingList:",
+        "  VC01: {type: video, encoder: libx264, passes: 1, "
+        "iFrameInterval: 2, preset: ultrafast}",
+        "srcList:",
+        "  SRC000: SRC000.avi",
+        "hrcList:",
+        "  HRC000: {videoCodingId: VC01, eventList: [[Q0, 6]]}",
+        "pvsList:",
+        f"  - {db_id}_SRC000_HRC000",
+        "postProcessingList:",
+        "  - {type: pc, displayWidth: 1920, displayHeight: 1080, "
+        "codingWidth: 1920, codingHeight: 1080, displayFrameRate: 24}",
+    ]) + "\n"
+    db = tmp_path / db_id
+    (db / "srcVid").mkdir(parents=True)
+    (db / f"{db_id}.yaml").write_text(yaml_text)
+    stream = {
+        "codec_type": "video", "codec_name": "h264",
+        "width": 1920, "height": 1080,
+        "coded_width": 1920, "coded_height": 1088,  # mb-aligned h264
+        "pix_fmt": "yuv420p", "duration": "10.000000",
+        "bit_rate": "8000000", "r_frame_rate": "24/1",
+        "avg_frame_rate": "24/1", "profile": "",
+    }
+    (db / "srcVid" / "SRC000.avi").write_bytes(b"\x00" * 64)
+    (db / "srcVid" / "SRC000.avi.probe.json").write_text(
+        json.dumps({"streams": [stream]})
+    )
+    (db / "srcVid" / "SRC000.avi.yaml").write_text(_yaml.safe_dump({
+        "md5sum": "-",
+        "get_stream_size": {"v": 8_000_000, "a": 0},
+        "get_src_info": stream,
+    }))
+    yaml_path = str(db / f"{db_id}.yaml")
+
+    ref = _reference_plan(yaml_path)
+    assert ref is not None
+    pvs_id = f"{db_id}_SRC000_HRC000"
+    # the reference's REAL canvas for this master: coded aspect 1920/1088
+    # != 1920/1080 at 3-decimal precision, so its height snaps to the
+    # coded SRC height (lib/ffmpeg.py:55 else-branch)
+    assert ref["avpvs_dims_coded"][pvs_id] == [1920, 1088]
+    # the display-dims math agrees with the coding target exactly
+    assert ref["avpvs_dims"][pvs_id] == [1920, 1080]
+
+    from processing_chain_tpu.config import StaticProber, TestConfig
+    from processing_chain_tpu.models.avpvs import avpvs_dimensions
+
+    prober = StaticProber({}, default=dict(
+        width=1920, height=1080, pix_fmt="yuv420p",
+        r_frame_rate="24", avg_frame_rate="24/1", video_duration=10.0,
+    ))
+    tc = TestConfig(yaml_path, prober=prober)
+    # OUR intended (deviating) behavior: display dims -> a 1080 canvas,
+    # not the reference's 1088 with its 8 coded padding rows
+    assert avpvs_dimensions(tc.pvses[pvs_id]) == (1920, 1080)
+
+
 def _probe_sidecar_from_real_media(path: str) -> None:
     """Record OUR native probe of a real media file as the ffprobe-JSON
     sidecar the stub serves to the reference: both chains then derive
