@@ -8,6 +8,15 @@
 # bench.py's harvest path takes (~/.cache/pc_tpu_device_<uid>.lock) —
 # watcher and harvest can never open two tunnel clients at once.
 #
+# Round-5 addition (VERDICT r4 #2): the pending `perf-chroma-batch`
+# branch is rehearsed AUTOMATICALLY on every live window, in a dedicated
+# worktree (the operator's tree is never touched): merge main + branch
+# there, pre-build, bench — and record both numbers in
+# $STATE_DIR/landing.json so the session (or operator) can decide the
+# main-branch merge with live evidence in hand. The worktree is kept
+# merged + built even while the tunnel is down, so a window is never
+# spent compiling.
+#
 # Usage: tools/tpu_watch.sh [interval_s] [log]
 set -u
 INTERVAL="${1:-900}"
@@ -16,9 +25,59 @@ mkdir -p -m 700 "$STATE_DIR" 2>/dev/null || mkdir -p "$STATE_DIR"
 LOG="${2:-$STATE_DIR/watch.log}"
 LOCK="$HOME/.cache/pc_tpu_device_$(id -u).lock"
 CHILD_JSON="$STATE_DIR/child.json"
+CACHE_DIR="$HOME/.cache/pc_bench_jax_cache_$(id -u)"
 cd "$(dirname "$0")/.." || exit 1
+REPO="$PWD"
+WT="$STATE_DIR/wt-perf"
+PERF_BRANCH="perf-chroma-batch"
+
+prep_worktree() {
+    # keep $WT at merge(main, perf-chroma-batch), native lib pre-built —
+    # cheap no-op when nothing moved; never touches the operator's tree
+    git -C "$REPO" rev-parse --verify -q "$PERF_BRANCH" >/dev/null || return 1
+    local want
+    want="$(git -C "$REPO" rev-parse main)+$(git -C "$REPO" rev-parse "$PERF_BRANCH")"
+    if [ -f "$STATE_DIR/wt_merged_for" ] && [ "$(cat "$STATE_DIR/wt_merged_for")" = "$want" ] \
+        && [ -d "$WT" ]; then
+        return 0
+    fi
+    if [ ! -d "$WT" ]; then
+        git -C "$REPO" worktree add -f --detach "$WT" main >> "$LOG" 2>&1 || return 1
+    fi
+    git -C "$WT" checkout -q -B perf-landing main >> "$LOG" 2>&1 || return 1
+    if ! git -C "$WT" merge --no-edit -q "$PERF_BRANCH" >> "$LOG" 2>&1; then
+        git -C "$WT" merge --abort >> "$LOG" 2>&1
+        echo "[$(date -u +%H:%M:%S)] landing: merge CONFLICT (main vs $PERF_BRANCH)" >> "$LOG"
+        return 1
+    fi
+    make -C "$WT/processing_chain_tpu/native" >> "$LOG" 2>&1 || return 1
+    echo "$want" > "$STATE_DIR/wt_merged_for"
+    echo "[$(date -u +%H:%M:%S)] landing: worktree merged+built ($want)" >> "$LOG"
+}
+
+rehearse_landing() {
+    # bench the merged worktree on the live tunnel; its live capture goes
+    # to a SIDE file (never main's BENCH_LIVE.json — different code hash)
+    prep_worktree || return 0
+    ( cd "$WT" && timeout -s KILL 400 env \
+        PC_BENCH_LIVE_FILE="$STATE_DIR/BENCH_LIVE_perf.json" \
+        JAX_COMPILATION_CACHE_DIR="$CACHE_DIR" \
+        python bench.py > "$STATE_DIR/perf_bench.json" 2>> "$LOG" )
+    if grep -q '"platform": "tpu"' "$STATE_DIR/perf_bench.json" 2>/dev/null; then
+        {
+            echo "{\"measured_at\": \"$(date -u +%FT%TZ)\","
+            echo " \"merged\": \"$(cat "$STATE_DIR/wt_merged_for")\","
+            echo " \"main_bench\": $(cat "$CHILD_JSON" 2>/dev/null || echo null),"
+            echo " \"perf_bench\": $(cat "$STATE_DIR/perf_bench.json")}"
+        } > "$STATE_DIR/landing.json"
+        echo "[$(date -u +%H:%M:%S)] landing: rehearsal captured -> landing.json" >> "$LOG"
+    else
+        echo "[$(date -u +%H:%M:%S)] landing: rehearsal got no TPU number" >> "$LOG"
+    fi
+}
 
 while :; do
+    prep_worktree || true   # do the merge+build while the tunnel is DOWN
     echo "[$(date -u +%H:%M:%S)] probing tunnel" >> "$LOG"
     # -n: if another client (a harvest) holds the device, skip this round.
     # The probe skips the optional extras and shares bench.py's per-user
@@ -29,23 +88,32 @@ while :; do
     # short matters — a harvest bench.py gives up on a busy lock after
     # 60 s and falls back to the cached live number.
     if flock -n "$LOCK" -c \
-        "PC_BENCH_NO_EXTRAS=1 JAX_COMPILATION_CACHE_DIR=$HOME/.cache/pc_bench_jax_cache_$(id -u) \
+        "PC_BENCH_NO_EXTRAS=1 JAX_COMPILATION_CACHE_DIR=$CACHE_DIR \
          timeout -s KILL 100 python bench.py --child > '$CHILD_JSON' 2>> '$LOG'" \
         && grep -q '"platform": "tpu"' "$CHILD_JSON"; then
         echo "[$(date -u +%H:%M:%S)] tunnel LIVE; running full bench" >> "$LOG"
-        # full bench takes the same lock itself (bench.py _DeviceLock)
+        # 1) protect the round's number first (refresh main's live cache;
+        #    bench.py takes the same lock itself)
         timeout -s KILL 400 python bench.py >> "$LOG" 2>&1
         echo "[$(date -u +%H:%M:%S)] bench done" >> "$LOG"
-        # one stage-split profile per live window (VERDICT r3 #3):
-        # profile_p03 takes the same lock; skip once captured
+        # 2) rehearse the pending perf-branch landing (VERDICT r4 #2)
+        rehearse_landing
+        # 3) session-provided extras for this window (e2e bench, ...)
+        if [ -x "$REPO/tools/live_extra.sh" ]; then
+            timeout -s KILL 500 bash "$REPO/tools/live_extra.sh" >> "$LOG" 2>&1 \
+                || echo "[live_extra failed]" >> "$LOG"
+            echo "[$(date -u +%H:%M:%S)] live_extra done" >> "$LOG"
+        fi
+        # 4) one stage-split profile per live window (VERDICT r3 #3):
+        #    profile_p03 takes the same lock; skip once captured
         if [ ! -s "$STATE_DIR/profile_tpu.json" ]; then
             timeout -s KILL 600 python tools/profile_p03.py \
                 --frames 48 --chunk 16 > "$STATE_DIR/profile_tpu.json" \
                 2>> "$LOG" || echo "[profile failed]" >> "$LOG"
             echo "[$(date -u +%H:%M:%S)] profile captured" >> "$LOG"
         fi
-        # one per-kernel variant sweep per live window: the data for the
-        # step-vs-kernel-sum gap analysis (docs/PERF.md headroom section)
+        # 5) one per-kernel variant sweep per live window: the data for the
+        #    step-vs-kernel-sum gap analysis (docs/PERF.md headroom section)
         if [ ! -s "$STATE_DIR/perf_sweep.json" ]; then
             timeout -s KILL 600 python tools/perf_sweep.py \
                 > "$STATE_DIR/perf_sweep.json" \
