@@ -89,16 +89,60 @@ def canvas_fps(pvs: Pvs, avpvs_src_fps: bool = False) -> float:
     return pvs.src.get_fps() if avpvs_src_fps else 60.0
 
 
+def avpvs_codec() -> str:
+    """AVPVS intermediate codec: `ffv1` (reference parity, default) or
+    `rawvideo` (PC_AVPVS_CODEC=rawvideo: a cheaper lossless intermediate
+    for hosts where FFV1 compression — not decode or device work — is the
+    p03 bottleneck; ~6x the disk footprint, near-memcpy writeback).
+    Decoded frames are identical either way; provenance records which
+    codec produced each artifact."""
+    codec = os.environ.get("PC_AVPVS_CODEC", "ffv1").strip().lower()
+    if codec not in ("ffv1", "rawvideo"):
+        raise ValueError(
+            f"PC_AVPVS_CODEC={codec!r}: expected 'ffv1' or 'rawvideo'"
+        )
+    return codec
+
+
+def ffv1_workers() -> int:
+    """Frame-parallel FFV1 encoder contexts (native/media.cpp fp mode).
+    PC_FFV1_WORKERS=N pins it; default: one worker per spare core, capped
+    at 8 (0 on a 1-2 core host — the pool only adds queue overhead when
+    there is no core for it to run on). FFV1 is intra-only, so frames
+    encode independently on private contexts and scale with cores where
+    slice threading (the reference's `-threads 4`, lib/ffmpeg.py:1047)
+    tops out at slices-per-frame."""
+    raw = os.environ.get("PC_FFV1_WORKERS", "").strip()
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            return 0
+    ncpu = os.cpu_count() or 1
+    return 0 if ncpu <= 2 else min(ncpu - 1, 8)
+
+
 def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
                  with_audio: bool, sample_rate: int = 48000,
                  audio_codec: str = "pcm_s16le") -> VideoWriter:
     frac = Fraction(rate).limit_denominator(1001)
     audio = dict(audio_codec=audio_codec, sample_rate=sample_rate, channels=2) if with_audio else {}
+    if avpvs_codec() == "rawvideo":
+        return VideoWriter(
+            path, "rawvideo", w, h, pix_fmt,
+            (frac.numerator, frac.denominator), **audio,
+        )
     # FFV1 level 3 + slicecrc stream integrity (reference :1047: -level 3
-    # -coder 1 -context 1 -slicecrc 1); -threads 4 parity
+    # -coder 1 -context 1 -slicecrc 1); -threads 4 parity. With fp
+    # workers, parallelism moves from slices to whole frames (gop=1) and
+    # per-context threading drops to 1.
+    workers = ffv1_workers()
+    opts = "level=3:coder=1:context=1:slicecrc=1"
+    if workers > 0:
+        opts += f":pc_fp_workers={workers}"
     return VideoWriter(
         path, "ffv1", w, h, pix_fmt, (frac.numerator, frac.denominator),
-        threads=4, opts="level=3:coder=1:context=1:slicecrc=1", **audio,
+        threads=1 if workers > 0 else 4, opts=opts, **audio,
     )
 
 
@@ -250,13 +294,20 @@ def _wo_buffer_out_path(pvs: Pvs) -> str:
 
 
 def _wo_buffer_provenance(pvs: Pvs, w: int, h: int, pix_fmt: str) -> dict:
+    codec = avpvs_codec()
+    workers = ffv1_workers() if codec == "ffv1" else 0
     return {
         "pvs": pvs.pvs_id,
         "pipeline": {
             "canvas": [w, h],
             "pix_fmt": pix_fmt,
             "segments": [s.filename for s in pvs.segments],
-            "codec": "ffv1(level3,slicecrc)",
+            "codec": (
+                "ffv1(level3,slicecrc"
+                + (f",fp_workers={workers}" if workers else "")
+                + ")"
+                if codec == "ffv1" else "rawvideo"
+            ),
         },
     }
 

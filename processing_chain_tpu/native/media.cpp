@@ -33,9 +33,14 @@ extern "C" {
 #include <libswscale/swscale.h>
 }
 
+#include <condition_variable>
 #include <cstdio>
 #include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #define EXPORT extern "C" __attribute__((visibility("default")))
@@ -680,6 +685,30 @@ struct MPEncoder {
     std::string stats_in;             // two-pass: pass 2 stats
     bool header_written = false;
     char errbuf[512] = {0};
+
+    // Frame-parallel encode mode ("pc_fp_workers=N" in the vopts string):
+    // FFV1 is intra-only, and with gop_size=1 every frame is a keyframe
+    // whose range-coder contexts reset — frames are therefore fully
+    // independent, so N worker threads each own a private AVCodecContext
+    // and the caller thread muxes finished packets back in sequence
+    // order. This is the native attack on the host-side FFV1 writeback
+    // bottleneck (reference: single `-threads 4` slice threading at
+    // lib/ffmpeg.py:1047); unlike slice threading it scales with frames
+    // in flight, not slices per frame. venc stays the parameter/extradata
+    // reference for the muxer and is never fed frames in this mode.
+    int fp_workers = 0;
+    std::vector<AVCodecContext*> fp_ctxs;   // one per worker thread
+    std::vector<std::thread> fp_threads;
+    std::deque<std::pair<int64_t, AVFrame*>> fp_q;       // seq -> frame
+    std::map<int64_t, std::vector<AVPacket*>> fp_done;   // seq -> packets
+    int64_t fp_next_mux = 0;   // next seq the muxer will write
+    int64_t fp_inflight = 0;   // queued or encoding, not yet muxed
+    bool fp_stop = false;
+    bool fp_error = false;
+    std::string fp_error_msg;
+    std::mutex fp_mu;
+    std::condition_variable fp_cv_work;  // workers: queue non-empty / stop
+    std::condition_variable fp_cv_done;  // caller: a seq finished
 };
 
 static int enc_write_packets(MPEncoder* e, AVCodecContext* ctx, AVStream* st) {
@@ -718,11 +747,146 @@ static int enc_write_packets(MPEncoder* e, AVCodecContext* ctx, AVStream* st) {
     return (ret == AVERROR(EAGAIN) || ret == AVERROR_EOF) ? 0 : ret;
 }
 
+// --------------------------- frame-parallel encode -------------------------
+
+// Copy contiguous caller plane buffers into an (already allocated, sized)
+// AVFrame, honoring the frame's linesize padding.
+static int fill_vframe(AVFrame* f, const uint8_t* const planes[4]) {
+    int ret = av_frame_make_writable(f);
+    if (ret < 0) return ret;
+    const AVPixFmtDescriptor* desc =
+        av_pix_fmt_desc_get((AVPixelFormat)f->format);
+    int nplanes = av_pix_fmt_count_planes((AVPixelFormat)f->format);
+    int bps = desc->comp[0].depth > 8 ? 2 : 1;
+    for (int p = 0; p < nplanes && p < 4; p++) {
+        if (!planes[p]) continue;
+        int is_chroma = (p == 1 || p == 2);
+        int ph = is_chroma ? AV_CEIL_RSHIFT(f->height, desc->log2_chroma_h)
+                           : f->height;
+        int row_bytes =
+            plane_row_bytes((AVPixelFormat)f->format, f->width, p, desc, bps);
+        for (int y = 0; y < ph; y++) {
+            memcpy(f->data[p] + (size_t)y * f->linesize[p],
+                   planes[p] + (size_t)y * row_bytes, (size_t)row_bytes);
+        }
+    }
+    return 0;
+}
+
+// Worker thread: pull frames off the shared queue, encode on a PRIVATE
+// context (legal because fp mode forces gop_size=1: every FFV1 frame is a
+// keyframe with fresh range-coder state, so no cross-frame context exists),
+// park the packets under the frame's sequence number.
+static void fp_worker_main(MPEncoder* e, AVCodecContext* ctx) {
+    for (;;) {
+        int64_t seq;
+        AVFrame* frame;
+        {
+            std::unique_lock<std::mutex> lk(e->fp_mu);
+            e->fp_cv_work.wait(lk,
+                               [&] { return e->fp_stop || !e->fp_q.empty(); });
+            if (e->fp_q.empty()) break;  // fp_stop and drained
+            seq = e->fp_q.front().first;
+            frame = e->fp_q.front().second;
+            e->fp_q.pop_front();
+        }
+        std::vector<AVPacket*> pkts;
+        int ret = avcodec_send_frame(ctx, frame);
+        while (ret >= 0) {
+            AVPacket* pkt = av_packet_alloc();
+            ret = avcodec_receive_packet(ctx, pkt);
+            if (ret == 0) {
+                pkts.push_back(pkt);
+                continue;
+            }
+            av_packet_free(&pkt);
+            if (ret == AVERROR(EAGAIN) || ret == AVERROR_EOF) ret = 0;
+            break;
+        }
+        av_frame_free(&frame);
+        {
+            std::lock_guard<std::mutex> lk(e->fp_mu);
+            if (ret < 0) {
+                for (auto* p : pkts) av_packet_free(&p);
+                if (!e->fp_error) {
+                    e->fp_error = true;
+                    e->fp_error_msg = "fp encode: " + av_errstr(ret);
+                }
+                // the seq must still resolve or the in-order mux stalls
+                e->fp_done[seq] = {};
+            } else {
+                e->fp_done[seq] = std::move(pkts);
+            }
+        }
+        e->fp_cv_done.notify_all();
+    }
+    // Drain the context. A sync intra encoder (ffv1) emits one packet per
+    // send, so this is normally empty — but any stragglers carry their
+    // frame's pts (== seq) and are parked under it for the in-order mux.
+    avcodec_send_frame(ctx, nullptr);
+    for (;;) {
+        AVPacket* pkt = av_packet_alloc();
+        if (avcodec_receive_packet(ctx, pkt) != 0) {
+            av_packet_free(&pkt);
+            break;
+        }
+        std::lock_guard<std::mutex> lk(e->fp_mu);
+        e->fp_done[pkt->pts].push_back(pkt);
+    }
+    e->fp_cv_done.notify_all();
+}
+
+// Mux every finished sequence that is next in order. Caller-thread only
+// (the muxer and the audio path share last_dts and the format context).
+// Called with fp_mu held via lk; drops the lock around the actual writes.
+static int fp_mux_ready_locked(MPEncoder* e, std::unique_lock<std::mutex>& lk) {
+    for (;;) {
+        auto it = e->fp_done.begin();
+        if (it == e->fp_done.end() || it->first != e->fp_next_mux) return 0;
+        std::vector<AVPacket*> pkts = std::move(it->second);
+        e->fp_done.erase(it);
+        lk.unlock();
+        int ret = 0;
+        for (auto* pkt : pkts) {
+            if (ret >= 0) {
+                if (pkt->duration == 0) pkt->duration = 1;
+                av_packet_rescale_ts(pkt, e->venc->time_base,
+                                     e->vstream->time_base);
+                pkt->stream_index = e->vstream->index;
+                int si = e->vstream->index < 2 ? e->vstream->index : 1;
+                if (pkt->dts != AV_NOPTS_VALUE &&
+                    e->last_dts[si] != INT64_MIN &&
+                    pkt->dts <= e->last_dts[si]) {
+                    pkt->dts = e->last_dts[si] + 1;
+                    if (pkt->pts != AV_NOPTS_VALUE && pkt->pts < pkt->dts)
+                        pkt->pts = pkt->dts;
+                }
+                if (pkt->dts != AV_NOPTS_VALUE) e->last_dts[si] = pkt->dts;
+                ret = av_interleaved_write_frame(e->fmt, pkt);
+            }
+            av_packet_free(&pkt);
+        }
+        lk.lock();
+        e->fp_next_mux++;
+        e->fp_inflight--;
+        e->fp_cv_done.notify_all();
+        if (ret < 0) {
+            if (!e->fp_error) {
+                e->fp_error = true;
+                e->fp_error_msg = "fp mux: " + av_errstr(ret);
+            }
+            return ret;
+        }
+    }
+}
+
 // Open an encoder+muxer. Video is configured from explicit arguments plus an
 // ffmpeg-style options string "k=v:k=v" applied to the codec context (private
 // options included, e.g. preset/crf/x265-params/speed/row-mt). Audio is
 // optional (acodec == nullptr to disable).
 //   pass: 0 = single pass, 1/2 = two-pass with stats at stats_path.
+//   vopts may carry "pc_fp_workers=N" (consumed here, never passed on):
+//   frame-parallel encode across N private contexts — ffv1 only.
 EXPORT MPEncoder* mp_encoder_open(
     const char* path, const char* vcodec, int width, int height,
     const char* pix_fmt, int fps_num, int fps_den, int64_t bit_rate,
@@ -806,6 +970,8 @@ EXPORT MPEncoder* mp_encoder_open(
     auto fail_cleanup = [&]() {
         av_dict_free(&opts);
         if (e->stats_file) fclose(e->stats_file);
+        for (auto*& wc : e->fp_ctxs) avcodec_free_context(&wc);
+        e->fp_ctxs.clear();  // worker threads only start once open succeeds
         avcodec_free_context(&e->venc);
         if (e->aenc) avcodec_free_context(&e->aenc);
         swr_free(&e->swr);
@@ -820,6 +986,31 @@ EXPORT MPEncoder* mp_encoder_open(
             return nullptr;
         }
     }
+    // pc_fp_workers is OURS, not an AVOption: consume it before the codec
+    // sees the dict. Frame-parallel mode is only sound for an intra-only
+    // codec whose frames can be made independent; restrict to FFV1.
+    if (AVDictionaryEntry* fpw = av_dict_get(opts, "pc_fp_workers", nullptr, 0)) {
+        e->fp_workers = atoi(fpw->value);
+        av_dict_set(&opts, "pc_fp_workers", nullptr, 0);
+        if (e->fp_workers > 0 && vc->id != AV_CODEC_ID_FFV1) {
+            set_err(err, errlen,
+                    "pc_fp_workers requires ffv1 (intra-only frames)");
+            fail_cleanup();
+            return nullptr;
+        }
+        if (e->fp_workers > 64) e->fp_workers = 64;
+        if (e->fp_workers > 0) {
+            // every frame a keyframe: resets the range-coder contexts, so
+            // frames encoded on different worker contexts are exactly the
+            // frames a single gop=1 context would produce
+            e->venc->gop_size = 1;
+            if (pass != 0) {
+                set_err(err, errlen, "pc_fp_workers is single-pass only");
+                fail_cleanup();
+                return nullptr;
+            }
+        }
+    }
     // entries avcodec_open2 does not consume stay in `opts` and are handed
     // to the muxer below — so e.g. "movflags=+frag_keyframe" in the same
     // option string reaches avformat_write_header (ffmpeg-CLI-like split)
@@ -832,6 +1023,49 @@ EXPORT MPEncoder* mp_encoder_open(
     e->vstream = avformat_new_stream(e->fmt, nullptr);
     e->vstream->time_base = e->venc->time_base;
     avcodec_parameters_from_context(e->vstream->codecpar, e->venc);
+
+    if (e->fp_workers > 0) {
+        // one private context per worker, configured IDENTICALLY to venc
+        // (same explicit fields, same remaining option string re-parsed
+        // per context) — verified below by comparing extradata, since the
+        // muxer's codecpar carries venc's FFV1 configuration record and a
+        // worker producing a different one would corrupt the stream.
+        for (int wi = 0; wi < e->fp_workers; wi++) {
+            AVCodecContext* c = avcodec_alloc_context3(vc);
+            c->width = width;
+            c->height = height;
+            c->time_base = e->venc->time_base;
+            c->framerate = e->venc->framerate;
+            c->pix_fmt = pf;
+            c->gop_size = 1;
+            c->max_b_frames = 0;
+            c->thread_count = threads >= 0 ? threads : 1;
+            c->flags = e->venc->flags & ~AV_CODEC_FLAG_PASS1 &
+                       ~AV_CODEC_FLAG_PASS2;
+            AVDictionary* wopts = nullptr;
+            if (vopts && vopts[0]) {
+                av_dict_parse_string(&wopts, vopts, "=", ":", 0);
+                av_dict_set(&wopts, "pc_fp_workers", nullptr, 0);
+            }
+            ret = avcodec_open2(c, vc, &wopts);
+            av_dict_free(&wopts);
+            bool extradata_ok =
+                ret >= 0 &&
+                c->extradata_size == e->venc->extradata_size &&
+                (c->extradata_size == 0 ||
+                 memcmp(c->extradata, e->venc->extradata,
+                        (size_t)c->extradata_size) == 0);
+            if (!extradata_ok) {
+                set_err(err, errlen,
+                        ret < 0 ? "fp worker avcodec_open2: " + av_errstr(ret)
+                                : std::string("fp worker extradata mismatch"));
+                avcodec_free_context(&c);
+                fail_cleanup();
+                return nullptr;
+            }
+            e->fp_ctxs.push_back(c);
+        }
+    }
 
     if (acodec && acodec[0]) {
         const AVCodec* ac = avcodec_find_encoder_by_name(acodec);
@@ -909,6 +1143,8 @@ EXPORT MPEncoder* mp_encoder_open(
     e->vframe->width = width;
     e->vframe->height = height;
     av_frame_get_buffer(e->vframe, 0);
+    for (auto* c : e->fp_ctxs)  // workers start only on a fully-open encoder
+        e->fp_threads.emplace_back(fp_worker_main, e, c);
     return e;
 }
 
@@ -917,25 +1153,53 @@ EXPORT int mp_encoder_write_video(MPEncoder* e, const uint8_t* p0,
                                   const uint8_t* p1, const uint8_t* p2,
                                   const uint8_t* p3, char* err, int errlen) {
     const uint8_t* planes[4] = {p0, p1, p2, p3};
-    int ret = av_frame_make_writable(e->vframe);
-    if (ret < 0) {
+    int ret;
+    if (e->fp_workers > 0) {
+        // frame-parallel path: hand the frame to the worker pool; mux
+        // whatever finished, in order, on this (caller) thread. ctypes
+        // released the GIL for this call, so workers and the Python
+        // producer genuinely overlap.
+        AVFrame* f = av_frame_alloc();
+        f->format = e->vframe->format;
+        f->width = e->vframe->width;
+        f->height = e->vframe->height;
+        if ((ret = av_frame_get_buffer(f, 0)) < 0 ||
+            (ret = fill_vframe(f, planes)) < 0) {
+            av_frame_free(&f);
+            set_err(err, errlen, "fp frame alloc/fill: " + av_errstr(ret));
+            return -1;
+        }
+        f->pts = e->vpts++;
+        f->pict_type = AV_PICTURE_TYPE_I;
+        std::unique_lock<std::mutex> lk(e->fp_mu);
+        // backpressure: bound in-flight frames (raw 4K frames are ~12 MB;
+        // 2 per worker + 2 keeps every worker fed without unbounded RAM)
+        while (!e->fp_error &&
+               e->fp_inflight >= 2 * (int64_t)e->fp_workers + 2) {
+            if (fp_mux_ready_locked(e, lk) < 0) break;
+            if (e->fp_inflight >= 2 * (int64_t)e->fp_workers + 2 &&
+                !e->fp_error)
+                e->fp_cv_done.wait(lk);
+        }
+        if (e->fp_error) {
+            av_frame_free(&f);
+            set_err(err, errlen, e->fp_error_msg);
+            return -1;
+        }
+        e->fp_q.emplace_back(f->pts, f);
+        e->fp_inflight++;
+        lk.unlock();
+        e->fp_cv_work.notify_one();
+        lk.lock();
+        if (fp_mux_ready_locked(e, lk) < 0 || e->fp_error) {
+            set_err(err, errlen, e->fp_error_msg);
+            return -1;
+        }
+        return 0;
+    }
+    if ((ret = fill_vframe(e->vframe, planes)) < 0) {
         set_err(err, errlen, "frame not writable");
         return -1;
-    }
-    const AVPixFmtDescriptor* desc = av_pix_fmt_desc_get((AVPixelFormat)e->vframe->format);
-    int nplanes = av_pix_fmt_count_planes((AVPixelFormat)e->vframe->format);
-    int bps = desc->comp[0].depth > 8 ? 2 : 1;
-    for (int p = 0; p < nplanes && p < 4; p++) {
-        if (!planes[p]) continue;
-        int is_chroma = (p == 1 || p == 2);
-        int ph = is_chroma ? AV_CEIL_RSHIFT(e->vframe->height, desc->log2_chroma_h)
-                           : e->vframe->height;
-        int row_bytes = plane_row_bytes(
-            (AVPixelFormat)e->vframe->format, e->vframe->width, p, desc, bps);
-        for (int y = 0; y < ph; y++) {
-            memcpy(e->vframe->data[p] + (size_t)y * e->vframe->linesize[p],
-                   planes[p] + (size_t)y * row_bytes, (size_t)row_bytes);
-        }
     }
     e->vframe->pts = e->vpts++;
     ret = avcodec_send_frame(e->venc, e->vframe);
@@ -996,8 +1260,32 @@ EXPORT int mp_encoder_write_audio(MPEncoder* e, const int16_t* samples, long n,
 EXPORT int mp_encoder_close(MPEncoder* e, char* err, int errlen) {
     int rc = 0;
     if (!e) return 0;
+    if (!e->fp_threads.empty()) {
+        // stop the pool: workers drain the queue, flush their contexts,
+        // and exit; then mux everything left in order on this thread
+        {
+            std::lock_guard<std::mutex> lk(e->fp_mu);
+            e->fp_stop = true;
+        }
+        e->fp_cv_work.notify_all();
+        for (auto& t : e->fp_threads) t.join();
+        e->fp_threads.clear();
+        {
+            std::unique_lock<std::mutex> lk(e->fp_mu);
+            if (fp_mux_ready_locked(e, lk) < 0) rc = -1;
+            // anything still parked is unreachable (a gap from a failed
+            // frame): free, never write out of order
+            for (auto& kv : e->fp_done)
+                for (auto* p : kv.second) av_packet_free(&p);
+            e->fp_done.clear();
+            if (e->fp_error) rc = -1;
+        }
+        for (auto*& c : e->fp_ctxs) avcodec_free_context(&c);
+        e->fp_ctxs.clear();
+    }
     if (e->header_written) {
-        // flush video
+        // flush video (fp mode: venc was never fed frames — its flush is
+        // an immediate EOF, harmless)
         avcodec_send_frame(e->venc, nullptr);
         if (enc_write_packets(e, e->venc, e->vstream) < 0) rc = -1;
         if (e->aenc) {
@@ -1040,7 +1328,10 @@ EXPORT int mp_encoder_close(MPEncoder* e, char* err, int errlen) {
     avcodec_free_context(&e->venc);
     if (e->aenc) avcodec_free_context(&e->aenc);
     avformat_free_context(e->fmt);
-    if (rc < 0) set_err(err, errlen, "failures while flushing encoder");
+    if (rc < 0)
+        set_err(err, errlen, e->fp_error_msg.empty()
+                                 ? "failures while flushing encoder"
+                                 : e->fp_error_msg);
     delete e;
     return rc;
 }

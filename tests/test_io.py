@@ -271,3 +271,33 @@ def test_nv12_semi_planar_rejected_loudly(tmp_path):
         w.write(np.zeros((48, 64), np.uint8), np.zeros((24, 64), np.uint8))
     with pytest.raises(medialib.MediaError, match="non-planar"):
         VideoReader(path)
+
+
+def test_ffv1_frame_parallel_ordering_stress(tmp_path):
+    """Native fp mode (pc_fp_workers): 120 frames through 4 worker
+    contexts, each frame's content IS its index — any mux reordering,
+    drop, or duplication shows up as a content mismatch. Also pins the
+    one-packet-per-frame property apply_stalling's packet scan relies on,
+    and that every frame is a keyframe (gop=1 independence)."""
+    from processing_chain_tpu.io.video import VideoReader, VideoWriter
+
+    path = str(tmp_path / "fp.avi")
+    h, w, n = 96, 128, 120
+    with VideoWriter(
+        path, "ffv1", w, h, "yuv420p", (30, 1), threads=1,
+        opts="level=3:coder=1:context=1:slicecrc=1:pc_fp_workers=4",
+    ) as wr:
+        rng = np.random.default_rng(5)
+        for i in range(n):
+            y = np.full((h, w), i % 256, np.uint8)
+            y[0:8] = rng.integers(0, 256, (8, w), np.uint8)  # defeat RLE ties
+            wr.write(y, np.full((h // 2, w // 2), 60, np.uint8),
+                     np.full((h // 2, w // 2), 200, np.uint8))
+    with VideoReader(path) as r:
+        frames = [f for f in r]
+    assert len(frames) == n
+    for i, f in enumerate(frames):
+        assert int(f.planes[0][-1, 0]) == i % 256, i
+    pk = medialib.scan_packets(path, "video")
+    assert len(pk["size"]) == n
+    assert all(int(k) == 1 for k in pk["key"]), "fp mode must be all-intra"
