@@ -178,6 +178,19 @@ def _dump_json_atomic(obj: dict, path: str) -> None:
     os.replace(tmp, path)
 
 
+def _hash_files(paths) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for path in sorted(paths):
+        try:
+            with open(path, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            pass
+    return h.hexdigest()[:16]
+
+
 def _compute_code_hash() -> str:
     """Hash of the device-path sources the measurement depends on; a live
     cache recorded under a different hash is rejected (it measured other
@@ -187,19 +200,26 @@ def _compute_code_hash() -> str:
     harness-plumbing edit here must not either; the measured math lives
     entirely in ops/ + parallel/)."""
     import glob
-    import hashlib
 
-    h = hashlib.sha256()
-    for path in sorted(
+    return _hash_files(
         glob.glob(os.path.join(_HERE, "processing_chain_tpu", "ops", "*.py"))
         + glob.glob(os.path.join(_HERE, "processing_chain_tpu", "parallel", "*.py"))
-    ):
-        try:
-            with open(path, "rb") as fh:
-                h.update(fh.read())
-        except OSError:
-            pass
-    return h.hexdigest()[:16]
+    )
+
+
+def _compute_e2e_code_hash() -> str:
+    """The e2e number depends on the WHOLE product path (decode, device
+    ops, prefetch engine, stage drivers, native boundary), so its cache
+    guard hashes every package source + media.cpp."""
+    import glob
+
+    return _hash_files(
+        glob.glob(
+            os.path.join(_HERE, "processing_chain_tpu", "**", "*.py"),
+            recursive=True,
+        )
+        + [os.path.join(_HERE, "processing_chain_tpu", "native", "media.cpp")]
+    )
 
 
 class _DeviceLock:
@@ -400,6 +420,278 @@ def _child() -> None:
     print(json.dumps(result))
 
 
+E2E_FRAMES = int(os.environ.get("BENCH_E2E_FRAMES", "96"))
+#: e2e live-TPU cache (separate from the kernel cache: broader code hash)
+E2E_LIVE_FILE = os.environ.get(
+    "PC_BENCH_E2E_LIVE_FILE", os.path.join(_HERE, "BENCH_E2E_LIVE.json")
+)
+
+
+def _e2e_db_yaml(db_id: str, seconds: int) -> str:
+    """BASELINE config 1's shape: one h264 960x540 PVS on a 1080p SRC,
+    pc post-processing at 1080p — so p03 is decode 540p -> device upscale
+    to the 1920x1080 canvas -> FFV1(+sidecar) writeback, the reference's
+    create_avpvs_short product path (lib/ffmpeg.py:940-1000)."""
+    return "\n".join([
+        f"databaseId: {db_id}",
+        "syntaxVersion: 6",
+        "type: short",
+        "qualityLevelList:",
+        "  Q0: {index: 0, videoCodec: h264, videoBitrate: 2500, "
+        "width: 960, height: 540, fps: 24}",
+        "codingList:",
+        "  VC01: {type: video, encoder: libx264, passes: 1, "
+        "iFrameInterval: 2, preset: ultrafast}",
+        "srcList:",
+        "  SRC000: SRC000.avi",
+        "hrcList:",
+        f"  HRC000: {{videoCodingId: VC01, eventList: [[Q0, {seconds}]]}}",
+        "pvsList:",
+        f"  - {db_id}_SRC000_HRC000",
+        "postProcessingList:",
+        "  - {type: pc, displayWidth: 1920, displayHeight: 1080, "
+        "codingWidth: 1920, codingHeight: 1080, displayFrameRate: 24}",
+    ]) + "\n"
+
+
+def _e2e_build_db(root: str, n_frames: int) -> str:
+    """Synthesize the SRC and run p01 once (untimed setup); returns the
+    database YAML path. Runs inside the measurement child."""
+    import numpy as np
+
+    from processing_chain_tpu.cli import main as cli_main
+    from processing_chain_tpu.io.video import VideoWriter
+
+    db_id = "P2SXM98"
+    seconds = max(1, n_frames // 24)
+    db = os.path.join(root, db_id)
+    os.makedirs(os.path.join(db, "srcVid"), exist_ok=True)
+    yaml_path = os.path.join(db, f"{db_id}.yaml")
+    with open(yaml_path, "w") as fh:
+        fh.write(_e2e_db_yaml(db_id, seconds))
+    rng = np.random.default_rng(0)
+    w, h = 1920, 1080
+    # moving gradient + noise: representative spatial/temporal complexity
+    # (pure noise over-costs x264; flat frames under-cost FFV1)
+    xx = np.arange(w, dtype=np.float32)[None, :]
+    yy = np.arange(h, dtype=np.float32)[:, None]
+    with VideoWriter(
+        os.path.join(db, "srcVid", "SRC000.avi"), "ffv1", w, h,
+        "yuv420p", (24, 1), threads=1,
+    ) as wr:
+        for i in range(seconds * 24):
+            y = ((np.sin((xx + 6 * i) / 37.0) + np.cos((yy - 3 * i) / 29.0))
+                 * 52 + 120).astype(np.uint8)
+            y[::7] += rng.integers(0, 13, (1, w), np.uint8)  # film grain row
+            u = np.full((h // 2, w // 2), 120, np.uint8)
+            v = ((y[::2, ::2] >> 2) + 90).astype(np.uint8)
+            wr.write(y, u, v)
+    rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
+    if rc != 0:
+        raise RuntimeError(f"e2e setup: p01 exited {rc}")
+    return yaml_path
+
+
+def _e2e_child() -> None:
+    """End-to-end p03 measurement: build the config-1 DB (untimed), run
+    the REAL p03 stage once for compile warmup, then time it. Prints one
+    JSON dict. Separate process for the same reason as _child: a wedged
+    tunnel blocks inside PJRT and only a kill recovers."""
+    import tempfile
+
+    force_cpu_backend_if_requested()
+    import jax
+
+    platform = jax.devices()[0].platform
+    from processing_chain_tpu.cli import main as cli_main
+
+    # CPU fallback exists only so a line is always emitted: shrink hard
+    # (per-frame fps is what's reported). The TPU run is also capped by
+    # default: the axon tunnel carries every decoded chunk up and every
+    # canvas chunk down, so a large n mostly measures tunnel bandwidth —
+    # raise BENCH_E2E_FRAMES on a host-attached deployment.
+    n = min(E2E_FRAMES, 48) if platform != "cpu" else min(E2E_FRAMES, 24)
+    out: dict = {"platform": platform}
+    with tempfile.TemporaryDirectory(prefix="pc_e2e_bench_") as root:
+        t0 = time.perf_counter()
+        yaml_path = _e2e_build_db(root, n)
+        n = max(1, n // 24) * 24  # what the DB actually holds
+        out["setup_s"] = round(time.perf_counter() - t0, 2)
+        out["n"] = n
+
+        def run_p03() -> float:
+            t0 = time.perf_counter()
+            rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements",
+                           "--force"])
+            if rc != 0:
+                raise RuntimeError(f"p03 exited {rc}")
+            return time.perf_counter() - t0
+
+        run_p03()  # warmup: jit compile + file caches
+        # one timed run (not best-of-N): the p03 product path is minutes
+        # of wall through the tunnel and the window is precious; the
+        # cache refreshes on every live window, so noise averages out
+        # across rounds
+        out["t_p03"] = run_p03()
+        # headline printed BEFORE optional extras (parent parses last
+        # full JSON line; a timeout mid-extra must not cost the number)
+        print(json.dumps(out), flush=True)
+
+        # the cheap-intermediate flag's measured value on this host
+        try:
+            # single run: only the writer changes, the jit cache is warm
+            os.environ["PC_AVPVS_CODEC"] = "rawvideo"
+            out["t_p03_raw"] = run_p03()
+        except Exception as exc:
+            out["raw_error"] = str(exc)[-200:]
+        finally:
+            os.environ.pop("PC_AVPVS_CODEC", None)
+
+        # reference-way single-core baseline on the SAME segment, when the
+        # parent asked for it (not yet pinned): decode h264 540p + swscale
+        # bicubic to the 1080p canvas + serial FFV1 writeback — exactly
+        # create_avpvs_short done the reference's way, minus our extra
+        # SI/TI sidecar (a handicap WE carry, not the baseline)
+        if os.environ.get("PC_E2E_NEED_BASELINE"):
+            try:
+                out.update(_e2e_measure_baseline(yaml_path))
+            except Exception as exc:
+                out["base_error"] = str(exc)[-200:]
+    print(json.dumps(out))
+
+
+def _e2e_measure_baseline(yaml_path: str) -> dict:
+    """Single-core reference-way p03 on the generated segment. Returns
+    {"base_core_fps", "base_n"}."""
+    import glob
+
+    import numpy as np
+
+    from processing_chain_tpu.io import medialib
+    from processing_chain_tpu.io.video import VideoReader, VideoWriter
+
+    segs = glob.glob(
+        os.path.join(os.path.dirname(yaml_path), "videoSegments", "*.mp4")
+    )
+    if not segs:
+        raise RuntimeError("no segment for e2e baseline")
+    out_path = segs[0] + ".base.avi"
+    done = 0
+    t0 = time.perf_counter()
+    try:
+        with VideoReader(segs[0]) as r, VideoWriter(
+            out_path, "ffv1", 1920, 1080, "yuv420p", (24, 1), threads=1,
+            opts="level=3:coder=1:context=1:slicecrc=1",
+        ) as wr:
+            for f in r:
+                y = medialib.sws_scale_plane(f.planes[0], 1920, 1080,
+                                             medialib.SWS_BICUBIC)
+                u = medialib.sws_scale_plane(f.planes[1], 960, 540,
+                                             medialib.SWS_BICUBIC)
+                v = medialib.sws_scale_plane(f.planes[2], 960, 540,
+                                             medialib.SWS_BICUBIC)
+                wr.write(y, u, v)
+                done += 1
+        dt = time.perf_counter() - t0
+    finally:
+        if os.path.isfile(out_path):
+            os.unlink(out_path)
+    return {"base_core_fps": done / dt, "base_n": done}
+
+
+def _e2e_flow(errors: list, try_tpu: bool) -> dict:
+    """The e2e measurement orchestration: TPU child -> cached live ->
+    CPU child, mirroring the kernel flow. try_tpu: this run already saw
+    the tunnel answer (a live kernel measurement), so an e2e TPU attempt
+    is worth the budget. Returns the e2e_* fields for the output line."""
+    pinned = _load_json(BASELINE_FILE) or {}
+    need_base = "e2e_baseline_8core_fps" not in pinned
+    env = {"PC_E2E_NEED_BASELINE": "1"} if need_base else {}
+
+    res = None
+    if try_tpu:
+        budget = _remaining() - 60
+        lock = _DeviceLock()
+        if budget >= 45 and lock.acquire(timeout_s=15):
+            try:
+                res, err = _run_child(
+                    dict(env, PC_BENCH_E2E_CHILD="1"), min(budget, 200)
+                )
+                if res is None:
+                    errors.append(f"e2e tpu: {err}")
+            finally:
+                lock.release()
+    code_hash = _compute_e2e_code_hash()
+    host_model = _host_fingerprint()["cpu_model"]
+    e2e_src = None
+    if res is not None and res.get("platform") == "tpu":
+        rec = dict(res, measured_at=_utcnow(), code_hash=code_hash,
+                   host_cpu_model=host_model)
+        try:
+            _dump_json_atomic(rec, E2E_LIVE_FILE)
+        except OSError:
+            pass
+    if res is None or res.get("platform") != "tpu":
+        cached = _load_json(E2E_LIVE_FILE)
+        if (cached is not None and cached.get("platform") == "tpu"
+                and cached.get("code_hash") == code_hash
+                and cached.get("host_cpu_model") == host_model):
+            res = cached
+            e2e_src = cached.get("measured_at", "unknown")
+        elif cached is not None:
+            errors.append("e2e live cache rejected: code_hash/host mismatch")
+    if res is None and _remaining() > 75:
+        res, err = _run_child(
+            dict(env, PC_BENCH_E2E_CHILD="1", JAX_PLATFORMS="cpu"),
+            min(_remaining() - 15, 240),
+        )
+        if res is None:
+            errors.append(f"e2e cpu: {err}")
+    if res is None:
+        return {"e2e_error": "no e2e measurement (see tpu_error)"}
+
+    out: dict = {
+        "e2e_platform": res["platform"],
+        "e2e_frames": res.get("n", 0),
+        "e2e_fps": round(res["n"] / res["t_p03"], 2),
+    }
+    if "t_p03_raw" in res:
+        out["e2e_rawvideo_fps"] = round(res["n"] / res["t_p03_raw"], 2)
+    if e2e_src:
+        out["e2e_source"] = "cached_live_run"
+        out["e2e_measured_at"] = e2e_src
+
+    # pin the reference-way baseline the first time it is measured
+    if "base_core_fps" in res and need_base:
+        pinned.setdefault("e2e_protocol", {
+            "content": "1080p FFV1 SRC -> x264 960x540 segment "
+                       "(ultrafast, 2.5 Mbps), then p03: decode + bicubic "
+                       "upscale to 1920x1080 + FFV1 level3 writeback",
+            "work_baseline": "single-thread decode + swscale bicubic x3 "
+                             "planes + serial FFV1 encode (no SI/TI - a "
+                             "handicap ours carries, the baseline doesn't)",
+            "model": "8 x single-core fps (reference parallelism model, "
+                     "as the kernel baseline)",
+            "frames": res.get("base_n", 0),
+        })
+        pinned["e2e_cpu_core_fps"] = round(res["base_core_fps"], 4)
+        pinned["e2e_baseline_8core_fps"] = round(8 * res["base_core_fps"], 4)
+        try:
+            _dump_json_atomic(pinned, BASELINE_FILE)
+        except OSError:
+            pass
+    base8 = pinned.get("e2e_baseline_8core_fps")
+    if base8:
+        out["e2e_baseline_8core_fps"] = round(float(base8), 2)
+        out["e2e_vs_baseline"] = round(out["e2e_fps"] / float(base8), 2)
+    base1 = pinned.get("e2e_cpu_core_fps")
+    if base1:
+        # equal-resource comparison: this run used ONE host core (+chip);
+        # the 8x model credits the reference with 8 (docs/PERF.md)
+        out["e2e_vs_baseline_1core"] = round(out["e2e_fps"] / float(base1), 2)
+    return out
+
+
 def _run_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
     """Run the measurement child; (parsed JSON, "") on success, else
     (None, diagnostic tail) so the caller can surface WHY it failed."""
@@ -589,9 +881,6 @@ def main() -> None:
         # measurement this bench persisted earlier (same host, same code)
         out["source"] = "cached_live_run"
         out["live_measured_at"] = live_used
-    if errors:
-        # env-down must be provable from the artifact alone
-        out["tpu_error"] = " | ".join(errors)[-600:]
     if "overlay_per_step" in res:
         # 4K spinner-overlay composite (BASELINE config 3's stalling
         # workload — the bufferer replacement); each step renders
@@ -628,12 +917,38 @@ def main() -> None:
                 banded.get("t", T) / banded["per_step"], 2
             )
 
+    # End-to-end p03 product path (VERDICT r4 #1): decode -> device ->
+    # FFV1 writeback on a real generated database through the real p03
+    # stage — the honest companion to the kernel headline above, with its
+    # own baseline and live cache. Disabled via PC_BENCH_NO_E2E for tests
+    # that pin the harness flow.
+    if not os.environ.get("PC_BENCH_NO_E2E"):
+        out.update(_e2e_flow(
+            errors,
+            try_tpu=res.get("platform") == "tpu" and live_used is None,
+        ))
+
+    if errors:
+        # env-down must be provable from the artifact alone
+        out["tpu_error"] = " | ".join(errors)[-600:]
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
-        _child()
+        if os.environ.get("PC_BENCH_E2E_CHILD"):
+            _e2e_child()
+        else:
+            _child()
+    elif "--e2e" in sys.argv:
+        # standalone e2e refresh (the watcher's live_extra hook): attempt
+        # the tunnel, persist/refresh BENCH_E2E_LIVE.json, print the
+        # e2e_* fields as one JSON line
+        _errors: list = []
+        _out = _e2e_flow(_errors, try_tpu=True)
+        if _errors:
+            _out["e2e_errors"] = " | ".join(_errors)[-400:]
+        print(json.dumps(_out))
     elif "--pin-baseline" in sys.argv:
         print(json.dumps(pin_baseline(), indent=1))
     else:

@@ -28,6 +28,10 @@ def _run_bench(tmp_path, extra_env, timeout=240):
         # synthetic cache/pinned artifacts, not the measurement
         "BENCH_FRAMES": "2",
         "BENCH_ITERS": "2",
+        # the e2e product-path flow has its own test (cache fallback
+        # below); a real CPU e2e run would add ~1 min to EVERY case here
+        "PC_BENCH_NO_E2E": "1",
+        "PC_BENCH_E2E_LIVE_FILE": str(tmp_path / "e2e_live.json"),
     })
     env.update(extra_env)
     proc = subprocess.run(
@@ -75,6 +79,39 @@ def test_cached_live_tpu_fallback(tmp_path):
     assert out["vs_baseline"] == 100.0
     assert out["baseline_source"] == "pinned"
     assert out["overlay_fps"] == 10000.0
+
+
+def test_e2e_cached_live_fallback(tmp_path):
+    """The e2e product-path flow mirrors the kernel cache discipline: a
+    harvest whose attempts can't reach the TPU reports the cached live
+    e2e capture (same e2e code hash, same host) with its own vs-baseline
+    fields, alongside the kernel line."""
+    bench = _bench_module()
+    host = bench._host_fingerprint()["cpu_model"]
+    (tmp_path / "live.json").write_text(json.dumps({
+        "per_step": 0.005, "platform": "tpu", "iters": 20, "t": 8,
+        "measured_at": "2026-07-30T00:00:00Z",
+        "code_hash": bench._compute_code_hash(), "host_cpu_model": host,
+    }))
+    (tmp_path / "e2e_live.json").write_text(json.dumps({
+        "platform": "tpu", "n": 48, "t_p03": 2.0, "t_p03_raw": 1.0,
+        "setup_s": 5.0, "measured_at": "2026-07-30T00:00:00Z",
+        "code_hash": bench._compute_e2e_code_hash(), "host_cpu_model": host,
+    }))
+    (tmp_path / "baseline.json").write_text(json.dumps({
+        "baseline_8core_fps": 16.0,
+        "e2e_cpu_core_fps": 12.0, "e2e_baseline_8core_fps": 96.0,
+        "protocol": {"frames_per_run": 8, "runs": 5, "stat": "median"},
+        "host": bench._host_fingerprint(),
+    }))
+    out = _run_bench(tmp_path, {"PC_BENCH_NO_E2E": ""})
+    assert out["source"] == "cached_live_run"
+    assert out["e2e_source"] == "cached_live_run"
+    assert out["e2e_platform"] == "tpu"
+    assert out["e2e_fps"] == 24.0           # 48 / 2.0
+    assert out["e2e_rawvideo_fps"] == 48.0  # 48 / 1.0
+    assert out["e2e_vs_baseline"] == 0.25   # 24 / 96
+    assert out["e2e_vs_baseline_1core"] == 2.0  # 24 / 12
 
 
 def test_cached_live_rejected_on_code_hash_mismatch(tmp_path):

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Session-provided extras for a live tunnel window (invoked by
+# tools/tpu_watch.sh after the headline bench + landing rehearsal):
+# refresh the END-TO-END p03 live capture (bench.py --e2e persists
+# BENCH_E2E_LIVE.json, the artifact the harvest's e2e_* fields fall back
+# to when its own attempts hit a wedged tunnel).
+set -u
+cd "$(dirname "$0")/.." || exit 1
+STATE_DIR="$HOME/.cache/pc_tpu_watch"
+mkdir -p "$STATE_DIR"
+BENCH_DEADLINE=420 timeout -s KILL 460 \
+    python bench.py --e2e > "$STATE_DIR/e2e_bench.json" 2>&1
