@@ -124,6 +124,42 @@ def _measure_baseline(n_frames: int, deadline_at: float | None = None) -> tuple[
     return done / (time.perf_counter() - t0), done
 
 
+def _measure_metrics_baseline(n_frames: int) -> tuple[float, int]:
+    """Single-core CPU PSNR+SSIM per 1080p frame pair — BASELINE config
+    4's workload done host-side (vectorized numpy + scipy separable
+    gaussian, the python analytics stack the reference uses for its own
+    in-python features, util/complexity_classification.py; its ffmpeg
+    C filters are the alternative but are not reachable as a library).
+    Returns (fps, frames_done)."""
+    from scipy.ndimage import convolve1d
+
+    rng = np.random.default_rng(0)
+    ref = rng.integers(0, 255, size=(H, W)).astype(np.float64)
+    deg = ref[:, ::-1] * 0.97 + 3.0
+    x = np.arange(11) - 5.0
+    g = np.exp(-(x * x) / (2 * 1.5 * 1.5))
+    g /= g.sum()
+    c1, c2 = (0.01 * 255) ** 2, (0.03 * 255) ** 2
+    t0 = time.perf_counter()
+    done = 0
+    for _ in range(n_frames):
+        _psnr = 10 * np.log10(255.0 ** 2 / max(np.mean((ref - deg) ** 2), 1e-12))
+        mu_r = convolve1d(convolve1d(ref, g, axis=0), g, axis=1)
+        mu_d = convolve1d(convolve1d(deg, g, axis=0), g, axis=1)
+        rr = convolve1d(convolve1d(ref * ref, g, axis=0), g, axis=1)
+        dd = convolve1d(convolve1d(deg * deg, g, axis=0), g, axis=1)
+        rd = convolve1d(convolve1d(ref * deg, g, axis=0), g, axis=1)
+        s_r = rr - mu_r * mu_r
+        s_d = dd - mu_d * mu_d
+        s_rd = rd - mu_r * mu_d
+        _ssim = np.mean(
+            ((2 * mu_r * mu_d + c1) * (2 * s_rd + c2))
+            / ((mu_r * mu_r + mu_d * mu_d + c1) * (s_r + s_d + c2))
+        )
+        done += 1
+    return done / (time.perf_counter() - t0), done
+
+
 def pin_baseline(runs: int = 5, frames: int = 8) -> dict:
     """Measure the pinned CPU baseline: median of `runs` independent
     single-core runs over `frames` pinned-content frames each, plus the
@@ -416,6 +452,75 @@ def _child() -> None:
             result["overlay_frames"] = plan.n_out  # played + inserted
         except Exception as exc:  # optional extra must never fail the child
             result["overlay_error"] = str(exc)[-200:]
+        # each extra lands incrementally: the parent takes the LAST
+        # complete line, so a window closing mid-extra keeps the rest
+        print(json.dumps(result), flush=True)
+
+        # per-frame PSNR+SSIM of 1080p pairs (BASELINE config 4's feature
+        # extraction: long-test AVPVS vs SRC quality metrics — the work
+        # the reference builds libvmaf for, done on the chip)
+        try:
+            from processing_chain_tpu.ops import metrics as mx
+
+            ref2 = (
+                jnp.arange(t * H * W, dtype=jnp.float32).reshape(t, H, W)
+                % 251.0
+            )
+            deg2 = jnp.flip(ref2, axis=2) * 0.97 + 3.0
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def mx_bench(a, b, n):
+                def body(c, _):
+                    p = mx.psnr_frames(a + c, b)
+                    s = mx.ssim_frames(a + c, b)
+                    tot = jnp.sum(p) + jnp.sum(s)
+                    return tot * 1e-20, tot
+                c, s = jax.lax.scan(body, jnp.float32(0), None, length=n)
+                return jnp.sum(s) + c
+
+            mx_iters = max(4, iters // 2)
+            float(mx_bench(ref2, deg2, mx_iters))
+            m_one = float("inf")
+            m_many = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(mx_bench(ref2, deg2, 1))
+                m_one = min(m_one, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                float(mx_bench(ref2, deg2, mx_iters))
+                m_many = min(m_many, time.perf_counter() - t0)
+            result["metrics_per_step"] = max(
+                (m_many - m_one) / (mx_iters - 1), 1e-9
+            )
+            result["metrics_frames"] = t
+        except Exception as exc:
+            result["metrics_error"] = str(exc)[-200:]
+        print(json.dumps(result), flush=True)
+
+        # PVS-batched step (BASELINE config 5's device shape): 4 lanes
+        # stacked into one resize+SI/TI launch, as parallel/p03_batch
+        # waves do — per-frame rate vs the t-frame headline shows the
+        # on-chip batching win (fewer launches, fuller tiles)
+        try:
+            rep = (4, 1, 1)
+            y4, u4, v4 = (jnp.tile(a, rep) for a in (y, u, v))
+            b_iters = max(2, iters // 4)
+            float(bench(y4, u4, v4, b_iters))
+            b_one = float("inf")
+            b_many = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                float(bench(y4, u4, v4, 1))
+                b_one = min(b_one, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                float(bench(y4, u4, v4, b_iters))
+                b_many = min(b_many, time.perf_counter() - t0)
+            result["batch_per_step"] = max(
+                (b_many - b_one) / (b_iters - 1), 1e-9
+            )
+            result["batch_frames"] = 4 * t
+        except Exception as exc:
+            result["batch_error"] = str(exc)[-200:]
 
     print(json.dumps(result))
 
@@ -887,6 +992,38 @@ def main() -> None:
         # played + inserted frames, so fps counts the plan's full output
         out["overlay_fps"] = round(
             res.get("overlay_frames", T) / res["overlay_per_step"], 2
+        )
+    if "metrics_per_step" in res:
+        # device PSNR+SSIM per 1080p pair (BASELINE config 4's feature
+        # extraction), against a pinned single-core numpy/scipy model x 8
+        out["metrics_fps"] = round(
+            res.get("metrics_frames", T) / res["metrics_per_step"], 2
+        )
+        mb8 = (pinned or {}).get("metrics_baseline_8core_fps")
+        if not mb8 and _remaining() > 25:
+            m_fps, m_done = _measure_metrics_baseline(6)
+            mb8 = 8.0 * m_fps
+            try:
+                art = _load_json(BASELINE_FILE) or {}
+                art["metrics_cpu_core_fps"] = round(m_fps, 4)
+                art["metrics_baseline_8core_fps"] = round(mb8, 4)
+                art.setdefault("metrics_protocol", {
+                    "work": "PSNR + single-scale SSIM (11-tap gaussian) "
+                            "per 1080p pair, float64 numpy/scipy, 1 core",
+                    "frames": m_done,
+                })
+                _dump_json_atomic(art, BASELINE_FILE)
+            except OSError:
+                pass
+        if mb8:
+            out["metrics_vs_baseline"] = round(
+                out["metrics_fps"] / float(mb8), 2
+            )
+    if "batch_per_step" in res:
+        # 4-lane PVS-batched step (BASELINE config 5's device shape via
+        # parallel/p03_batch waves): per-frame rate with fuller tiles
+        out["batch_fps"] = round(
+            res.get("batch_frames", 4 * T) / res["batch_per_step"], 2
         )
 
     # Optional: fused-Pallas vs banded method comparison (TPU only, when

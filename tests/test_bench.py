@@ -60,6 +60,8 @@ def test_cached_live_tpu_fallback(tmp_path):
     cache = {
         "per_step": 0.005, "platform": "tpu", "iters": 20, "t": 8,
         "overlay_per_step": 0.001, "overlay_frames": 10,
+        "metrics_per_step": 0.002, "metrics_frames": 8,
+        "batch_per_step": 0.016, "batch_frames": 32,
         "measured_at": "2026-07-30T00:00:00Z",
         "code_hash": bench._compute_code_hash(),
         "host_cpu_model": bench._host_fingerprint()["cpu_model"],
@@ -69,6 +71,7 @@ def test_cached_live_tpu_fallback(tmp_path):
     # vs_baseline must divide by the pinned number)
     (tmp_path / "baseline.json").write_text(json.dumps({
         "baseline_8core_fps": 16.0,
+        "metrics_baseline_8core_fps": 16.0,
         "protocol": {"frames_per_run": 8, "runs": 5, "stat": "median"},
         "host": bench._host_fingerprint(),
     }))
@@ -79,6 +82,10 @@ def test_cached_live_tpu_fallback(tmp_path):
     assert out["vs_baseline"] == 100.0
     assert out["baseline_source"] == "pinned"
     assert out["overlay_fps"] == 10000.0
+    # BASELINE configs 4/5 companions ride the same cache discipline
+    assert out["metrics_fps"] == 4000.0
+    assert out["metrics_vs_baseline"] == 250.0
+    assert out["batch_fps"] == 2000.0
 
 
 def test_e2e_cached_live_fallback(tmp_path):
