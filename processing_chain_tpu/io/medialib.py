@@ -184,11 +184,20 @@ def ensure_loaded() -> ct.CDLL:
         ]
         lib.mp_decoder_close.restype = None
         lib.mp_decoder_close.argtypes = [ct.c_void_p]
-        lib.mp_decode_audio_s16.restype = ct.c_long
-        lib.mp_decode_audio_s16.argtypes = [
-            ct.c_char_p, ct.c_double, ct.c_double, i16p, ct.c_long,
-            ct.POINTER(ct.c_int32), ct.POINTER(ct.c_int32), ct.c_char_p, ct.c_int,
-        ]
+        try:
+            lib.mp_decode_audio_s16_ch.restype = ct.c_long
+            lib.mp_decode_audio_s16_ch.argtypes = [
+                ct.c_char_p, ct.c_double, ct.c_double, ct.c_int, i16p,
+                ct.c_long, ct.POINTER(ct.c_int32), ct.POINTER(ct.c_int32),
+                ct.c_char_p, ct.c_int,
+            ]
+        except AttributeError as exc:
+            # a prebuilt .so from before this symbol existed: reject it
+            # loudly (the struct-size handshake can't see function ABI)
+            raise MediaError(
+                f"libpcmedia.so predates mp_decode_audio_s16_ch; rebuild "
+                f"with `make -B -C {_NATIVE_DIR}`"
+            ) from exc
         lib.mp_encoder_open.restype = ct.c_void_p
         lib.mp_encoder_open.argtypes = [
             ct.c_char_p, ct.c_char_p, ct.c_int, ct.c_int, ct.c_char_p,
@@ -442,21 +451,28 @@ def extract_ivf(path: str, out_path: str) -> None:
         raise MediaError(f"extract_ivf({path}): {err.value.decode()}")
 
 
-def decode_audio_s16(path: str, start: float = 0.0, duration: float = 0.0):
-    """Decode best audio stream to (samples[n, channels] int16, sample_rate)."""
+def decode_audio_s16(path: str, start: float = 0.0, duration: float = 0.0,
+                     channels: int = 0):
+    """Decode best audio stream to (samples[n, channels] int16, sample_rate).
+
+    channels > 0 remixes to that count inside libswresample with the
+    ffmpeg CLI's `-ac N` default matrix — e.g. channels=2 reproduces the
+    reference's stereo downmix (audio_mux `-ac 2`, lib/ffmpeg.py:1285)
+    exactly, 5.1 center/surround mixing and normalization included.
+    0 keeps the file's native layout."""
     lib = ensure_loaded()
     err = _err_buf()
     rate = ct.c_int32()
     chans = ct.c_int32()
-    n = lib.mp_decode_audio_s16(
-        path.encode(), start, duration, None, 0, ct.byref(rate),
+    n = lib.mp_decode_audio_s16_ch(
+        path.encode(), start, duration, channels, None, 0, ct.byref(rate),
         ct.byref(chans), err, 512,
     )
     if n < 0:
         raise MediaError(f"decode_audio({path}): {err.value.decode()}")
     buf = np.zeros((int(n), max(1, chans.value)), np.int16)
-    n2 = lib.mp_decode_audio_s16(
-        path.encode(), start, duration,
+    n2 = lib.mp_decode_audio_s16_ch(
+        path.encode(), start, duration, channels,
         buf.ctypes.data_as(ct.POINTER(ct.c_int16)), n,
         ct.byref(rate), ct.byref(chans), err, 512,
     )

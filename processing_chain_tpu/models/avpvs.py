@@ -188,17 +188,13 @@ def _short_rate_chunks(
     return rate, chunks
 
 
-def _to_stereo(samples: np.ndarray) -> np.ndarray:
-    """int16 [n] / [n, C] -> [n, 2]: mono duplicates, multichannel takes
-    the front pair — NEVER a reshape that flattens channels into the time
-    axis (that turns [n, 6] into 3x-duration noise)."""
-    if samples.ndim == 1:
-        samples = samples[:, None]
-    if samples.shape[1] == 1:
-        return np.repeat(samples, 2, axis=1)
-    if samples.shape[1] > 2:
-        return samples[:, :2]
-    return samples
+def _decode_stereo(path: str, start: float = 0.0, duration: float = 0.0):
+    """(samples[n, 2] int16, rate): decode with libswresample's stereo
+    remix — the ffmpeg `-ac 2` the reference applies in audio_mux
+    (lib/ffmpeg.py:1285), so a 5.1 SRC downmixes with the proper
+    center/surround matrix instead of the front-pair truncation the
+    round-4 advisor flagged; mono upmixes with ffmpeg's matrix too."""
+    return medialib.decode_audio_s16(path, start, duration, channels=2)
 
 
 def _short_segment_audio(seg):
@@ -206,7 +202,7 @@ def _short_segment_audio(seg):
     as FLAC (reference create_avpvs_short's bare `-i segment ... -c:a
     flac`, lib/ffmpeg.py:995). (samples, rate) or (None, rate)."""
     try:
-        samples, srate = medialib.decode_audio_s16(seg.file_path)
+        samples, srate = _decode_stereo(seg.file_path)
     except medialib.MediaError as exc:
         # no-audio-stream and decode-failure are one exception type; the
         # warning keeps a real failure from silently shipping an
@@ -217,7 +213,7 @@ def _short_segment_audio(seg):
         return None, 48000
     if samples.size == 0:
         return None, srate
-    return _to_stereo(samples), srate
+    return samples, srate
 
 
 def siti_sidecar_path(avpvs_path: str) -> str:
@@ -363,10 +359,7 @@ def create_avpvs_wo_buffer(
         else:
             rate = canvas_fps(pvs, avpvs_src_fps)
             total = float(sum(s.get_segment_duration() for s in pvs.segments))
-            samples, srate = medialib.decode_audio_s16(
-                pvs.src.file_path, 0.0, total
-            )
-            samples = _to_stereo(samples)
+            samples, srate = _decode_stereo(pvs.src.file_path, 0.0, total)
             with pf.AsyncWriter(
                 _ffv1_writer(
                     out_path, w, h, pix_fmt, rate, with_audio=True,
@@ -630,10 +623,8 @@ def create_avpvs_wo_buffer_batch(
                 total = float(
                     sum(s.get_segment_duration() for s in pvs.segments)
                 )
-                samples, srate = medialib.decode_audio_s16(
-                    pvs.src.file_path, 0.0, total
-                )
-                _write_wav(wav_tmp, _to_stereo(samples), srate)
+                samples, srate = _decode_stereo(pvs.src.file_path, 0.0, total)
+                _write_wav(wav_tmp, samples, srate)
                 medialib.remux(cat_tmp, out_path, audio_path=wav_tmp)
 
                 # stitch features: TI at each segment join diffs the next
@@ -691,6 +682,24 @@ def create_avpvs_wo_buffer_batch(
         output_path="",
         fn=run,
     )
+
+
+#: Versioned record of the bufferer-kinematics ASSUMPTIONS baked into
+#: every spinner-stalled AVPVS (VERDICT r4 #5). The upstream bufferer's
+#: pip source is unreachable from this offline environment, so these are
+#: pinned, not cited (ops/overlay.py header); they are calibratable from
+#: a real bufferer clip via tools/bufferer_calibrate. If calibration ever
+#: lands different constants, BUMP THE VERSION — artifacts rendered under
+#: the old assumptions are then identifiable from provenance logs alone.
+SPINNER_KINEMATICS = {
+    "version": 1,
+    "status": "ASSUMED",
+    "rps": 1.0,  # mirrors ops/overlay.plan_stalling's spinner_rps default
+    "direction": "clockwise",
+    "phase": "continuous-across-events",
+    "basis": "bufferer source unreachable offline; "
+             "calibrate with tools/bufferer_calibrate",
+}
 
 
 def load_spinner(path: str) -> np.ndarray:
@@ -758,7 +767,9 @@ def apply_stalling(
         audio = None
         srate = 48000
         try:
-            audio, srate = medialib.decode_audio_s16(in_path)
+            # the wo_buffer AVPVS is stereo by construction; channels=2
+            # just pins the writer contract against a surprise layout
+            audio, srate = _decode_stereo(in_path)
         except medialib.MediaError:
             audio = None
         if audio is not None and audio.size and not skipping:
@@ -864,13 +875,22 @@ def apply_stalling(
                     writer.put(fr.quantize_device([oy, ou, ovv], ten_bit))
         return out_path
 
+    lf = pvs.get_logfile_path()
+    prov = {
+        "pvs": pvs.pvs_id,
+        "mode": "skipping" if skipping else "spinner-stall",
+        "events": events,
+    }
+    if not skipping:
+        prov["spinner_kinematics"] = dict(
+            SPINNER_KINEMATICS, n_rotations=n_rotations
+        )
     return Job(
         label=f"stalling {pvs.pvs_id}",
         output_path=out_path,
         fn=run,
-        provenance={
-            "pvs": pvs.pvs_id,
-            "mode": "skipping" if skipping else "spinner-stall",
-            "events": events,
-        },
+        # own provenance file: the wo_buffer render already owns
+        # logs/<pvs>.log and a shared path would overwrite it
+        logfile_path=(lf[:-4] if lf.endswith(".log") else lf) + "_stalling.log",
+        provenance=prov,
     )

@@ -563,10 +563,18 @@ EXPORT void mp_decoder_close(MPDecoder* d) {
 // start_s+dur_s). Two-phase: call with buf == nullptr to get the required
 // sample count (per channel), then with a buffer of size
 // samples*channels*2 bytes. Returns samples (per channel) or < 0.
-EXPORT long mp_decode_audio_s16(const char* path, double start_s, double dur_s,
-                                int16_t* buf, long buf_samples,
-                                int32_t* sample_rate_out, int32_t* channels_out,
-                                char* err, int errlen) {
+//
+// out_channels > 0 remixes to that channel count's default layout INSIDE
+// libswresample — byte-for-byte the ffmpeg CLI's `-ac N` semantics (the
+// reference's stereo downmix in audio_mux, lib/ffmpeg.py:1285: `-ac 2`),
+// including its 5.1->stereo matrix and normalization. 0 keeps the native
+// layout. channels_out reports the OUTPUT channel count.
+EXPORT long mp_decode_audio_s16_ch(const char* path, double start_s,
+                                   double dur_s, int out_channels,
+                                   int16_t* buf, long buf_samples,
+                                   int32_t* sample_rate_out,
+                                   int32_t* channels_out, char* err,
+                                   int errlen) {
     AVFormatContext* fmt = nullptr;
     int ret = avformat_open_input(&fmt, path, nullptr, nullptr);
     if (ret < 0) {
@@ -593,14 +601,19 @@ EXPORT long mp_decode_audio_s16(const char* path, double start_s, double dur_s,
         avformat_close_input(&fmt);
         return -1;
     }
-    int channels = dec->ch_layout.nb_channels;
+    int channels = out_channels > 0 ? out_channels
+                                    : dec->ch_layout.nb_channels;
     int rate = dec->sample_rate;
     if (sample_rate_out) *sample_rate_out = rate;
     if (channels_out) *channels_out = channels;
 
     SwrContext* swr = nullptr;
     AVChannelLayout out_layout;
-    av_channel_layout_copy(&out_layout, &dec->ch_layout);
+    if (out_channels > 0) {
+        av_channel_layout_default(&out_layout, out_channels);
+    } else {
+        av_channel_layout_copy(&out_layout, &dec->ch_layout);
+    }
     ret = swr_alloc_set_opts2(&swr, &out_layout, AV_SAMPLE_FMT_S16, rate,
                               &dec->ch_layout, dec->sample_fmt, rate, 0, nullptr);
     if (ret < 0 || swr_init(swr) < 0) {
@@ -661,6 +674,15 @@ EXPORT long mp_decode_audio_s16(const char* path, double start_s, double dur_s,
     avcodec_free_context(&dec);
     avformat_close_input(&fmt);
     return total;
+}
+
+// Back-compat shim: native channel layout (out_channels = 0).
+EXPORT long mp_decode_audio_s16(const char* path, double start_s, double dur_s,
+                                int16_t* buf, long buf_samples,
+                                int32_t* sample_rate_out, int32_t* channels_out,
+                                char* err, int errlen) {
+    return mp_decode_audio_s16_ch(path, start_s, dur_s, 0, buf, buf_samples,
+                                  sample_rate_out, channels_out, err, errlen);
 }
 
 // ---------------------------------------------------------------------------

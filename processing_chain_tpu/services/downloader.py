@@ -543,6 +543,43 @@ class Downloader:
             log.warning("Protocol '%s' not available for video %s.", protocol, filename)
         return dl_file
 
+    def plan_capability(self, seg, force: bool = False) -> Optional[str]:
+        """Plan-time feasibility of producing this online segment in THIS
+        environment: None when a run can succeed, else an actionable
+        reason. The reference discovers these failures only at download
+        time, deep inside p01 (lib/downloader.py:306-326 yt-dlp import,
+        :734-740 Bitmovin wait) — here p00/p01 fail (or skip under -sos)
+        BEFORE any work runs, with the full affected-segment list."""
+        if not force and os.path.isfile(
+            os.path.join(self.video_segments_folder, seg.filename)
+        ):
+            return None  # already produced; plan is a no-op
+        if seg.video_coding.encoder.casefold() == "bitmovin":
+            if self.bitmovin_api is not None and self.store is not None:
+                return None
+            # resume levels 1-2 work without the SDK: existing chunks
+            audio = seg.quality_level.audio_bitrate is not None
+            if self._chunk_level(
+                seg.filename, seg.quality_level.video_codec, audio
+            ) > 0:
+                return None
+            if self.store is not None and str(
+                seg.quality_level.video_codec
+            ).casefold() in ("h264", "h265", "hevc", "avc"):
+                return None  # a finished cloud mp4 may still be fetchable
+            return (
+                "Bitmovin cloud encode needs bitmovin_settings/ credentials "
+                "+ the bitmovin-api-sdk (none configured) and no "
+                "local/remote chunks exist to resume from"
+            )
+        if self.youtube is None:
+            return (
+                "YouTube download needs yt-dlp (or youtube-dl), which is "
+                "not importable in this environment — pip install yt-dlp, "
+                "or re-run with -sos to skip online segments"
+            )
+        return None
+
     def init_download(self, seg, force: bool = False) -> Optional[str]:
         """Segment-level entry for p01 (reference init_download, :351-385):
         resolves the fps ladder spec against the SRC fps, then downloads."""

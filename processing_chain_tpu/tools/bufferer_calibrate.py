@@ -52,6 +52,44 @@ def _stall_spans(events: list, fps: float, n_in: int) -> list[tuple[int, int]]:
     return spans
 
 
+def estimate_spinner_kinematics(
+    frames: np.ndarray, fps: float
+) -> tuple[float, float, float]:
+    """(rps, phase0_rad, residual): like ops/overlay.estimate_spinner_rps
+    but also recovering the spinner's angular PHASE at the clip's first
+    frame — the quantity needed to verify phase continuity across stall
+    events (the third ASSUMED kinematic constant). Same
+    luminance-centroid method; phase0 is the linear fit's intercept,
+    wrapped to (-pi, pi]."""
+    t = frames.shape[0]
+    if t < 3:
+        raise ValueError("need at least 3 stall frames to estimate a rate")
+    h, w = frames.shape[1:]
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float64)
+    cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+    angles = np.empty(t)
+    for k, f in enumerate(np.asarray(frames, np.float64)):
+        wgt = np.clip(f - f.min(), 0, None)
+        s = wgt.sum()
+        if s <= 0:
+            raise ValueError(f"stall frame {k} is uniform; cannot locate spinner")
+        angles[k] = np.arctan2(
+            (wgt * yy).sum() / s - cy, (wgt * xx).sum() / s - cx
+        )
+    ang = np.unwrap(angles)
+    n = np.arange(t)
+    slope, intercept = np.polyfit(n, ang, 1)
+    resid = float(np.sqrt(np.mean((ang - (slope * n + intercept)) ** 2)))
+    phase0 = float((intercept + np.pi) % (2.0 * np.pi) - np.pi)
+    return float(slope * fps / (2.0 * np.pi)), phase0, resid
+
+
+def _wrapped_diff(a: float, b: float) -> float:
+    """|a - b| on the circle, in radians."""
+    d = (a - b + np.pi) % (2.0 * np.pi) - np.pi
+    return abs(float(d))
+
+
 def calibrate(
     rendered_path: str,
     events: list,
@@ -79,6 +117,7 @@ def calibrate(
         crop = min(h, w) // 2
     y0, x0 = (h - crop) // 2, (w - crop) // 2
     rates = []
+    fits = []  # (span, rps, phase0) per measurable event
     for (a, b), (t, d) in zip(spans, sorted(map(tuple, events))):
         seg = luma[a:b, y0: y0 + crop, x0: x0 + crop]
         # background blackness: corners of the full frame, away from the
@@ -91,11 +130,28 @@ def calibrate(
             "background_black": bool(np.median(corners) <= 20),
         }
         if b - a >= 3:
-            rps, resid = ov.estimate_spinner_rps(seg, fps)
+            rps, phase0, resid = estimate_spinner_kinematics(seg, fps)
             ev["spinner_rps"] = round(rps, 4)
+            ev["phase0_rad"] = round(phase0, 4)
             ev["fit_residual_rad"] = round(resid, 4)
             rates.append(rps)
+            fits.append(((a, b), rps, phase0))
         report["events"].append(ev)
+    if len(fits) >= 2:
+        # phase continuity (third ASSUMED constant): under our model the
+        # spinner advances only DURING stall frames, so event k+1's first
+        # frame continues one step past event k's last. Compare measured
+        # phase0 of each later event against the previous fit extrapolated
+        # by its stall-frame count, on the circle.
+        omega = 2.0 * np.pi * float(np.mean(rates)) / fps  # rad/frame
+        ok = True
+        deltas = []
+        for ((a1, b1), _r1, p1), ((_a2, _b2), _r2, p2) in zip(fits, fits[1:]):
+            expected = p1 + omega * (b1 - a1)
+            deltas.append(round(_wrapped_diff(p2, expected), 4))
+            ok = ok and deltas[-1] < 0.35  # ~1/18 rev tolerance
+        report["phase_continuity_deltas_rad"] = deltas
+        report["phase_continuous_across_events"] = bool(ok)
     if rates:
         report["spinner_rps_mean"] = round(float(np.mean(rates)), 4)
         report["spinner_direction"] = (

@@ -301,3 +301,43 @@ def test_ffv1_frame_parallel_ordering_stress(tmp_path):
     pk = medialib.scan_packets(path, "video")
     assert len(pk["size"]) == n
     assert all(int(k) == 1 for k in pk["key"]), "fp mode must be all-intra"
+
+
+def test_decode_audio_stereo_downmix_matches_ffmpeg_ac2(tmp_path):
+    """decode_audio_s16(channels=2) must reproduce ffmpeg's `-ac 2`
+    downmix (the reference's audio_mux, lib/ffmpeg.py:1285) via
+    libswresample: for 5.1 (FL FR FC LFE BL BR), L=(FL+.707FC+.707BL),
+    R=(FR+.707FC+.707BR), normalized by 2.414, LFE dropped — NOT the
+    front-pair truncation the round-4 advisor flagged."""
+    from processing_chain_tpu.io.video import VideoWriter
+
+    n = 4800
+    levels = [10000, -8000, 6000, 4000, 2000, -2000]  # FL FR FC LFE BL BR
+    aud = np.stack([np.full(n, v, np.int16) for v in levels], axis=1)
+    path = str(tmp_path / "five1.avi")
+    with VideoWriter(path, "rawvideo", 32, 32, "yuv420p", (24, 1),
+                     audio_codec="pcm_s16le", sample_rate=48000,
+                     channels=6) as w:
+        w.write_audio(aud)
+        for _ in range(3):
+            w.write(np.zeros((32, 32), np.uint8),
+                    np.zeros((16, 16), np.uint8),
+                    np.zeros((16, 16), np.uint8))
+
+    native, sr = medialib.decode_audio_s16(path)
+    assert native.shape[1] == 6 and sr == 48000
+    np.testing.assert_array_equal(native[100], levels)
+
+    st, sr = medialib.decode_audio_s16(path, channels=2)
+    assert st.shape == (n, 2)
+    norm = 1.0 + 0.70703125 + 0.70703125  # swr's q15-quantized 0.707
+    want_l = (10000 + 0.707 * 6000 + 0.707 * 2000) / norm
+    want_r = (-8000 + 0.707 * 6000 + 0.707 * -2000) / norm
+    assert abs(int(st[100, 0]) - want_l) < 40, (st[100, 0], want_l)
+    assert abs(int(st[100, 1]) - want_r) < 40, (st[100, 1], want_r)
+    # LFE must NOT leak: its 4000 would shift both by >1100 if mixed
+    assert abs(int(st[100, 0]) - want_l) < 100
+
+    # mono requests also route through swr's matrix (not duplication)
+    mono, _ = medialib.decode_audio_s16(path, channels=1)
+    assert mono.shape == (n, 1)

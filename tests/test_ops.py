@@ -726,6 +726,56 @@ def test_estimate_spinner_rps_recovers_known_rate():
         assert resid < 0.2
 
 
+def test_estimate_spinner_kinematics_recovers_perturbed_values():
+    """The phase-aware estimator (tools/bufferer_calibrate, VERDICT r4
+    #5) must recover PERTURBED kinematics, not just the shipped defaults:
+    off-grid rates, and the cross-event phase relationship implied by
+    'rotation advances only during stall frames'."""
+    from processing_chain_tpu.tools.bufferer_calibrate import (
+        _wrapped_diff,
+        estimate_spinner_kinematics,
+    )
+
+    # perturbed rates round-trip (the default is 1.0; none of these are)
+    for rps in (0.73, 1.7, 0.31):
+        luma, plan = _render_stalled_luma([[0.25, 1.0]], rps=rps)
+        a = int(np.argmax(plan.stall_mask))
+        b = a + int(plan.stall_mask[a:].sum())
+        crop = luma[a:b, 32:160, 32:160]
+        got, _phase, resid = estimate_spinner_kinematics(crop, 24.0)
+        assert abs(got - rps) < 0.08, (rps, got)
+        assert resid < 0.25
+
+    # two events: event 2's measured starting phase must continue event
+    # 1's fit by exactly its stall-frame count (phase frozen during play)
+    luma, plan = _render_stalled_luma(
+        [[0.25, 0.75], [0.75, 0.75]], n_in=36, rps=0.73
+    )
+    spans = []
+    k = 0
+    while k < plan.n_out:
+        if plan.stall_mask[k]:
+            j = k
+            while j < plan.n_out and plan.stall_mask[j]:
+                j += 1
+            spans.append((k, j))
+            k = j
+        else:
+            k += 1
+    assert len(spans) == 2, spans
+    fits = [
+        estimate_spinner_kinematics(luma[a:b, 32:160, 32:160], 24.0)
+        for a, b in spans
+    ]
+    omega = 2 * np.pi * 0.73 / 24.0
+    (a1, b1), (p1, p2) = spans[0], (fits[0][1], fits[1][1])
+    assert _wrapped_diff(p2, p1 + omega * (b1 - a1)) < 0.35
+    # and a deliberately WRONG continuity hypothesis fails the check:
+    # phase advancing through played frames too would land elsewhere
+    wrong = p1 + omega * (spans[1][0] - a1)
+    assert _wrapped_diff(p2, wrong) > 0.5
+
+
 def test_spinner_phase_continuous_across_events():
     """Pinned assumption, explicit: rotation does not reset between
     consecutive stall events."""

@@ -181,6 +181,21 @@ def test_p03_stalling(short_db):
     assert planes[0][55].mean() < planes[0][10].mean()
 
 
+def test_p03_stalling_provenance_records_assumed_kinematics(short_db):
+    """Every spinner-stalled AVPVS carries the versioned ASSUMED-constants
+    record (VERDICT r4 #5): if calibration ever replaces the spinner
+    kinematics, artifacts rendered under the old assumptions stay
+    identifiable from their provenance logs alone."""
+    db = os.path.dirname(short_db)
+    log = open(os.path.join(
+        db, "logs", "P2SXM90_SRC000_HRC002_stalling.log"
+    )).read()
+    assert "spinner_kinematics" in log
+    for needle in ('"version": 1', '"status": "ASSUMED"', '"rps": 1.0',
+                   '"direction": "clockwise"', '"n_rotations"'):
+        assert needle in log, needle
+
+
 def test_p04_cpvs(short_db):
     db = os.path.dirname(short_db)
     cp = os.path.join(db, "cpvs", "P2SXM90_SRC000_HRC000_PC.avi")
