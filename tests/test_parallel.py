@@ -295,9 +295,9 @@ def test_sharded_stall_renderer_skipping_mode(devices8):
 
 @pytest.mark.parametrize("n_procs", [
     2,
-    pytest.param(4, marks=pytest.mark.skipif(
+    pytest.param(4, marks=[pytest.mark.slow, pytest.mark.skipif(
         not os.environ.get("PC_SLOW_TESTS"),
-        reason="4-process cluster: set PC_SLOW_TESTS=1")),
+        reason="4-process cluster: set PC_SLOW_TESTS=1")]),
 ])
 def test_multiprocess_distributed_end_to_end(n_procs):
     """Real OS processes form a jax.distributed cluster (CPU transport)

@@ -309,6 +309,8 @@ def test_long_chain_cpvs_audio_normalized(long_db):
     assert -26.0 < rms_db < -20.0  # ~-23 dBFS RMS target
 
 
+@pytest.mark.slow  # ~17 s: a full -f60 re-render; the -z test (fast lane)
+# covers the same resample machinery at lower cost
 def test_p03_force_60_fps(short_db):
     """-f60 resamples the AVPVS canvas to 60 fps via the streaming fps
     filter: round(48/24*60)=120 frames, duplicates of the 24 fps content."""
