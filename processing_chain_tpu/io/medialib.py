@@ -101,6 +101,23 @@ def _build(force: bool = False) -> None:
     )
 
 
+def _build_or_raise(force: bool = False) -> None:
+    """_build with every failure mapped onto MediaError, so callers that
+    degrade on the documented exception type (`except MediaError`) never
+    see a raw FileNotFoundError/CalledProcessError from the loader."""
+    try:
+        _build(force)
+    except subprocess.CalledProcessError as exc:
+        raise MediaError(
+            f"native build failed:\n{(exc.stderr or str(exc))[-800:]}"
+        ) from exc
+    except OSError as exc:
+        raise MediaError(
+            f"native toolchain unavailable ({exc}) and no loadable "
+            f"libpcmedia.so at {_SO_PATH}"
+        ) from exc
+
+
 def ensure_loaded() -> ct.CDLL:
     global _lib
     with _lock:
@@ -132,9 +149,9 @@ def ensure_loaded() -> ct.CDLL:
                 except OSError:
                     pass
             if lib is None:
-                # nothing loadable: force a rebuild so the REAL build
-                # error (missing toolchain, compile failure) surfaces
-                _build(force=True)
+                # nothing loadable: retry the build so the REAL problem
+                # surfaces — as a MediaError, the loader's documented type
+                _build_or_raise(force=True)
         if lib is None:
             try:
                 lib = ct.CDLL(_SO_PATH)
@@ -142,7 +159,7 @@ def ensure_loaded() -> ct.CDLL:
                 # a stale or foreign-platform binary (e.g. a checkout moved
                 # between architectures): force a rebuild for THIS host once
                 # (-B: the broken .so may look up-to-date to make)
-                _build(force=True)
+                _build_or_raise(force=True)
                 lib = ct.CDLL(_SO_PATH)
         # ABI handshake: mtime-equal edge cases can survive the make; a
         # layout mismatch must fail loudly, never probe at the wrong stride

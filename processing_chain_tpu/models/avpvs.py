@@ -117,7 +117,11 @@ def ffv1_workers() -> int:
         try:
             return max(0, int(raw))
         except ValueError:
-            return 0
+            # loud, like PC_AVPVS_CODEC: a typo'd value silently running
+            # serial would erase the advertised scaling with no signal
+            raise ValueError(
+                f"PC_FFV1_WORKERS={raw!r}: expected an integer"
+            ) from None
     ncpu = os.cpu_count() or 1
     return 0 if ncpu <= 2 else min(ncpu - 1, 8)
 

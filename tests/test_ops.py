@@ -938,6 +938,7 @@ def test_resize_fused_10bit_matches_banded():
     np.testing.assert_array_equal(fused, banded)
 
 
+@pytest.mark.slow  # ~11 s randomized tail; fixed-geometry goldens stay fast
 def test_resize_golden_random_geometries():
     """Seeded random-geometry golden fuzz vs libswscale: the fixed-case
     goldens cover the headline ratios; this sweeps arbitrary even up/down
@@ -1019,6 +1020,7 @@ class TestNormalizeRmsOracle:
         assert normalize_rms(e).size == 0
 
 
+@pytest.mark.slow  # ~10 s; the single-scale SSIM golden stays fast
 def test_msssim_against_numpy_reference():
     """Device MS-SSIM vs an independent numpy implementation of
     Wang/Simoncelli/Bovik 2003 (5 dyadic scales, cs at every scale,

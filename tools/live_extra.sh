@@ -8,5 +8,8 @@ set -u
 cd "$(dirname "$0")/.." || exit 1
 STATE_DIR="$HOME/.cache/pc_tpu_watch"
 mkdir -p "$STATE_DIR"
+# stderr goes to the shared watch log: e2e_bench.json must stay pure
+# JSON (JAX/absl chatter would break a json.loads on the artifact)
 BENCH_DEADLINE=420 timeout -s KILL 460 \
-    python bench.py --e2e > "$STATE_DIR/e2e_bench.json" 2>&1
+    python bench.py --e2e > "$STATE_DIR/e2e_bench.json" \
+    2>> "$STATE_DIR/watch.log"
