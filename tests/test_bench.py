@@ -110,12 +110,14 @@ def test_e2e_cached_live_fallback(tmp_path):
     }))
     (tmp_path / "e2e_live.json").write_text(json.dumps({
         "platform": "tpu", "n": 48, "t_p03": 2.0, "t_p03_raw": 1.0,
+        "t_p03_long": 4.0, "long_n": 48, "t_qm": 0.5,
         "setup_s": 5.0, "measured_at": "2026-07-30T00:00:00Z",
         "code_hash": bench._compute_e2e_code_hash(), "host_cpu_model": host,
     }))
     (tmp_path / "baseline.json").write_text(json.dumps({
         "baseline_8core_fps": 16.0,
         "e2e_cpu_core_fps": 12.0, "e2e_baseline_8core_fps": 96.0,
+        "metrics_baseline_8core_fps": 16.0,
         "protocol": {"frames_per_run": 8, "runs": 5, "stat": "median"},
         "host": bench._host_fingerprint(),
     }))
@@ -127,6 +129,11 @@ def test_e2e_cached_live_fallback(tmp_path):
     assert out["e2e_rawvideo_fps"] == 48.0  # 48 / 1.0
     assert out["e2e_vs_baseline"] == 0.25   # 24 / 96
     assert out["e2e_vs_baseline_1core"] == 2.0  # 24 / 12
+    # config 4 companions (long product path + quality-metrics tool)
+    assert out["e2e_long_fps"] == 12.0      # 48 / 4.0
+    assert out["e2e_long_vs_baseline"] == 0.12
+    assert out["e2e_qm_fps"] == 96.0        # 48 / 0.5
+    assert out["e2e_qm_vs_baseline"] == 6.0
 
 
 def test_cached_live_rejected_on_code_hash_mismatch(tmp_path):
