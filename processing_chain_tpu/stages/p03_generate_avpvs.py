@@ -37,6 +37,15 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     spinner = getattr(cli_args, "spinner_path", None) or _DEFAULT_SPINNER
     avpvs_src_fps = getattr(cli_args, "avpvs_src_fps", False)
     force_60_fps = getattr(cli_args, "force_60_fps", False)
+    # writeback knobs: the flag (when given) takes precedence over the
+    # env, by becoming it — every model-layer consumer (single-device,
+    # batch, stalling) reads the env, so one mechanism serves both
+    ffv1_workers = getattr(cli_args, "ffv1_workers", None)
+    if ffv1_workers is not None:
+        os.environ["PC_FFV1_WORKERS"] = str(max(0, ffv1_workers))
+    avpvs_codec = getattr(cli_args, "avpvs_codec", None)
+    if avpvs_codec:
+        os.environ["PC_AVPVS_CODEC"] = avpvs_codec
     shard = local_shard(test_config.pvses)
     eligible = []
     for pvs_id, pvs in shard:

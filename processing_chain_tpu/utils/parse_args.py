@@ -89,6 +89,18 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
             "-f60", "--force-60-fps", action="store_true",
             help="pin the AVPVS frame rate at 60 fps regardless of the SRC",
         )
+        parser.add_argument(
+            "--ffv1-workers", default=None, type=int, metavar="N",
+            help="frame-parallel FFV1 writeback contexts (0 = serial "
+            "slice-threaded; default: PC_FFV1_WORKERS env, else one per "
+            "spare core)",
+        )
+        parser.add_argument(
+            "--avpvs-codec", default=None, choices=("ffv1", "rawvideo"),
+            help="AVPVS intermediate codec (default: PC_AVPVS_CODEC env, "
+            "else ffv1; rawvideo trades ~6x disk for near-memcpy "
+            "writeback and is recorded in provenance)",
+        )
     if script == 4:
         parser.add_argument(
             "-e", "--lightweight-preview", action="store_true",

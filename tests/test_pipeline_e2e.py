@@ -910,6 +910,14 @@ def test_p03_ffv1_frame_parallel_and_rawvideo_intermediate(tmp_path, monkeypatch
     prov_path = os.path.join(db, "logs", "P2SXM84_SRC000_HRC000.log")
     assert "rawvideo" in open(prov_path).read()
 
+    # CLI flags are a first-class route to the same knobs and take
+    # precedence over the env (flag becomes the env inside the stage)
+    monkeypatch.setenv("PC_AVPVS_CODEC", "ffv1")
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements", "--force",
+                   "--avpvs-codec", "rawvideo", "--ffv1-workers", "0"])
+    assert rc == 0
+    assert medialib.probe(av)["streams"][0]["codec_name"] == "rawvideo"
+
     monkeypatch.setenv("PC_AVPVS_CODEC", "bogus")
     with pytest.raises(Exception):
         render()
