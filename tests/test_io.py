@@ -341,3 +341,36 @@ def test_decode_audio_stereo_downmix_matches_ffmpeg_ac2(tmp_path):
     # mono requests also route through swr's matrix (not duplication)
     mono, _ = medialib.decode_audio_s16(path, channels=1)
     assert mono.shape == (n, 1)
+
+
+def test_ffv1_frame_parallel_randomized_configs(tmp_path):
+    """Seeded sweep over fp-pool geometry: worker counts around the
+    frame count (more workers than frames, one worker, prime counts),
+    tiny and non-square dims — order/content exactness in every combo."""
+    from processing_chain_tpu.io.video import VideoReader, VideoWriter
+
+    rng = np.random.default_rng(11)
+    for case, (workers, n) in enumerate(
+        [(1, 7), (5, 3), (3, 31), (7, 16)]
+    ):
+        h = int(rng.choice([32, 48, 96]))
+        w = int(rng.choice([48, 64, 112]))
+        path = str(tmp_path / f"fp{case}.avi")
+        frames = []
+        with VideoWriter(
+            path, "ffv1", w, h, "yuv420p", (24, 1), threads=1,
+            opts=f"level=3:coder=1:slicecrc=1:pc_fp_workers={workers}",
+        ) as wr:
+            for _ in range(n):
+                y = rng.integers(0, 256, (h, w), np.uint8)
+                u = rng.integers(0, 256, (h // 2, w // 2), np.uint8)
+                v = rng.integers(0, 256, (h // 2, w // 2), np.uint8)
+                frames.append((y, u, v))
+                wr.write(y, u, v)
+        with VideoReader(path) as r:
+            got = [f for f in r]
+        assert len(got) == n, (case, len(got))
+        for k, (f, (y, u, v)) in enumerate(zip(got, frames)):
+            assert np.array_equal(f.planes[0], y), (case, k)
+            assert np.array_equal(f.planes[1], u), (case, k)
+            assert np.array_equal(f.planes[2], v), (case, k)
