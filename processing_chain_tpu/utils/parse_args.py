@@ -79,7 +79,10 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
     if script == 3:
         parser.add_argument(
             "-s", "--spinner-path", default=_DEFAULT_SPINNER,
-            help="PNG composited (rotating) over stall frames",
+            help="PNG composited (rotating) over stall frames; an "
+            "alternative 12-spoke spinner ships as "
+            "assets/spinner-spokes-128.png (the reference's util/5.png "
+            "analog)",
         )
         parser.add_argument(
             "-z", "--avpvs-src-fps", action="store_true",
