@@ -256,3 +256,35 @@ def test_busy_lock_degrades_to_cpu_with_diagnostics(tmp_path):
     assert out["value"] > 0
     assert "device lock busy" in out.get("tpu_error", "")
     assert out["baseline_source"].startswith("pinned")
+
+
+def test_e2e_db_builders_produce_runnable_databases(tmp_path):
+    """Guards the e2e bench's database builders against bitrot: both the
+    short (config 1) and long (config 4) builders must produce databases
+    p01 actually encodes (segments on disk) — otherwise the e2e fields
+    silently vanish from the driver line behind e2e_error."""
+    import glob
+
+    bench = _bench_module()
+    short_yaml = bench._e2e_build_db(str(tmp_path / "s"), 24)
+    segs = glob.glob(os.path.join(
+        os.path.dirname(short_yaml), "videoSegments", "*.mp4"))
+    assert len(segs) == 1 and os.path.getsize(segs[0]) > 10_000
+
+    long_yaml = bench._e2e_build_long_db(str(tmp_path / "l"), 48)
+    segs = glob.glob(os.path.join(
+        os.path.dirname(long_yaml), "videoSegments", "*.mp4"))
+    assert len(segs) == 1 and os.path.getsize(segs[0]) > 10_000
+
+
+def test_fp_bench_tool_smoke(tmp_path):
+    """tools/fp_bench.py runs and reports a fps per worker setting."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fp_bench.py"),
+         "--frames", "6", "--size", "320x180", "--workers", "0,2"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert set(out["results"]) == {"0", "2"}
+    assert all(v > 0 for v in out["results"].values())

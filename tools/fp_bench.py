@@ -1,0 +1,77 @@
+"""FFV1 writeback scaling harness: measure frames/s of the AVPVS
+writeback at several PC_FFV1_WORKERS settings ON THIS HOST.
+
+The frame-parallel encoder (native/media.cpp fp mode) scales with cores;
+this tool produces the host-capability evidence — run it on a deployment
+host to pick a worker count (and to verify the pool pays for itself
+there). On a 1-core host the curve is flat by physics; the tool prints
+it anyway, honestly.
+
+Usage: python tools/fp_bench.py [--frames N] [--size WxH] [--workers 0,1,2,4,8]
+Prints one JSON line: {"host_cores", "frames", "size", "results": {workers: fps}}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(workers: int, frames, w: int, h: int, tmpdir: str) -> float:
+    from processing_chain_tpu.io.video import VideoWriter
+
+    opts = "level=3:coder=1:context=1:slicecrc=1"
+    threads = 4 if workers == 0 else 1  # serial keeps the reference's -threads 4
+    if workers > 0:
+        opts += f":pc_fp_workers={workers}"
+    path = os.path.join(tmpdir, f"fp{workers}.avi")
+    t0 = time.perf_counter()
+    with VideoWriter(path, "ffv1", w, h, "yuv420p", (24, 1),
+                     threads=threads, opts=opts) as wr:
+        for y, u, v in frames:
+            wr.write(y, u, v)
+    dt = time.perf_counter() - t0
+    os.unlink(path)
+    return len(frames) / dt
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--frames", type=int, default=48)
+    ap.add_argument("--size", default="1920x1080")
+    ap.add_argument("--workers", default="0,1,2,4,8")
+    args = ap.parse_args(argv)
+    w, h = (int(x) for x in args.size.split("x"))
+    rng = np.random.default_rng(0)
+    xx = np.arange(w, dtype=np.float32)[None, :]
+    yy = np.arange(h, dtype=np.float32)[:, None]
+    frames = []
+    for i in range(args.frames):
+        y = ((np.sin((xx + 6 * i) / 37.0) + np.cos((yy - 3 * i) / 29.0))
+             * 52 + 120).astype(np.uint8)
+        y[::7] += rng.integers(0, 13, (1, w), np.uint8)
+        frames.append((y, np.full((h // 2, w // 2), 120, np.uint8),
+                       ((y[::2, ::2] >> 2) + 90).astype(np.uint8)))
+    results = {}
+    with tempfile.TemporaryDirectory(prefix="pc_fp_bench_") as tmpdir:
+        for wk in (int(x) for x in args.workers.split(",")):
+            results[str(wk)] = round(measure(wk, frames, w, h, tmpdir), 2)
+            print(f"workers={wk}: {results[str(wk)]} f/s",
+                  file=sys.stderr, flush=True)
+    print(json.dumps({
+        "host_cores": os.cpu_count(), "frames": args.frames,
+        "size": args.size, "results": results,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
