@@ -1373,3 +1373,28 @@ def test_cpvs_t_cap_frames_ffmpeg_semantics():
     # not ceil(3.0000000000000004) = 4
     assert t_cap_frames(0.1 + 0.2, Fraction(10)) == 3
     assert t_cap_frames(sum([1.1] * 2), Fraction(25)) == 55
+
+
+def test_p03_stalling_under_rawvideo_intermediate(tmp_path, monkeypatch):
+    """The bufferer pass must survive the cheap-intermediate flag end to
+    end: a rawvideo wo_buffer AVPVS in, a rawvideo stalled AVPVS out,
+    with the planned frame insertion intact."""
+    yaml_text = minimal_short_yaml("P2SXM85").replace(
+        "eventList: [[Q0, 2]]", "eventList: [[Q0, 2], [stall, 0.5]]"
+    )
+    yaml_path = write_db(tmp_path, "P2SXM85", yaml_text,
+                         {"SRC000.avi": dict(n=48)})
+    monkeypatch.setenv("PC_AVPVS_CODEC", "rawvideo")
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "13",
+                   "--skip-requirements"])
+    assert rc == 0
+    db = os.path.dirname(yaml_path)
+    stalled = os.path.join(db, "avpvs", "P2SXM85_SRC000_HRC000.avi")
+    wo = os.path.join(db, "avpvs",
+                      "P2SXM85_SRC000_HRC000_concat_wo_buffer.avi")
+    for p in (stalled, wo):
+        assert medialib.probe(p)["streams"][0]["codec_name"] == "rawvideo", p
+    with VideoReader(stalled) as r:
+        planes, _ = r.read_all()
+    assert planes[0].shape[0] == 48 + 12  # + round(0.5 s * 24 fps)
+    assert planes[0][55].mean() < planes[0][10].mean()  # stall is dark
