@@ -474,7 +474,7 @@ def decode_audio_s16(path: str, start: float = 0.0, duration: float = 0.0,
 
     channels > 0 remixes to that count inside libswresample with the
     ffmpeg CLI's `-ac N` default matrix — e.g. channels=2 reproduces the
-    reference's stereo downmix (audio_mux `-ac 2`, lib/ffmpeg.py:1285)
+    reference's stereo downmix (audio_mux `-ac 2`, lib/ffmpeg.py:1284)
     exactly, 5.1 center/surround mixing and normalization included.
     0 keeps the file's native layout."""
     lib = ensure_loaded()

@@ -195,7 +195,7 @@ def _short_rate_chunks(
 def _decode_stereo(path: str, start: float = 0.0, duration: float = 0.0):
     """(samples[n, 2] int16, rate): decode with libswresample's stereo
     remix — the ffmpeg `-ac 2` the reference applies in audio_mux
-    (lib/ffmpeg.py:1285), so a 5.1 SRC downmixes with the proper
+    (lib/ffmpeg.py:1284), so a 5.1 SRC downmixes with the proper
     center/surround matrix instead of the front-pair truncation the
     round-4 advisor flagged; mono upmixes with ffmpeg's matrix too."""
     return medialib.decode_audio_s16(path, start, duration, channels=2)

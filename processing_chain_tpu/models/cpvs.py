@@ -168,7 +168,8 @@ def t_cap_frames(t: float, rate: Fraction) -> int:
     t*fps when the product lands on an integer.
 
     `t` is quantized the way the value reaches ffmpeg in the reference
-    (`-t str(t)` at lib/ffmpeg.py:1203-1213): Python's shortest-repr
+    (`-t {total_duration}` at lib/ffmpeg.py:1191 pc / :1221 mobile):
+    Python's shortest-repr
     decimal, parsed by ffmpeg at microsecond precision — NOT the raw
     binary float (Fraction(0.1+0.2) would carry the 4e-17 fuzz across
     the ceil and emit one extra frame when t*fps lands on an integer)."""

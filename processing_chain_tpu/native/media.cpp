@@ -566,7 +566,7 @@ EXPORT void mp_decoder_close(MPDecoder* d) {
 //
 // out_channels > 0 remixes to that channel count's default layout INSIDE
 // libswresample — byte-for-byte the ffmpeg CLI's `-ac N` semantics (the
-// reference's stereo downmix in audio_mux, lib/ffmpeg.py:1285: `-ac 2`),
+// reference's stereo downmix in audio_mux, lib/ffmpeg.py:1284: `-ac 2`),
 // including its 5.1->stereo matrix and normalization. 0 keeps the native
 // layout. channels_out reports the OUTPUT channel count.
 EXPORT long mp_decode_audio_s16_ch(const char* path, double start_s,

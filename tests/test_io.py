@@ -305,7 +305,7 @@ def test_ffv1_frame_parallel_ordering_stress(tmp_path):
 
 def test_decode_audio_stereo_downmix_matches_ffmpeg_ac2(tmp_path):
     """decode_audio_s16(channels=2) must reproduce ffmpeg's `-ac 2`
-    downmix (the reference's audio_mux, lib/ffmpeg.py:1285) via
+    downmix (the reference's audio_mux, lib/ffmpeg.py:1284) via
     libswresample: for 5.1 (FL FR FC LFE BL BR), L=(FL+.707FC+.707BL),
     R=(FR+.707FC+.707BR), normalized by 2.414, LFE dropped — NOT the
     front-pair truncation the round-4 advisor flagged."""
