@@ -108,10 +108,12 @@ def ffv1_workers() -> int:
     """Frame-parallel FFV1 encoder contexts (native/media.cpp fp mode).
     PC_FFV1_WORKERS=N pins it; default: one worker per spare core, capped
     at 8 (0 on a 1-2 core host — the pool only adds queue overhead when
-    there is no core for it to run on). FFV1 is intra-only, so frames
-    encode independently on private contexts and scale with cores where
-    slice threading (the reference's `-threads 4`, lib/ffmpeg.py:1047)
-    tops out at slices-per-frame."""
+    there is no core for it to run on). The p03 stage refines the default
+    to (spare cores)/(job-pool width) so `-p` runs don't oversubscribe
+    (stages/p03_generate_avpvs). FFV1 is intra-only, so frames encode
+    independently on private contexts and scale with cores where slice
+    threading (the reference's `-threads 4`, lib/ffmpeg.py:1047) tops
+    out at slices-per-frame."""
     raw = os.environ.get("PC_FFV1_WORKERS", "").strip()
     if raw:
         try:

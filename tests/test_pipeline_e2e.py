@@ -1398,3 +1398,26 @@ def test_p03_stalling_under_rawvideo_intermediate(tmp_path, monkeypatch):
         planes, _ = r.read_all()
     assert planes[0].shape[0] == 48 + 12  # + round(0.5 s * 24 fps)
     assert planes[0][55].mean() < planes[0][10].mean()  # stall is dark
+
+
+def test_p03_fp_worker_pool_aware_default(tmp_path, monkeypatch):
+    """The auto fp-worker default divides spare cores across the `-p`
+    job pool (4 jobs x (cores-1) contexts would oversubscribe); explicit
+    env or flag values are never overridden."""
+    yaml_path = write_db(tmp_path, "P2SXM86", minimal_short_yaml("P2SXM86"),
+                         {"SRC000.avi": dict(n=24)})
+    rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+
+    monkeypatch.delenv("PC_FFV1_WORKERS", raising=False)
+    monkeypatch.setattr(os, "cpu_count", lambda: 9)
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements", "--force",
+                   "-p", "2"])
+    assert rc == 0
+    assert os.environ["PC_FFV1_WORKERS"] == "4"  # (9-1) // 2
+
+    monkeypatch.setenv("PC_FFV1_WORKERS", "1")
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements", "--force",
+                   "-p", "2"])
+    assert rc == 0
+    assert os.environ["PC_FFV1_WORKERS"] == "1"  # env respected
