@@ -10,7 +10,6 @@ database folder layout and the processingchain_defaults.yaml override file.
 from __future__ import annotations
 
 import os
-import re
 from pathlib import Path
 from typing import Any, Optional
 

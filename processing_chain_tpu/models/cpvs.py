@@ -13,7 +13,6 @@ ffmpeg-normalize step, lib/ffmpeg.py:1233-1245) applied in-process.
 from __future__ import annotations
 
 import math
-import os
 from fractions import Fraction
 from typing import Optional
 
