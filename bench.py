@@ -325,6 +325,32 @@ def force_cpu_backend_if_requested() -> bool:
     return True
 
 
+def _min_marginal_per_step(run_fn, many: int, reps: int = 3) -> float:
+    """Best-of-`reps` marginal per-step time of `run_fn(n_steps)`: warm
+    both step counts (separate jit compiles), then minimize the 1-step
+    and `many`-step wall times INDEPENDENTLY — a min over paired
+    differences would cherry-pick a (fast many, slow one) pairing and
+    overstate throughput; both minima estimate the interference-free
+    mode of the same fixed-overhead + k-steps quantity, so their
+    difference is the unbiased marginal cost of many-1 steps."""
+    run_fn(many)
+    if many > 1:
+        run_fn(1)
+    t_one = float("inf")
+    t_many = float("inf")
+    for _ in range(reps):
+        if many > 1:
+            t0 = time.perf_counter()
+            run_fn(1)
+            t_one = min(t_one, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_fn(many)
+        t_many = min(t_many, time.perf_counter() - t0)
+    if many <= 1:
+        return max(t_many, 1e-9)
+    return max((t_many - t_one) / (many - 1), 1e-9)
+
+
 def _child() -> None:
     """Device measurement; prints one JSON dict {"per_step", "platform"}.
 
@@ -372,27 +398,12 @@ def _child() -> None:
         float(bench(y, u, v, iters))
         per_step = (time.perf_counter() - t0) / iters
     else:
-        # best-of-3: repeated measurements on this chip are bimodal
+        # best-of-5: repeated measurements on this chip are bimodal
         # (~2x spread from tunnel/tenant interference and power-state
-        # ramp); the minimum is the chip's actual steady-state throughput.
-        # Minimize t_one and t_many INDEPENDENTLY before subtracting: a
-        # min over paired differences would cherry-pick a (fast t_many,
-        # slow t_one) pairing and overstate throughput — both minima
-        # represent the interference-free mode of the same fixed
-        # dispatch-overhead + k-steps quantity, so their difference is
-        # the unbiased marginal cost of iters-1 steps.
-        float(bench(y, u, v, 1))
-        t_one = float("inf")
-        t_many = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
-            float(bench(y, u, v, 1))
-            t_one = min(t_one, time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            float(bench(y, u, v, iters))
-            t_many = min(t_many, time.perf_counter() - t0)
-        per_step = (
-            max((t_many - t_one) / (iters - 1), 1e-9) if iters > 1 else t_many
+        # ramp); the minimum is the chip's actual steady-state throughput
+        # (methodology in _min_marginal_per_step)
+        per_step = _min_marginal_per_step(
+            lambda k: float(bench(y, u, v, k)), iters, reps=5
         )
 
     result = {"per_step": per_step, "platform": platform, "iters": iters, "t": t}
@@ -436,18 +447,8 @@ def _child() -> None:
 
         ov_iters = max(4, iters // 2)
         try:
-            float(ov_bench(frames4k, ov_iters))
-            o_one = float("inf")
-            o_many = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                float(ov_bench(frames4k, 1))
-                o_one = min(o_one, time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                float(ov_bench(frames4k, ov_iters))
-                o_many = min(o_many, time.perf_counter() - t0)
-            result["overlay_per_step"] = max(
-                (o_many - o_one) / (ov_iters - 1), 1e-9
+            result["overlay_per_step"] = _min_marginal_per_step(
+                lambda k: float(ov_bench(frames4k, k)), ov_iters
             )
             result["overlay_frames"] = plan.n_out  # played + inserted
         except Exception as exc:  # optional extra must never fail the child
@@ -479,18 +480,8 @@ def _child() -> None:
                 return jnp.sum(s) + c
 
             mx_iters = max(4, iters // 2)
-            float(mx_bench(ref2, deg2, mx_iters))
-            m_one = float("inf")
-            m_many = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                float(mx_bench(ref2, deg2, 1))
-                m_one = min(m_one, time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                float(mx_bench(ref2, deg2, mx_iters))
-                m_many = min(m_many, time.perf_counter() - t0)
-            result["metrics_per_step"] = max(
-                (m_many - m_one) / (mx_iters - 1), 1e-9
+            result["metrics_per_step"] = _min_marginal_per_step(
+                lambda k: float(mx_bench(ref2, deg2, k)), mx_iters
             )
             result["metrics_frames"] = t
         except Exception as exc:
@@ -505,18 +496,8 @@ def _child() -> None:
             rep = (4, 1, 1)
             y4, u4, v4 = (jnp.tile(a, rep) for a in (y, u, v))
             b_iters = max(2, iters // 4)
-            float(bench(y4, u4, v4, b_iters))
-            b_one = float("inf")
-            b_many = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                float(bench(y4, u4, v4, 1))
-                b_one = min(b_one, time.perf_counter() - t0)
-                t0 = time.perf_counter()
-                float(bench(y4, u4, v4, b_iters))
-                b_many = min(b_many, time.perf_counter() - t0)
-            result["batch_per_step"] = max(
-                (b_many - b_one) / (b_iters - 1), 1e-9
+            result["batch_per_step"] = _min_marginal_per_step(
+                lambda k: float(bench(y4, u4, v4, k)), b_iters
             )
             result["batch_frames"] = 4 * t
         except Exception as exc:
