@@ -623,7 +623,9 @@ def _e2e_write_src(path: str, seconds: int, audio: bool = False) -> None:
             wr.write(y, u, v)
 
 
-def _e2e_build_long_db(root: str, n_frames: int) -> str:
+def _e2e_build_long_db(root: str, n_frames: int) -> tuple[str, int]:
+    """Returns (yaml path, canvas frame count) — the count is derived
+    here, once, from the whole-2s-segment rounding."""
     from processing_chain_tpu.cli import main as cli_main
 
     db_id = "P2LXM98"
@@ -638,7 +640,7 @@ def _e2e_build_long_db(root: str, n_frames: int) -> str:
     rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
     if rc != 0:
         raise RuntimeError(f"e2e long setup: p01 exited {rc}")
-    return yaml_path
+    return yaml_path, seconds * 24
 
 
 def _e2e_child() -> None:
@@ -716,14 +718,13 @@ def _e2e_child() -> None:
         # harvest budget is tight there and the phase is device-weighted.
         if platform != "cpu" or os.environ.get("PC_BENCH_E2E_LONG"):
             try:
-                long_yaml = _e2e_build_long_db(root, n)
+                long_yaml, out["long_n"] = _e2e_build_long_db(root, n)
                 t0 = time.perf_counter()
                 rc = cli_main(["p03", "-c", long_yaml,
                                "--skip-requirements", "--force", "-z"])
                 if rc != 0:
                     raise RuntimeError(f"long p03 exited {rc}")
                 out["t_p03_long"] = time.perf_counter() - t0
-                out["long_n"] = max(2, (n // 48) * 2) * 24
                 t0 = time.perf_counter()
                 rc = cli_main(["tools", "metrics", "-c", long_yaml])
                 if rc != 0:

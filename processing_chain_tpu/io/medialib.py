@@ -101,6 +101,12 @@ def _build(force: bool = False) -> None:
     )
 
 
+def _compile_error(exc: subprocess.CalledProcessError) -> MediaError:
+    return MediaError(
+        f"native build failed:\n{(exc.stderr or str(exc))[-800:]}"
+    )
+
+
 def _build_or_raise(force: bool = False) -> None:
     """_build with every failure mapped onto MediaError, so callers that
     degrade on the documented exception type (`except MediaError`) never
@@ -108,9 +114,7 @@ def _build_or_raise(force: bool = False) -> None:
     try:
         _build(force)
     except subprocess.CalledProcessError as exc:
-        raise MediaError(
-            f"native build failed:\n{(exc.stderr or str(exc))[-800:]}"
-        ) from exc
+        raise _compile_error(exc) from exc
     except OSError as exc:
         raise MediaError(
             f"native toolchain unavailable ({exc}) and no loadable "
@@ -135,9 +139,7 @@ def ensure_loaded() -> ct.CDLL:
             # a prebuilt binary here would silently run pre-edit native
             # code while the compile error never surfaces. Fail loudly
             # WITH the compiler's message (make ran output-captured).
-            raise MediaError(
-                f"native build failed:\n{(exc.stderr or str(exc))[-800:]}"
-            ) from exc
+            raise _compile_error(exc) from exc
         except OSError:
             # make itself is missing (a deploy host without a toolchain):
             # a prebuilt .so is still loadable — the ABI handshake below

@@ -36,6 +36,10 @@ from ..utils.log import get_logger
 #: segment length sanity window, seconds (reference :118-126)
 _SEGMENT_LEN_RANGE = (7, 9)
 
+#: codecs whose Bitmovin cloud encodes land as ONE finished mp4 (MP4Muxing)
+#: instead of a chunk tree (reference :698-711)
+_H26X = ("h264", "h265", "hevc", "avc")
+
 
 def fix_codec(vcodec: str) -> str:
     """Codec name normalization for format matching (reference :90-99)."""
@@ -565,7 +569,7 @@ class Downloader:
                 return None
             if self.store is not None and str(
                 seg.quality_level.video_codec
-            ).casefold() in ("h264", "h265", "hevc", "avc"):
+            ).casefold() in _H26X:
                 return None  # a finished cloud mp4 may still be fetchable
             return (
                 "Bitmovin cloud encode needs bitmovin_settings/ credentials "
@@ -712,7 +716,7 @@ class Downloader:
         codec = seg.quality_level.video_codec
 
         force = overwrite or self.overwrite
-        h26x = str(codec).casefold() in ("h264", "h265", "hevc", "avc")
+        h26x = str(codec).casefold() in _H26X
         if not force and os.path.isfile(
             os.path.join(self.video_segments_folder, filename)
         ):

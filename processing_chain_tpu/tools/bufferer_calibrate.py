@@ -60,7 +60,10 @@ def estimate_spinner_kinematics(
     frame — the quantity needed to verify phase continuity across stall
     events (the third ASSUMED kinematic constant). Same
     luminance-centroid method; phase0 is the linear fit's intercept,
-    wrapped to (-pi, pi]."""
+    wrapped to (-pi, pi]. (The ~20 fit lines are deliberately duplicated
+    from the ops/ estimator rather than refactored into it: calibration
+    is host-tool surface, and ops/ is the device-kernel layer whose
+    sources gate the live-bench cache hash.)"""
     t = frames.shape[0]
     if t < 3:
         raise ValueError("need at least 3 stall frames to estimate a rate")

@@ -271,7 +271,8 @@ def test_e2e_db_builders_produce_runnable_databases(tmp_path):
         os.path.dirname(short_yaml), "videoSegments", "*.mp4"))
     assert len(segs) == 1 and os.path.getsize(segs[0]) > 10_000
 
-    long_yaml = bench._e2e_build_long_db(str(tmp_path / "l"), 48)
+    long_yaml, long_n = bench._e2e_build_long_db(str(tmp_path / "l"), 48)
+    assert long_n == 48
     segs = glob.glob(os.path.join(
         os.path.dirname(long_yaml), "videoSegments", "*.mp4"))
     assert len(segs) == 1 and os.path.getsize(segs[0]) > 10_000

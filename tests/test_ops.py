@@ -748,20 +748,11 @@ def test_estimate_spinner_kinematics_recovers_perturbed_values():
 
     # two events: event 2's measured starting phase must continue event
     # 1's fit by exactly its stall-frame count (phase frozen during play)
-    luma, plan = _render_stalled_luma(
-        [[0.25, 0.75], [0.75, 0.75]], n_in=36, rps=0.73
-    )
-    spans = []
-    k = 0
-    while k < plan.n_out:
-        if plan.stall_mask[k]:
-            j = k
-            while j < plan.n_out and plan.stall_mask[j]:
-                j += 1
-            spans.append((k, j))
-            k = j
-        else:
-            k += 1
+    from processing_chain_tpu.tools.bufferer_calibrate import _stall_spans
+
+    events = [[0.25, 0.75], [0.75, 0.75]]
+    luma, plan = _render_stalled_luma(events, n_in=36, rps=0.73)
+    spans = _stall_spans(events, 24.0, 36)
     assert len(spans) == 2, spans
     fits = [
         estimate_spinner_kinematics(luma[a:b, 32:160, 32:160], 24.0)
