@@ -811,3 +811,41 @@ def test_quality_metrics_vif_column(tmp_path):
     assert (dfc.vif_y > 0.999).all()
     assert (dfn.vif_y < 1.0).all() and (dfn.vif_y > 0.0).all()
     assert (dfn.vif_y < dfc.vif_y).all()
+
+
+def test_quality_metrics_both_flags_column_order(tmp_path):
+    """msssim_y and vif_y together: stable declarative order (the
+    round-4 advisor found insert-position dependence — msssim-only put
+    msssim_y at index 4 but both flags shifted it) — pinned here."""
+    from processing_chain_tpu.io.video import VideoWriter
+    from processing_chain_tpu.tools import quality_metrics as qm
+
+    rng = np.random.default_rng(8)
+    h, w, n = 64, 96, 2
+    frames = rng.integers(16, 235, size=(n, h, w), dtype=np.uint8)
+    src = tmp_path / "src.avi"
+    with VideoWriter(str(src), "ffv1", w, h, "yuv420p", (24, 1)) as wr:
+        for f in frames:
+            wr.write(f, np.full((h // 2, w // 2), 128, np.uint8),
+                     np.full((h // 2, w // 2), 128, np.uint8))
+
+    src_path = str(src)
+    fake_src = type("S", (), {"file_path": src_path})()
+
+    class FakeTc:
+        def get_side_information_path(self):
+            return str(tmp_path / "sideInfo")
+
+    class FakePvs:
+        test_config = FakeTc()
+        src = fake_src
+        pvs_id = "DB_S_H9"
+
+        def get_avpvs_file_path(self):
+            return src_path
+
+    df = pd.read_csv(qm.compute_pvs_metrics(FakePvs(), msssim=True, vif=True))
+    assert list(df.columns) == [
+        "frame", "psnr_y", "psnr_u", "psnr_v", "ssim_y",
+        "msssim_y", "vif_y", "si", "ti",
+    ]
