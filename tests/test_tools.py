@@ -821,7 +821,7 @@ def test_quality_metrics_both_flags_column_order(tmp_path):
     from processing_chain_tpu.tools import quality_metrics as qm
 
     rng = np.random.default_rng(8)
-    h, w, n = 64, 96, 2
+    h, w, n = 192, 192, 2  # >= the 5-scale MS-SSIM pyramid minimum
     frames = rng.integers(16, 235, size=(n, h, w), dtype=np.uint8)
     src = tmp_path / "src.avi"
     with VideoWriter(str(src), "ffv1", w, h, "yuv420p", (24, 1)) as wr:
