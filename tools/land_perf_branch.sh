@@ -1,0 +1,53 @@
+#!/bin/bash
+# Perform the perf-chroma-batch landing from the watcher's rehearsal
+# evidence. tools/tpu_watch.sh writes ~/.cache/pc_tpu_watch/landing.json
+# on a live tunnel window (main's bench + the merged worktree's bench);
+# this script makes the decision mechanical:
+#   merged-bench value >= ~97% of main's  ->  merge + adopt the merged
+#   live cache (its code hash matches post-merge ops/+parallel/), else
+#   report and leave the branch parked.
+# Run with a CLEAN tree.
+set -eu
+cd "$(dirname "$0")/.."
+STATE_DIR="$HOME/.cache/pc_tpu_watch"
+L="$STATE_DIR/landing.json"
+[ -s "$L" ] || { echo "no landing.json yet (no live window captured)"; exit 1; }
+[ -z "$(git status --porcelain)" ] || { echo "tree not clean; commit first"; exit 1; }
+
+DECISION=$(python - "$L" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+main_b = d.get("main_bench") or {}
+perf_b = d.get("perf_bench") or {}
+main_fps = (main_b.get("t", 0) / main_b["per_step"]) if main_b.get("per_step") else None
+perf_fps = perf_b.get("value")
+print(f"main={main_fps} merged={perf_fps}", file=sys.stderr)
+if perf_fps is None:
+    print("abort")
+elif main_fps is None or perf_fps >= 0.97 * main_fps:
+    print("merge")
+else:
+    print("keep-parked")
+EOF
+)
+echo "decision: $DECISION"
+case "$DECISION" in
+merge)
+    git merge --no-edit perf-chroma-batch
+    cp "$STATE_DIR/BENCH_LIVE_perf.json" BENCH_LIVE.json
+    git add BENCH_LIVE.json
+    git commit -m "Land perf-chroma-batch with its live rehearsal capture
+
+Watcher rehearsal (landing.json) benched the merged tree on a live
+tunnel window; the merged live cache replaces BENCH_LIVE.json (same
+code hash as post-merge ops/+parallel/)."
+    echo "landed. consider re-running: python bench.py"
+    ;;
+keep-parked)
+    echo "merged tree benched SLOWER than main; branch stays parked."
+    echo "evidence: $L"
+    ;;
+*)
+    echo "rehearsal incomplete; see $L"
+    ;;
+esac
