@@ -353,9 +353,17 @@ def create_preview(pvs: Pvs) -> Optional[Job]:
                     )
                 return [y, u, v]
 
+            # ProRes is all-intra: the same frame-parallel pool as the
+            # FFV1 writeback applies (PC_FFV1_WORKERS — the knob names
+            # the host intra-writeback pool, not one codec)
+            from .avpvs import ffv1_workers
+
+            workers = ffv1_workers()
             with pfe.AsyncWriter(VideoWriter(
                 out_path, "prores_ks", reader.width, reader.height,
-                "yuv422p10le", (frac.numerator, frac.denominator), **aud,
+                "yuv422p10le", (frac.numerator, frac.denominator),
+                opts=f"pc_fp_workers={workers}" if workers > 0 else "",
+                **aud,
             )) as writer:
                 if aud:
                     writer.write_audio(audio)

@@ -1014,9 +1014,12 @@ EXPORT MPEncoder* mp_encoder_open(
     if (AVDictionaryEntry* fpw = av_dict_get(opts, "pc_fp_workers", nullptr, 0)) {
         e->fp_workers = atoi(fpw->value);
         av_dict_set(&opts, "pc_fp_workers", nullptr, 0);
-        if (e->fp_workers > 0 && vc->id != AV_CODEC_ID_FFV1) {
+        // intra-only codecs whose frames are independent by construction:
+        // FFV1 (with gop=1 forced below) and ProRes (always all-intra)
+        if (e->fp_workers > 0 && vc->id != AV_CODEC_ID_FFV1 &&
+            vc->id != AV_CODEC_ID_PRORES) {
             set_err(err, errlen,
-                    "pc_fp_workers requires ffv1 (intra-only frames)");
+                    "pc_fp_workers requires an intra-only codec (ffv1/prores)");
             fail_cleanup();
             return nullptr;
         }

@@ -374,3 +374,34 @@ def test_ffv1_frame_parallel_randomized_configs(tmp_path):
             assert np.array_equal(f.planes[0], y), (case, k)
             assert np.array_equal(f.planes[1], u), (case, k)
             assert np.array_equal(f.planes[2], v), (case, k)
+
+
+def test_prores_frame_parallel_matches_serial(tmp_path):
+    """fp mode extends to ProRes (all-intra by construction): the
+    frame-parallel encode must produce frames decoding EXACTLY like the
+    serial encode (per-frame quantization is frame-local, so identical
+    inputs give identical bitstreams), in order."""
+    from processing_chain_tpu.io.video import VideoReader, VideoWriter
+
+    rng = np.random.default_rng(9)
+    h, w, n = 96, 128, 10
+    frames = [(rng.integers(0, 1024, (h, w), np.uint16),
+               rng.integers(0, 1024, (h, w // 2), np.uint16),
+               rng.integers(0, 1024, (h, w // 2), np.uint16))
+              for _ in range(n)]
+
+    def write(path, opts):
+        with VideoWriter(path, "prores_ks", w, h, "yuv422p10le", (24, 1),
+                         opts=opts) as wr:
+            for y, u, v in frames:
+                wr.write(y, u, v)
+
+    write(str(tmp_path / "ser.mov"), "")
+    write(str(tmp_path / "fp.mov"), "pc_fp_workers=3")
+    with VideoReader(str(tmp_path / "ser.mov")) as r:
+        ser, _ = r.read_all()
+    with VideoReader(str(tmp_path / "fp.mov")) as r:
+        fp, _ = r.read_all()
+    assert ser[0].shape[0] == fp[0].shape[0] == n
+    for p, q in zip(ser, fp):
+        assert np.array_equal(p, q)
