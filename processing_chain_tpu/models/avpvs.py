@@ -128,6 +128,20 @@ def ffv1_workers() -> int:
     return 0 if ncpu <= 2 else min(ncpu - 1, 8)
 
 
+def set_default_fp_workers(pool_width: int) -> None:
+    """Install the POOL-AWARE fp-worker default into the env (no-op when
+    PC_FFV1_WORKERS is already pinned by the operator or a flag):
+    `pool_width` concurrent jobs each opening (cores-1) contexts would
+    oversubscribe the host, so the spare cores are divided across the
+    pool. Called by every stage that runs intra writebacks `-p`-wide
+    (p03 renders, p04 previews)."""
+    if "PC_FFV1_WORKERS" in os.environ:
+        return
+    ncpu = os.cpu_count() or 1
+    per_job = (ncpu - 1) // max(1, pool_width) if ncpu > 2 else 0
+    os.environ["PC_FFV1_WORKERS"] = str(max(0, min(per_job, 8)))
+
+
 def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
                  with_audio: bool, sample_rate: int = 48000,
                  audio_codec: str = "pcm_s16le") -> VideoWriter:

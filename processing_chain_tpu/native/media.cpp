@@ -908,7 +908,8 @@ static int fp_mux_ready_locked(MPEncoder* e, std::unique_lock<std::mutex>& lk) {
 // optional (acodec == nullptr to disable).
 //   pass: 0 = single pass, 1/2 = two-pass with stats at stats_path.
 //   vopts may carry "pc_fp_workers=N" (consumed here, never passed on):
-//   frame-parallel encode across N private contexts — ffv1 only.
+//   frame-parallel encode across N private contexts — intra-only codecs
+//   (ffv1 with gop=1 forced, prores).
 EXPORT MPEncoder* mp_encoder_open(
     const char* path, const char* vcodec, int width, int height,
     const char* pix_fmt, int fps_num, int fps_den, int64_t bit_rate,
@@ -1009,8 +1010,8 @@ EXPORT MPEncoder* mp_encoder_open(
         }
     }
     // pc_fp_workers is OURS, not an AVOption: consume it before the codec
-    // sees the dict. Frame-parallel mode is only sound for an intra-only
-    // codec whose frames can be made independent; restrict to FFV1.
+    // sees the dict. Frame-parallel mode is only sound for intra-only
+    // codecs whose frames can be made independent (gate below).
     if (AVDictionaryEntry* fpw = av_dict_get(opts, "pc_fp_workers", nullptr, 0)) {
         e->fp_workers = atoi(fpw->value);
         av_dict_set(&opts, "pc_fp_workers", nullptr, 0);
@@ -1064,6 +1065,14 @@ EXPORT MPEncoder* mp_encoder_open(
             c->pix_fmt = pf;
             c->gop_size = 1;
             c->max_b_frames = 0;
+            // rate-control fields mirror venc: for ProRes there is no
+            // extradata for the equality check below to compare, so any
+            // field NOT copied here would silently diverge from the
+            // serial encode
+            c->bit_rate = e->venc->bit_rate;
+            c->rc_min_rate = e->venc->rc_min_rate;
+            c->rc_max_rate = e->venc->rc_max_rate;
+            c->rc_buffer_size = e->venc->rc_buffer_size;
             c->thread_count = threads >= 0 ? threads : 1;
             c->flags = e->venc->flags & ~AV_CODEC_FLAG_PASS1 &
                        ~AV_CODEC_FLAG_PASS2;

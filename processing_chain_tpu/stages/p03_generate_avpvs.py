@@ -43,13 +43,8 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
     ffv1_workers = getattr(cli_args, "ffv1_workers", None)
     if ffv1_workers is not None:
         os.environ["PC_FFV1_WORKERS"] = str(max(0, ffv1_workers))
-    elif "PC_FFV1_WORKERS" not in os.environ:
-        # pool-aware auto default: `-p` jobs each opening (cores-1)
-        # fp contexts would oversubscribe the host; divide the spare
-        # cores across the concurrent p03 jobs instead
-        ncpu = os.cpu_count() or 1
-        per_job = (ncpu - 1) // max(1, pvs_par) if ncpu > 2 else 0
-        os.environ["PC_FFV1_WORKERS"] = str(max(0, min(per_job, 8)))
+    else:
+        av.set_default_fp_workers(pvs_par)
     avpvs_codec = getattr(cli_args, "avpvs_codec", None)
     if avpvs_codec:
         os.environ["PC_AVPVS_CODEC"] = avpvs_codec

@@ -19,6 +19,11 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             cli_args.filter_pvs,
         )
     pvs_par = device_stage_parallelism(cli_args.parallelism, "p04")
+    # previews run ProRes through the same intra-writeback pool as the
+    # p03 renders: install the pool-aware fp default (no-op when pinned)
+    from ..models.avpvs import set_default_fp_workers
+
+    set_default_fp_workers(pvs_par)
     runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
         parallelism=pvs_par, name="p04",

@@ -14,6 +14,24 @@ L="$STATE_DIR/landing.json"
 [ -s "$L" ] || { echo "no landing.json yet (no live window captured)"; exit 1; }
 [ -z "$(git status --porcelain)" ] || { echo "tree not clean; commit first"; exit 1; }
 
+# the rehearsal benched merge(main@A, branch@B) — refuse to land a merge
+# that was never benched (main moved since): the adopted live cache's
+# code hash would no longer match the post-merge tree, which is exactly
+# the cached-live-bench invalidation this dance exists to avoid. The
+# watcher re-preps + re-benches automatically on the next live window.
+WANT="$(git rev-parse main)+$(git rev-parse perf-chroma-batch)"
+GOT=$(python -c "import json,sys; print(json.load(open(sys.argv[1])).get('merged',''))" "$L")
+if [ "$WANT" != "$GOT" ]; then
+    echo "landing.json rehearsed $GOT but heads are now $WANT — stale;"
+    echo "wait for the watcher's next live-window rehearsal."
+    exit 1
+fi
+[ -s "$STATE_DIR/BENCH_LIVE_perf.json" ] || {
+    echo "BENCH_LIVE_perf.json missing; refusing to merge without the"
+    echo "live cache to adopt (a merge would strand a stale BENCH_LIVE)."
+    exit 1
+}
+
 DECISION=$(python - "$L" <<'EOF'
 import json, sys
 d = json.load(open(sys.argv[1]))
