@@ -405,3 +405,25 @@ def test_prores_frame_parallel_matches_serial(tmp_path):
     assert ser[0].shape[0] == fp[0].shape[0] == n
     for p, q in zip(ser, fp):
         assert np.array_equal(p, q)
+
+
+def test_ffv1_frame_parallel_zero_and_one_frames(tmp_path):
+    """fp-pool shutdown is clean on degenerate streams: zero frames
+    (workers started, no jobs) and a single frame — no deadlock, no
+    stray packets, correct frame counts."""
+    from processing_chain_tpu.io.video import VideoReader, VideoWriter
+
+    opts = "level=3:coder=1:slicecrc=1:pc_fp_workers=3"
+    p0 = str(tmp_path / "zero.avi")
+    with VideoWriter(p0, "ffv1", 64, 48, "yuv420p", (24, 1), opts=opts):
+        pass
+    assert len(medialib.scan_packets(p0, "video")["size"]) == 0
+
+    p1 = str(tmp_path / "one.avi")
+    y = np.full((48, 64), 77, np.uint8)
+    with VideoWriter(p1, "ffv1", 64, 48, "yuv420p", (24, 1), opts=opts) as w:
+        w.write(y, np.full((24, 32), 100, np.uint8),
+                np.full((24, 32), 200, np.uint8))
+    with VideoReader(p1) as r:
+        frames = [f for f in r]
+    assert len(frames) == 1 and np.array_equal(frames[0].planes[0], y)
