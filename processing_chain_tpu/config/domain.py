@@ -604,11 +604,22 @@ class Segment:
         return self.segment_info
 
     def _key(self) -> tuple:
+        # `index` is part of identity: it is in the FILENAME, so two
+        # content-equal segments with different indexes are distinct
+        # artifacts (each referenced by its own HRC's plan). The
+        # reference dedups by full command string — filename included —
+        # so its cross-HRC dedup also merges only equal-index segments.
+        # (Found by a randomized planner sweep: two HRCs with different
+        # segmentDuration histories both truncating against SRC end
+        # produce the same (src, ql, coding, start, duration) at
+        # DIFFERENT indexes; deduping them left one HRC's segment file
+        # never encoded.)
         return (
             self.src,
             self.quality_level,
             self.video_coding,
             self.audio_coding,
+            self.index,
             self.start_time,
             self.duration,
         )

@@ -1620,3 +1620,55 @@ def test_invalid_database_rejection_parity(tmp_path, name, old, new):
             r_frame_rate=str(SRC_FPS), avg_frame_rate=f"{SRC_FPS}/1",
             video_duration=10.0,
         )))
+
+
+def test_truncated_tail_segments_distinct_per_index(tmp_path):
+    """Round-5 sweep find (seed 79 of a 40-seed fresh run): two HRCs
+    with DIFFERENT segmentDuration histories that both truncate against
+    the SRC end produce segments with equal (src, ql, coding, start,
+    duration) but different INDEXES — hence different filenames, hence
+    distinct artifacts. The planner's cross-HRC dedup must keep both
+    (the reference dedups by command string, filename included); folding
+    them left one HRC's segment file never encoded and its p03 would
+    crash on a missing input."""
+    db_id = "P2LXM78"
+    yaml_text = "\n".join([
+        f"databaseId: {db_id}",
+        "syntaxVersion: 6",
+        "type: long",
+        "segmentDuration: 4",
+        "qualityLevelList:",
+        "  Q0: {index: 0, videoCodec: h264, videoCrf: 25, width: 1280, "
+        "height: 720, fps: 24, audioCodec: aac, audioBitrate: 96}",
+        "  Q2: {index: 1, videoCodec: h264, videoCrf: 31, width: 960, "
+        "height: 540, fps: 24, audioCodec: aac, audioBitrate: 96}",
+        "codingList:",
+        "  VC02: {type: video, encoder: libx264, crf: yes, passes: 2, "
+        "iFrameInterval: 1, preset: veryfast}",
+        "  AC01: {type: audio, encoder: aac}",
+        "srcList:",
+        "  SRC000: SRC000.avi",
+        "hrcList:",
+        # 9 s SRC. HRC000 (segDur 2): Q2 fills 0-8 (indexes 0-3), the Q0
+        # tail truncates to 8-9 at index 4. HRC002 (segDur 4): Q0 covers
+        # 0-4, 4-8, then truncates to 8-9 at index 2. Same content
+        # window, different filenames.
+        "  HRC000: {videoCodingId: VC02, audioCodingId: AC01, "
+        "eventList: [[Q2, 8], [Q0, 4]], segmentDuration: 2}",
+        "  HRC002: {videoCodingId: VC02, audioCodingId: AC01, "
+        "eventList: [[Q0, 12]]}",
+        "pvsList:",
+        f"  - {db_id}_SRC000_HRC000",
+        f"  - {db_id}_SRC000_HRC002",
+        "postProcessingList:",
+        "  - {type: pc, displayWidth: 1920, displayHeight: 1080, "
+        "codingWidth: 1920, codingHeight: 1080, displayFrameRate: 24}",
+    ]) + "\n"
+    yaml_path = _build_fixture(tmp_path, db_id, yaml_text, 9.0)
+    ours = _our_plan(yaml_path, 9.0)
+    names = {s["filename"] for s in ours["segments"]}
+    assert f"{db_id}_SRC000_Q0_VC02_0002_8-9.mp4" in names
+    assert f"{db_id}_SRC000_Q0_VC02_0004_8-9.mp4" in names
+    ref = _reference_plan(yaml_path)
+    assert ref is not None
+    assert names == {s["filename"] for s in ref["segments"]}
