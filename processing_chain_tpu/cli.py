@@ -11,8 +11,10 @@ sites.
 from __future__ import annotations
 
 import sys
+import time
 from typing import Optional, Sequence
 
+from . import telemetry
 from .config.errors import ConfigError
 from .io.medialib import MediaError
 from .utils import log as log_mod
@@ -20,6 +22,27 @@ from .utils import parse_args as pa
 from .utils import tracing
 from .utils.runner import ChainError
 from .utils.version import check_requirements
+
+
+def _write_telemetry(out_dir: str, status: str, wall_s: float) -> None:
+    """Persist the run's metrics/events/trace under one stamp into
+    `out_dir`. Best-effort: persistence failures must never replace the
+    run's own outcome (mirrors the --trace report guard below)."""
+    telemetry.emit("run_end", status=status, duration_s=round(wall_s, 4))
+    try:
+        paths = telemetry.write_outputs(out_dir)
+        tracing.get_tracer().write_report(out_dir, name=paths["stamp"])
+        log_mod.get_logger().info(
+            "telemetry: %s metrics_%s.{json,prom} + events + trace",
+            out_dir, paths["stamp"],
+        )
+    except Exception as exc:  # noqa: BLE001 - runs in _dispatch's finally:
+        # anything narrower would let a persistence error (unwritable dir,
+        # a non-serializable emit() field) replace the propagating
+        # pipeline exception
+        log_mod.get_logger().warning(
+            "could not write telemetry to %s: %s", out_dir, exc
+        )
 
 
 def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
@@ -32,9 +55,16 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
     from .utils.device import ensure_backend
 
     ensure_backend()
+    telemetry_dir = getattr(args, "telemetry", None)
+    if telemetry_dir:
+        telemetry.enable()
+        telemetry.attach_log_handler(log_mod.get_logger())
+        telemetry.emit("run_start", name=name, argv=list(argv))
     tracing_on = getattr(args, "trace", None) is not None
     profiler = tracing.DeviceProfiler(args.trace or None) if tracing_on else None
     test_config = None
+    status = "ok"
+    t0 = time.perf_counter()
     try:
         if profiler is not None:
             profiler.start()
@@ -58,11 +88,17 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
             }[stage]
             test_config = mod.run(args)
     except (ConfigError, ChainError) as exc:
+        status = "fail"
         log_mod.get_logger().error("%s", exc)
         return 1
+    except BaseException:
+        status = "fail"
+        raise
     finally:
         if profiler is not None:
             profiler.stop()
+        if telemetry_dir:
+            _write_telemetry(telemetry_dir, status, time.perf_counter() - t0)
         if tracing_on:
             tracer = tracing.get_tracer()
             tracer.log_summary()
@@ -91,13 +127,20 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
 
 def _dispatch_tool(argv: Sequence[str]) -> int:
     """`tools <name> …` subcommands (reference util/ scripts)."""
-    tools = ("src-analysis", "complexity", "plots", "metrics", "clean-logs")
+    tools = (
+        "src-analysis", "complexity", "plots", "metrics", "clean-logs",
+        "run-report",
+    )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
         return 2
     name, rest = argv[0], list(argv[1:])
     log_mod.setup_custom_logger("main")
     try:
+        if name == "run-report":
+            from .telemetry import report
+
+            return report.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

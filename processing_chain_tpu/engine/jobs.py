@@ -13,13 +13,40 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from .. import telemetry as tm
 from ..utils import tracing
 from ..utils.log import get_logger
 from ..utils.runner import ChainError, ParallelRunner
 from ..utils.version import get_processing_chain_version
+
+# Job accounting (docs/TELEMETRY.md): every planning decision and run
+# outcome is counted per runner — except redos, decided inside
+# Job.should_run where no runner context exists, counted chain-wide —
+# and mirrored into the event log, so a report can answer "which PVSes
+# were skipped vs. rebuilt and why".
+_JOBS_PLANNED = tm.counter(
+    "chain_jobs_planned_total", "jobs accepted for execution", ("runner",)
+)
+_JOBS_SKIPPED = tm.counter(
+    "chain_jobs_skipped_total", "jobs skipped (output exists)", ("runner",)
+)
+_JOBS_DEDUPED = tm.counter(
+    "chain_jobs_deduped_total", "identical plans submitted twice", ("runner",)
+)
+_JOBS_FAILED = tm.counter(
+    "chain_jobs_failed_total", "jobs whose fn raised", ("runner",)
+)
+_JOBS_REDONE = tm.counter(
+    "chain_jobs_redone_total",
+    "jobs re-run over an existing output (crash sentinel)",
+)
+_JOB_SECONDS = tm.histogram(
+    "chain_job_duration_seconds", "wall time of each executed job"
+)
 
 
 def mark_inprogress(output_path: str) -> bool:
@@ -76,6 +103,12 @@ class Job:
                     "completed (crashed?); re-running",
                     self.output_path,
                 )
+                _JOBS_REDONE.inc()
+                tm.emit(
+                    "job_redo", job=self.label,
+                    output=os.path.basename(self.output_path),
+                    reason="crash_sentinel",
+                )
                 return True
             get_logger().warning(
                 "output %s already exists, will not convert. Use --force to "
@@ -101,10 +134,13 @@ class Job:
 
     def run(self) -> Any:
         marked = mark_inprogress(self.output_path)
+        tm.emit("job_start", job=self.label,
+                output=os.path.basename(self.output_path))
+        t0 = time.perf_counter()
         with tracing.span(self.label, output=os.path.basename(self.output_path)):
             try:
                 result = self.fn()
-            except BaseException:
+            except BaseException as exc:
                 # streaming jobs surface decode errors mid-write: a partial
                 # artifact must never survive to satisfy a later run's
                 # skip-existing check (enforced here once, for every job)
@@ -112,7 +148,16 @@ class Job:
                     os.unlink(self.output_path)
                 if marked:
                     clear_inprogress(self.output_path)
+                tm.emit(
+                    "job_end", job=self.label, status="fail",
+                    duration_s=round(time.perf_counter() - t0, 4),
+                    error=repr(exc)[:300],
+                )
                 raise
+        dur = time.perf_counter() - t0
+        _JOB_SECONDS.observe(dur)
+        tm.emit("job_end", job=self.label, status="ok",
+                duration_s=round(dur, 4))
         self.write_provenance()
         # removed only after the output (and its provenance) are complete:
         # a crash anywhere above leaves the sentinel and the next run redoes
@@ -167,6 +212,7 @@ class JobRunner:
         if job.output_path:
             prior = self._writers.get(job.output_path)
             if prior == job.label:
+                _JOBS_DEDUPED.labels(runner=self.name).inc()
                 return  # same plan submitted again: dedup
             if prior is not None:
                 raise ChainError(
@@ -175,7 +221,24 @@ class JobRunner:
                 )
             self._writers[job.output_path] = job.label
         if job.should_run(self.force):
+            _JOBS_PLANNED.labels(runner=self.name).inc()
+            tm.emit("job_planned", job=job.label, runner=self.name,
+                    output=os.path.basename(job.output_path))
             self.jobs.append(job)
+        else:
+            _JOBS_SKIPPED.labels(runner=self.name).inc()
+            tm.emit("job_skip", job=job.label, runner=self.name,
+                    output=os.path.basename(job.output_path),
+                    reason="output_exists")
+
+    def _run_job(self, job: Job) -> Any:
+        """Execute one job, attributing a failure to this runner's
+        telemetry series before the error propagates."""
+        try:
+            return job.run()
+        except BaseException:
+            _JOBS_FAILED.labels(runner=self.name).inc()
+            raise
 
     def run(self) -> dict[str, Any]:
         log = get_logger()
@@ -188,7 +251,7 @@ class JobRunner:
             return {j.label: None for j in planned}
         runner = ParallelRunner(max_parallel=self.parallelism, name=self.name)
         for job in self.jobs:
-            runner.add(job.run, label=job.label)
+            runner.add(self._run_job, job, label=job.label)
         self.jobs = []
         self._writers.clear()
         return runner.run()
@@ -207,7 +270,7 @@ class JobRunner:
                 results[job.label] = None
             else:
                 try:
-                    results[job.label] = job.run()
+                    results[job.label] = self._run_job(job)
                 except Exception as exc:
                     raise ChainError(
                         f"{self.name}: job '{job.label}' failed: {exc!r}"

@@ -22,11 +22,57 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
+from .. import telemetry as tm
+
 _SENTINEL = object()
+
+# Telemetry handles, bound once at import: every mutation below starts
+# with the registry's enabled check, and the hot loops additionally
+# guard with `tm.enabled()` so a disabled run never calls qsize() or
+# perf_counter(). Granularity is per CHUNK (≈64 frames), never per frame.
+_Q_DEPTH = tm.histogram(
+    "chain_queue_depth",
+    "bounded-queue depth sampled at each consumer pull / producer push",
+    ("queue",),
+    buckets=tm.DEFAULT_DEPTH_BUCKETS,
+)
+_Q_DECODE = _Q_DEPTH.labels(queue="decode")
+_Q_ENCODE = _Q_DEPTH.labels(queue="encode")
+_WAIT = tm.counter(
+    "chain_pipeline_wait_seconds_total",
+    "time the pipeline spent blocked on a bounded queue, by side",
+    ("side",),
+)
+_WAIT_CONSUMER = _WAIT.labels(side="consumer")
+_WAIT_PRODUCER = _WAIT.labels(side="producer")
+_FRAMES_DECODED = tm.FRAMES_DECODED
+_FRAMES_ENCODED = tm.FRAMES_ENCODED
+_BYTES_ENCODED = tm.BYTES_ENCODED
+_EVENT_SAMPLE_EVERY = 64  # every Nth depth sample also lands in the event log
+
+
+class _DepthSampler:
+    """Per-pipeline-object sampling helper: histogram every sample, event
+    log every Nth (events are for forensics; the histogram carries the
+    distribution)."""
+
+    __slots__ = ("_bound", "_queue_name", "_n")
+
+    def __init__(self, bound, queue_name: str) -> None:
+        self._bound = bound
+        self._queue_name = queue_name
+        self._n = 0
+
+    def sample(self, depth: int) -> None:
+        self._bound.observe(depth)
+        self._n += 1
+        if self._n % _EVENT_SAMPLE_EVERY == 1:
+            tm.emit("queue_depth", queue=self._queue_name, depth=depth)
 
 
 def _put_until_stop(q: queue.Queue, item: Any, stop: threading.Event) -> None:
@@ -89,10 +135,17 @@ class Prefetcher:
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+        self._depth_sampler = _DepthSampler(_Q_DECODE, "decode")
 
     def __iter__(self) -> Iterator[Any]:
         while True:
-            item = self._q.get()
+            if tm.enabled():
+                self._depth_sampler.sample(self._q.qsize())
+                t0 = time.perf_counter()
+                item = self._q.get()
+                _WAIT_CONSUMER.inc(time.perf_counter() - t0)
+            else:
+                item = self._q.get()
             if item is _SENTINEL:
                 if self._err is not None:
                     err, self._err = self._err, None
@@ -140,16 +193,26 @@ class AsyncWriter:
                     planes = [np.asarray(p) for p in item]
                     for i in range(planes[0].shape[0]):
                         self._writer.write(*(p[i] for p in planes))
+                    if tm.enabled():
+                        _FRAMES_ENCODED.inc(planes[0].shape[0])
+                        _BYTES_ENCODED.inc(sum(p.nbytes for p in planes))
                 except BaseException as exc:  # noqa: BLE001 - re-raised in close
                     self._err = exc
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+        self._depth_sampler = _DepthSampler(_Q_ENCODE, "encode")
 
     def put(self, planes_chunk) -> None:
         if self._err is not None:
             self._finish()
-        self._q.put(list(planes_chunk))
+        if tm.enabled():
+            self._depth_sampler.sample(self._q.qsize())
+            t0 = time.perf_counter()
+            self._q.put(list(planes_chunk))
+            _WAIT_PRODUCER.inc(time.perf_counter() - t0)
+        else:
+            self._q.put(list(planes_chunk))
 
     def write_audio(self, samples: np.ndarray) -> None:
         """Audio goes straight through (written once, before video)."""
@@ -232,12 +295,19 @@ class MultiSegmentPrefetcher:
         ]
         for t in self._threads:
             t.start()
+        self._depth_sampler = _DepthSampler(_Q_DECODE, "decode")
 
     def __iter__(self) -> Iterator[Any]:
         for idx in range(self._n):
             q = self._queues[idx]
             while True:
-                item = q.get()
+                if tm.enabled():
+                    self._depth_sampler.sample(q.qsize())
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    _WAIT_CONSUMER.inc(time.perf_counter() - t0)
+                else:
+                    item = q.get()
                 if item is _SENTINEL:
                     err = self._errs[idx]
                     if err is not None:
@@ -268,12 +338,14 @@ def iter_plane_chunks(reader, chunk: int = 64) -> Iterator[list[np.ndarray]]:
     for frame in reader:
         buf.append(frame)
         if len(buf) == chunk:
+            _FRAMES_DECODED.inc(chunk)
             yield [
                 np.stack([f.planes[p] for f in buf])
                 for p in range(len(buf[0].planes))
             ]
             buf = []
     if buf:
+        _FRAMES_DECODED.inc(len(buf))
         yield [
             np.stack([f.planes[p] for f in buf])
             for p in range(len(buf[0].planes))
@@ -342,28 +414,33 @@ def _stream_gather_impl(
     last_planes: Optional[list[np.ndarray]] = None
     it = iter(frames)
     exhausted = False
-    while n_out is None or k < n_out:
-        # decode forward until the current frame is the one output k wants
-        target = out_index(k)
-        while not exhausted and cur < target:
-            try:
-                frame = next(it)
-            except StopIteration:
-                exhausted = True
-                if n_out is None:
-                    n_out = n_out_fn(cur + 1) if n_out_fn is not None else k
+    try:
+        while n_out is None or k < n_out:
+            # decode forward until the current frame is the one output k wants
+            target = out_index(k)
+            while not exhausted and cur < target:
+                try:
+                    frame = next(it)
+                except StopIteration:
+                    exhausted = True
+                    if n_out is None:
+                        n_out = n_out_fn(cur + 1) if n_out_fn is not None else k
+                    break
+                cur += 1
+                last_planes = list(frame.planes)
+            if n_out is not None and k >= n_out:
                 break
-            cur += 1
-            last_planes = list(frame.planes)
-        if n_out is not None and k >= n_out:
-            break
-        if last_planes is None:  # empty source
-            break
-        # past-the-end outputs repeat the last decoded frame (clamp)
-        buf.append(last_planes)
-        k += 1
-        if len(buf) == chunk:
-            yield flush()
-    tail = flush()
-    if tail is not None:
-        yield tail
+            if last_planes is None:  # empty source
+                break
+            # past-the-end outputs repeat the last decoded frame (clamp)
+            buf.append(last_planes)
+            k += 1
+            if len(buf) == chunk:
+                yield flush()
+        tail = flush()
+        if tail is not None:
+            yield tail
+    finally:
+        # decoded-frame accounting in one batch (never per frame); the
+        # finally also covers a consumer that closes the generator early
+        _FRAMES_DECODED.inc(cur + 1)

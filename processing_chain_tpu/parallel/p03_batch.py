@@ -32,10 +32,26 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 from dataclasses import dataclass
 from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
+
+from .. import telemetry as tm
+
+_XFER_SECONDS = tm.counter(
+    "chain_device_transfer_seconds_total",
+    "host<->device transfer wall time in the batch driver", ("direction",),
+)
+_XFER_BYTES = tm.counter(
+    "chain_device_transfer_bytes_total",
+    "host<->device bytes moved by the batch driver", ("direction",),
+)
+_XFER_PUT_S = _XFER_SECONDS.labels(direction="put")
+_XFER_GET_S = _XFER_SECONDS.labels(direction="get")
+_XFER_PUT_B = _XFER_BYTES.labels(direction="put")
+_XFER_GET_B = _XFER_BYTES.labels(direction="get")
 
 
 @dataclass
@@ -236,15 +252,41 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
         # pad the wave's batch axis up to the mesh's pvs size
         while len(filled) < n_pvs:
             filled.append(zero_block)
-        planes = [
-            jax.device_put(np.stack([blk[p] for blk in filled]), sharding)
-            for p in range(3)
-        ]
-        oy, ou, ov, si, ti = step(
-            *planes, jax.device_put(prev, prev_sharding), first
-        )
-        host = [np.asarray(o) for o in (oy, ou, ov)]
-        si_h, ti_h = np.asarray(si), np.asarray(ti)
+        if tm.enabled():
+            # interleave stack/device_put like the untimed branch (holding
+            # all three stacked host copies alive through the step would
+            # raise peak RSS by a full wave); block before each timer stops
+            # so async dispatch can't shift device compute into the
+            # transfer counters
+            t_put = time.perf_counter()
+            put_bytes = prev.nbytes
+            planes = []
+            for p in range(3):
+                s = np.stack([blk[p] for blk in filled])
+                put_bytes += s.nbytes
+                planes.append(jax.device_put(s, sharding))
+            prev_dev = jax.device_put(prev, prev_sharding)
+            jax.block_until_ready(planes)
+            _XFER_PUT_S.inc(time.perf_counter() - t_put)
+            _XFER_PUT_B.inc(put_bytes)
+            oy, ou, ov, si, ti = jax.block_until_ready(
+                step(*planes, prev_dev, first)
+            )
+            t_get = time.perf_counter()
+            host = [np.asarray(o) for o in (oy, ou, ov)]
+            si_h, ti_h = np.asarray(si), np.asarray(ti)
+            _XFER_GET_S.inc(time.perf_counter() - t_get)
+            _XFER_GET_B.inc(sum(h.nbytes for h in host))
+        else:
+            planes = [
+                jax.device_put(np.stack([blk[p] for blk in filled]), sharding)
+                for p in range(3)
+            ]
+            oy, ou, ov, si, ti = step(
+                *planes, jax.device_put(prev, prev_sharding), first
+            )
+            host = [np.asarray(o) for o in (oy, ou, ov)]
+            si_h, ti_h = np.asarray(si), np.asarray(ti)
         for i, ln in enumerate(wave):
             if valids[i]:
                 ln.emit([h[i][: valids[i]] for h in host])

@@ -21,6 +21,7 @@ parallel/p03_batch._sharded_resize_step, the p03 product variant).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional
 
 import jax
@@ -28,9 +29,46 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import telemetry as tm
 from ..ops import metrics as metrics_ops
 from ..ops import resize as resize_ops
 from ..ops import siti as siti_ops
+
+_STEP_SECONDS = tm.histogram(
+    "chain_device_step_seconds",
+    "wall time of each jitted device-step call, device compute included "
+    "(the call blocks until outputs are ready when telemetry is on; the "
+    "first call of a step also covers trace + XLA compile)",
+    ("step",),
+)
+
+
+def _instrument_step(fn, step: str):
+    """Wrap a jitted step so each call lands in the latency histogram and
+    the first call (the compile) is flagged in the event log. Transparent
+    when telemetry is off: one flag check per call. When on, the call
+    blocks until outputs are ready — dispatch is async, and an unblocked
+    timer would record ~0 and misattribute device compute to whatever
+    blocks next (the host readback); every caller fetches the outputs to
+    host right after the step, so the sync costs no real overlap."""
+    bound = _STEP_SECONDS.labels(step=step)
+    state = {"first": True}
+
+    @functools.wraps(fn)
+    def call(*args, **kwargs):
+        if not tm.enabled():
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args, **kwargs))
+        dur = time.perf_counter() - t0
+        bound.observe(dur)
+        if state["first"]:
+            state["first"] = False
+            tm.emit("device_step", step=step, first=True,
+                    duration_s=round(dur, 4))
+        return out
+
+    return call
 
 
 def avpvs_siti_step(
@@ -126,7 +164,7 @@ def make_sharded_step(mesh: Mesh, dst_h: int, dst_w: int, kernel: str = "lanczos
             P("pvs", "time"),
         ),
     )
-    return jax.jit(mapped)
+    return _instrument_step(jax.jit(mapped), "sharded_avpvs_step")
 
 
 def make_batch_metrics_step(mesh: Mesh):
@@ -147,4 +185,4 @@ def make_batch_metrics_step(mesh: Mesh):
         in_specs=(P("pvs", "time", None, None), P("pvs", "time", None, None)),
         out_specs=(P("pvs", "time"), P("pvs", "time")),
     )
-    return jax.jit(mapped)
+    return _instrument_step(jax.jit(mapped), "batch_metrics_step")

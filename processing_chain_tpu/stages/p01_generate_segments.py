@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import telemetry as tm
 from ..config import TestConfig
 from ..engine.jobs import Job, JobRunner
 from ..models import segments as seg_model
@@ -12,6 +13,11 @@ from ..utils.log import get_logger
 
 
 def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
+    with tm.stage_span("p01"):
+        return _run(cli_args, test_config)
+
+
+def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     log = get_logger()
     if test_config is None:
         test_config = TestConfig(
@@ -76,6 +82,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             "tooling/credentials)"
         )
     log.info("p01: %d segment encodes planned", len(runner.jobs))
+    tm.STAGE_ITEMS.labels(stage="p01").set(len(runner.jobs))
     # pure host work (libav encode via ctypes releases the GIL): run the
     # encodes `-p`-wide like the reference's Pool(4) (cmd_utils.py:93-101);
     # each encode stays -threads 1, so parallelism comes from the pool

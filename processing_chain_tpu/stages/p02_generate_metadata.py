@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import telemetry as tm
 from ..config import TestConfig
 from ..models import metadata as md
 from ..parallel.distributed import local_shard
@@ -11,12 +12,18 @@ from ..utils.log import get_logger
 
 
 def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
+    with tm.stage_span("p02"):
+        return _run(cli_args, test_config)
+
+
+def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     log = get_logger()
     if test_config is None:
         test_config = TestConfig(
             cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
             cli_args.filter_pvs,
         )
+    n_items = 0
     for pvs_id, pvs in local_shard(test_config.pvses):
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
@@ -25,4 +32,6 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             log.info("[dry-run] metadata for %s", pvs_id)
             continue
         md.generate_pvs_metadata(pvs, force=cli_args.force)
+        n_items += 1
+    tm.STAGE_ITEMS.labels(stage="p02").set(n_items)
     return test_config

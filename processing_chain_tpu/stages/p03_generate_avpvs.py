@@ -5,6 +5,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from .. import telemetry as tm
 from ..config import TestConfig
 from ..engine.jobs import JobRunner, device_stage_parallelism
 from ..models import avpvs as av
@@ -13,6 +14,11 @@ from ..utils.log import get_logger
 
 
 def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
+    with tm.stage_span("p03"):
+        return _run(cli_args, test_config)
+
+
+def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     log = get_logger()
     if test_config is None:
         test_config = TestConfig(
@@ -56,6 +62,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             continue
         eligible.append(pvs)
         stall_runner.add(av.apply_stalling(pvs, spinner_path=spinner))
+    tm.STAGE_ITEMS.labels(stage="p03").set(len(eligible))
     from ..utils.device import device_count, select_device
 
     gpu_loc = getattr(cli_args, "set_gpu_loc", -1)

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .. import telemetry as tm
 from ..config import TestConfig
 from ..engine.jobs import JobRunner, device_stage_parallelism
 from ..models import cpvs as cp
@@ -12,6 +13,11 @@ from ..utils.log import get_logger
 
 
 def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
+    with tm.stage_span("p04"):
+        return _run(cli_args, test_config)
+
+
+def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     log = get_logger()
     if test_config is None:
         test_config = TestConfig(
@@ -28,10 +34,12 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
         force=cli_args.force, dry_run=cli_args.dry_run,
         parallelism=pvs_par, name="p04",
     )
+    n_items = 0
     for pvs_id, pvs in local_shard(test_config.pvses):
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue
+        n_items += 1
         for pp in test_config.post_processings:
             runner.add(
                 cp.create_cpvs(
@@ -42,6 +50,7 @@ def run(cli_args, test_config: Optional[TestConfig] = None) -> TestConfig:
             )
         if getattr(cli_args, "lightweight_preview", False):
             runner.add(cp.create_preview(pvs))
+    tm.STAGE_ITEMS.labels(stage="p04").set(n_items)
     from ..utils.device import select_device
 
     with select_device(getattr(cli_args, "set_gpu_loc", -1)):

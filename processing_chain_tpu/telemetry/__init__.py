@@ -1,0 +1,159 @@
+"""Telemetry subsystem: metrics registry + structured run events.
+
+The quantitative observability layer the tracing module (wall-time
+spans) and provenance .log files don't cover: counters/gauges/histograms
+for throughput and queueing, and a structured JSONL event log for run
+forensics. See docs/TELEMETRY.md for the metric catalog and the event
+schema, and tools/run_report.py for the aggregated human-readable view.
+
+Enablement is process-wide and OFF by default; every instrumentation
+site in the chain is guarded so a disabled run pays one attribute check
+per call site, with zero allocation. `--telemetry DIR` on any stage CLI
+enables it and persists three artifacts into DIR at exit:
+
+    metrics_<ts>.json    registry snapshot (counters/gauges/histograms)
+    metrics_<ts>.prom    Prometheus textfile-collector export
+    events_<ts>.jsonl    the structured event log
+
+plus a trace_<ts>.json span report (shared with `--trace`), all under
+one collision-safe <ts> stamp so tools/run_report.py can join them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+from .events import (  # noqa: F401  (re-exports)
+    EVENTS,
+    EventLog,
+    EventLogHandler,
+    attach_log_handler,
+    detach_log_handler,
+    emit,
+    read_jsonl,
+)
+from .metrics import (  # noqa: F401
+    DEFAULT_DEPTH_BUCKETS,
+    DEFAULT_LATENCY_BUCKETS,
+    REGISTRY,
+    MetricError,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+)
+
+
+def enabled() -> bool:
+    return REGISTRY.enabled
+
+
+def enable() -> None:
+    REGISTRY.enabled = True
+    EVENTS.enabled = True
+
+
+def disable() -> None:
+    REGISTRY.enabled = False
+    EVENTS.enabled = False
+
+
+def reset() -> None:
+    """Zero all series and drop all events (for a fresh run in one
+    process — registrations and bound handles stay valid)."""
+    REGISTRY.reset()
+    EVENTS.clear()
+
+
+def unique_stamp() -> str:
+    """Wall-clock stamp that never collides within a process even when
+    two callers hit the same second: pid + a monotonic counter."""
+    global _STAMP_SEQ
+    with _STAMP_LOCK:
+        _STAMP_SEQ += 1
+        seq = _STAMP_SEQ
+    return f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid()}-{seq}"
+
+
+_STAMP_SEQ = 0
+_STAMP_LOCK = threading.Lock()
+
+# Cross-layer counters the stage spans diff against. Frames/bytes are
+# incremented by the prefetch pipeline (engine/prefetch.py) where every
+# decoded and encoded chunk already flows through one choke point.
+FRAMES_DECODED = counter(
+    "chain_frames_decoded_total", "video frames decoded into the pipeline"
+)
+FRAMES_ENCODED = counter(
+    "chain_frames_encoded_total", "video frames written back out"
+)
+BYTES_ENCODED = counter(
+    "chain_bytes_encoded_total", "raw plane bytes handed to writers"
+)
+STAGE_SECONDS = gauge(
+    "chain_stage_wall_seconds", "wall time of the last run of each stage",
+    ("stage",),
+)
+STAGE_ITEMS = gauge(
+    "chain_stage_items", "work items handled by the last run of each stage",
+    ("stage",),
+)
+
+
+@contextmanager
+def stage_span(stage: str, **fields) -> Iterator[None]:
+    """Wrap one stage run (p01..p04): emits stage_start/stage_end events
+    carrying the frames/bytes counter deltas, from which a report derives
+    per-stage throughput without any per-stage plumbing inside the
+    models layer."""
+    if not REGISTRY.enabled:
+        yield
+        return
+    before = (
+        FRAMES_DECODED.get(), FRAMES_ENCODED.get(), BYTES_ENCODED.get(),
+    )
+    emit("stage_start", stage=stage, **fields)
+    t0 = time.perf_counter()
+    status = "ok"
+    try:
+        yield
+    except BaseException:
+        status = "fail"
+        raise
+    finally:
+        wall = time.perf_counter() - t0
+        STAGE_SECONDS.labels(stage=stage).set(wall)
+        emit(
+            "stage_end",
+            stage=stage,
+            status=status,
+            duration_s=round(wall, 4),
+            frames_decoded=FRAMES_DECODED.get() - before[0],
+            frames_encoded=FRAMES_ENCODED.get() - before[1],
+            bytes_encoded=BYTES_ENCODED.get() - before[2],
+            **fields,
+        )
+
+
+def write_outputs(out_dir: str, stamp: Optional[str] = None) -> dict[str, str]:
+    """Persist the registry + event log into `out_dir` under one stamp.
+    Returns {"metrics": path, "prom": path, "events": path, "stamp": s}."""
+    stamp = stamp or unique_stamp()
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {
+        "metrics": REGISTRY.write_json(
+            os.path.join(out_dir, f"metrics_{stamp}.json")
+        ),
+        "prom": REGISTRY.write_prometheus(
+            os.path.join(out_dir, f"metrics_{stamp}.prom")
+        ),
+        "events": EVENTS.write_jsonl(
+            os.path.join(out_dir, f"events_{stamp}.jsonl")
+        ),
+        "stamp": stamp,
+    }
+    return paths

@@ -1,0 +1,145 @@
+"""Structured run-event log: an append-only list of JSON records.
+
+One record per interesting state transition — run start/end, stage
+start/end, per-job planned/start/end/skip/redo/fail, prefetch queue
+samples, device compile timings — written out by `--telemetry DIR` as
+events_<ts>.jsonl and consumed by tools/run_report.py.
+
+Same enablement contract as the metrics registry: `emit()` starts with
+one attribute check and allocates nothing while telemetry is off, so the
+call can sit on hot-ish paths unguarded (per-chunk, per-job — never
+per-frame).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+
+class EventLog:
+    """Thread-safe, in-memory, bounded event recorder.
+
+    The cap exists so a pathological emitter (e.g. a queue-depth sampler
+    on a week-long run) degrades to dropped samples + a drop counter,
+    never to unbounded host memory; `drops` is exported in the tail
+    record so a report can say the log is partial.
+    """
+
+    def __init__(self, max_events: int = 200_000) -> None:
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self.max_events = max_events
+        self.drops = 0
+        self.enabled = False
+        self._t0 = time.time()
+        self._t0_perf = time.perf_counter()
+
+    def emit(self, event: str, **fields) -> None:
+        if not self.enabled:
+            return
+        record = {
+            "t": round(time.perf_counter() - self._t0_perf, 6),
+            "event": event,
+        }
+        record.update(fields)
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.drops += 1
+                return
+            self._events.append(record)
+
+    def records(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.drops = 0
+        self._t0 = time.time()
+        self._t0_perf = time.perf_counter()
+
+    def write_jsonl(self, path: str) -> str:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with self._lock:
+            events = list(self._events)
+            drops = self.drops
+            t0 = self._t0
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "event": "log_meta", "t": 0.0, "epoch_t0": round(t0, 3),
+                "n_events": len(events), "dropped": drops,
+            }) + "\n")
+            for record in events:
+                f.write(json.dumps(record) + "\n")
+        return path
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Inverse of write_jsonl (used by tools/run_report.py); tolerates a
+    truncated final line from an interrupted writer."""
+    out: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                break
+    return out
+
+
+EVENTS = EventLog()
+
+
+def emit(event: str, **fields) -> None:
+    EVENTS.emit(event, **fields)
+
+
+class EventLogHandler(logging.Handler):
+    """Bridges WARNING+ chain log records into the event log, so the
+    structured record of a run carries the same anomalies the console
+    showed (skip-existing warnings, degraded-path notices, errors).
+
+    Runs as a SECOND handler on the "main" logger next to the ANSI
+    console handler — which is why `_ColorFormatter` must not mutate
+    `record.levelname` in place (utils/log.py): the escaped name would
+    leak into these structured records depending on handler order.
+    """
+
+    def __init__(self, log: Optional[EventLog] = None) -> None:
+        super().__init__(level=logging.WARNING)
+        self._log = log or EVENTS
+
+    def emit(self, record: logging.LogRecord) -> None:  # noqa: A003
+        try:
+            self._log.emit(
+                "log",
+                level=record.levelname,
+                message=record.getMessage(),
+            )
+        except Exception:  # pragma: no cover - logging must never raise
+            self.handleError(record)
+
+
+def attach_log_handler(logger: logging.Logger) -> EventLogHandler:
+    """Install (idempotently) the event-log bridge on `logger`."""
+    for h in logger.handlers:
+        if isinstance(h, EventLogHandler):
+            return h
+    handler = EventLogHandler()
+    logger.addHandler(handler)
+    return handler
+
+
+def detach_log_handler(logger: logging.Logger) -> None:
+    for h in list(logger.handlers):
+        if isinstance(h, EventLogHandler):
+            logger.removeHandler(h)

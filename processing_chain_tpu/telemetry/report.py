@@ -1,0 +1,339 @@
+"""Aggregate one run's telemetry artifacts into a human-readable report.
+
+Joins the three `--telemetry DIR` outputs (metrics_<ts>.json,
+events_<ts>.jsonl, metrics_<ts>.prom) with the span report
+(trace_<ts>.json) under the same stamp and renders:
+
+  * run header (stage selection, status, wall time),
+  * per-stage throughput table (frames decoded/encoded, frames/sec, MB/s),
+  * job accounting per runner (planned / skipped / deduped / failed / redone),
+  * top wall-time spans,
+  * pipeline stall diagnosis from queue-depth samples + blocked-time
+    counters (starved consumer vs. backed-up producer).
+
+Entry point: tools/run_report.py (repo root) or
+`python -m processing_chain_tpu.telemetry.report DIR`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from .events import read_jsonl
+
+_STAMP_RE = re.compile(r"metrics_(?P<stamp>.+)\.json$")
+
+
+@dataclass
+class RunData:
+    directory: str
+    stamp: str
+    metrics: dict = field(default_factory=dict)
+    events: list = field(default_factory=list)
+    trace: dict = field(default_factory=dict)
+
+
+class ReportError(ValueError):
+    """Raised when a run directory has no loadable telemetry artifacts."""
+
+
+def list_stamps(directory: str) -> list[str]:
+    """Run stamps in the directory, oldest first. Ordered by artifact
+    mtime, not stamp text: stamps embed an unpadded pid/sequence, so a
+    lexicographic sort could call an older run 'latest'."""
+    entries = []
+    for path in glob.glob(os.path.join(directory, "metrics_*.json")):
+        m = _STAMP_RE.search(os.path.basename(path))
+        if m:
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            entries.append((mtime, m.group("stamp")))
+    return [stamp for _, stamp in sorted(entries)]
+
+
+def load_run(directory: str, stamp: Optional[str] = None) -> RunData:
+    """Load the artifacts of one run (latest stamp unless given)."""
+    if not os.path.isdir(directory):
+        raise ReportError(f"not a directory: {directory}")
+    stamps = list_stamps(directory)
+    if stamp is None:
+        if not stamps:
+            raise ReportError(
+                f"no metrics_<ts>.json in {directory} — was the run started "
+                "with --telemetry?"
+            )
+        stamp = stamps[-1]
+    elif stamp not in stamps:
+        raise ReportError(f"no metrics_{stamp}.json in {directory}")
+    run = RunData(directory=directory, stamp=stamp)
+    with open(os.path.join(directory, f"metrics_{stamp}.json")) as f:
+        run.metrics = json.load(f)
+    events_path = os.path.join(directory, f"events_{stamp}.jsonl")
+    if os.path.isfile(events_path):
+        run.events = read_jsonl(events_path)
+    trace_path = os.path.join(directory, f"trace_{stamp}.json")
+    if os.path.isfile(trace_path):
+        with open(trace_path) as f:
+            run.trace = json.load(f)
+    return run
+
+
+# ------------------------------------------------------------- accessors
+
+
+def _series(run: RunData, name: str) -> list[dict]:
+    return run.metrics.get(name, {}).get("series", [])
+
+
+def _value(run: RunData, name: str, **labels) -> float:
+    for s in _series(run, name):
+        if s.get("labels", {}) == labels or not labels:
+            return float(s.get("value", s.get("sum", 0.0)))
+    return 0.0
+
+
+def _by_label(run: RunData, name: str, label: str) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    for s in _series(run, name):
+        out[s["labels"].get(label, "")] = s
+    return out
+
+
+def _events(run: RunData, kind: str) -> list[dict]:
+    return [e for e in run.events if e.get("event") == kind]
+
+
+# -------------------------------------------------------------- sections
+
+
+def _fmt_table(header: Sequence[str], rows: list[Sequence[str]]) -> list[str]:
+    widths = [len(h) for h in header]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    def line(cells):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths)).rstrip()
+    out = [line(header), line("-" * w for w in widths)]
+    out.extend(line(r) for r in rows)
+    return out
+
+
+def _header_section(run: RunData) -> list[str]:
+    lines = [f"run {run.stamp}  ({run.directory})"]
+    starts = _events(run, "run_start")
+    ends = _events(run, "run_end")
+    if starts:
+        s = starts[0]
+        lines.append(
+            f"  command: {s.get('name', '?')}  argv: {' '.join(s.get('argv', []))}"
+        )
+    if ends:
+        e = ends[-1]
+        lines.append(
+            f"  status: {e.get('status', '?')}  wall: {e.get('duration_s', '?')}s"
+        )
+    return lines
+
+
+def _stage_section(run: RunData) -> list[str]:
+    stage_ends = _events(run, "stage_end")
+    if not stage_ends:
+        return ["no stage_end events (single-layer run?)"]
+    rows = []
+    for e in stage_ends:
+        wall = float(e.get("duration_s", 0.0)) or 1e-9
+        frames = float(e.get("frames_encoded", 0.0))
+        dec = float(e.get("frames_decoded", 0.0))
+        mb = float(e.get("bytes_encoded", 0.0)) / 1e6
+        rows.append((
+            e.get("stage", "?"),
+            e.get("status", "?"),
+            f"{wall:.2f}",
+            f"{int(dec)}",
+            f"{int(frames)}",
+            f"{frames / wall:.1f}",
+            f"{mb / wall:.1f}",
+        ))
+    return _fmt_table(
+        ("stage", "status", "wall_s", "frames_dec", "frames_enc",
+         "frames/s", "MB/s"),
+        rows,
+    )
+
+
+def _jobs_section(run: RunData) -> list[str]:
+    names = {
+        "planned": "chain_jobs_planned_total",
+        "skipped": "chain_jobs_skipped_total",
+        "deduped": "chain_jobs_deduped_total",
+        "failed": "chain_jobs_failed_total",
+    }
+    per_runner: dict[str, dict[str, int]] = {}
+    for col, metric in names.items():
+        for runner, s in _by_label(run, metric, "runner").items():
+            per_runner.setdefault(runner, {})[col] = int(s.get("value", 0))
+    # chain-wide (the redo decision predates runner attribution)
+    redone = int(_value(run, "chain_jobs_redone_total"))
+    if not per_runner and not redone:
+        return ["no job counters recorded"]
+    rows = [
+        (runner, *(per_runner[runner].get(c, 0) for c in names))
+        for runner in sorted(per_runner)
+    ]
+    lines = _fmt_table(("runner", *names), rows) if rows else []
+    if redone:
+        lines.append(f"redone over crash sentinels (chain-wide): {redone}")
+    return lines
+
+
+def _spans_section(run: RunData, top: int = 10) -> list[str]:
+    summary = run.trace.get("summary", {})
+    if not summary:
+        return ["no span report (trace_<ts>.json missing)"]
+    items = sorted(summary.items(), key=lambda kv: -kv[1]["total_s"])[:top]
+    rows = [
+        (name[:56], e["count"], f"{e['total_s']:.3f}", f"{e['max_s']:.3f}")
+        for name, e in items
+    ]
+    return _fmt_table(("span", "count", "total_s", "max_s"), rows)
+
+
+def _queue_stats(run: RunData) -> dict[str, dict]:
+    """{queue: {samples, mean_depth}} from the depth histogram."""
+    out = {}
+    for queue, s in _by_label(run, "chain_queue_depth", "queue").items():
+        n = int(s.get("count", 0))
+        out[queue] = {
+            "samples": n,
+            "mean_depth": (float(s.get("sum", 0.0)) / n) if n else 0.0,
+        }
+    return out
+
+
+def _stall_section(run: RunData) -> list[str]:
+    queues = _queue_stats(run)
+    waits = {
+        side: float(s.get("value", 0.0))
+        for side, s in _by_label(
+            run, "chain_pipeline_wait_seconds_total", "side"
+        ).items()
+    }
+    if not queues and not waits:
+        return ["no pipeline samples (no prefetch activity in this run)"]
+    lines = []
+    for queue, st in sorted(queues.items()):
+        lines.append(
+            f"  queue {queue}: {st['samples']} samples, "
+            f"mean depth {st['mean_depth']:.2f}"
+        )
+    for side, total in sorted(waits.items()):
+        lines.append(f"  blocked on {side}: {total:.2f}s total")
+    # diagnosis: a consumer repeatedly finding its decode queue empty is
+    # starved (decode-bound run); a producer blocked pushing into a full
+    # encode queue means writeback can't keep up (encode-bound run).
+    consumer_wait = waits.get("consumer", 0.0)
+    producer_wait = waits.get("producer", 0.0)
+    decode_depth = queues.get("decode", {}).get("mean_depth")
+    encode_depth = queues.get("encode", {}).get("mean_depth")
+    if decode_depth is not None and decode_depth < 0.5 and consumer_wait > max(
+        1.0, 2 * producer_wait
+    ):
+        lines.append(
+            "  diagnosis: consumer starved (decode queue mostly empty, "
+            "device/compute waiting on decode) — raise decode workers or "
+            "prefetch depth"
+        )
+    elif encode_depth is not None and encode_depth >= 2.0 and producer_wait > max(
+        1.0, 2 * consumer_wait
+    ):
+        lines.append(
+            "  diagnosis: producer blocked (encode queue full, writeback "
+            "can't keep up) — raise FFV1 workers or writer depth"
+        )
+    else:
+        lines.append("  diagnosis: no stall signature (pipeline balanced)")
+    return lines
+
+
+def _device_section(run: RunData) -> list[str]:
+    compiles = _events(run, "device_step")
+    steps = _by_label(run, "chain_device_step_seconds", "step")
+    if not compiles and not steps:
+        return []
+    lines = ["device steps:"]
+    for step, s in sorted(steps.items()):
+        n = int(s.get("count", 0))
+        if n:
+            lines.append(
+                f"  {step}: {n} dispatches, {float(s['sum']):.3f}s total"
+            )
+    for e in compiles:
+        if e.get("first"):
+            lines.append(
+                f"  {e.get('step', '?')}: first dispatch (incl. compile) "
+                f"{e.get('duration_s', '?')}s"
+            )
+    return lines
+
+
+def render_report(run: RunData) -> str:
+    parts = [
+        "\n".join(_header_section(run)),
+        "stage throughput:\n" + "\n".join(f"  {l}" for l in _stage_section(run)),
+        "jobs:\n" + "\n".join(f"  {l}" for l in _jobs_section(run)),
+        "top spans:\n" + "\n".join(f"  {l}" for l in _spans_section(run)),
+        "pipeline:\n" + "\n".join(_stall_section(run)),
+    ]
+    device = _device_section(run)
+    if device:
+        parts.append("\n".join(device))
+    warnings = [
+        e for e in _events(run, "log")
+        if e.get("level") in ("WARNING", "ERROR", "CRITICAL")
+    ]
+    if warnings:
+        parts.append(
+            f"log anomalies ({len(warnings)}):\n" + "\n".join(
+                f"  [{e['level']}] {e.get('message', '')[:100]}"
+                for e in warnings[:15]
+            )
+        )
+    return "\n\n".join(parts) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render a human-readable report from a --telemetry DIR"
+    )
+    parser.add_argument("directory", help="directory holding metrics_<ts>.json etc.")
+    parser.add_argument(
+        "--stamp", default=None,
+        help="specific run stamp (default: latest in the directory)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list run stamps and exit"
+    )
+    args = parser.parse_args(argv)
+    if args.list:
+        for stamp in list_stamps(args.directory):
+            print(stamp)
+        return 0
+    try:
+        run = load_run(args.directory, args.stamp)
+    except ReportError as exc:
+        print(f"run-report: {exc}")
+        return 1
+    print(render_report(run), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,8 +22,14 @@ _RESET = "\033[0m"
 class _ColorFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         if sys.stderr.isatty():
+            # format a COPY: the record is shared with every other handler
+            # on the logger (e.g. the telemetry event-log bridge), and an
+            # in-place escape would leak ANSI codes into structured output
+            # depending on handler order
+            colored = logging.makeLogRecord(record.__dict__)
             color = _COLORS.get(record.levelno, "")
-            record.levelname = f"{color}{record.levelname}{_RESET}"
+            colored.levelname = f"{color}{record.levelname}{_RESET}"
+            return super().format(colored)
         return super().format(record)
 
 

@@ -1,7 +1,7 @@
 """CLI flag surface — parity with reference lib/parse_args.py:25-137.
 
 All shared flags (-c -f -v -n -p -r --filter-src/hrc/pvs -sos -str
---skip-requirements --trace) plus per-stage extras: -g/--set-gpu-loc on
+--skip-requirements --trace --telemetry) plus per-stage extras: -g/--set-gpu-loc on
 p00/p01/p03/p04 (device index pinning the p03/p04 device work; accepted on
 p01 for reference-CLI compatibility), p03 -s/--spinner-path
 -z/--avpvs-src-fps -f60/--force-60-fps, p04 -e -a -ccrf.
@@ -125,6 +125,12 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
         "--trace", nargs="?", const="", default=None, metavar="DIR",
         help="record per-op timing spans to the database logs/ folder; "
         "with DIR, also capture a jax.profiler device trace there",
+    )
+    parser.add_argument(
+        "--telemetry", default=None, metavar="DIR",
+        help="enable the metrics registry + structured event log and "
+        "write metrics_<ts>.json, metrics_<ts>.prom, events_<ts>.jsonl "
+        "and trace_<ts>.json into DIR (render with tools/run_report.py)",
     )
     return parser
 

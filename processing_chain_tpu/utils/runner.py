@@ -19,13 +19,22 @@ Deliberate fixes over the reference (SURVEY.md quirks list — do-not-copy):
 from __future__ import annotations
 
 import subprocess
+import time
 from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
+from .. import telemetry as tm
 from .log import get_logger
 
 logger_ = get_logger
+
+_IN_FLIGHT = tm.gauge(
+    "chain_runner_in_flight", "tasks currently executing", ("runner",)
+)
+_TASK_SECONDS = tm.histogram(
+    "chain_task_duration_seconds", "per-task latency", ("runner",)
+)
 
 
 @dataclass
@@ -67,6 +76,22 @@ class ParallelRunner:
     def __len__(self) -> int:
         return len(self._tasks)
 
+    def _call(self, task: Task) -> Any:
+        """Worker-side task body with concurrency/latency telemetry (one
+        flag check per TASK when disabled — never per item of work)."""
+        if not tm.enabled():
+            return task.fn(*task.args, **task.kwargs)
+        in_flight = _IN_FLIGHT.labels(runner=self.name)
+        in_flight.inc()
+        t0 = time.perf_counter()
+        try:
+            return task.fn(*task.args, **task.kwargs)
+        finally:
+            in_flight.dec()
+            _TASK_SECONDS.labels(runner=self.name).observe(
+                time.perf_counter() - t0
+            )
+
     def run(self) -> dict[str, Any]:
         """Run all tasks; raise ChainError on first failure (fail-fast,
         reference cmd_utils.py:97-99 aborts the whole run on any nonzero
@@ -76,26 +101,32 @@ class ParallelRunner:
             return self.results
         log = logger_()
         log.debug("%s: running %d tasks, %d-wide", self.name, len(self._tasks), self.max_parallel)
-        with ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
-            futures = {pool.submit(t.fn, *t.args, **t.kwargs): t for t in self._tasks}
-            done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
-            first_err: BaseException | None = None
-            err_task: Task | None = None
-            for fut in done:
-                task = futures[fut]
-                exc = fut.exception()
-                if exc is not None and first_err is None:
-                    first_err, err_task = exc, task
-                elif exc is None:
-                    self.results[task.key()] = fut.result()
-            if first_err is not None:
-                for fut in not_done:
-                    fut.cancel()
-                raise ChainError(
-                    f"{self.name}: task '{err_task.key()}' failed: {first_err!r}"
-                ) from first_err
-        self._tasks.clear()
-        self._seen.clear()
+        try:
+            with ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
+                futures = {pool.submit(self._call, t): t for t in self._tasks}
+                done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
+                first_err: BaseException | None = None
+                err_task: Task | None = None
+                for fut in done:
+                    task = futures[fut]
+                    exc = fut.exception()
+                    if exc is not None and first_err is None:
+                        first_err, err_task = exc, task
+                    elif exc is None:
+                        self.results[task.key()] = fut.result()
+                if first_err is not None:
+                    for fut in not_done:
+                        fut.cancel()
+                    raise ChainError(
+                        f"{self.name}: task '{err_task.key()}' failed: {first_err!r}"
+                    ) from first_err
+        finally:
+            # batch state is consumed either way: a caller that catches
+            # ChainError and retries must not silently re-run the failed
+            # batch on top of its new tasks (stale _seen would also
+            # dedup-away legitimate resubmissions)
+            self._tasks.clear()
+            self._seen.clear()
         return self.results
 
 

@@ -99,9 +99,16 @@ class Tracer:
 
     def write_report(self, logs_dir: str, name: str = "") -> str:
         """Write spans + summary as JSON next to the provenance logs.
-        Returns the report path."""
+        Returns the report path. The default stamp is collision-safe:
+        two stages finishing within the same second (or two processes
+        sharing a logs dir) must not overwrite each other's report."""
         os.makedirs(logs_dir, exist_ok=True)
-        stamp = name or time.strftime("%Y%m%d-%H%M%S")
+        if name:
+            stamp = name
+        else:
+            from .. import telemetry
+
+            stamp = telemetry.unique_stamp()
         path = os.path.join(logs_dir, f"trace_{stamp}.json")
         payload = {
             "summary": self.summary(),

@@ -1,0 +1,396 @@
+"""Telemetry subsystem tests: metrics registry (labels, buckets, thread
+safety), structured event log, stage spans, the Tracer report additions,
+and the end-to-end contract that a JobRunner run emits planned / skipped
+/ failed events matching its jobs (docs/TELEMETRY.md)."""
+
+import json
+import logging
+import os
+import threading
+import time
+
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.telemetry import report as report_mod
+from processing_chain_tpu.telemetry.metrics import MetricError, MetricsRegistry
+from processing_chain_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """Every test starts from zeroed series + empty event log, enabled;
+    the process-wide default (disabled) is restored afterwards so other
+    test modules never see telemetry side effects."""
+    tm.reset()
+    tm.enable()
+    yield
+    tm.disable()
+    tm.reset()
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_counter_labels_and_get():
+    c = tm.counter("t_req_total", "requests", ("verb",))
+    c.labels(verb="get").inc()
+    c.labels(verb="get").inc(2)
+    c.labels(verb="put").inc()
+    assert c.labels(verb="get").get() == 3
+    assert c.labels(verb="put").get() == 1
+    # same name returns the same metric, values included
+    again = tm.counter("t_req_total", "requests", ("verb",))
+    assert again.labels(verb="get").get() == 3
+
+
+def test_disabled_registry_is_noop():
+    tm.disable()
+    c = tm.counter("t_noop_total")
+    c.inc(100)
+    g = tm.gauge("t_noop_gauge")
+    g.set(5)
+    h = tm.histogram("t_noop_hist")
+    h.observe(1.0)
+    tm.enable()
+    assert c.get() == 0
+    assert g.get() == 0
+    assert h.get() == 0
+    assert "t_noop_total" not in [
+        n for n, d in tm.REGISTRY.snapshot().items() if d["series"]
+    ]
+
+
+def test_kind_and_label_contracts():
+    tm.counter("t_contract_total", labelnames=("a",))
+    with pytest.raises(MetricError, match="re-registered"):
+        tm.gauge("t_contract_total", labelnames=("a",))
+    with pytest.raises(MetricError, match="re-registered"):
+        tm.counter("t_contract_total", labelnames=("b",))
+    c = tm.counter("t_contract_total", labelnames=("a",))
+    with pytest.raises(MetricError, match="expected labels"):
+        c.labels(wrong="x")
+    h = tm.histogram("t_contract_hist")
+    with pytest.raises(MetricError, match="inc"):
+        h.inc()
+    with pytest.raises(MetricError, match="observe"):
+        c.observe(1.0)
+    with pytest.raises(MetricError, match="dec"):
+        c.dec()
+
+
+def test_histogram_bucket_placement():
+    h = tm.histogram("t_lat_seconds", buckets=(0.1, 1.0, 5.0))
+    for v in (0.05, 0.1, 0.5, 10.0):  # boundary 0.1 is le-inclusive
+        h.observe(v)
+    snap = tm.REGISTRY.snapshot()["t_lat_seconds"]
+    (series,) = snap["series"]
+    assert series["count"] == 4
+    assert series["sum"] == pytest.approx(10.65)
+    assert series["buckets"] == {"0.1": 2, "1.0": 1, "5.0": 0, "+Inf": 1}
+
+
+def test_concurrent_increments_from_threads():
+    c = tm.counter("t_threads_total")
+    h = tm.histogram("t_threads_hist", buckets=(0.5,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.get() == 8000
+    snap = tm.REGISTRY.snapshot()["t_threads_hist"]["series"][0]
+    assert snap["count"] == 8000 and snap["buckets"]["0.5"] == 8000
+
+
+def test_reset_keeps_registrations_and_bound_handles():
+    c = tm.counter("t_reset_total", labelnames=("k",))
+    bound = c.labels(k="x")
+    bound.inc(7)
+    tm.reset()
+    assert bound.get() == 0
+    bound.inc()  # the pre-reset handle still feeds the same series
+    assert c.labels(k="x").get() == 1
+
+
+def test_prometheus_render_format():
+    reg = MetricsRegistry()
+    reg.enabled = True
+    reg.counter("r_total", "help text", ("q",)).labels(q='a"b').inc(2)
+    reg.histogram("r_seconds", "", (), buckets=(1.0, 2.0)).observe(1.5)
+    text = reg.render_prometheus()
+    assert "# HELP r_total help text" in text
+    assert "# TYPE r_total counter" in text
+    assert 'r_total{q="a\\"b"} 2' in text
+    # histogram buckets are cumulative and end with +Inf == count
+    assert 'r_seconds_bucket{le="1.0"} 0' in text
+    assert 'r_seconds_bucket{le="2.0"} 1' in text
+    assert 'r_seconds_bucket{le="+Inf"} 1' in text
+    assert "r_seconds_sum 1.5" in text
+    assert "r_seconds_count 1" in text
+
+
+# -------------------------------------------------------------- event log
+
+
+def test_event_log_roundtrip(tmp_path):
+    tm.emit("thing", a=1, s="x")
+    tm.emit("thing", a=2)
+    path = tm.EVENTS.write_jsonl(str(tmp_path / "events.jsonl"))
+    records = tm.read_jsonl(path)
+    assert records[0]["event"] == "log_meta" and records[0]["n_events"] == 2
+    body = [r for r in records if r["event"] == "thing"]
+    assert [r["a"] for r in body] == [1, 2]
+    assert all("t" in r for r in body)
+
+
+def test_event_log_bounded(tmp_path):
+    from processing_chain_tpu.telemetry.events import EventLog
+
+    log = EventLog(max_events=3)
+    log.enabled = True
+    for i in range(5):
+        log.emit("e", i=i)
+    assert len(log.records()) == 3 and log.drops == 2
+    path = log.write_jsonl(str(tmp_path / "e.jsonl"))
+    assert tm.read_jsonl(path)[0]["dropped"] == 2
+
+
+def test_read_jsonl_tolerates_truncated_tail(tmp_path):
+    path = tmp_path / "t.jsonl"
+    path.write_text('{"event": "a"}\n{"event": "b"}\n{"eve')
+    assert [r["event"] for r in tm.read_jsonl(str(path))] == ["a", "b"]
+
+
+def test_log_handler_bridges_warnings():
+    logger = logging.getLogger("t_telemetry_bridge")
+    logger.setLevel(logging.DEBUG)
+    handler = tm.attach_log_handler(logger)
+    try:
+        assert tm.attach_log_handler(logger) is handler  # idempotent
+        logger.info("quiet")
+        logger.warning("loud %d", 7)
+    finally:
+        tm.detach_log_handler(logger)
+    logs = [r for r in tm.EVENTS.records() if r["event"] == "log"]
+    assert len(logs) == 1  # INFO stays below the bridge's threshold
+    assert logs[0]["level"] == "WARNING" and logs[0]["message"] == "loud 7"
+
+
+def test_color_formatter_does_not_mutate_record(monkeypatch):
+    """Satellite fix: with the telemetry JSONL handler as a second
+    handler, an in-place ANSI escape on the record would leak into
+    structured output — the formatter must format a copy."""
+    import sys
+
+    from processing_chain_tpu.utils.log import _ColorFormatter
+
+    monkeypatch.setattr(sys.stderr, "isatty", lambda: True)
+    record = logging.LogRecord(
+        "main", logging.WARNING, __file__, 1, "msg", (), None
+    )
+    out = _ColorFormatter("%(levelname)s %(message)s").format(record)
+    assert "\033[" in out
+    assert record.levelname == "WARNING"
+
+
+# ------------------------------------------------------------ stage spans
+
+
+def test_stage_span_emits_counter_deltas():
+    tm.FRAMES_DECODED.inc(5)  # pre-existing activity must not leak in
+    with tm.stage_span("pXX"):
+        tm.FRAMES_DECODED.inc(10)
+        tm.FRAMES_ENCODED.inc(8)
+        tm.BYTES_ENCODED.inc(1024)
+    starts = [r for r in tm.EVENTS.records() if r["event"] == "stage_start"]
+    ends = [r for r in tm.EVENTS.records() if r["event"] == "stage_end"]
+    assert len(starts) == 1 and len(ends) == 1
+    end = ends[0]
+    assert end["stage"] == "pXX" and end["status"] == "ok"
+    assert end["frames_decoded"] == 10
+    assert end["frames_encoded"] == 8
+    assert end["bytes_encoded"] == 1024
+    assert tm.STAGE_SECONDS.labels(stage="pXX").get() >= 0
+
+
+def test_stage_span_marks_failure():
+    with pytest.raises(RuntimeError):
+        with tm.stage_span("pYY"):
+            raise RuntimeError("boom")
+    (end,) = [r for r in tm.EVENTS.records() if r["event"] == "stage_end"]
+    assert end["status"] == "fail"
+
+
+# ----------------------------------------------------------------- tracer
+
+
+def test_tracer_summary_aggregates():
+    tracer = tracing.Tracer()
+    for _ in range(3):
+        with tracer.span("op"):
+            pass
+    with tracer.span("other"):
+        pass
+    summary = tracer.summary()
+    assert summary["op"]["count"] == 3 and summary["other"]["count"] == 1
+    assert summary["op"]["max_s"] <= summary["op"]["total_s"]
+
+
+def test_tracer_write_report_collision_safe(tmp_path):
+    """Two stages finishing within the same wall-clock second must not
+    overwrite each other's trace report."""
+    tracer = tracing.Tracer()
+    with tracer.span("op"):
+        pass
+    p1 = tracer.write_report(str(tmp_path))
+    p2 = tracer.write_report(str(tmp_path))
+    assert p1 != p2 and os.path.isfile(p1) and os.path.isfile(p2)
+    with open(p1) as f:
+        payload = json.load(f)
+    assert payload["summary"]["op"]["count"] == 1
+    assert payload["spans"][0]["name"] == "op"
+    named = tracer.write_report(str(tmp_path), name="fixed")
+    assert named.endswith("trace_fixed.json")
+
+
+def test_unique_stamp_never_collides():
+    stamps = {tm.unique_stamp() for _ in range(50)}
+    assert len(stamps) == 50
+
+
+# ------------------------------------------------------- JobRunner events
+
+
+def _job_events(kind):
+    return [r for r in tm.EVENTS.records() if r["event"] == kind]
+
+
+def test_jobrunner_run_emits_matching_events(tmp_path):
+    from processing_chain_tpu.engine.jobs import Job, JobRunner
+    from processing_chain_tpu.utils.runner import ChainError
+
+    existing = tmp_path / "done.avi"
+    existing.write_bytes(b"x")
+    runner = JobRunner(name="tele-test", parallelism=2)
+    runner.add(Job(label="ok", output_path=str(tmp_path / "ok.avi"),
+                   fn=lambda: (tmp_path / "ok.avi").write_bytes(b"y")))
+    runner.add(Job(label="skipme", output_path=str(existing), fn=lambda: None))
+    runner.add(Job(label="ok", output_path=str(tmp_path / "ok.avi"),
+                   fn=lambda: None))  # identical plan: dedup
+
+    def boom():
+        raise ValueError("nope")
+
+    runner.add(Job(label="bad", output_path=str(tmp_path / "bad.avi"), fn=boom))
+    with pytest.raises(ChainError, match="bad"):
+        runner.run()
+
+    lbl = dict(runner="tele-test")
+    planned = tm.REGISTRY.snapshot()["chain_jobs_planned_total"]["series"]
+    assert {"labels": lbl, "value": 2} in planned
+    assert [e["job"] for e in _job_events("job_planned")] == ["ok", "bad"]
+    (skip,) = _job_events("job_skip")
+    assert skip["job"] == "skipme" and skip["reason"] == "output_exists"
+    ends = {e["job"]: e["status"] for e in _job_events("job_end")}
+    assert ends == {"ok": "ok", "bad": "fail"}
+    snap = tm.REGISTRY.snapshot
+    assert {"labels": lbl, "value": 1} in snap()["chain_jobs_skipped_total"]["series"]
+    assert {"labels": lbl, "value": 1} in snap()["chain_jobs_deduped_total"]["series"]
+    assert {"labels": lbl, "value": 1} in snap()["chain_jobs_failed_total"]["series"]
+
+
+def test_jobrunner_redo_event_on_crash_sentinel(tmp_path):
+    from processing_chain_tpu.engine.jobs import Job, JobRunner, mark_inprogress
+
+    out = tmp_path / "half.avi"
+    out.write_bytes(b"partial")
+    mark_inprogress(str(out))  # simulate a crashed writer
+    runner = JobRunner(name="tele-redo")
+    runner.add(Job(label="redo", output_path=str(out),
+                   fn=lambda: out.write_bytes(b"full")))
+    runner.run()
+    (redo,) = [r for r in tm.EVENTS.records() if r["event"] == "job_redo"]
+    assert redo["reason"] == "crash_sentinel"
+    assert tm.REGISTRY.snapshot()["chain_jobs_redone_total"]["series"][0]["value"] == 1
+
+
+# -------------------------------------------------- outputs + run report
+
+
+def _make_run_dir(tmp_path):
+    """Simulate an instrumented run and persist its artifacts."""
+    tm.emit("run_start", name="p03", argv=["-c", "db.yaml"])
+    with tm.stage_span("p03"):
+        tm.FRAMES_DECODED.inc(480)
+        tm.FRAMES_ENCODED.inc(480)
+        tm.BYTES_ENCODED.inc(480 * 320 * 180)
+    tm.counter(
+        "chain_jobs_planned_total", labelnames=("runner",)
+    ).labels(runner="avpvs").inc(3)
+    tm.counter("chain_jobs_redone_total").inc(2)
+    tm.emit("run_end", status="ok", duration_s=1.25)
+    paths = tm.write_outputs(str(tmp_path))
+    tracer = tracing.Tracer()
+    with tracer.span("avpvs P2SXM00_SRC000_HRC000"):
+        pass
+    tracer.write_report(str(tmp_path), name=paths["stamp"])
+    return paths
+
+
+def test_write_outputs_one_stamp(tmp_path):
+    paths = _make_run_dir(tmp_path)
+    stamp = paths["stamp"]
+    for key, suffix in (("metrics", ".json"), ("prom", ".prom"),
+                        ("events", ".jsonl")):
+        assert os.path.isfile(paths[key])
+        assert paths[key].endswith(f"_{stamp}{suffix}")
+    with open(paths["metrics"]) as f:
+        snap = json.load(f)
+    assert snap["chain_frames_decoded_total"]["series"][0]["value"] == 480
+    prom = open(paths["prom"]).read()
+    assert "# TYPE chain_frames_decoded_total counter" in prom
+
+
+def test_run_report_renders_throughput_table(tmp_path, capsys):
+    _make_run_dir(tmp_path)
+    rc = report_mod.main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "p03" in out and "frames/s" in out
+    # 480 frames over the (fast) measured stage wall → nonzero rate
+    stage_line = next(l for l in out.splitlines() if l.strip().startswith("p03"))
+    rate = float(stage_line.split()[-2])
+    assert rate > 0
+    assert "planned" in out and "avpvs" in out
+    # redone has no runner label: a chain-wide line, never a phantom row
+    assert "redone over crash sentinels (chain-wide): 2" in out
+    assert "top spans" in out
+
+
+def test_list_stamps_ordered_by_mtime_not_text(tmp_path):
+    """Stamps embed unpadded pid/seq, so 'latest' must come from file
+    mtime — lexicographically, 9999-9 would wrongly sort after 10000-10."""
+    old = tmp_path / "metrics_20260802-120000-9999-9.json"
+    new = tmp_path / "metrics_20260802-120000-10000-10.json"
+    for p in (old, new):
+        p.write_text("{}")
+    now = time.time()
+    os.utime(old, (now - 100, now - 100))
+    os.utime(new, (now, now))
+    stamps = report_mod.list_stamps(str(tmp_path))
+    assert stamps == ["20260802-120000-9999-9", "20260802-120000-10000-10"]
+
+
+def test_run_report_lists_stamps_and_rejects_empty(tmp_path, capsys):
+    assert report_mod.main([str(tmp_path)]) == 1
+    assert "--telemetry" in capsys.readouterr().out
+    paths = _make_run_dir(tmp_path)
+    assert report_mod.main([str(tmp_path), "--list"]) == 0
+    assert paths["stamp"] in capsys.readouterr().out

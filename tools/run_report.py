@@ -1,0 +1,22 @@
+"""Render a human-readable report from one run's `--telemetry DIR`.
+
+Joins metrics_<ts>.json + events_<ts>.jsonl + trace_<ts>.json under the
+latest (or --stamp'ed) run stamp and prints the stage-throughput table,
+job accounting, top spans, and a pipeline stall diagnosis. All logic
+lives in processing_chain_tpu.telemetry.report (see docs/TELEMETRY.md);
+this wrapper only makes it runnable from a checkout without installing.
+
+Usage: python tools/run_report.py DIR [--stamp STAMP] [--list]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from processing_chain_tpu.telemetry.report import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
