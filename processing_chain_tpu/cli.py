@@ -55,6 +55,11 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
     from .utils.device import ensure_backend
 
     ensure_backend()
+    from .store import runtime as store_runtime
+
+    store = store_runtime.configure_from_args(args)
+    if store is not None:
+        log_mod.get_logger().info("artifact store: %s", store.root)
     telemetry_dir = getattr(args, "telemetry", None)
     if telemetry_dir:
         telemetry.enable()
@@ -97,6 +102,10 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
     finally:
         if profiler is not None:
             profiler.stop()
+        if store is not None:
+            # persist the stat-keyed input digest cache (best-effort by
+            # contract) so the next run's plan hashing pays stats, not reads
+            store.digests.save()
         if telemetry_dir:
             _write_telemetry(telemetry_dir, status, time.perf_counter() - t0)
         if tracing_on:
@@ -129,7 +138,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
     """`tools <name> …` subcommands (reference util/ scripts)."""
     tools = (
         "src-analysis", "complexity", "plots", "metrics", "clean-logs",
-        "run-report",
+        "run-report", "store",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -141,6 +150,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .telemetry import report
 
             return report.main(rest)
+        if name == "store":
+            from .tools import store_admin
+
+            return store_admin.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

@@ -18,6 +18,13 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from .. import telemetry as tm
+from ..store import runtime as store_runtime
+from ..store.store import (
+    STORE_ADOPTIONS,
+    STORE_HITS,
+    STORE_MISSES,
+    StoreCorruption,
+)
 from ..utils import tracing
 from ..utils.log import get_logger
 from ..utils.runner import ChainError, ParallelRunner
@@ -58,6 +65,15 @@ def mark_inprogress(output_path: str) -> bool:
     if not output_path:
         return False
     try:
+        # CAS safety: a store-materialized output is HARDLINKED to its
+        # object. The encoders open output paths with truncation, which
+        # would destroy the shared inode — the store's bytes — while its
+        # manifest still vouches for them. Breaking the link first makes
+        # every rewrite copy-on-write with respect to the store. This is
+        # the one choke point every about-to-write site already passes
+        # through (Job.run and the p03 batch lanes).
+        if os.path.isfile(output_path) and os.stat(output_path).st_nlink > 1:
+            os.unlink(output_path)
         with open(output_path + ".inprogress", "w"):
             pass
         return True
@@ -76,20 +92,145 @@ def clear_inprogress(output_path: str) -> None:
 
 @dataclass
 class Job:
-    """One unit of work producing `output_path`."""
+    """One unit of work producing `output_path`.
+
+    With `plan` set and a store active (store/runtime), stale-vs-fresh is
+    plan-hash equality against the store instead of the reference's
+    "output exists" bit: one changed HRC parameter invalidates exactly
+    the artifacts downstream of it, and a corrupted cached object is
+    detected on read and transparently rebuilt. Jobs without a plan (or
+    runs without a store) keep the legacy skip-existing semantics.
+    """
 
     label: str
     output_path: str
     fn: Callable[[], Any]
     provenance: dict = field(default_factory=dict)
     logfile_path: Optional[str] = None
+    #: plan payload (store/keys schema; file inputs via keys.file_ref)
+    plan: Optional[dict] = None
+    #: `output_path + suffix` files committed/materialized with the artifact
+    sidecar_suffixes: tuple = ()
+    #: companion files at their own absolute paths (multi-output jobs,
+    #: e.g. p02's vfi/afi/buff next to the qchanges main output)
+    extra_outputs: tuple = ()
+    #: why should_run returned False
+    #: ("output_exists" | "store_hit" | "store_adopted")
+    skip_reason: Optional[str] = None
 
     @property
     def _sentinel_path(self) -> str:
         return self.output_path + ".inprogress"
 
-    def should_run(self, force: bool) -> bool:
+    def _resolve_plan_hash(self, store) -> Optional[str]:
+        """Hash this job's plan against the store; None (with a debug log)
+        when an input file is unreadable — e.g. a removed intermediate —
+        which degrades that one decision to the legacy exists-check."""
+        try:
+            return store.plan_hash(self.plan)
+        except OSError as exc:
+            get_logger().debug(
+                "store: cannot resolve plan for %s (%s); using legacy "
+                "skip-existing", self.label, exc,
+            )
+            return None
+
+    def _store_should_run(
+        self, store, force: bool, dry_run: bool, runner: str
+    ) -> bool:
+        """Plan-hash decision: hit → verify + materialize + skip;
+        corrupt/miss → run. Only called when the plan hash resolved."""
+        if force:
+            return True
+        manifest = store.lookup(self._plan_hash)
+        if manifest is not None:
+            if store.serve_hit(manifest, self.output_path,
+                               materialize=not dry_run):
+                STORE_HITS.labels(runner=runner).inc()
+                self.skip_reason = "store_hit"
+                if not dry_run:
+                    clear_inprogress(self.output_path)
+                return False
+            return True  # corruption converted to a miss; rebuild
+        STORE_MISSES.labels(runner=runner).inc()
+        if not os.path.isfile(self.output_path):
+            return True
+        if os.path.isfile(self._sentinel_path):
+            # crashed writer: never adopt a truncated output. Same redo
+            # forensics as the legacy path — the sentinel story in the
+            # event log must not disappear when --store is on.
+            get_logger().warning(
+                "output %s exists but its producing run never completed "
+                "(crashed?); re-running", self.output_path,
+            )
+            _JOBS_REDONE.inc()
+            tm.emit(
+                "job_redo", job=self.label,
+                output=os.path.basename(self.output_path),
+                reason="crash_sentinel",
+            )
+            return True
+        if not all(os.path.isfile(p) for p in self.extra_outputs):
+            return True  # partial multi-output set: rebuild, never adopt
+        if store.should_adopt(self.output_path):
+            # pre-store artifact on its first store-enabled run: keep the
+            # legacy skip-existing trust, but bind it to the current plan
+            # hash (with the commit-time integrity probe) so every LATER
+            # change is detected by hash inequality. A failed probe means
+            # the existing file is corrupt — rebuild it now.
+            if dry_run:  # planning must not mutate the store
+                self.skip_reason = "store_adopted"
+                return False
+            try:
+                store.commit(
+                    self._plan_hash, self.output_path, producer=self.label,
+                    provenance=self.provenance,
+                    sidecar_suffixes=self.sidecar_suffixes,
+                    extra_outputs=self.extra_outputs, adopted=True,
+                )
+            except (StoreCorruption, OSError) as exc:
+                get_logger().warning(
+                    "output %s exists but cannot be adopted into the store "
+                    "(%s); rebuilding", self.output_path, exc,
+                )
+                return True
+            STORE_ADOPTIONS.inc()
+            self.skip_reason = "store_adopted"
+            get_logger().info(
+                "output %s adopted into the artifact store (pre-store "
+                "artifact, first sight)", self.output_path,
+            )
+            return False
+        # the legacy idiom would have trusted this file; hash inequality
+        # against the plans that previously produced it says its plan
+        # changed under it
+        get_logger().info(
+            "output %s exists but its plan hash changed; rebuilding",
+            self.output_path,
+        )
+        _JOBS_REDONE.inc()
+        tm.emit(
+            "job_redo", job=self.label,
+            output=os.path.basename(self.output_path),
+            reason="plan_changed",
+        )
+        return True
+
+    def should_run(self, force: bool, dry_run: bool = False,
+                   runner: str = "") -> bool:
+        self.skip_reason = None
+        self._plan_hash = None
+        store = store_runtime.active()
+        if store is not None and self.plan is not None and self.output_path:
+            self._plan_hash = self._resolve_plan_hash(store)
+        if self._plan_hash is not None:
+            return self._store_should_run(store, force, dry_run, runner)
         if force or not self.output_path:
+            return True
+        if any(not os.path.isfile(p) for p in self.extra_outputs):
+            # a missing companion file must regenerate even when the main
+            # output exists (p02's tables are one artifact set; the model
+            # layer's per-file guards keep existing files untouched)
             return True
         if os.path.isfile(self.output_path):
             if os.path.isfile(self._sentinel_path):
@@ -115,8 +256,39 @@ class Job:
                 "force overwriting.",
                 self.output_path,
             )
+            self.skip_reason = "output_exists"
             return False
         return True
+
+    def commit_to_store(self) -> None:
+        """Bind the freshly-produced artifact to its plan hash. The hash
+        is ALWAYS re-resolved here: an input produced earlier in the same
+        run (p03's stalling pass reads the wo_buffer render) makes any
+        plan-time hash stale, and committing under it would bind the new
+        bytes to the old inputs. Store I/O failures degrade to a warning
+        (the artifact itself is complete); a failed container read-back
+        probe raises — an output that does not decode must fail HERE, not
+        when something consumes it."""
+        store = store_runtime.active()
+        if store is None or self.plan is None or not self.output_path:
+            return
+        self._plan_hash = self._resolve_plan_hash(store)
+        if self._plan_hash is None or not os.path.isfile(self.output_path):
+            return
+        try:
+            store.commit(
+                self._plan_hash, self.output_path, producer=self.label,
+                provenance=self.provenance,
+                sidecar_suffixes=self.sidecar_suffixes,
+                extra_outputs=self.extra_outputs,
+            )
+        except StoreCorruption:
+            raise
+        except OSError as exc:
+            get_logger().warning(
+                "store: could not commit %s (%s); artifact left uncached",
+                self.output_path, exc,
+            )
 
     def write_provenance(self) -> None:
         if not self.logfile_path:
@@ -159,6 +331,10 @@ class Job:
         tm.emit("job_end", job=self.label, status="ok",
                 duration_s=round(dur, 4))
         self.write_provenance()
+        # commit before the sentinel clears: a crash inside the commit
+        # leaves the sentinel, so the next run redoes the job instead of
+        # trusting an output the store never vouched for
+        self.commit_to_store()
         # removed only after the output (and its provenance) are complete:
         # a crash anywhere above leaves the sentinel and the next run redoes
         # the job instead of trusting a possibly-truncated artifact
@@ -220,7 +396,7 @@ class JobRunner:
                     f"write {job.output_path} — write-write race"
                 )
             self._writers[job.output_path] = job.label
-        if job.should_run(self.force):
+        if job.should_run(self.force, self.dry_run, runner=self.name):
             _JOBS_PLANNED.labels(runner=self.name).inc()
             tm.emit("job_planned", job=job.label, runner=self.name,
                     output=os.path.basename(job.output_path))
@@ -229,7 +405,7 @@ class JobRunner:
             _JOBS_SKIPPED.labels(runner=self.name).inc()
             tm.emit("job_skip", job=job.label, runner=self.name,
                     output=os.path.basename(job.output_path),
-                    reason="output_exists")
+                    reason=job.skip_reason or "output_exists")
 
     def _run_job(self, job: Job) -> Any:
         """Execute one job, attributing a failure to this runner's

@@ -39,6 +39,7 @@ from ..engine.jobs import Job
 from ..io import medialib
 from ..io.video import VideoReader, VideoWriter
 from ..ops import overlay as ov
+from ..store import keys as store_keys
 from ..utils import fsio
 from ..utils.log import get_logger
 from . import frames as fr
@@ -104,6 +105,18 @@ def avpvs_codec() -> str:
     return codec
 
 
+def effective_avpvs_codec(pix_fmt: str) -> str:
+    """The codec that will actually be written for this pix_fmt: the
+    requested intermediate codec, except that 10-bit rawvideo degrades to
+    ffv1 (`_ffv1_writer`'s AVI-fourcc fallback). Provenance and plan
+    payloads record THIS, so artifacts stay attributable to the encoder
+    that really produced them."""
+    codec = avpvs_codec()
+    if codec == "rawvideo" and "10" in pix_fmt:
+        return "ffv1"
+    return codec
+
+
 def ffv1_workers() -> int:
     """Frame-parallel FFV1 encoder contexts (native/media.cpp fp mode).
     PC_FFV1_WORKERS=N pins it; default: one worker per spare core, capped
@@ -148,10 +161,22 @@ def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
     frac = Fraction(rate).limit_denominator(1001)
     audio = dict(audio_codec=audio_codec, sample_rate=sample_rate, channels=2) if with_audio else {}
     if avpvs_codec() == "rawvideo":
-        return VideoWriter(
-            path, "rawvideo", w, h, pix_fmt,
-            (frac.numerator, frac.denominator), **audio,
-        )
+        if "10" in pix_fmt:
+            # AVI has no fourcc for planar 10-bit rawvideo: the muxer
+            # writes the tag-less stream anyway and every later read
+            # decodes garbage (silent corruption, round-5 advisor repro).
+            # FFV1 carries 10-bit losslessly, so fall back rather than
+            # produce bytes that cannot round-trip.
+            get_logger().warning(
+                "%s: rawvideo cannot carry 10-bit %s in AVI (no fourcc; "
+                "reads back as garbage) — falling back to ffv1",
+                path, pix_fmt,
+            )
+        else:
+            return VideoWriter(
+                path, "rawvideo", w, h, pix_fmt,
+                (frac.numerator, frac.denominator), **audio,
+            )
     # FFV1 level 3 + slicecrc stream integrity (reference :1047: -level 3
     # -coder 1 -context 1 -slicecrc 1); -threads 4 parity. With fp
     # workers, parallelism moves from slices to whole frames (gop=1) and
@@ -309,8 +334,36 @@ def _wo_buffer_out_path(pvs: Pvs) -> str:
     )
 
 
+def _wo_buffer_plan(
+    pvs: Pvs, w: int, h: int, pix_fmt: str,
+    avpvs_src_fps: bool, force_60_fps: bool,
+) -> dict:
+    """Plan payload for the wo_buffer render: encoded segment digests,
+    the SRC (long tests mux its audio), canvas geometry, and the rate /
+    codec knobs. fp-worker count is deliberately absent — frame-parallel
+    FFV1 yields different bytes but identical decoded frames, and the
+    cache key tracks semantic content inputs, not byte-stream accidents."""
+    tc = pvs.test_config
+    return {
+        "op": "avpvs_wo_buffer",
+        "segments": [store_keys.file_ref(s.file_path) for s in pvs.segments],
+        "src_audio": (
+            store_keys.file_ref(pvs.src.file_path) if tc.is_long() else None
+        ),
+        "canvas": [w, h],
+        "pix_fmt": pix_fmt,
+        "codec": effective_avpvs_codec(pix_fmt),
+        "rate": {
+            "avpvs_src_fps": bool(avpvs_src_fps),
+            "force_60_fps": bool(force_60_fps),
+        },
+        "durations": [float(s.get_segment_duration()) for s in pvs.segments]
+        if tc.is_long() else None,
+    }
+
+
 def _wo_buffer_provenance(pvs: Pvs, w: int, h: int, pix_fmt: str) -> dict:
-    codec = avpvs_codec()
+    codec = effective_avpvs_codec(pix_fmt)
     workers = ffv1_workers() if codec == "ffv1" else 0
     return {
         "pvs": pvs.pvs_id,
@@ -403,6 +456,8 @@ def create_avpvs_wo_buffer(
         output_path=out_path,
         fn=run,
         logfile_path=pvs.get_logfile_path(),
+        plan=_wo_buffer_plan(pvs, w, h, pix_fmt, avpvs_src_fps, force_60_fps),
+        sidecar_suffixes=(".siti.csv",),
         provenance=_wo_buffer_provenance(pvs, w, h, pix_fmt),
     )
 
@@ -905,10 +960,34 @@ def apply_stalling(
         prov["spinner_kinematics"] = dict(
             SPINNER_KINEMATICS, n_rotations=n_rotations
         )
+    # plan: the wo_buffer render is THE input (its digest covers every
+    # upstream knob transitively), plus the stall schedule and spinner.
+    # NOTE the input file is produced earlier in the same p03 run, so the
+    # stage plans stalling only after phase one executed (commit_to_store
+    # re-resolves the hash at commit time regardless).
+    plan = {
+        "op": "avpvs_stalling",
+        "input": store_keys.file_ref(in_path),
+        "events": [[float(e[0]), float(e[1])] for e in events],
+        "mode": "skipping" if skipping else "spinner-stall",
+        "spinner": (
+            store_keys.file_ref(spinner_path)
+            if not skipping and spinner_path else None
+        ),
+        "kinematics": (
+            dict(SPINNER_KINEMATICS, n_rotations=n_rotations)
+            if not skipping else None
+        ),
+        # requested, not effective: the input's pix_fmt is unknown until
+        # run time, so a 10-bit ffv1 fallback over-invalidates on codec
+        # flips rather than under-invalidating
+        "codec": avpvs_codec(),
+    }
     return Job(
         label=f"stalling {pvs.pvs_id}",
         output_path=out_path,
         fn=run,
+        plan=plan,
         # own provenance file: the wo_buffer render already owns
         # logs/<pvs>.log and a shared path would overwrite it
         logfile_path=(lf[:-4] if lf.endswith(".log") else lf) + "_stalling.log",

@@ -26,6 +26,7 @@ from ..io import medialib
 from ..io.video import VideoReader, VideoWriter
 from ..ops import pad as pad_ops
 from ..ops import pixfmt as pf
+from ..store import keys as store_keys
 from . import frames as fr
 
 CHUNK = 64
@@ -303,10 +304,29 @@ def create_cpvs(
                             writer.put(mobile_chunk(chunk))
         return out_path
 
+    # plan: the AVPVS digest covers every upstream knob transitively;
+    # the rest is this render's own decision surface (cpvs_plan's inputs)
+    plan = {
+        "op": "cpvs",
+        "input": store_keys.file_ref(pvs.get_avpvs_file_path()),
+        "context": pp.processing_type,
+        "display": [pp.display_width, pp.display_height],
+        "coding": [pp.coding_width, pp.coding_height],
+        "display_fps": float(pp.display_frame_rate)
+        if pp.display_frame_rate is not None else None,
+        "rawvideo": bool(rawvideo),
+        "crf": int(nonraw_crf),
+        "profile": mobile_vprofile,
+        "preset": mobile_preset,
+        "t": float(pvs.hrc.get_long_hrc_duration())
+        if tc.is_long() else None,
+    }
+
     return Job(
         label=f"cpvs {pvs.pvs_id} {pp.processing_type}",
         output_path=out_path,
         fn=run,
+        plan=plan,
         provenance={
             "pvs": pvs.pvs_id,
             "context": pp.processing_type,
@@ -378,5 +398,10 @@ def create_preview(pvs: Pvs) -> Optional[Job]:
         label=f"preview {pvs.pvs_id}",
         output_path=out_path,
         fn=run,
+        plan={
+            "op": "preview",
+            "input": store_keys.file_ref(pvs.get_avpvs_file_path()),
+            "codec": "prores_ks",
+        },
         provenance={"pvs": pvs.pvs_id, "codec": "prores_ks"},
     )

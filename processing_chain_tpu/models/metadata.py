@@ -34,14 +34,77 @@ import numpy as np
 import pandas as pd
 
 from ..config.domain import Pvs
+from ..engine.jobs import Job
 from ..io import framesizes, probe
 from ..io.medialib import MediaError
+from ..store import keys as store_keys
 from ..utils.fsio import atomic_write
 from ..utils.log import get_logger
 
 
 class MetadataError(RuntimeError):
     pass
+
+
+def metadata_paths(pvs: Pvs) -> dict:
+    """The four p02 artifact paths for one PVS (buff only for buffering
+    HRCs)."""
+    tc = pvs.test_config
+    paths = {
+        "qchanges": os.path.join(
+            tc.get_quality_change_event_files_path(), pvs.pvs_id + ".qchanges"
+        ),
+        "vfi": os.path.join(
+            tc.get_video_frame_information_path(), pvs.pvs_id + ".vfi"
+        ),
+        "afi": os.path.join(
+            tc.get_audio_frame_information_path(), pvs.pvs_id + ".afi"
+        ),
+    }
+    if pvs.has_buffering():
+        paths["buff"] = os.path.join(
+            tc.get_buff_event_files_path(), pvs.pvs_id + ".buff"
+        )
+    return paths
+
+
+def metadata_job(pvs: Pvs, force: bool = False) -> Job:
+    """p02 as a Job: qchanges is the main output, vfi/afi/buff ride as
+    extra outputs, and the plan is the segment digests + stall schedule
+    (everything the four tables derive from). With a store active the
+    inner per-file force is unconditional — the job only runs when the
+    plan says these tables are stale, and a rebuild must refresh ALL of
+    them; without one, the legacy per-file `_maybe_write` semantics are
+    preserved bit for bit."""
+    paths = metadata_paths(pvs)
+    extras = tuple(p for k, p in paths.items() if k != "qchanges")
+
+    def run() -> str:
+        from ..store import runtime as store_runtime
+
+        generate_pvs_metadata(
+            pvs, force=force or store_runtime.active() is not None
+        )
+        return paths["qchanges"]
+
+    return Job(
+        label=f"metadata {pvs.pvs_id}",
+        output_path=paths["qchanges"],
+        fn=run,
+        plan={
+            "op": "pvs_metadata",
+            "segments": [
+                store_keys.file_ref(s.file_path) for s in pvs.segments
+            ],
+            "events": (
+                [[float(e[0]), float(e[1])] for e in
+                 pvs.get_buff_events_media_time()]
+                if pvs.has_buffering() else None
+            ),
+        },
+        extra_outputs=extras,
+        provenance={"pvs": pvs.pvs_id, "artifacts": sorted(paths)},
+    )
 
 
 def _maybe_write(path: str, force: bool, write_fn) -> None:

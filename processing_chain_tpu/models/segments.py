@@ -20,6 +20,7 @@ from ..engine.jobs import Job
 from ..io.video import VideoReader, VideoWriter
 from ..io import medialib
 from ..ops import fps as fps_ops
+from ..store import keys as store_keys
 from ..utils.log import get_logger
 from . import frames as fr
 
@@ -350,11 +351,47 @@ def encode_segment(segment: Segment) -> Optional[Job]:
             raise
         return out_path
 
+    # plan payload (store/keys schema): everything that determines the
+    # encoded bytes — the SRC's content digest, the decode window, the
+    # resolved scale/fps/encode surface. One flipped quality-level or
+    # coding field changes the hash and invalidates exactly this segment.
+    plan = {
+        "op": "encode_segment",
+        "src": store_keys.file_ref(segment.src.file_path),
+        "window": [segment.start_time, segment.duration],
+        "scale": [target_w, target_h, "bicubic"],
+        "fps": out_fps,
+        "pix_fmt": segment.target_pix_fmt,
+        "encoder": encoder,
+        "passes": passes,
+        "rate_control": rc,
+        "coding": {
+            "crf": segment.quality_level.video_crf
+            if coding.crf is not None else None,
+            "qp": segment.quality_level.video_qp
+            if coding.qp is not None else None,
+            "preset": coding.preset,
+            "scenecut": bool(coding.scenecut),
+            "speed": getattr(coding, "speed", None),
+            "quality": getattr(coding, "quality", None),
+            "cpu_used": getattr(coding, "cpu_used", None),
+            "enc_options": coding.enc_options or None,
+        },
+        "audio": {
+            "long": bool(tc.is_long()),
+            "encoder": segment.audio_coding.encoder
+            if tc.is_long() and segment.audio_coding is not None else None,
+            "bitrate_kbps": float(segment.quality_level.audio_bitrate or 0)
+            if tc.is_long() else None,
+        },
+    }
+
     job = Job(
         label=f"encode {segment.filename}",
         output_path=out_path,
         fn=run,
         logfile_path=segment.get_logfile_path(),
+        plan=plan,
         provenance={
             "segmentFilename": segment.filename,
             "pipeline": {

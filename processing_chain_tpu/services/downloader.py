@@ -547,6 +547,24 @@ class Downloader:
             log.warning("Protocol '%s' not available for video %s.", protocol, filename)
         return dl_file
 
+    def _youtube_available(self) -> bool:
+        """Whether a YouTube download could succeed in this environment.
+        `download_video` constructs YtdlClient lazily, so keying the plan
+        decision on `self.youtube is None` would declare a perfectly
+        feasible run infeasible (constructed without a client but with
+        yt-dlp importable) — probe actual importability instead."""
+        if self.youtube is not None:
+            return True
+        import importlib.util
+
+        try:
+            return (
+                importlib.util.find_spec("yt_dlp") is not None
+                or importlib.util.find_spec("youtube_dl") is not None
+            )
+        except (ImportError, ValueError):
+            return False
+
     def plan_capability(self, seg, force: bool = False) -> Optional[str]:
         """Plan-time feasibility of producing this online segment in THIS
         environment: None when a run can succeed, else an actionable
@@ -576,7 +594,7 @@ class Downloader:
                 "+ the bitmovin-api-sdk (none configured) and no "
                 "local/remote chunks exist to resume from"
             )
-        if self.youtube is None:
+        if not self._youtube_available():
             return (
                 "YouTube download needs yt-dlp (or youtube-dl), which is "
                 "not importable in this environment — pip install yt-dlp, "

@@ -6,6 +6,7 @@ from typing import Optional
 
 from .. import telemetry as tm
 from ..config import TestConfig
+from ..engine.jobs import JobRunner
 from ..models import metadata as md
 from ..parallel.distributed import local_shard
 from ..utils.log import get_logger
@@ -23,15 +24,23 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
             cli_args.test_config, cli_args.filter_src, cli_args.filter_hrc,
             cli_args.filter_pvs,
         )
+    # Job-per-PVS (like every other stage) so metadata participates in
+    # the artifact store: plan = segment digests + stall schedule, the
+    # four tables commit/materialize together. Without a store, Job's
+    # skip-existing on the qchanges table plus the model's per-file
+    # _maybe_write guards reproduce the legacy behavior. Serial: the
+    # native demux + numpy scans are already parallel inside.
+    runner = JobRunner(
+        force=cli_args.force, dry_run=cli_args.dry_run,
+        parallelism=1, name="p02",
+    )
     n_items = 0
     for pvs_id, pvs in local_shard(test_config.pvses):
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue
-        if cli_args.dry_run:
-            log.info("[dry-run] metadata for %s", pvs_id)
-            continue
-        md.generate_pvs_metadata(pvs, force=cli_args.force)
+        runner.add(md.metadata_job(pvs, force=cli_args.force))
         n_items += 1
     tm.STAGE_ITEMS.labels(stage="p02").set(n_items)
+    runner.run_serial()
     return test_config

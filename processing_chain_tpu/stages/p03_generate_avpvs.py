@@ -61,7 +61,6 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue
         eligible.append(pvs)
-        stall_runner.add(av.apply_stalling(pvs, spinner_path=spinner))
     tm.STAGE_ITEMS.labels(stage="p03").set(len(eligible))
     from ..utils.device import device_count, select_device
 
@@ -73,6 +72,7 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
         # user wants ONE device busy — meshing over all of them would
         # override the pin via explicit shardings, so the pin disables
         # batching.
+        batch = None
         if (
             not cli_args.dry_run
             and gpu_loc < 0
@@ -91,13 +91,14 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
             }
             todo = [
                 pvs for pvs, job in per_pvs.items()
-                if job.should_run(cli_args.force)
+                if job.should_run(cli_args.force, runner="p03")
             ]
             runner.add(
                 av.create_avpvs_wo_buffer_batch(
                     todo, avpvs_src_fps=avpvs_src_fps, force_60_fps=force_60_fps
                 )
             )
+            batch = (todo, per_pvs)
         else:
             for pvs in eligible:
                 runner.add(
@@ -109,6 +110,16 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
                 )
         # two phases: stalling reads the wo_buffer outputs of phase one
         runner.run()
+        if batch is not None:
+            # batch finals are written outside Job.run: bind them to their
+            # plan hashes here (no-op without an active store)
+            for pvs in batch[0]:
+                batch[1][pvs].commit_to_store()
+        # stalling is planned only NOW: its plan input (the wo_buffer
+        # render) must exist with its final bytes for the store's
+        # hit/miss decision to be about THIS run's input, not a stale one
+        for pvs in eligible:
+            stall_runner.add(av.apply_stalling(pvs, spinner_path=spinner))
         stall_runner.run()
 
     if cli_args.remove_intermediate:

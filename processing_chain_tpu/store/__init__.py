@@ -1,0 +1,22 @@
+"""Content-addressed artifact store: the chain's cache, checkpoint, and
+integrity layer.
+
+The reference (and PR 0's Job model) decides stale-vs-fresh with one bit:
+"does the output file exist". Editing a single HRC parameter in the YAML
+therefore either silently serves stale artifacts or forces a --force
+rebuild of the entire database. This package replaces that bit with a
+canonical **plan hash** per job — input file digests, resolved encode
+parameters, tool + chain version (keys.py) — and a CAS object directory
+with atomic commits, integrity-verified reads, and mark-and-sweep GC
+(store.py, gc.py). See docs/STORE.md for the key schema, the on-disk
+layout, the GC policy, and the telemetry series.
+
+Layering: models build *plan payloads* (plain dicts with `keys.file_ref`
+markers for input files); the engine (engine/jobs.py) resolves and hashes
+them against the process-wide active store (runtime.py) at plan time and
+commits outputs after a successful run. Nothing in this package imports
+the model or stage layers.
+"""
+
+from .keys import canonical_json, file_ref, plan_hash  # noqa: F401
+from .store import ArtifactStore, Manifest, StoreCorruption  # noqa: F401

@@ -1,0 +1,150 @@
+"""Mark-and-sweep garbage collection for the artifact store.
+
+Roots (mark phase): every readable manifest, because a manifest IS the
+liveness record of a cached plan — plus the pins file, which exempts its
+manifests from LRU eviction entirely. Ref-counting is implicit: an object
+is live while any surviving manifest (artifact or sidecar) names its
+digest.
+
+Sweep phases, in order:
+  1. stale tmp/ entries older than `tmp_max_age_s` (crashed writers);
+  2. orphan objects no manifest references (older than `min_object_age_s`,
+     so an in-flight commit's just-renamed object is never raced);
+  3. LRU eviction of unpinned manifests, oldest last-used first, until
+     referenced bytes fit `size_budget_bytes` — each eviction re-runs the
+     implicit ref-count so objects shared with a surviving manifest stay.
+
+Every eviction counts `chain_store_evictions_total`; a `dry_run` pass
+reports what would happen without touching disk.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from .. import telemetry as tm
+from ..utils.log import get_logger
+from .store import STORE_EVICTIONS, ArtifactStore, Manifest
+
+
+def _manifest_digests(manifest: Manifest) -> set[str]:
+    return {d["sha256"] for d in manifest.all_digests()}
+
+
+def collect(
+    store: ArtifactStore,
+    size_budget_bytes: Optional[int] = None,
+    dry_run: bool = False,
+    tmp_max_age_s: float = 3600.0,
+    min_object_age_s: float = 3600.0,
+    now: Optional[float] = None,
+) -> dict:
+    """Run one mark-and-sweep pass; returns the report dict the
+    `tools store gc` command renders."""
+    log = get_logger()
+    now = time.time() if now is None else now
+    report = {
+        "dry_run": dry_run,
+        "tmp_removed": 0,
+        "orphans_removed": 0,
+        "orphan_bytes": 0,
+        "evicted_manifests": [],
+        "evicted_bytes": 0,
+        "kept_manifests": 0,
+        "kept_bytes": 0,
+    }
+
+    # phase 1: crashed-writer leftovers in tmp/
+    try:
+        for name in os.listdir(store.tmp_dir):
+            path = os.path.join(store.tmp_dir, name)
+            try:
+                if now - os.stat(path).st_mtime < tmp_max_age_s:
+                    continue
+                if not dry_run:
+                    os.unlink(path)
+                report["tmp_removed"] += 1
+            except OSError:
+                continue
+    except OSError:
+        pass
+
+    # mark: manifests (with their LRU stamp) and the digests they hold live
+    pins = set(store.pins())
+    manifests: list[tuple[float, Manifest]] = []
+    for m in store.iter_manifests():
+        try:
+            mtime = os.stat(store.manifest_path(m.plan_hash)).st_mtime
+        except OSError:
+            mtime = 0.0
+        manifests.append((mtime, m))
+    live: set[str] = set()
+    for _, m in manifests:
+        live.update(_manifest_digests(m))
+
+    # phase 2: orphan objects
+    sizes: dict[str, int] = {}
+    for sha, size in store.iter_objects():
+        sizes[sha] = size
+        if sha in live:
+            continue
+        path = store.object_path(sha)
+        try:
+            if now - os.stat(path).st_mtime < min_object_age_s:
+                continue
+            if not dry_run:
+                os.unlink(path)
+            report["orphans_removed"] += 1
+            report["orphan_bytes"] += size
+        except OSError:
+            continue
+
+    # phase 3: LRU eviction to the size budget (pinned manifests exempt)
+    def referenced_bytes(ms: list[tuple[float, Manifest]]) -> int:
+        refs: set[str] = set()
+        for _, m in ms:
+            refs.update(_manifest_digests(m))
+        return sum(sizes.get(sha, 0) for sha in refs)
+
+    if size_budget_bytes is not None:
+        manifests.sort(key=lambda e: e[0])  # oldest last-used first
+        while manifests and referenced_bytes(manifests) > size_budget_bytes:
+            victim_i = next(
+                (i for i, (_, m) in enumerate(manifests)
+                 if m.plan_hash not in pins),
+                None,
+            )
+            if victim_i is None:
+                log.warning(
+                    "store gc: size budget %d unreachable — every remaining "
+                    "manifest is pinned", size_budget_bytes,
+                )
+                break
+            _, victim = manifests.pop(victim_i)
+            survivors: set[str] = set()
+            for _, m in manifests:
+                survivors.update(_manifest_digests(m))
+            freed = sum(
+                sizes.get(sha, 0)
+                for sha in _manifest_digests(victim) - survivors
+            )
+            if not dry_run:
+                store._drop_manifest(victim.plan_hash)
+                for sha in _manifest_digests(victim) - survivors:
+                    try:
+                        os.unlink(store.object_path(sha))
+                    except OSError:
+                        pass
+                STORE_EVICTIONS.inc()
+                tm.emit("store_evict", plan=victim.plan_hash,
+                        producer=victim.producer, freed_bytes=freed)
+            report["evicted_manifests"].append(victim.plan_hash)
+            report["evicted_bytes"] += freed
+
+    report["kept_manifests"] = len(manifests)
+    report["kept_bytes"] = referenced_bytes(manifests)
+    if not dry_run:
+        store.update_gauges(full=True)
+    return report

@@ -1,0 +1,40 @@
+"""Process-wide active-store slot.
+
+The engine consults `active()` at plan and commit time; the CLI calls
+`configure()` once per dispatch from `--store DIR` / `--no-store` /
+`PC_STORE_DIR`. Holding this in its own module (instead of threading a
+store object through four stages and three model layers) mirrors the
+telemetry registry's design: call sites pay one attribute load when no
+store is configured.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .store import ArtifactStore
+
+_ACTIVE: Optional[ArtifactStore] = None
+
+
+def configure(root: Optional[str]) -> Optional[ArtifactStore]:
+    """Install the store rooted at `root` (created on demand) as the
+    process-wide active store; None deactivates. Returns the store."""
+    global _ACTIVE
+    _ACTIVE = ArtifactStore(root) if root else None
+    return _ACTIVE
+
+
+def configure_from_args(args) -> Optional[ArtifactStore]:
+    """CLI wiring: --no-store wins, then --store DIR, then PC_STORE_DIR.
+    Always reassigns the slot so successive in-process dispatches (tests,
+    orchestrators) never inherit a previous run's store by accident."""
+    if getattr(args, "no_store", False):
+        return configure(None)
+    root = getattr(args, "store", None) or os.environ.get("PC_STORE_DIR") or None
+    return configure(root)
+
+
+def active() -> Optional[ArtifactStore]:
+    return _ACTIVE

@@ -1,0 +1,647 @@
+"""CAS object directory with atomic commits and integrity-verified reads.
+
+On-disk layout under the store root (docs/STORE.md):
+
+    objects/<digest[:2]>/<digest>     content-addressed artifact bytes
+    manifests/<plan_hash>.json        one manifest per cached plan
+    tmp/                              in-flight commits (pid-unique names)
+    pins.json                         {plan_hash: label} GC roots
+    seen-paths.jsonl                  every output path ever bound (the
+                                      adoption ledger: survives manifest
+                                      eviction/corruption drops)
+    digest-cache.json                 stat-keyed input digest cache
+
+Commit protocol: artifact bytes are hardlinked (copied across devices)
+into tmp/ first, fsync'd, then os.replace'd into objects/ — a writer
+crashed at any instant leaves at worst a tmp/ orphan that GC sweeps,
+never a half-object under a valid digest. The manifest is written last
+(atomic_write), so a plan hash resolves only to fully-committed bytes.
+
+Read protocol (`serve_hit`): the manifest's object is spot-checked
+(size + head digest; full digest for small objects or deep verifies),
+then materialized to the legacy output path by hardlink when possible.
+A mismatch anywhere counts `chain_store_corrupt_total`, drops the
+manifest, and the caller rebuilds — corruption converts to a cache miss,
+never to a served artifact. Media objects additionally get a container
+read-back probe (open + decode one frame) at commit, which rejects the
+write-time corruption class the round-5 advisor reproduced (10-bit
+rawvideo muxed into AVI reads back as garbage) before it can be cached.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from .. import telemetry as tm
+from ..utils.fsio import atomic_write
+from ..utils.log import get_logger
+from . import keys
+
+STORE_HITS = tm.counter(
+    "chain_store_hits_total", "jobs served from the artifact store", ("runner",)
+)
+STORE_MISSES = tm.counter(
+    "chain_store_misses_total", "plan hashes with no committed artifact",
+    ("runner",),
+)
+STORE_EVICTIONS = tm.counter(
+    "chain_store_evictions_total", "manifests evicted by GC (LRU or orphan)"
+)
+STORE_CORRUPT = tm.counter(
+    "chain_store_corrupt_total",
+    "integrity failures detected on read (digest or container probe)",
+)
+STORE_ADOPTIONS = tm.counter(
+    "chain_store_adoptions_total",
+    "pre-store artifacts adopted on first sight (legacy skip-existing parity)",
+)
+STORE_BYTES = tm.gauge(
+    "chain_store_object_bytes", "bytes held in the store's object directory"
+)
+STORE_OBJECTS = tm.gauge(
+    "chain_store_objects", "objects held in the store's object directory"
+)
+
+#: full-digest verification threshold for ordinary (non-deep) reads
+_FULL_VERIFY_MAX = 64 << 20
+
+#: containers worth a read-back probe (everything the chain muxes)
+_MEDIA_EXTS = {".avi", ".mp4", ".mkv", ".webm", ".mov"}
+
+
+class StoreCorruption(RuntimeError):
+    """An artifact failed integrity verification (digest mismatch or a
+    container that does not read back)."""
+
+
+@dataclass
+class Manifest:
+    """One cached plan → artifact binding (manifests/<plan_hash>.json)."""
+
+    plan_hash: str
+    object: dict  # {"sha256", "head_sha256", "size"}
+    producer: str = ""
+    created_at: float = 0.0
+    chain_version: str = ""
+    provenance: dict = field(default_factory=dict)
+    media: Optional[dict] = None  # commit-time read-back probe summary
+    sidecars: dict = field(default_factory=dict)  # suffix -> object digest dict
+    #: path RELATIVE to the output's directory -> digest (relative so a
+    #: relocated database still materializes companions next to the new
+    #: dest instead of resurrecting the old tree)
+    extras: dict = field(default_factory=dict)
+    materialized: Optional[dict] = None  # {"path", "size", "mtime_ns"}
+
+    def to_json(self) -> dict:
+        return {
+            "planHash": self.plan_hash,
+            "object": self.object,
+            "producer": self.producer,
+            "createdAt": self.created_at,
+            "chainVersion": self.chain_version,
+            "provenance": self.provenance,
+            "media": self.media,
+            "sidecars": self.sidecars,
+            "extras": self.extras,
+            "materialized": self.materialized,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "Manifest":
+        return cls(
+            plan_hash=data["planHash"],
+            object=data["object"],
+            producer=data.get("producer", ""),
+            created_at=float(data.get("createdAt", 0.0)),
+            chain_version=data.get("chainVersion", ""),
+            provenance=data.get("provenance", {}),
+            media=data.get("media"),
+            sidecars=data.get("sidecars", {}),
+            extras=data.get("extras", {}),
+            materialized=data.get("materialized"),
+        )
+
+    def all_digests(self) -> list[dict]:
+        """Main object + sidecars + extras, for verification and GC."""
+        return [self.object, *self.sidecars.values(), *self.extras.values()]
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    try:
+        os.link(src, dst)
+    except OSError:
+        # cross-device stores (or filesystems without hardlinks) copy
+        shutil.copyfile(src, dst)
+
+
+def _probe_readback(path: str) -> Optional[dict]:
+    """Open a media container and decode one frame; a summary dict on
+    success, None for non-media files or when the native media boundary
+    is unavailable in this environment, StoreCorruption when the file
+    does not read back."""
+    if os.path.splitext(path)[1].lower() not in _MEDIA_EXTS:
+        return None
+    try:
+        from ..io import medialib
+        medialib.ensure_loaded()
+    except Exception:
+        return None  # no decoder on this host: digest checks still apply
+    from ..io.medialib import MediaError
+    from ..io.video import VideoReader
+
+    try:
+        streams = medialib.probe(path).get("streams", [])
+        with VideoReader(path) as reader:
+            decoded = 0
+            for _ in reader:
+                decoded += 1
+                break
+            if decoded == 0:
+                raise MediaError("no frames decodable")
+            return {
+                "pix_fmt": reader.pix_fmt,
+                "width": reader.width,
+                "height": reader.height,
+                "fps": round(reader.fps, 6),
+                "streams": len(streams),
+            }
+    except MediaError as exc:
+        raise StoreCorruption(f"{path}: container read-back failed: {exc}") from exc
+
+
+class ArtifactStore:
+    """Content-addressed store rooted at one directory. Thread-compatible
+    with the chain's job pools: commits are tmp+rename (last writer of an
+    identical object wins, harmlessly), manifests are whole-file atomic
+    writes, and the digest cache and adoption ledger carry their own
+    locks (commit-time hash re-resolution runs on JobRunner workers)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        self.objects_dir = os.path.join(self.root, "objects")
+        self.manifests_dir = os.path.join(self.root, "manifests")
+        self.tmp_dir = os.path.join(self.root, "tmp")
+        for d in (self.objects_dir, self.manifests_dir, self.tmp_dir):
+            os.makedirs(d, exist_ok=True)
+        self.digests = keys.DigestCache(os.path.join(self.root, "digest-cache.json"))
+        self._pins_path = os.path.join(self.root, "pins.json")
+        #: lazily-built set of output paths the store has ever bound
+        #: (manifests ∪ the durable seen-paths ledger) — the
+        #: adopt-vs-rebuild discriminator (see should_adopt)
+        self._known_paths: Optional[set[str]] = None
+        self._paths_path = os.path.join(self.root, "seen-paths.jsonl")
+        self._paths_lock = threading.Lock()
+        self._seen_paths: Optional[set[str]] = None  # lazy ledger cache
+        #: incrementally-maintained gauge state ({"objects", "bytes"});
+        #: None until the first update_gauges walk
+        self._gauge_stats: Optional[dict] = None
+
+    # ------------------------------------------------------------- hashing
+
+    def plan_hash(self, payload: dict) -> str:
+        """Resolve a payload's file_refs through this store's digest cache
+        and hash it. Raises OSError when an input file is missing."""
+        return keys.plan_hash(payload, digest=self.digests.digest)
+
+    # -------------------------------------------------------------- layout
+
+    def object_path(self, sha256: str) -> str:
+        return os.path.join(self.objects_dir, sha256[:2], sha256)
+
+    def manifest_path(self, plan_hash: str) -> str:
+        return os.path.join(self.manifests_dir, plan_hash + ".json")
+
+    # ------------------------------------------------------------ manifests
+
+    def lookup(self, plan_hash: str) -> Optional[Manifest]:
+        path = self.manifest_path(plan_hash)
+        try:
+            with open(path) as f:
+                return Manifest.from_json(json.load(f))
+        except FileNotFoundError:
+            return None
+        except OSError as exc:
+            # transient environment error (EMFILE/EIO/EACCES), not data
+            # corruption: degrade to a miss but leave the manifest alone —
+            # deleting a healthy cache entry over a busy file table would
+            # force a spurious rebuild and misreport corruption
+            get_logger().warning("store: cannot read manifest %s (%s); "
+                                 "treating as a miss", path, exc)
+            return None
+        except (ValueError, KeyError) as exc:
+            # an unparseable manifest is corruption reported as a miss;
+            # the rebuild's commit overwrites it atomically. Deleting it
+            # HERE would make read-only surfaces (ls, verify without
+            # --drop, gc --dry-run — all funnel through lookup) mutate
+            # the store as a side effect.
+            get_logger().warning(
+                "store: unreadable manifest %s (%s); treating as a miss "
+                "(`tools store verify --drop` removes it)", path, exc,
+            )
+            STORE_CORRUPT.inc()
+            return None
+
+    def iter_manifests(self) -> Iterator[Manifest]:
+        try:
+            names = sorted(os.listdir(self.manifests_dir))
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            m = self.lookup(name[:-5])
+            if m is not None:
+                yield m
+
+    def _write_manifest(self, manifest: Manifest) -> None:
+        def _write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                json.dump(manifest.to_json(), f, indent=1, sort_keys=True)
+
+        atomic_write(self.manifest_path(manifest.plan_hash), _write)
+
+    def _drop_manifest(self, plan_hash: str) -> None:
+        try:
+            os.unlink(self.manifest_path(plan_hash))
+        except FileNotFoundError:
+            pass
+
+    def touch(self, manifest: Manifest) -> None:
+        """LRU bookkeeping: manifest file mtime is the last-used stamp."""
+        try:
+            os.utime(self.manifest_path(manifest.plan_hash))
+        except OSError:
+            pass
+
+    # --------------------------------------------------------------- pins
+
+    def pins(self) -> dict[str, str]:
+        try:
+            with open(self._pins_path) as f:
+                data = json.load(f)
+            return data if isinstance(data, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def pin(self, plan_hash: str, label: str = "") -> None:
+        pins = self.pins()
+        pins[plan_hash] = label or time.strftime("%Y-%m-%d")
+        self._write_pins(pins)
+
+    def unpin(self, plan_hash: str) -> None:
+        pins = self.pins()
+        if pins.pop(plan_hash, None) is not None:
+            self._write_pins(pins)
+
+    def _write_pins(self, pins: dict) -> None:
+        def _write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                json.dump(pins, f, indent=1, sort_keys=True)
+
+        atomic_write(self._pins_path, _write)
+
+    # ---------------------------------------------------- adoption ledger
+
+    def _load_seen_paths(self) -> set[str]:
+        """The ledger, loaded once per store object (JSONL: one JSON
+        string per line; a torn last line from a crash is skipped)."""
+        if self._seen_paths is None:
+            seen: set[str] = set()
+            try:
+                with open(self._paths_path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            entry = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail from a crashed appender
+                        if isinstance(entry, str):
+                            seen.add(entry)
+            except OSError:
+                pass
+            self._seen_paths = seen
+        return self._seen_paths
+
+    def _record_seen_path(self, path: str) -> None:
+        """Durably record an output path the store has bound. The ledger
+        must outlive the manifest: GC eviction (or a corruption drop)
+        removes the manifest but leaves the materialized output on disk,
+        and without this record a later run with a CHANGED plan would
+        re-adopt those stale bytes instead of rebuilding (defeating
+        hash-equality staleness exactly where it matters). O(1) per
+        commit: append-only JSONL, deduped through the in-memory cache.
+        Best-effort: a persistence failure degrades to the legacy
+        adoption trust."""
+        path = os.path.abspath(path)
+        with self._paths_lock:
+            seen = self._load_seen_paths()
+            if path in seen:
+                return
+            seen.add(path)
+            if self._known_paths is not None:
+                self._known_paths.add(path)
+            try:
+                with open(self._paths_path, "a") as f:
+                    f.write(json.dumps(path) + "\n")
+            except OSError:
+                pass
+
+    # -------------------------------------------------------------- commit
+
+    def _ingest(self, path: str) -> dict:
+        """Hash `path` and place its bytes under objects/ atomically;
+        returns the digest dict. Identical objects dedupe by construction.
+        The tmp name is pid+thread-unique: two workers committing
+        byte-identical companions would otherwise share one tmp path and
+        truncate it under each other."""
+        digest = keys.hash_file(path)
+        obj = self.object_path(digest["sha256"])
+        if not os.path.isfile(obj):
+            os.makedirs(os.path.dirname(obj), exist_ok=True)
+            tmp = os.path.join(
+                self.tmp_dir,
+                f"{digest['sha256']}.{os.getpid()}.{threading.get_ident()}.part",
+            )
+            try:
+                _link_or_copy(path, tmp)
+                os.replace(tmp, obj)
+            except BaseException:
+                if os.path.isfile(tmp):
+                    os.unlink(tmp)
+                raise
+            try:
+                # hardlinked objects inherit the SOURCE file's mtime — an
+                # adopted years-old artifact would land already "old" and
+                # GC's min_object_age orphan guard (the defense against
+                # sweeping an object whose manifest is milliseconds from
+                # being written) would not protect it. Stamp ingestion
+                # time explicitly.
+                os.utime(obj)
+            except OSError:
+                pass
+            if self._gauge_stats is not None:
+                self._gauge_stats["objects"] += 1
+                self._gauge_stats["bytes"] += digest["size"]
+        return digest
+
+    def commit(
+        self,
+        plan_hash: str,
+        output_path: str,
+        producer: str = "",
+        provenance: Optional[dict] = None,
+        sidecar_suffixes: tuple = (),
+        extra_outputs: tuple = (),
+        adopted: bool = False,
+    ) -> Manifest:
+        """Bind `plan_hash` to the artifact at `output_path` (plus any
+        existing `output_path + suffix` sidecars and `extra_outputs`
+        companion files at their own absolute paths). The container
+        read-back probe runs BEFORE ingestion: an artifact that does not
+        decode is rejected here, at the boundary where rebuilding is
+        cheap, instead of being served as a 'verified' cache hit later."""
+        media = _probe_readback(output_path)
+        digest = self._ingest(output_path)
+        sidecars = {}
+        for suffix in sidecar_suffixes:
+            side = output_path + suffix
+            if os.path.isfile(side):
+                sidecars[suffix] = self._ingest(side)
+        extras = {}
+        base = os.path.dirname(os.path.abspath(output_path))
+        for extra in extra_outputs:
+            if os.path.isfile(extra):
+                rel = os.path.relpath(os.path.abspath(extra), base)
+                extras[rel] = self._ingest(extra)
+        st = os.stat(output_path)
+        provenance = dict(provenance or {})
+        if adopted:
+            provenance["adopted"] = True
+        manifest = Manifest(
+            plan_hash=plan_hash,
+            object=digest,
+            producer=producer,
+            created_at=time.time(),
+            chain_version=keys.chain_version(),
+            provenance=provenance,
+            media=media,
+            sidecars=sidecars,
+            extras=extras,
+            materialized={"path": os.path.abspath(output_path),
+                          "size": st.st_size, "mtime_ns": st.st_mtime_ns},
+        )
+        self._write_manifest(manifest)
+        self._record_seen_path(output_path)
+        self.update_gauges()
+        return manifest
+
+    def should_adopt(self, output_path: str) -> bool:
+        """Whether an existing output the store has never seen should be
+        adopted (committed as-is under the current plan hash) instead of
+        rebuilt. True exactly when the store has never bound this path —
+        neither a live manifest nor the durable seen-paths ledger (which
+        survives GC eviction and corruption drops) knows it. Pre-store
+        artifacts keep the legacy skip-existing trust on the first
+        store-enabled run; a path the store HAS tracked whose plan hash
+        no longer matches is genuinely stale and must rebuild."""
+        if self._known_paths is None:
+            self._known_paths = {
+                m.materialized["path"]
+                for m in self.iter_manifests()
+                if m.materialized
+            } | self._load_seen_paths()
+        return os.path.abspath(output_path) not in self._known_paths
+
+    # ---------------------------------------------------------------- read
+
+    def verify_object(self, digest: dict, deep: bool = False) -> None:
+        """Raise StoreCorruption unless the stored object matches its
+        digest: size always, head digest always, full digest when small
+        or `deep`."""
+        obj = self.object_path(digest["sha256"])
+        try:
+            size = os.stat(obj).st_size
+        except OSError as exc:
+            raise StoreCorruption(f"object {digest['sha256'][:12]} missing") from exc
+        if size != digest["size"]:
+            raise StoreCorruption(
+                f"object {digest['sha256'][:12]}: size {size} != recorded "
+                f"{digest['size']}"
+            )
+        if deep or size <= _FULL_VERIFY_MAX:
+            found = keys.hash_file(obj)
+            if found["sha256"] != digest["sha256"]:
+                raise StoreCorruption(
+                    f"object {digest['sha256'][:12]}: content digest mismatch"
+                )
+        else:
+            with open(obj, "rb") as f:
+                head = f.read(1 << 20)
+            if keys.sha256_hex(head) != digest["head_sha256"]:
+                raise StoreCorruption(
+                    f"object {digest['sha256'][:12]}: head digest mismatch"
+                )
+
+    def drop_corrupt_objects(self, manifest: Manifest) -> None:
+        """Unlink every object of `manifest` that fails verification. The
+        bytes must go WITH the manifest: the rebuild produces the same
+        content digest, and `_ingest` dedupes on object existence — a
+        corrupt object left in place would be silently re-adopted and
+        re-detected on every later run."""
+        for digest in manifest.all_digests():
+            try:
+                self.verify_object(digest, deep=True)
+            except StoreCorruption:
+                try:
+                    os.unlink(self.object_path(digest["sha256"]))
+                except OSError:
+                    continue
+                if self._gauge_stats is not None:
+                    self._gauge_stats["objects"] -= 1
+                    self._gauge_stats["bytes"] -= digest["size"]
+
+    def _materialize_one(self, digest: dict, dest: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+        tmp = f"{dest}.store.{os.getpid()}.part"
+        try:
+            _link_or_copy(self.object_path(digest["sha256"]), tmp)
+            os.replace(tmp, dest)
+        except BaseException:
+            if os.path.isfile(tmp):
+                os.unlink(tmp)
+            raise
+
+    def _dest_current(self, manifest: Manifest, dest: str) -> bool:
+        """Cheap staleness check for an already-materialized output: stat
+        signature equality with what commit/materialize recorded."""
+        rec = manifest.materialized
+        if not rec or rec.get("path") != os.path.abspath(dest):
+            return False
+        try:
+            st = os.stat(dest)
+        except OSError:
+            return False
+        return st.st_size == rec["size"] and st.st_mtime_ns == rec["mtime_ns"]
+
+    def serve_hit(
+        self, manifest: Manifest, dest: str, materialize: bool = True,
+        deep: bool = False,
+    ) -> bool:
+        """Serve a plan-hash hit: verify the object, then ensure `dest`
+        (and sidecars) hold its bytes. False — after counting the
+        corruption and dropping the manifest — means the caller must
+        rebuild; the store never serves bytes it cannot vouch for."""
+        try:
+            for digest in manifest.all_digests():
+                self.verify_object(digest, deep=deep)
+        except StoreCorruption as exc:
+            get_logger().warning(
+                "store: %s (plan %s, producer %r); %s", exc,
+                manifest.plan_hash[:12], manifest.producer,
+                "dropping manifest and rebuilding" if materialize
+                else "would drop manifest and rebuild (dry-run: store "
+                     "left untouched)",
+            )
+            STORE_CORRUPT.inc()
+            tm.emit("store_corrupt", plan=manifest.plan_hash,
+                    producer=manifest.producer, error=str(exc)[:300])
+            if materialize:
+                # dry-run planning must not mutate the store: report the
+                # corruption (counter + "would rebuild") and leave the
+                # drop to the real run
+                self.drop_corrupt_objects(manifest)
+                self._drop_manifest(manifest.plan_hash)
+            return False  # rebuild required
+        if not materialize:  # dry-run planning: count the hit, touch nothing
+            return True
+        # extras rebase onto the CURRENT dest (they are stored relative
+        # to the output's directory): a relocated database materializes
+        # its companions next to the new output instead of resurrecting
+        # the directory tree recorded at commit time
+        extra_dest = os.path.dirname(os.path.abspath(dest))
+        try:
+            if not self._dest_current(manifest, dest):
+                self._materialize_one(manifest.object, dest)
+                for suffix, digest in manifest.sidecars.items():
+                    self._materialize_one(digest, dest + suffix)
+                for rel, digest in manifest.extras.items():
+                    self._materialize_one(
+                        digest, os.path.normpath(os.path.join(extra_dest, rel))
+                    )
+                st = os.stat(dest)
+                manifest.materialized = {
+                    "path": os.path.abspath(dest),
+                    "size": st.st_size, "mtime_ns": st.st_mtime_ns,
+                }
+                self._write_manifest(manifest)
+                self._record_seen_path(dest)
+            else:
+                # main output untouched, but a companion may have been
+                # deleted out-of-band (e.g. -r removed an intermediate's
+                # sidecar): restore any that are missing
+                for suffix, digest in manifest.sidecars.items():
+                    if not os.path.isfile(dest + suffix):
+                        self._materialize_one(digest, dest + suffix)
+                for rel, digest in manifest.extras.items():
+                    path = os.path.normpath(os.path.join(extra_dest, rel))
+                    if not os.path.isfile(path):
+                        self._materialize_one(digest, path)
+            self.touch(manifest)
+            return True
+        except OSError as exc:
+            get_logger().warning(
+                "store: could not materialize %s -> %s (%s); rebuilding",
+                manifest.plan_hash[:12], dest, exc,
+            )
+            return False
+
+    # ----------------------------------------------------------- accounting
+
+    def iter_objects(self) -> Iterator[tuple[str, int]]:
+        """(sha256, size) for every object on disk."""
+        try:
+            shards = sorted(os.listdir(self.objects_dir))
+        except OSError:
+            return
+        for shard in shards:
+            shard_dir = os.path.join(self.objects_dir, shard)
+            if not os.path.isdir(shard_dir):
+                continue
+            for name in sorted(os.listdir(shard_dir)):
+                try:
+                    yield name, os.stat(os.path.join(shard_dir, name)).st_size
+                except OSError:
+                    continue
+
+    def stats(self) -> dict:
+        n = 0
+        total = 0
+        for _, size in self.iter_objects():
+            n += 1
+            total += size
+        manifests = sum(
+            1 for f in os.listdir(self.manifests_dir) if f.endswith(".json")
+        ) if os.path.isdir(self.manifests_dir) else 0
+        return {"objects": n, "bytes": total, "manifests": manifests,
+                "pins": len(self.pins())}
+
+    def update_gauges(self, full: bool = False) -> None:
+        """Refresh the byte/object gauges. The full objects/ walk runs
+        once (then GC passes force it with `full=True`); per-commit calls
+        apply the increments _ingest tracked — a walk per commit would
+        make store population O(N²) in stat calls."""
+        if not tm.enabled():
+            return
+        if full or self._gauge_stats is None:
+            s = self.stats()
+            self._gauge_stats = {"objects": s["objects"], "bytes": s["bytes"]}
+        STORE_BYTES.set(self._gauge_stats["bytes"])
+        STORE_OBJECTS.set(self._gauge_stats["objects"])

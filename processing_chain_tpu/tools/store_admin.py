@@ -1,0 +1,213 @@
+"""Operator surface of the artifact store: `tools store <cmd>`.
+
+    tools store ls       [--store DIR]             manifest inventory
+    tools store verify   [--store DIR] [--deep] [--drop]
+    tools store gc       [--store DIR] [--max-bytes N] [--dry-run]
+                         [--tmp-max-age S] [--min-object-age S]
+    tools store pin      [--store DIR] HASH [--label TEXT]
+    tools store unpin    [--store DIR] HASH
+
+The store root resolves like the pipeline's: --store DIR, else
+PC_STORE_DIR. `verify` deep-checks every manifest's objects and exits 1
+when corruption is found (counted in chain_store_corrupt_total); with
+--drop, corrupt manifests are removed so the next pipeline run rebuilds
+exactly those artifacts. `gc` is store.gc.collect with a human report —
+the same run-report ergonomics as `tools run-report` (docs/STORE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Optional, Sequence
+
+from ..store import gc as store_gc
+from ..store.store import ArtifactStore, StoreCorruption
+from ..utils.log import get_logger
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def _parse_bytes(text: str) -> int:
+    """'500M', '2G', '1024' → bytes."""
+    text = text.strip().upper()
+    mult = 1
+    for suffix, m in (("K", 1 << 10), ("M", 1 << 20), ("G", 1 << 30),
+                      ("T", 1 << 40)):
+        if text.endswith(suffix) or text.endswith(suffix + "B"):
+            text = text[: -1 - text.endswith(suffix + "B")]
+            mult = m
+            break
+    return int(float(text) * mult)
+
+
+def _open_store(root: Optional[str]) -> ArtifactStore:
+    root = root or os.environ.get("PC_STORE_DIR") or ""
+    if not root:
+        raise ValueError(
+            "no store root: pass --store DIR or set PC_STORE_DIR"
+        )
+    if not os.path.isdir(root):
+        # admin never creates a store (the pipeline does): a mistyped
+        # root must error, not mkdir an empty tree and report a false
+        # "verified 0 ok" all-clear
+        raise ValueError(f"store root {root} does not exist")
+    return ArtifactStore(root)
+
+
+def _cmd_ls(store: ArtifactStore) -> int:
+    pins = store.pins()
+    rows = []
+    for m in store.iter_manifests():
+        age_s = max(0.0, time.time() - m.created_at) if m.created_at else 0.0
+        size = m.object.get("size", 0)
+        size += sum(d.get("size", 0) for d in m.sidecars.values())
+        size += sum(d.get("size", 0) for d in m.extras.values())
+        rows.append((
+            m.plan_hash[:12],
+            _human_bytes(size),
+            f"{age_s / 3600:.1f}h",
+            "pin" if m.plan_hash in pins else "",
+            "adopted" if m.provenance.get("adopted") else "",
+            m.producer,
+        ))
+    if not rows:
+        print(f"{store.root}: empty store")
+        return 0
+    widths = [max(len(r[i]) for r in rows) for i in range(5)]
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r[:5], widths)) + "  " + r[5])
+    s = store.stats()
+    print(
+        f"-- {s['manifests']} manifest(s), {s['objects']} object(s), "
+        f"{_human_bytes(s['bytes'])}, {s['pins']} pin(s)"
+    )
+    return 0
+
+
+def _cmd_verify(store: ArtifactStore, deep: bool, drop: bool) -> int:
+    ok = 0
+    corrupt = []
+    # unparseable manifest files first: lookup reports them as misses
+    # (read paths must not mutate the store), so iter_manifests would
+    # silently walk past them — verify is where they must surface
+    for name in sorted(os.listdir(store.manifests_dir)):
+        if not name.endswith(".json"):
+            continue
+        ph = name[:-5]
+        if (store.lookup(ph) is None
+                and os.path.isfile(store.manifest_path(ph))):
+            corrupt.append((ph, None, "manifest unreadable/unparseable"))
+    for m in store.iter_manifests():
+        try:
+            for digest in m.all_digests():
+                store.verify_object(digest, deep=deep)
+            ok += 1
+        except StoreCorruption as exc:
+            corrupt.append((m.plan_hash, m, str(exc)))
+    for ph, m, why in corrupt:
+        print(f"CORRUPT {ph[:12]} ({m.producer if m else '?'}): {why}")
+        if drop:
+            if m is not None:
+                # bytes go with the manifest: a rebuild re-produces the
+                # same digest and _ingest would dedupe onto the corrupt
+                # object (unknowable for an unparseable manifest — its
+                # orphaned objects fall to `gc`)
+                store.drop_corrupt_objects(m)
+            store._drop_manifest(ph)
+    if corrupt and drop:
+        print(
+            f"dropped {len(corrupt)} corrupt manifest(s); the next "
+            "pipeline run rebuilds exactly those artifacts (orphaned "
+            "objects are swept by `tools store gc`)"
+        )
+    print(
+        f"-- verified {ok} ok, {len(corrupt)} corrupt "
+        f"({'deep' if deep else 'spot'} check)"
+    )
+    return 1 if corrupt else 0
+
+
+def _cmd_gc(store: ArtifactStore, max_bytes: Optional[int], dry_run: bool,
+            tmp_max_age: float, min_object_age: float) -> int:
+    report = store_gc.collect(
+        store, size_budget_bytes=max_bytes, dry_run=dry_run,
+        tmp_max_age_s=tmp_max_age, min_object_age_s=min_object_age,
+    )
+    tag = "[dry-run] " if dry_run else ""
+    print(f"{tag}tmp swept:        {report['tmp_removed']}")
+    print(f"{tag}orphans removed:  {report['orphans_removed']} "
+          f"({_human_bytes(report['orphan_bytes'])})")
+    print(f"{tag}manifests evicted:{len(report['evicted_manifests']):>2} "
+          f"({_human_bytes(report['evicted_bytes'])})")
+    for ph in report["evicted_manifests"]:
+        print(f"{tag}  evict {ph[:12]}")
+    print(f"{tag}kept:             {report['kept_manifests']} manifest(s), "
+          f"{_human_bytes(report['kept_bytes'])}")
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    # --store is accepted both before and after the subcommand (the
+    # docs show the natural `tools store verify --store DIR` order).
+    # SUPPRESS keeps an unset subparser occurrence from clobbering a
+    # pre-subcommand value with its default.
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--store", default=argparse.SUPPRESS, metavar="DIR",
+                        help="store root (default: PC_STORE_DIR)")
+    parser = argparse.ArgumentParser(prog="tools store", description=__doc__,
+                                     parents=[common])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("ls", help="manifest inventory", parents=[common])
+    p_verify = sub.add_parser("verify", help="integrity-check every object",
+                              parents=[common])
+    p_verify.add_argument("--deep", action="store_true",
+                          help="full content digest for every object "
+                          "(default: size + head/full spot check)")
+    p_verify.add_argument("--drop", action="store_true",
+                          help="remove corrupt manifests so the next run "
+                          "rebuilds them")
+    p_gc = sub.add_parser("gc", help="mark-and-sweep garbage collection",
+                          parents=[common])
+    p_gc.add_argument("--max-bytes", default=None, metavar="N",
+                      help="LRU size budget (accepts K/M/G suffixes)")
+    p_gc.add_argument("--dry-run", action="store_true")
+    p_gc.add_argument("--tmp-max-age", default=3600.0, type=float,
+                      metavar="S", help="sweep tmp/ entries older than S")
+    p_gc.add_argument("--min-object-age", default=3600.0, type=float,
+                      metavar="S", help="never sweep objects younger than S")
+    p_pin = sub.add_parser("pin", help="exempt a plan hash from GC",
+                           parents=[common])
+    p_pin.add_argument("plan_hash")
+    p_pin.add_argument("--label", default="")
+    p_unpin = sub.add_parser("unpin", help="remove a pin", parents=[common])
+    p_unpin.add_argument("plan_hash")
+    args = parser.parse_args(argv)
+
+    store = _open_store(getattr(args, "store", None))
+    if args.cmd == "ls":
+        return _cmd_ls(store)
+    if args.cmd == "verify":
+        return _cmd_verify(store, deep=args.deep, drop=args.drop)
+    if args.cmd == "gc":
+        max_bytes = _parse_bytes(args.max_bytes) if args.max_bytes else None
+        return _cmd_gc(store, max_bytes, args.dry_run, args.tmp_max_age,
+                       args.min_object_age)
+    if args.cmd == "pin":
+        store.pin(args.plan_hash, args.label)
+        get_logger().info("pinned %s", args.plan_hash[:12])
+        return 0
+    store.unpin(args.plan_hash)
+    get_logger().info("unpinned %s", args.plan_hash[:12])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

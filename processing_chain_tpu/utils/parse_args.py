@@ -132,6 +132,18 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
         "write metrics_<ts>.json, metrics_<ts>.prom, events_<ts>.jsonl "
         "and trace_<ts>.json into DIR (render with tools/run_report.py)",
     )
+    parser.add_argument(
+        "--store", default=None, metavar="DIR",
+        help="content-addressed artifact store root (docs/STORE.md): "
+        "stale-vs-fresh becomes plan-hash equality, cached artifacts are "
+        "integrity-verified and materialized instead of rebuilt "
+        "(default: PC_STORE_DIR env, else no store)",
+    )
+    parser.add_argument(
+        "--no-store", action="store_true",
+        help="ignore --store and PC_STORE_DIR: plain skip-existing "
+        "semantics for this run",
+    )
     return parser
 
 

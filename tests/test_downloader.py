@@ -919,6 +919,51 @@ def test_p01_online_fails_at_plan_time_without_ytdlp(tmp_path):
     assert "SRC000" in msg  # the affected segment is named
 
 
+def test_plan_capability_probes_importability_not_client_slot(tmp_path, monkeypatch):
+    """plan_capability must agree with download_video's LAZY YtdlClient
+    construction: a Downloader built with youtube=None in an environment
+    where yt-dlp IS importable can download fine, so the plan probe keys
+    on importability, not on the client slot being filled (which
+    from_settings only fills when construction succeeded)."""
+    import importlib.machinery
+    import sys
+    import types
+
+    class Seg:
+        filename = "S.mp4"
+
+        class video_coding:
+            encoder = "youtube"
+
+        class quality_level:
+            audio_bitrate = None
+            video_codec = "h264"
+
+    d = dl.Downloader(str(tmp_path), youtube=None)
+
+    # environment truly lacks yt-dlp here: infeasible, with the fix named
+    monkeypatch.delitem(sys.modules, "yt_dlp", raising=False)
+    monkeypatch.delitem(sys.modules, "youtube_dl", raising=False)
+    if d._youtube_available():
+        pytest.skip("yt-dlp installed here; the missing-tool path is moot")
+    reason = d.plan_capability(Seg)
+    assert reason is not None and "yt-dlp" in reason
+
+    # now yt-dlp is importable (fake module with a spec): the SAME
+    # downloader — youtube slot still None — must plan as feasible,
+    # because download_video would lazily construct the client
+    fake = types.ModuleType("yt_dlp")
+    fake.__spec__ = importlib.machinery.ModuleSpec("yt_dlp", loader=None)
+    monkeypatch.setitem(sys.modules, "yt_dlp", fake)
+    assert d.youtube is None
+    assert d.plan_capability(Seg) is None
+
+    # an injected client short-circuits the probe entirely
+    d2 = dl.Downloader(str(tmp_path), youtube=FakeYoutube([]))
+    monkeypatch.delitem(sys.modules, "yt_dlp", raising=False)
+    assert d2.plan_capability(Seg) is None
+
+
 def test_p01_online_sos_skips_and_existing_file_passes(tmp_path):
     """-sos skips online segments cleanly; a segment whose output already
     exists plans as a no-op regardless of tooling (resume semantics)."""

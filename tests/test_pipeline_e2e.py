@@ -923,6 +923,42 @@ def test_p03_ffv1_frame_parallel_and_rawvideo_intermediate(tmp_path, monkeypatch
         render()
 
 
+def test_p03_rawvideo_intermediate_falls_back_to_ffv1_on_ten_bit(
+    tmp_path, monkeypatch
+):
+    """PC_AVPVS_CODEC=rawvideo on a 10-bit AVPVS must NOT produce a
+    rawvideo AVI: AVI has no fourcc for planar 10-bit rawvideo, so the
+    mux succeeds and every later read decodes garbage (round-5 advisor
+    repro). The writer falls back to ffv1 — lossless either way — and
+    the artifact still decodes to real 10-bit content."""
+    try:
+        medialib.ensure_loaded()
+    except Exception as exc:  # pragma: no cover - env-dependent
+        pytest.skip(f"native media boundary unavailable: {exc}")
+    yaml_path = write_db(tmp_path, "P2SXM85",
+                         minimal_short_yaml("P2SXM85", codec="h265",
+                                            encoder="libx265", iframe=2,
+                                            w=320, h=180, bitrate=300),
+                         {"SRC000.avi": dict(n=48, ten_bit=True)})
+    monkeypatch.setenv("PC_AVPVS_CODEC", "rawvideo")
+    rc = cli_main(["p00", "-c", yaml_path, "-str", "13",
+                   "--skip-requirements"])
+    assert rc == 0
+    db = os.path.dirname(yaml_path)
+    av = os.path.join(db, "avpvs", "P2SXM85_SRC000_HRC000.avi")
+    v = [s for s in medialib.probe(av)["streams"]
+         if s["codec_type"] == "video"][0]
+    assert v["codec_name"] == "ffv1"  # fell back; NOT silently-corrupt raw
+    with VideoReader(av) as r:
+        assert r.pix_fmt == "yuv420p10le"
+        planes, _ = r.read_all()
+    assert planes[0].dtype == np.uint16
+    assert 300 < planes[0].mean() < 800  # real 10-bit range content
+    # provenance records the codec that actually produced the artifact
+    prov = open(os.path.join(db, "logs", "P2SXM85_SRC000_HRC000.log")).read()
+    assert "ffv1" in prov and "rawvideo" not in prov
+
+
 def test_p04_mobile_ccrf_effect(tmp_path):
     """-ccrf must actually reach the mobile x264 encode: the same AVPVS
     rendered at CRF 10 vs CRF 45 differs drastically in size (reference

@@ -1,0 +1,19 @@
+"""Artifact-store admin from a checkout without installing.
+
+    python tools/store_admin.py ls|verify|gc|pin|unpin … [--store DIR]
+
+All logic lives in processing_chain_tpu.tools.store_admin (also exposed
+as `tools store …` through the package CLI); see docs/STORE.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from processing_chain_tpu.tools.store_admin import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
