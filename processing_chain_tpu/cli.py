@@ -24,13 +24,14 @@ from .utils.runner import ChainError
 from .utils.version import check_requirements
 
 
-def _write_telemetry(out_dir: str, status: str, wall_s: float) -> None:
+def _write_telemetry(out_dir: str, status: str, wall_s: float,
+                     stamp: Optional[str] = None) -> None:
     """Persist the run's metrics/events/trace under one stamp into
     `out_dir`. Best-effort: persistence failures must never replace the
     run's own outcome (mirrors the --trace report guard below)."""
     telemetry.emit("run_end", status=status, duration_s=round(wall_s, 4))
     try:
-        paths = telemetry.write_outputs(out_dir)
+        paths = telemetry.write_outputs(out_dir, stamp=stamp)
         tracing.get_tracer().write_report(out_dir, name=paths["stamp"])
         log_mod.get_logger().info(
             "telemetry: %s metrics_%s.{json,prom} + events + trace",
@@ -61,10 +62,76 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
     if store is not None:
         log_mod.get_logger().info("artifact store: %s", store.root)
     telemetry_dir = getattr(args, "telemetry", None)
-    if telemetry_dir:
+    live_port = getattr(args, "live_port", None)
+    status_file = getattr(args, "status_file", None)
+    wd_soft = getattr(args, "watchdog_soft", None)
+    wd_hard = getattr(args, "watchdog_hard", None)
+    live_on = (
+        telemetry_dir is not None or live_port is not None
+        or status_file is not None or wd_soft is not None
+        or wd_hard is not None
+    )
+    run_stamp = None
+    if live_on:
+        # live observability IS telemetry, just served instead of (or as
+        # well as) persisted: /metrics renders the same registry, the
+        # watchdog's forensics land in the same event log
         telemetry.enable()
         telemetry.attach_log_handler(log_mod.get_logger())
+        if telemetry_dir:
+            # stream events to disk AS THEY HAPPEN under a stamp fixed
+            # now: a run that crashes or is SIGKILLed leaves its event
+            # history (incl. watchdog forensics) for a partial
+            # run-report, instead of only an orderly-exit snapshot
+            import os as os_mod
+
+            run_stamp = telemetry.unique_stamp()
+            try:
+                telemetry.EVENTS.open_stream(os_mod.path.join(
+                    telemetry_dir, f"events_{run_stamp}.jsonl"
+                ))
+            except OSError as exc:
+                log_mod.get_logger().warning(
+                    "cannot stream events to %s: %s", telemetry_dir, exc
+                )
         telemetry.emit("run_start", name=name, argv=list(argv))
+    # the watchdog rides the live surface or its own flags — NOT bare
+    # --telemetry: coarse units of work (a long encode job) beat only on
+    # completion, so a default-on watchdog would flag healthy long jobs
+    # on every routine instrumented run
+    watchdog_on = (
+        live_port is not None or status_file is not None
+        or wd_soft is not None or wd_hard is not None
+    )
+    live_server = status_writer = watchdog = None
+    if watchdog_on:
+        from .telemetry import live as live_mod
+        from .telemetry import watchdog as watchdog_mod
+
+        live_mod.RUN_META.clear()
+        live_mod.RUN_META.update(name=name, argv=list(argv))
+        try:
+            if live_port is not None:
+                live_server = live_mod.LiveServer(live_port).start()
+                log_mod.get_logger().info(
+                    "live status: %s/{healthz,metrics,status}",
+                    live_server.url,
+                )
+            if status_file:
+                status_writer = live_mod.StatusFileWriter(status_file).start()
+        except OSError as exc:
+            # an unbindable port / unwritable status path is an operator
+            # mistake, not a pipeline failure: clean exit 1, like ConfigError
+            log_mod.get_logger().error(
+                "cannot start live observability: %s", exc
+            )
+            if live_server is not None:
+                live_server.stop()
+            return 1
+        watchdog = watchdog_mod.start_watchdog(
+            soft_s=wd_soft if wd_soft is not None else watchdog_mod.DEFAULT_SOFT_S,
+            hard_s=wd_hard,
+        )
     tracing_on = getattr(args, "trace", None) is not None
     profiler = tracing.DeviceProfiler(args.trace or None) if tracing_on else None
     test_config = None
@@ -100,6 +167,16 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
         status = "fail"
         raise
     finally:
+        if watchdog is not None:
+            from .telemetry import watchdog as watchdog_mod
+
+            watchdog_mod.stop_watchdog()
+        if status_writer is not None:
+            # writes one final snapshot so the file records how the run
+            # ended, then stops the rewriter
+            status_writer.stop()
+        if live_server is not None:
+            live_server.stop()
         if profiler is not None:
             profiler.stop()
         if store is not None:
@@ -107,7 +184,10 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
             # contract) so the next run's plan hashing pays stats, not reads
             store.digests.save()
         if telemetry_dir:
-            _write_telemetry(telemetry_dir, status, time.perf_counter() - t0)
+            _write_telemetry(
+                telemetry_dir, status, time.perf_counter() - t0,
+                stamp=run_stamp,
+            )
         if tracing_on:
             tracer = tracing.get_tracer()
             tracer.log_summary()
@@ -138,7 +218,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
     """`tools <name> …` subcommands (reference util/ scripts)."""
     tools = (
         "src-analysis", "complexity", "plots", "metrics", "clean-logs",
-        "run-report", "store",
+        "run-report", "store", "chain-top",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -154,6 +234,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import store_admin
 
             return store_admin.main(rest)
+        if name == "chain-top":
+            from .tools import chain_top
+
+            return chain_top.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from .. import telemetry as tm
+from ..telemetry.heartbeat import HEARTBEATS
 from ..store import runtime as store_runtime
 from ..store.store import (
     STORE_ADOPTIONS,
@@ -308,6 +309,9 @@ class Job:
         marked = mark_inprogress(self.output_path)
         tm.emit("job_start", job=self.label,
                 output=os.path.basename(self.output_path))
+        # live view: this job is in flight from here; its completion also
+        # advances the enclosing stage's jobs-done progress (stage_span)
+        hb = HEARTBEATS.register(self.label, kind="job")
         t0 = time.perf_counter()
         with tracing.span(self.label, output=os.path.basename(self.output_path)):
             try:
@@ -320,6 +324,8 @@ class Job:
                     os.unlink(self.output_path)
                 if marked:
                     clear_inprogress(self.output_path)
+                hb.finish("fail")
+                HEARTBEATS.stage_advance(1)
                 tm.emit(
                     "job_end", job=self.label, status="fail",
                     duration_s=round(time.perf_counter() - t0, 4),
@@ -327,6 +333,8 @@ class Job:
                 )
                 raise
         dur = time.perf_counter() - t0
+        hb.finish("ok")
+        HEARTBEATS.stage_advance(1)
         _JOB_SECONDS.observe(dur)
         tm.emit("job_end", job=self.label, status="ok",
                 duration_s=round(dur, 4))
@@ -398,6 +406,9 @@ class JobRunner:
             self._writers[job.output_path] = job.label
         if job.should_run(self.force, self.dry_run, runner=self.name):
             _JOBS_PLANNED.labels(runner=self.name).inc()
+            # the live per-stage denominator: every planned job is one
+            # unit of the enclosing stage's progress (stage_span)
+            HEARTBEATS.stage_add_planned(1)
             tm.emit("job_planned", job=job.label, runner=self.name,
                     output=os.path.basename(job.output_path))
             self.jobs.append(job)

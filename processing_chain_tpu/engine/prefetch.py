@@ -28,6 +28,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 import numpy as np
 
 from .. import telemetry as tm
+from ..telemetry.heartbeat import HEARTBEATS, NULL_HEARTBEAT, TaskCancelled
 
 _SENTINEL = object()
 
@@ -75,16 +76,23 @@ class _DepthSampler:
             tm.emit("queue_depth", queue=self._queue_name, depth=depth)
 
 
-def _put_until_stop(q: queue.Queue, item: Any, stop: threading.Event) -> None:
+def _put_until_stop(q: queue.Queue, item: Any, stop: threading.Event,
+                    hb=NULL_HEARTBEAT) -> bool:
     """Blocking put that a concurrent close() can always interrupt: close()
     sets `stop` and keeps the queue drained, so either the put lands or the
-    worker observes stop within one timeout tick — never a hung put."""
+    worker observes stop within one timeout tick — never a hung put. A
+    watchdog hard timeout (`hb.cancelled`) interrupts the same way, so a
+    put blocked on a wedged consumer cannot outlive its kill. Returns
+    whether the item landed."""
     while not stop.is_set():
+        if hb.cancelled:
+            return False
         try:
             q.put(item, timeout=0.1)
-            return
+            return True
         except queue.Full:
             continue
+    return False
 
 
 def _drain_join(queues: list, threads: list) -> None:
@@ -121,16 +129,30 @@ class Prefetcher:
         self._err: Optional[BaseException] = None
 
         def worker() -> None:
+            # the heartbeat beats once per prefetched item: a healthy
+            # stream keeps it fresh, a wedged decode or a blocked put
+            # ages it for the watchdog; a hard timeout lands here as
+            # TaskCancelled and surfaces at the consumer's next pull
+            hb = HEARTBEATS.register("decode-prefetch", kind="prefetch")
+            status = "ok"
             try:
                 for item in source:
                     if self._stop.is_set():
                         return
+                    hb.check_cancelled()
                     if transform is not None:
                         item = transform(item)
-                    _put_until_stop(self._q, item, self._stop)
+                    if _put_until_stop(self._q, item, self._stop, hb):
+                        hb.beat(advance=1)
+                    hb.check_cancelled()
             except BaseException as exc:  # noqa: BLE001 - re-raised in consumer
+                status = "fail"
                 self._err = exc
             finally:
+                hb.finish(status)
+                # the sentinel put is interruptible by close() only, NOT
+                # by cancellation: the consumer's blocking get() needs the
+                # sentinel to learn about the stored TaskCancelled at all
                 _put_until_stop(self._q, _SENTINEL, self._stop)
 
         self._thread = threading.Thread(target=worker, daemon=True)
@@ -183,9 +205,25 @@ class AsyncWriter:
         self._err: Optional[BaseException] = None
 
         def worker() -> None:
+            # beats once per written chunk (progress, not liveness): a
+            # writer starved by a slow producer ages alongside it, a
+            # wedged native write ages alone — the stack dump tells
+            # which. A hard timeout turns further work into a drain.
+            hb = HEARTBEATS.register("encode-writeback", kind="writeback")
+            status = "ok"
             while True:
-                item = self._q.get()
+                try:
+                    item = self._q.get(timeout=0.5)
+                except queue.Empty:
+                    if hb.cancelled and self._err is None:
+                        status = "fail"
+                        self._err = TaskCancelled(
+                            "writeback 'encode-writeback' cancelled by the "
+                            "watchdog hard timeout"
+                        )
+                    continue
                 if item is _SENTINEL:
+                    hb.finish(status)
                     return
                 if self._err is not None:
                     continue  # drain without writing after a failure
@@ -193,10 +231,12 @@ class AsyncWriter:
                     planes = [np.asarray(p) for p in item]
                     for i in range(planes[0].shape[0]):
                         self._writer.write(*(p[i] for p in planes))
+                    hb.beat(advance=1)
                     if tm.enabled():
                         _FRAMES_ENCODED.inc(planes[0].shape[0])
                         _BYTES_ENCODED.inc(sum(p.nbytes for p in planes))
                 except BaseException as exc:  # noqa: BLE001 - re-raised in close
+                    status = "fail"
                     self._err = exc
 
         self._thread = threading.Thread(target=worker, daemon=True)
@@ -273,21 +313,50 @@ class MultiSegmentPrefetcher:
         self._claim_lock = threading.Lock()
 
         def worker() -> None:
-            while not self._stop.is_set():
-                with self._claim_lock:
-                    idx = self._next
-                    if idx >= self._n:
-                        return
-                    self._next = idx + 1
-                q = self._queues[idx]
-                try:
-                    for item in self._factories[idx]():
-                        _put_until_stop(q, item, self._stop)
-                        if self._stop.is_set():
+            # planned stays None: streams are CLAIMED across workers, so
+            # a per-worker denominator of n would double-count in /status
+            # (units_done still says how many streams this worker finished)
+            hb = HEARTBEATS.register("decode-multiseg", kind="prefetch")
+            status = "ok"
+            try:
+                while not self._stop.is_set() and not hb.cancelled:
+                    with self._claim_lock:
+                        idx = self._next
+                        if idx >= self._n:
                             return
-                except BaseException as exc:  # noqa: BLE001 - consumer re-raises
-                    self._errs[idx] = exc
-                _put_until_stop(q, _SENTINEL, self._stop)
+                        self._next = idx + 1
+                    q = self._queues[idx]
+                    try:
+                        for item in self._factories[idx]():
+                            if _put_until_stop(q, item, self._stop, hb):
+                                hb.beat()  # chunk-level liveness
+                            if self._stop.is_set():
+                                return
+                            hb.check_cancelled()
+                    except BaseException as exc:  # noqa: BLE001 - consumer re-raises
+                        status = "fail"
+                        self._errs[idx] = exc
+                    else:
+                        hb.beat(advance=1)  # one unit = one finished stream
+                    # sentinel interruptible by close() only (see Prefetcher)
+                    _put_until_stop(q, _SENTINEL, self._stop)
+            finally:
+                if hb.cancelled:
+                    # hard-killed: fail every stream this worker would
+                    # still have claimed, so a consumer that gets past the
+                    # current stream meets an error, never a silent hang
+                    while True:
+                        with self._claim_lock:
+                            idx = self._next
+                            if idx >= self._n:
+                                break
+                            self._next = idx + 1
+                        self._errs[idx] = TaskCancelled(
+                            "prefetch 'decode-multiseg' cancelled by the "
+                            "watchdog hard timeout"
+                        )
+                        _put_until_stop(self._queues[idx], _SENTINEL, self._stop)
+                hb.finish(status)
 
         self._threads = [
             threading.Thread(target=worker, daemon=True)

@@ -113,7 +113,7 @@ def fs_barrier_init(sync_dir: str) -> None:
 
 def fs_barrier(
     stage: str, sync_dir: str, timeout_s: float = 24 * 3600.0,
-    poll_s: float = 2.0,
+    poll_s: float = 2.0, report_every_s: float = 60.0,
 ) -> None:
     """Filesystem barrier between pipeline stages on a shared filesystem.
 
@@ -125,10 +125,19 @@ def fs_barrier(
     shared across PVSes) while p02-p04 shard by pvs_id — a host's PVS may
     need segments another host encoded. No-op single-host.
 
+    Never waits silently: every `report_every_s` it logs + emits a
+    `barrier_wait` event naming the hosts still missing, its heartbeat
+    beats only when a new peer arrives (so the watchdog sees a barrier
+    stuck on a dead host as stalled, and a hard timeout cancels it), and
+    the final TimeoutError names the missing peers.
+
     Correctness rests entirely on PC_RUN_ID freshness (see barrier_run_id):
     markers of other run ids are never read nor deleted, so concurrent runs
     on one database can't interfere."""
     import time
+
+    from .. import telemetry as tm
+    from ..telemetry.heartbeat import HEARTBEATS
 
     pid, num = process_topology()
     if num == 1:
@@ -142,17 +151,54 @@ def fs_barrier(
         os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{i}")
         for i in range(num)
     ]
-    deadline = time.monotonic() + timeout_s
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    next_report = t0 + report_every_s
     log = get_logger()
     log.info("barrier %s: host %d/%d waiting", stage, pid, num)
+    hb = HEARTBEATS.register(
+        f"barrier:{stage}", kind="barrier", planned=num
+    )
+
+    def _missing_names(missing):
+        return [os.path.basename(m) for m in missing]
+
     while True:
         missing = [p for p in want if not os.path.isfile(p)]
+        # beats only on arrivals (beat() refreshes the liveness stamp
+        # unconditionally, so an every-poll beat would blind the
+        # watchdog): a barrier waiting on a dead host makes no progress
+        # and its beat age keeps growing
+        if num - len(missing) > hb.units_done:
+            hb.beat(done=num - len(missing))
         if not missing:
+            hb.finish("ok")
             return
-        if time.monotonic() > deadline:
+        now = time.monotonic()
+        if hb.cancelled:
+            hb.finish("timeout")
             raise TimeoutError(
-                f"barrier {stage}: timed out waiting for "
-                f"{[os.path.basename(m) for m in missing]}"
+                f"barrier {stage}: cancelled by the watchdog hard timeout "
+                f"after {now - t0:.0f}s; still missing "
+                f"{_missing_names(missing)} in {sync_dir}"
+            )
+        if now > deadline:
+            hb.finish("fail")
+            raise TimeoutError(
+                f"barrier {stage}: timed out after {now - t0:.0f}s waiting "
+                f"for {len(missing)}/{num} hosts — missing "
+                f"{_missing_names(missing)} in {sync_dir}"
+            )
+        if now >= next_report:
+            next_report = now + report_every_s
+            names = _missing_names(missing)
+            log.warning(
+                "barrier %s: host %d still waiting after %.0fs for %d/%d "
+                "peers: %s", stage, pid, now - t0, len(missing), num, names,
+            )
+            tm.emit(
+                "barrier_wait", stage=stage, host=pid,
+                waited_s=round(now - t0, 1), missing=names,
             )
         time.sleep(poll_s)
 

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import telemetry as tm
+from ..telemetry.heartbeat import HEARTBEATS
 from ..ops import metrics as metrics_ops
 from ..ops import resize as resize_ops
 from ..ops import siti as siti_ops
@@ -58,8 +59,17 @@ def _instrument_step(fn, step: str):
     def call(*args, **kwargs):
         if not tm.enabled():
             return fn(*args, **kwargs)
+        # in-flight for the duration of the blocking call: a device step
+        # stuck in compile or a wedged collective shows up in /status
+        # (and eventually the watchdog) with the step's name on it
+        hb = HEARTBEATS.register(step, kind="device_step")
         t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(*args, **kwargs))
+        try:
+            out = jax.block_until_ready(fn(*args, **kwargs))
+        except BaseException:
+            hb.finish("fail")
+            raise
+        hb.finish("ok")
         dur = time.perf_counter() - t0
         bound.observe(dur)
         if state["first"]:

@@ -82,7 +82,7 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
             "tooling/credentials)"
         )
     log.info("p01: %d segment encodes planned", len(runner.jobs))
-    tm.STAGE_ITEMS.labels(stage="p01").set(len(runner.jobs))
+    tm.stage_items("p01", len(runner.jobs))
     # pure host work (libav encode via ctypes releases the GIL): run the
     # encodes `-p`-wide like the reference's Pool(4) (cmd_utils.py:93-101);
     # each encode stays -threads 1, so parallelism comes from the pool

@@ -41,6 +41,6 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
             continue
         runner.add(md.metadata_job(pvs, force=cli_args.force))
         n_items += 1
-    tm.STAGE_ITEMS.labels(stage="p02").set(n_items)
+    tm.stage_items("p02", n_items)
     runner.run_serial()
     return test_config

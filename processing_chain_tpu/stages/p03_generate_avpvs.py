@@ -61,7 +61,7 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue
         eligible.append(pvs)
-    tm.STAGE_ITEMS.labels(stage="p03").set(len(eligible))
+    tm.stage_items("p03", len(eligible))
     from ..utils.device import device_count, select_device
 
     gpu_loc = getattr(cli_args, "set_gpu_loc", -1)

@@ -50,7 +50,7 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
             )
         if getattr(cli_args, "lightweight_preview", False):
             runner.add(cp.create_preview(pvs))
-    tm.STAGE_ITEMS.labels(stage="p04").set(n_items)
+    tm.stage_items("p04", n_items)
     from ..utils.device import select_device
 
     with select_device(getattr(cli_args, "set_gpu_loc", -1)):

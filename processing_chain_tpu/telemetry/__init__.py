@@ -36,6 +36,11 @@ from .events import (  # noqa: F401  (re-exports)
     emit,
     read_jsonl,
 )
+from .heartbeat import (  # noqa: F401
+    HEARTBEATS,
+    HeartbeatRegistry,
+    TaskCancelled,
+)
 from .metrics import (  # noqa: F401
     DEFAULT_DEPTH_BUCKETS,
     DEFAULT_LATENCY_BUCKETS,
@@ -55,18 +60,21 @@ def enabled() -> bool:
 def enable() -> None:
     REGISTRY.enabled = True
     EVENTS.enabled = True
+    HEARTBEATS.enabled = True
 
 
 def disable() -> None:
     REGISTRY.enabled = False
     EVENTS.enabled = False
+    HEARTBEATS.enabled = False
 
 
 def reset() -> None:
-    """Zero all series and drop all events (for a fresh run in one
-    process — registrations and bound handles stay valid)."""
+    """Zero all series, drop all events and heartbeats (for a fresh run
+    in one process — registrations and bound handles stay valid)."""
     REGISTRY.reset()
     EVENTS.clear()
+    HEARTBEATS.reset()
 
 
 def unique_stamp() -> str:
@@ -109,14 +117,17 @@ def stage_span(stage: str, **fields) -> Iterator[None]:
     """Wrap one stage run (p01..p04): emits stage_start/stage_end events
     carrying the frames/bytes counter deltas, from which a report derives
     per-stage throughput without any per-stage plumbing inside the
-    models layer."""
-    if not REGISTRY.enabled:
+    models layer. Also opens the stage's live heartbeat (units = jobs;
+    planned by JobRunner.add, advanced by Job completion) so /status can
+    answer per-stage progress + ETA while the stage runs."""
+    if not REGISTRY.enabled and not HEARTBEATS.enabled:
         yield
         return
     before = (
         FRAMES_DECODED.get(), FRAMES_ENCODED.get(), BYTES_ENCODED.get(),
     )
     emit("stage_start", stage=stage, **fields)
+    HEARTBEATS.stage_begin(stage)
     t0 = time.perf_counter()
     status = "ok"
     try:
@@ -127,6 +138,7 @@ def stage_span(stage: str, **fields) -> Iterator[None]:
     finally:
         wall = time.perf_counter() - t0
         STAGE_SECONDS.labels(stage=stage).set(wall)
+        HEARTBEATS.stage_end(stage, status)
         emit(
             "stage_end",
             stage=stage,
@@ -137,6 +149,14 @@ def stage_span(stage: str, **fields) -> Iterator[None]:
             bytes_encoded=BYTES_ENCODED.get() - before[2],
             **fields,
         )
+
+
+def stage_items(stage: str, n: float) -> None:
+    """Record a stage's work-item count on both surfaces at once: the
+    STAGE_ITEMS gauge (post-run metrics) and the live status document
+    (the `items` field next to the jobs-based progress)."""
+    STAGE_ITEMS.labels(stage=stage).set(n)
+    HEARTBEATS.stage_items(stage, n)
 
 
 def write_outputs(out_dir: str, stamp: Optional[str] = None) -> dict[str, str]:

@@ -38,6 +38,35 @@ class EventLog:
         self.enabled = False
         self._t0 = time.time()
         self._t0_perf = time.perf_counter()
+        self._stream = None
+
+    def open_stream(self, path: str) -> str:
+        """Additionally append every record to `path` AS IT IS EMITTED,
+        so a run that crashes or hangs still leaves its event history on
+        disk for forensics (tools run-report renders such a file as a
+        partial run). write_jsonl to the same path at run end replaces
+        the stream with the canonical complete file."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        f = open(path, "w")
+        f.write(json.dumps({
+            "event": "log_meta", "t": 0.0,
+            "epoch_t0": round(self._t0, 3), "streaming": True,
+        }) + "\n")
+        f.flush()
+        with self._lock:
+            old, self._stream = self._stream, f
+        if old is not None:
+            old.close()
+        return path
+
+    def close_stream(self) -> None:
+        with self._lock:
+            stream, self._stream = self._stream, None
+        if stream is not None:
+            try:
+                stream.close()
+            except OSError:
+                pass
 
     def emit(self, event: str, **fields) -> None:
         if not self.enabled:
@@ -48,6 +77,17 @@ class EventLog:
         }
         record.update(fields)
         with self._lock:
+            if self._stream is not None:
+                try:
+                    # flushed per record, and BEFORE the memory-cap check:
+                    # the stream is disk-backed forensics for runs that
+                    # never reach an orderly shutdown, so a week-long run
+                    # that overflowed the in-memory log must still record
+                    # its tail (watchdog stalls, the crash) on disk
+                    self._stream.write(json.dumps(record) + "\n")
+                    self._stream.flush()
+                except (OSError, TypeError, ValueError):
+                    pass  # forensics stream must never break the run
             if len(self._events) >= self.max_events:
                 self.drops += 1
                 return
@@ -58,6 +98,7 @@ class EventLog:
             return list(self._events)
 
     def clear(self) -> None:
+        self.close_stream()
         with self._lock:
             self._events.clear()
             self.drops = 0
@@ -70,6 +111,15 @@ class EventLog:
             events = list(self._events)
             drops = self.drops
             t0 = self._t0
+            # the canonical end-of-run file replaces any live stream to
+            # the same path; close first so the rewrite wins on Windows
+            # semantics too, not only via POSIX last-writer
+            stream, self._stream = self._stream, None
+        if stream is not None:
+            try:
+                stream.close()
+            except OSError:
+                pass
         with open(path, "w") as f:
             f.write(json.dumps({
                 "event": "log_meta", "t": 0.0, "epoch_t0": round(t0, 3),

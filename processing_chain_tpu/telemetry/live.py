@@ -1,0 +1,188 @@
+"""Live status surface: HTTP endpoint + atomically-rewritten status file.
+
+`LiveServer` is a stdlib `ThreadingHTTPServer` (no new dependencies)
+exposing three read-only endpoints while a run is in flight:
+
+    /healthz   liveness: {"status": "ok", "uptime_s": ...}
+    /metrics   MetricsRegistry.render_prometheus(), LIVE — the same
+               format the post-run metrics_<ts>.prom persists
+    /status    JSON: per-stage progress + ETA, in-flight tasks with
+               beat ages, chain counters (schema below)
+
+`StatusFileWriter` rewrites the same /status JSON to a file every
+`interval_s` via tmp + os.replace, so a reader (tools chain-top, a
+cron probe) never observes a torn write — the headless twin of the
+endpoint for batch hosts with no reachable port.
+
+Status document schema (docs/TELEMETRY.md "Live monitoring"):
+
+    {"schema": 1, "pid": ..., "generated_at": epoch, "uptime_s": ...,
+     "run": {...},                        # run_meta set by the CLI
+     "stages": {stage: {state, jobs_done, jobs_planned?, progress?,
+                        eta_s?, wall_s, items?}},
+     "current_stage": ..., "tasks": [...], "recent": [...],
+     "counters": {frames_decoded, frames_encoded, bytes_encoded}}
+
+Binding defaults to 127.0.0.1 (an operator forwarding the port owns the
+exposure decision); PC_LIVE_HOST overrides.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .heartbeat import HEARTBEATS
+from .metrics import REGISTRY
+
+_T0 = time.monotonic()
+
+#: Mutable run metadata merged into /status (the CLI sets name/argv).
+RUN_META: dict = {}
+
+
+def build_status() -> dict:
+    """One JSON-able status document from the live registries."""
+    doc = {
+        "schema": 1,
+        "pid": os.getpid(),
+        "generated_at": round(time.time(), 3),
+        "uptime_s": round(time.monotonic() - _T0, 3),
+        "run": dict(RUN_META),
+    }
+    doc.update(HEARTBEATS.snapshot())
+    from . import BYTES_ENCODED, FRAMES_DECODED, FRAMES_ENCODED
+
+    doc["counters"] = {
+        "frames_decoded": FRAMES_DECODED.get(),
+        "frames_encoded": FRAMES_ENCODED.get(),
+        "bytes_encoded": BYTES_ENCODED.get(),
+    }
+    return doc
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "chain-live/1"
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._reply(200, "application/json", json.dumps({
+                "status": "ok",
+                "pid": os.getpid(),
+                "uptime_s": round(time.monotonic() - _T0, 3),
+            }))
+        elif path == "/metrics":
+            self._reply(
+                200, "text/plain; version=0.0.4",
+                REGISTRY.render_prometheus(),
+            )
+        elif path == "/status":
+            self._reply(200, "application/json", json.dumps(build_status()))
+        else:
+            self._reply(404, "text/plain", "not found: try /healthz /metrics /status\n")
+
+    def _reply(self, code: int, ctype: str, body: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):  # impatient curl
+            pass
+
+    def log_message(self, fmt: str, *args) -> None:  # noqa: A003
+        pass  # never spam the chain's console per scrape
+
+
+class LiveServer:
+    """Threaded HTTP server on a daemon thread. Port 0 binds an
+    ephemeral port; `.port` is the bound one either way."""
+
+    def __init__(self, port: int, host: Optional[str] = None) -> None:
+        self.host = host or os.environ.get("PC_LIVE_HOST", "127.0.0.1")
+        self._server = ThreadingHTTPServer((self.host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "LiveServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="chain-live-http", daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def __enter__(self) -> "LiveServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def write_status_file(path: str) -> str:
+    """One atomic rewrite: readers see the old document or the new one,
+    never a torn half-write (tmp is thread/process-unique)."""
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(build_status(), f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+class StatusFileWriter:
+    """Periodic atomic status-file rewriter for headless runs (no port
+    reachable). `stop()` writes one final snapshot so the file's last
+    state reflects the run's end, not its second-to-last tick."""
+
+    def __init__(self, path: str, interval_s: float = 2.0) -> None:
+        self.path = path
+        self.interval_s = max(0.2, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                write_status_file(self.path)
+            except OSError:  # a transiently-full disk must not kill the run
+                pass
+
+    def start(self) -> "StatusFileWriter":
+        if self._thread is None:
+            write_status_file(self.path)  # visible immediately, not at t+interval
+            self._thread = threading.Thread(
+                target=self._loop, name="chain-status-file", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        try:
+            write_status_file(self.path)
+        except OSError:
+            pass

@@ -28,6 +28,7 @@ from typing import Optional, Sequence
 from .events import read_jsonl
 
 _STAMP_RE = re.compile(r"metrics_(?P<stamp>.+)\.json$")
+_EVENTS_STAMP_RE = re.compile(r"events_(?P<stamp>.+)\.jsonl$")
 
 
 @dataclass
@@ -37,6 +38,9 @@ class RunData:
     metrics: dict = field(default_factory=dict)
     events: list = field(default_factory=list)
     trace: dict = field(default_factory=dict)
+    #: events JSONL present but no metrics snapshot: the run crashed (or
+    #: is still in flight) before telemetry.write_outputs persisted it
+    partial: bool = False
 
 
 class ReportError(ValueError):
@@ -46,37 +50,55 @@ class ReportError(ValueError):
 def list_stamps(directory: str) -> list[str]:
     """Run stamps in the directory, oldest first. Ordered by artifact
     mtime, not stamp text: stamps embed an unpadded pid/sequence, so a
-    lexicographic sort could call an older run 'latest'."""
+    lexicographic sort could call an older run 'latest'. Stamps with
+    only a (streamed) events file — a run still in flight, or one that
+    crashed before its metrics snapshot — are included: run-report must
+    be able to answer for exactly those runs."""
     entries = []
-    for path in glob.glob(os.path.join(directory, "metrics_*.json")):
-        m = _STAMP_RE.search(os.path.basename(path))
-        if m:
-            try:
-                mtime = os.path.getmtime(path)
-            except OSError:
-                continue
-            entries.append((mtime, m.group("stamp")))
+    seen = set()
+    for pattern, regex in (
+        ("metrics_*.json", _STAMP_RE),
+        ("events_*.jsonl", _EVENTS_STAMP_RE),
+    ):
+        for path in glob.glob(os.path.join(directory, pattern)):
+            m = regex.search(os.path.basename(path))
+            if m and m.group("stamp") not in seen:
+                try:
+                    mtime = os.path.getmtime(path)
+                except OSError:
+                    continue
+                seen.add(m.group("stamp"))
+                entries.append((mtime, m.group("stamp")))
     return [stamp for _, stamp in sorted(entries)]
 
 
 def load_run(directory: str, stamp: Optional[str] = None) -> RunData:
-    """Load the artifacts of one run (latest stamp unless given)."""
+    """Load the artifacts of one run (latest stamp unless given). A
+    stamp whose metrics snapshot is absent but whose events JSONL exists
+    loads as a PARTIAL run (crashed or still in flight) instead of
+    raising — the events are exactly the forensics an operator needs."""
     if not os.path.isdir(directory):
         raise ReportError(f"not a directory: {directory}")
     stamps = list_stamps(directory)
     if stamp is None:
         if not stamps:
             raise ReportError(
-                f"no metrics_<ts>.json in {directory} — was the run started "
-                "with --telemetry?"
+                f"no metrics_<ts>.json (or events_<ts>.jsonl) in "
+                f"{directory} — was the run started with --telemetry?"
             )
         stamp = stamps[-1]
     elif stamp not in stamps:
         raise ReportError(f"no metrics_{stamp}.json in {directory}")
     run = RunData(directory=directory, stamp=stamp)
-    with open(os.path.join(directory, f"metrics_{stamp}.json")) as f:
-        run.metrics = json.load(f)
+    metrics_path = os.path.join(directory, f"metrics_{stamp}.json")
     events_path = os.path.join(directory, f"events_{stamp}.jsonl")
+    if os.path.isfile(metrics_path):
+        with open(metrics_path) as f:
+            run.metrics = json.load(f)
+    elif os.path.isfile(events_path):
+        run.partial = True
+    else:
+        raise ReportError(f"no artifacts for stamp {stamp} in {directory}")
     if os.path.isfile(events_path):
         run.events = read_jsonl(events_path)
     trace_path = os.path.join(directory, f"trace_{stamp}.json")
@@ -140,12 +162,62 @@ def _header_section(run: RunData) -> list[str]:
         lines.append(
             f"  status: {e.get('status', '?')}  wall: {e.get('duration_s', '?')}s"
         )
+    elif run.partial:
+        last_t = run.events[-1].get("t", "?") if run.events else "?"
+        lines.append(
+            "  status: RUN DID NOT COMPLETE (events streamed, no metrics "
+            f"snapshot) — crashed or still in flight; last event at "
+            f"t={last_t}s"
+        )
+    return lines
+
+
+def _partial_section(run: RunData) -> list[str]:
+    """Forensics for a run without an end: which jobs started but never
+    ended, and any watchdog incidents the stream captured."""
+    started = {e.get("job"): e for e in _events(run, "job_start")}
+    ended = {e.get("job") for e in _events(run, "job_end")}
+    open_jobs = [j for j in started if j not in ended]
+    lines = []
+    if open_jobs:
+        last_t = run.events[-1].get("t", 0.0) if run.events else 0.0
+        lines.append(f"jobs started but never finished ({len(open_jobs)}):")
+        for job in open_jobs[:10]:
+            t_start = started[job].get("t", 0.0)
+            lines.append(
+                f"  {job}  (started t={t_start}s, "
+                f"{float(last_t) - float(t_start):.1f}s before the stream ended)"
+            )
+    incidents = (
+        _events(run, "task_stalled") + _events(run, "task_hard_timeout")
+        + _events(run, "barrier_wait")
+    )
+    if incidents:
+        lines.append(f"watchdog/barrier incidents ({len(incidents)}):")
+        for e in incidents[:10]:
+            desc = e.get("task") or f"missing {e.get('missing')}"
+            lines.append(
+                f"  t={e.get('t')}s {e['event']}: {desc} "
+                f"(no progress for {e.get('beat_age_s', e.get('waited_s', '?'))}s)"
+            )
+        lines.append(
+            "  (full stack dumps are in the task_stalled/task_hard_timeout "
+            "event records)"
+        )
+    if not lines:
+        lines.append("no in-flight jobs captured before the stream ended")
     return lines
 
 
 def _stage_section(run: RunData) -> list[str]:
     stage_ends = _events(run, "stage_end")
     if not stage_ends:
+        starts = _events(run, "stage_start")
+        if starts and run.partial:
+            return [
+                f"stage {s.get('stage', '?')} started at t={s.get('t')}s "
+                "and never ended" for s in starts
+            ]
         return ["no stage_end events (single-layer run?)"]
     rows = []
     for e in stage_ends:
@@ -287,6 +359,12 @@ def _device_section(run: RunData) -> list[str]:
 def render_report(run: RunData) -> str:
     parts = [
         "\n".join(_header_section(run)),
+    ]
+    if run.partial:
+        parts.append(
+            "partial run:\n" + "\n".join(f"  {l}" for l in _partial_section(run))
+        )
+    parts += [
         "stage throughput:\n" + "\n".join(f"  {l}" for l in _stage_section(run)),
         "jobs:\n" + "\n".join(f"  {l}" for l in _jobs_section(run)),
         "top spans:\n" + "\n".join(f"  {l}" for l in _spans_section(run)),

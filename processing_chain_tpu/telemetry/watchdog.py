@@ -1,0 +1,195 @@
+"""Stall watchdog: a daemon thread over the heartbeat registry.
+
+Two thresholds, scanned every `poll_s`:
+
+  * **soft** (default 300 s) — a task whose beat age exceeds it is
+    flagged: one structured `task_stalled` event carrying an all-thread
+    stack dump (the forensics that distinguish "blocked on a queue put"
+    from "stuck in a native decode") plus a console warning. The flag
+    re-arms when the task beats again (`task_recovered` event), so a
+    task that stalls twice is reported twice.
+  * **hard** (opt-in, off by default) — past it the task is *marked
+    failed with forensics instead of hanging forever*: a
+    `task_hard_timeout` event with the stack dump, the heartbeat is
+    removed from the live set with status "timeout", and its
+    `cancelled` flag is set so cooperative wait loops (the distributed
+    barrier, prefetch queue puts) abort with `TaskCancelled` at their
+    next check. Python cannot kill a hung native call, so cancellation
+    is cooperative by design — the event log still records WHERE it
+    hung either way.
+
+Beat age measures time since the last *progress* beat (see
+telemetry/heartbeat.py), so slow-but-flowing pipelines stay quiet and
+genuinely wedged ones surface within one soft threshold.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Optional
+
+from .events import emit
+from .heartbeat import HEARTBEATS, HeartbeatRegistry
+
+#: Stack dumps are bounded so one stalled scan can't blow the event
+#: log's memory cap (events are capped in count, not record size).
+_MAX_STACK_CHARS = 8000
+
+DEFAULT_SOFT_S = 300.0
+
+#: Kinds whose wait loops poll `cancelled` and abort: these the hard
+#: timeout genuinely terminates, so their heartbeat is finished as
+#: "timeout". Execution wrappers (job/task/device_step/runner) wrap
+#: uninterruptible work — Python cannot kill it — so for those the hard
+#: timeout records the same forensics and sets `cancelled`, but leaves
+#: the heartbeat live: if the work does eventually finish, its real
+#: outcome is recorded instead of a false "timeout" verdict.
+CANCELLABLE_KINDS = frozenset({"barrier", "prefetch", "writeback"})
+
+
+def dump_all_stacks(limit: int = _MAX_STACK_CHARS) -> str:
+    """All-thread stack dump, bounded; names threads for readability."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in sys._current_frames().items():
+        parts.append(
+            f"--- thread {names.get(ident, '?')} ({ident}) ---\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    text = "\n".join(parts)
+    if len(text) > limit:
+        text = text[:limit] + "\n... [stack dump truncated]"
+    return text
+
+
+class Watchdog:
+    """Daemon scanning thread. `start()`/`stop()` are idempotent; `scan()`
+    is callable directly (the tests drive it with an injected clock)."""
+
+    def __init__(self, soft_s: float = DEFAULT_SOFT_S,
+                 hard_s: Optional[float] = None,
+                 poll_s: Optional[float] = None,
+                 registry: HeartbeatRegistry = HEARTBEATS) -> None:
+        self.soft_s = float(soft_s)
+        self.hard_s = float(hard_s) if hard_s else None
+        # scan often enough that a stall is seen well inside one soft
+        # threshold, but never busier than 1 Hz
+        self.poll_s = float(poll_s) if poll_s else max(1.0, self.soft_s / 10.0)
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="chain-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.scan()
+            except Exception:  # pragma: no cover - the watchdog must
+                pass  # never take the run down with it
+
+    # --------------------------------------------------------------- scan
+
+    def scan(self) -> list[dict]:
+        """One pass over the live heartbeats; returns the incidents found
+        ({"task", "incident": "stalled"|"hard_timeout", ...})."""
+        from ..utils.log import get_logger
+
+        registry = self._registry
+        now = registry._clock()
+        incidents: list[dict] = []
+        for hb in registry.live():
+            if hb.kind == "stage":
+                continue  # stages stall iff their jobs do; report those
+            age = now - hb.t_beat
+            if self.hard_s is not None and age > self.hard_s:
+                if hb.cancelled:
+                    continue  # already killed; waiting on its loop to see it
+                stacks = dump_all_stacks()
+                hb.cancelled = True
+                emit(
+                    "task_hard_timeout", task=hb.label, kind=hb.kind,
+                    stage=hb.stage, beat_age_s=round(age, 1),
+                    units_done=hb.units_done, hard_s=self.hard_s,
+                    stacks=stacks,
+                )
+                if hb.kind in CANCELLABLE_KINDS:
+                    registry._finish(hb, "timeout")
+                    get_logger().error(
+                        "watchdog: %s '%s' exceeded the hard timeout "
+                        "(%.0fs without progress > %.0fs); cancelled, "
+                        "forensics in the event log",
+                        hb.kind, hb.label, age, self.hard_s,
+                    )
+                else:
+                    get_logger().error(
+                        "watchdog: %s '%s' exceeded the hard timeout "
+                        "(%.0fs without progress > %.0fs); cannot be "
+                        "interrupted — forensics recorded, left running",
+                        hb.kind, hb.label, age, self.hard_s,
+                    )
+                incidents.append({
+                    "task": hb.label, "incident": "hard_timeout",
+                    "beat_age_s": age,
+                })
+            elif age > self.soft_s and not hb.stall_flagged:
+                hb.stall_flagged = True
+                stacks = dump_all_stacks()
+                emit(
+                    "task_stalled", task=hb.label, kind=hb.kind,
+                    stage=hb.stage, beat_age_s=round(age, 1),
+                    units_done=hb.units_done, soft_s=self.soft_s,
+                    stacks=stacks,
+                )
+                get_logger().warning(
+                    "watchdog: %s '%s' has made no progress for %.0fs "
+                    "(soft threshold %.0fs) — stack dump in the event log",
+                    hb.kind, hb.label, age, self.soft_s,
+                )
+                incidents.append({
+                    "task": hb.label, "incident": "stalled",
+                    "beat_age_s": age,
+                })
+        return incidents
+
+
+_ACTIVE: Optional[Watchdog] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def start_watchdog(soft_s: float = DEFAULT_SOFT_S,
+                   hard_s: Optional[float] = None) -> Watchdog:
+    """Process-wide watchdog slot (the CLI's entry point). Restarting
+    with new thresholds replaces the previous instance."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            _ACTIVE.stop()
+        _ACTIVE = Watchdog(soft_s=soft_s, hard_s=hard_s).start()
+        return _ACTIVE
+
+
+def stop_watchdog() -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            _ACTIVE.stop()
+            _ACTIVE = None

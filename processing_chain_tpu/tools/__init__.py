@@ -9,4 +9,6 @@ Each module doubles as a library (importable functions) and a CLI
     (reference util/complexity_classification.py)
   * plots — HRC timeline / bitrate-resolution design plots
     (reference util/plot_config_{long,short}.py)
+  * chain_top — refreshing terminal view of a live run's --live-port
+    endpoint or --status-file (docs/TELEMETRY.md "Live monitoring")
 """

@@ -133,6 +133,31 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
         "and trace_<ts>.json into DIR (render with tools/run_report.py)",
     )
     parser.add_argument(
+        "--live-port", default=None, type=int, metavar="PORT",
+        help="serve live observability on PORT while the run is in "
+        "flight: /healthz, /metrics (Prometheus, live), /status (JSON "
+        "progress/ETA/in-flight tasks; render with tools chain-top). "
+        "0 binds an ephemeral port (logged). Implies telemetry "
+        "collection (persisted only with --telemetry DIR)",
+    )
+    parser.add_argument(
+        "--status-file", default=None, metavar="PATH",
+        help="atomically rewrite PATH with the /status JSON every ~2s "
+        "(headless twin of --live-port; render with tools chain-top)",
+    )
+    parser.add_argument(
+        "--watchdog-soft", default=None, type=float, metavar="SECONDS",
+        help="flag any in-flight task without progress for SECONDS: "
+        "task_stalled event + all-thread stack dump in the event log "
+        "(default 300 when live observability is on)",
+    )
+    parser.add_argument(
+        "--watchdog-hard", default=None, type=float, metavar="SECONDS",
+        help="opt-in hard limit: a task without progress for SECONDS is "
+        "marked failed with forensics (task_hard_timeout event + stack "
+        "dump) and cancelled instead of hanging forever (default: off)",
+    )
+    parser.add_argument(
         "--store", default=None, metavar="DIR",
         help="content-addressed artifact store root (docs/STORE.md): "
         "stale-vs-fresh becomes plan-hash equality, cached artifacts are "

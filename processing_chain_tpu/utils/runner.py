@@ -22,9 +22,10 @@ import subprocess
 import time
 from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 from dataclasses import dataclass, field
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from .. import telemetry as tm
+from ..telemetry.heartbeat import HEARTBEATS
 from .log import get_logger
 
 logger_ = get_logger
@@ -63,6 +64,7 @@ class ParallelRunner:
         self._tasks: list[Task] = []
         self._seen: set[str] = set()
         self.results: dict[str, Any] = {}
+        self._batch_hb = None  # live batch progress, set per run()
 
     def add(self, fn: Callable[..., Any], *args: Any, label: str = "", **kwargs: Any) -> None:
         task = Task(fn, args, kwargs, label)
@@ -79,18 +81,27 @@ class ParallelRunner:
     def _call(self, task: Task) -> Any:
         """Worker-side task body with concurrency/latency telemetry (one
         flag check per TASK when disabled — never per item of work)."""
-        if not tm.enabled():
+        if not tm.enabled() and not HEARTBEATS.enabled:
             return task.fn(*task.args, **task.kwargs)
         in_flight = _IN_FLIGHT.labels(runner=self.name)
         in_flight.inc()
+        hb = HEARTBEATS.register(f"{self.name}:{task.key()}"[:120], kind="task")
         t0 = time.perf_counter()
         try:
-            return task.fn(*task.args, **task.kwargs)
+            result = task.fn(*task.args, **task.kwargs)
+        except BaseException:
+            hb.finish("fail")
+            raise
+        else:
+            hb.finish("ok")
+            return result
         finally:
             in_flight.dec()
             _TASK_SECONDS.labels(runner=self.name).observe(
                 time.perf_counter() - t0
             )
+            if self._batch_hb is not None:
+                self._batch_hb.beat(advance=1)
 
     def run(self) -> dict[str, Any]:
         """Run all tasks; raise ChainError on first failure (fail-fast,
@@ -101,6 +112,12 @@ class ParallelRunner:
             return self.results
         log = logger_()
         log.debug("%s: running %d tasks, %d-wide", self.name, len(self._tasks), self.max_parallel)
+        # batch-level heartbeat: planned = this batch's task count, one
+        # beat per completed task — the live per-runner progress + ETA
+        self._batch_hb = HEARTBEATS.register(
+            self.name, kind="runner", planned=len(self._tasks)
+        )
+        batch_status = "ok"
         try:
             with ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
                 futures = {pool.submit(self._call, t): t for t in self._tasks}
@@ -117,10 +134,13 @@ class ParallelRunner:
                 if first_err is not None:
                     for fut in not_done:
                         fut.cancel()
+                    batch_status = "fail"
                     raise ChainError(
                         f"{self.name}: task '{err_task.key()}' failed: {first_err!r}"
                     ) from first_err
         finally:
+            self._batch_hb.finish(batch_status)
+            self._batch_hb = None
             # batch state is consumed either way: a caller that catches
             # ChainError and retries must not silently re-run the failed
             # batch on top of its new tasks (stale _seen would also
@@ -139,16 +159,50 @@ def run_task(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
         raise ChainError(f"task {getattr(fn, '__name__', fn)!r} failed: {exc!r}") from exc
 
 
-def shell(cmd: Sequence[str] | str, check: bool = True) -> subprocess.CompletedProcess:
+def _stderr_tail(stderr, limit: int = 2000) -> str:
+    """Bounded stderr tail for error messages: enough to diagnose, never
+    megabytes of encoder spew in an exception repr."""
+    text = (stderr or "").strip()
+    if isinstance(text, bytes):  # TimeoutExpired may carry bytes
+        text = text.decode(errors="replace").strip()
+    if len(text) > limit:
+        text = "…" + text[-limit:]
+    return text
+
+
+def shell(
+    cmd: Sequence[str] | str,
+    check: bool = True,
+    timeout: Optional[float] = None,
+) -> subprocess.CompletedProcess:
     """Minimal subprocess helper (reference shell_call, cmd_utils.py:42-57).
 
     Only used at the edges (e.g. `git describe` for versioning); media work
-    never goes through a shell in this framework.
+    never goes through a shell in this framework. `timeout` bounds the
+    child's wall time so an edge call can never hang a run (the child is
+    killed on expiry), and both failure modes raise ChainError carrying
+    a bounded stderr tail instead of an opaque nonzero-exit notice.
     """
-    return subprocess.run(
-        cmd,
-        shell=isinstance(cmd, str),
-        check=check,
-        capture_output=True,
-        text=True,
-    )
+    cmd_text = cmd if isinstance(cmd, str) else " ".join(map(str, cmd))
+    try:
+        result = subprocess.run(
+            cmd,
+            shell=isinstance(cmd, str),
+            check=False,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as exc:
+        tail = _stderr_tail(exc.stderr)
+        raise ChainError(
+            f"command '{cmd_text}' timed out after {timeout}s"
+            + (f"; stderr tail: {tail}" if tail else "")
+        ) from exc
+    if check and result.returncode != 0:
+        tail = _stderr_tail(result.stderr)
+        raise ChainError(
+            f"command '{cmd_text}' failed with exit {result.returncode}"
+            + (f"; stderr tail: {tail}" if tail else "")
+        )
+    return result

@@ -1,0 +1,596 @@
+"""Live-observability tests: heartbeat registry (progress, EWMA ETA,
+stage aggregation), watchdog soft/hard paths (stalled event + stack
+dump, hard-timeout forensics + cooperative cancellation), live HTTP
+endpoints, status-file atomicity, chain-top rendering, and the
+satellites (shell timeout, barrier wait events, partial run-report).
+See docs/TELEMETRY.md "Live monitoring"."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.telemetry import live as live_mod
+from processing_chain_tpu.telemetry import report as report_mod
+from processing_chain_tpu.telemetry import watchdog as wd_mod
+from processing_chain_tpu.telemetry.heartbeat import (
+    HEARTBEATS,
+    HeartbeatRegistry,
+    TaskCancelled,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    """Same hygiene as test_telemetry: enabled + zeroed per test, the
+    process-wide disabled default restored afterwards."""
+    tm.reset()
+    tm.enable()
+    yield
+    tm.disable()
+    tm.reset()
+
+
+@pytest.fixture
+def clocked():
+    """A registry on an injectable clock so stalls age without sleeping."""
+    clk = [0.0]
+    reg = HeartbeatRegistry(clock=lambda: clk[0])
+    reg.enabled = True
+    return reg, clk
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_heartbeat_progress_and_ewma_eta(clocked):
+    reg, clk = clocked
+    hb = reg.register("encode", kind="task", planned=10)
+    assert hb.progress() == 0.0 and hb.eta_s() is None  # no rate yet
+    for _ in range(5):
+        clk[0] += 2.0
+        hb.beat(advance=1)
+    # steady 1 unit / 2s -> 5 remaining ≈ 10s ETA
+    assert hb.progress() == pytest.approx(0.5)
+    assert hb.eta_s() == pytest.approx(10.0, rel=0.3)
+    snap = reg.snapshot()
+    (task,) = snap["tasks"]
+    assert task["label"] == "encode" and task["progress"] == pytest.approx(0.5)
+    assert task["eta_s"] is not None
+
+
+def test_heartbeat_done_semantics_and_finish(clocked):
+    reg, clk = clocked
+    hb = reg.register("barrier:p01", kind="barrier", planned=4)
+    hb.beat(done=2)  # absolute count (peers arrived), not a delta
+    hb.beat(done=2)  # repeat must not double-count
+    assert hb.units_done == 2
+    hb.finish("ok")
+    assert reg.live() == []
+    snap = reg.snapshot()
+    assert snap["tasks"] == []
+    assert snap["recent"][0]["label"] == "barrier:p01"
+    assert snap["recent"][0]["status"] == "ok"
+
+
+def test_disabled_registry_returns_noop_handle():
+    reg = HeartbeatRegistry()
+    hb = reg.register("x", kind="task", planned=3)
+    hb.beat(advance=1)
+    hb.check_cancelled()
+    hb.finish("ok")
+    assert reg.live() == [] and reg.snapshot()["tasks"] == []
+
+
+def test_task_context_manager_records_failure(clocked):
+    reg, _ = clocked
+    with pytest.raises(ValueError):
+        with reg.task("boom", kind="task"):
+            raise ValueError("x")
+    assert reg.snapshot()["recent"][0]["status"] == "fail"
+
+
+def test_stage_heartbeat_aggregates_job_progress(clocked):
+    reg, clk = clocked
+    reg.stage_begin("p03")
+    reg.stage_items("p03", 7)
+    for _ in range(4):
+        reg.stage_add_planned(1)
+    clk[0] += 1.0
+    reg.stage_advance(1)
+    clk[0] += 1.0
+    reg.stage_advance(1)
+    snap = reg.snapshot()
+    st = snap["stages"]["p03"]
+    assert snap["current_stage"] == "p03"
+    assert st["jobs_planned"] == 4 and st["jobs_done"] == 2
+    assert st["progress"] == pytest.approx(0.5)
+    assert st["items"] == 7
+    assert st["eta_s"] == pytest.approx(2.0, rel=0.3)  # 1 job/s, 2 left
+    reg.stage_end("p03", "ok")
+    assert reg.snapshot()["stages"]["p03"]["state"] == "ok"
+    assert reg.snapshot()["current_stage"] is None
+
+
+def test_stage_span_wires_the_live_registry():
+    with tm.stage_span("pZZ"):
+        assert HEARTBEATS.snapshot()["current_stage"] == "pZZ"
+        tm.stage_items("pZZ", 5)
+    snap = HEARTBEATS.snapshot()
+    assert snap["stages"]["pZZ"]["state"] == "ok"
+    assert snap["stages"]["pZZ"]["items"] == 5
+
+
+def test_jobrunner_feeds_stage_progress(tmp_path):
+    from processing_chain_tpu.engine.jobs import Job, JobRunner
+
+    with tm.stage_span("pQQ"):
+        runner = JobRunner(name="pQQ", parallelism=2)
+        for i in range(3):
+            out = tmp_path / f"o{i}.avi"
+            runner.add(Job(label=f"j{i}", output_path=str(out),
+                           fn=lambda o=out: o.write_bytes(b"x")))
+        st = HEARTBEATS.snapshot()["stages"]["pQQ"]
+        assert st["jobs_planned"] == 3 and st["jobs_done"] == 0
+        runner.run()
+        st = HEARTBEATS.snapshot()["stages"]["pQQ"]
+        assert st["jobs_done"] == 3 and st["progress"] == 1.0
+
+
+# ---------------------------------------------------------------- watchdog
+
+
+def test_watchdog_soft_flags_stall_with_stack_dump(clocked):
+    reg, clk = clocked
+    hb = reg.register("stuck", kind="task")
+    dog = wd_mod.Watchdog(soft_s=300, registry=reg)
+    clk[0] = 200.0
+    assert dog.scan() == []  # young: quiet
+    clk[0] = 400.0
+    (incident,) = dog.scan()
+    assert incident["incident"] == "stalled" and incident["task"] == "stuck"
+    assert dog.scan() == []  # flagged once per episode, not per poll
+    (ev,) = [r for r in tm.EVENTS.records() if r["event"] == "task_stalled"]
+    assert ev["task"] == "stuck" and ev["beat_age_s"] >= 300
+    # the all-thread stack dump is the forensics payload
+    assert "thread" in ev["stacks"] and "test_live_obs" in ev["stacks"]
+    # a beat re-arms the episode and records the recovery
+    hb.beat()
+    assert [r for r in tm.EVENTS.records() if r["event"] == "task_recovered"]
+    clk[0] = 800.0
+    (again,) = dog.scan()
+    assert again["incident"] == "stalled"
+
+
+def test_watchdog_hard_timeout_kills_with_forensics(clocked):
+    reg, clk = clocked
+    hb = reg.register("wedged", kind="prefetch")
+    dog = wd_mod.Watchdog(soft_s=10, hard_s=100, registry=reg)
+    clk[0] = 150.0
+    (incident,) = dog.scan()
+    assert incident["incident"] == "hard_timeout"
+    (ev,) = [r for r in tm.EVENTS.records() if r["event"] == "task_hard_timeout"]
+    assert ev["task"] == "wedged" and "stacks" in ev
+    # marked failed: out of the live set, cancelled for cooperative loops
+    assert reg.live() == [] and hb.cancelled
+    assert reg.snapshot()["recent"][0]["status"] == "timeout"
+    with pytest.raises(TaskCancelled):
+        hb.check_cancelled()
+    assert dog.scan() == []  # not reported twice
+
+
+def test_watchdog_hard_timeout_on_uncancellable_work(clocked):
+    """Execution wrappers (job/task/device_step) wrap work Python cannot
+    kill: the hard timeout records forensics + cancelled but leaves the
+    heartbeat live, and a later genuine completion keeps its REAL
+    outcome instead of a false 'timeout' verdict."""
+    reg, clk = clocked
+    hb = reg.register("long-encode", kind="job")
+    dog = wd_mod.Watchdog(soft_s=10, hard_s=100, registry=reg)
+    clk[0] = 150.0
+    (incident,) = dog.scan()
+    assert incident["incident"] == "hard_timeout"
+    (ev,) = [r for r in tm.EVENTS.records() if r["event"] == "task_hard_timeout"]
+    assert ev["task"] == "long-encode" and "stacks" in ev
+    assert hb.cancelled
+    assert [h.label for h in reg.live()] == ["long-encode"]  # still live
+    assert dog.scan() == []  # forensics recorded once, not per poll
+    hb.finish("ok")  # the encode completed after all
+    assert reg.snapshot()["recent"][0]["status"] == "ok"
+
+
+def test_watchdog_ignores_stage_heartbeats(clocked):
+    reg, clk = clocked
+    reg.stage_begin("p01")
+    clk[0] = 1e6
+    assert wd_mod.Watchdog(soft_s=1, registry=reg).scan() == []
+
+
+def test_watchdog_thread_start_stop():
+    dog = wd_mod.Watchdog(soft_s=1000, poll_s=0.05).start()
+    assert dog.start() is dog  # idempotent
+    time.sleep(0.12)  # at least one scan tick
+    dog.stop()
+    assert dog._thread is None
+
+
+def test_prefetch_put_cancellation_surfaces_at_consumer():
+    """A watchdog hard cancel of a prefetch worker blocked on a full
+    queue must abort the item put and surface TaskCancelled at the
+    consumer's next pulls — the sentinel still arrives (it is
+    interruptible by close() only), so the consumer can never hang
+    waiting for a vanished worker."""
+    from processing_chain_tpu.engine.prefetch import Prefetcher
+
+    def chunks():
+        for i in range(100):
+            yield i
+
+    p = Prefetcher(chunks(), depth=1)
+    deadline = time.monotonic() + 5.0
+    hb = None
+    while hb is None and time.monotonic() < deadline:
+        live = [h for h in HEARTBEATS.live() if h.kind == "prefetch"]
+        hb = live[0] if live else None
+    assert hb is not None, "prefetch worker never registered"
+    hb.cancelled = True  # what the watchdog's hard path does
+    consumed = []
+    with pytest.raises(TaskCancelled):
+        for item in p:
+            consumed.append(item)
+    assert len(consumed) < 100  # the stream was cut short, not completed
+    p._thread.join(timeout=5.0)
+    assert not p._thread.is_alive()
+    p.close()
+
+
+# -------------------------------------------------------------- live server
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_live_server_endpoints():
+    tm.counter("t_live_total", "live smoke").inc(3)
+    hb = HEARTBEATS.register("serve-me", kind="task", planned=2)
+    hb.beat(advance=1)
+    with live_mod.LiveServer(0) as srv:  # port 0: ephemeral, never collides
+        assert srv.port > 0
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200 and "t_live_total 3" in body
+        code, body = _get(srv.url + "/status")
+        status = json.loads(body)
+        assert code == 200 and status["schema"] == 1
+        (task,) = status["tasks"]
+        assert task["label"] == "serve-me" and task["units_done"] == 1
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.url + "/nope")
+        assert err.value.code == 404
+    hb.finish("ok")
+
+
+def test_status_file_atomic_rewrite(tmp_path):
+    path = str(tmp_path / "status.json")
+    HEARTBEATS.register("file-me", kind="task")
+    live_mod.write_status_file(path)
+    first = json.loads(open(path).read())
+    assert first["tasks"][0]["label"] == "file-me"
+    # rewrite goes through tmp + os.replace: no tmp residue, no torn file
+    live_mod.write_status_file(path)
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+    again = json.loads(open(path).read())
+    assert again["generated_at"] >= first["generated_at"]
+
+
+def test_status_file_writer_updates_and_final_snapshot(tmp_path):
+    path = str(tmp_path / "status.json")
+    writer = live_mod.StatusFileWriter(path, interval_s=0.25).start()
+    assert os.path.isfile(path)  # visible immediately, not at t+interval
+    hb = HEARTBEATS.register("late-task", kind="task")
+    writer.stop()  # final snapshot captures state at stop time
+    doc = json.loads(open(path).read())
+    assert [t["label"] for t in doc["tasks"]] == ["late-task"]
+    hb.finish("ok")
+
+
+# ---------------------------------------------------------------- chain-top
+
+
+def _toy_status():
+    return {
+        "schema": 1, "pid": 42, "uptime_s": 125.0,
+        "run": {"name": "processAll", "argv": ["-c", "db.yaml"]},
+        "current_stage": "p03",
+        "stages": {
+            "p01": {"state": "ok", "jobs_done": 8, "jobs_planned": 8,
+                    "progress": 1.0, "wall_s": 60.0},
+            "p03": {"state": "running", "jobs_done": 3, "jobs_planned": 12,
+                    "progress": 0.25, "eta_s": 540.0, "wall_s": 180.0},
+        },
+        "tasks": [
+            {"label": "avpvs P2SXC01_SRC000_HRC001", "kind": "job",
+             "age_s": 42.0, "beat_age_s": 1.0, "units_done": 0},
+            {"label": "decode-prefetch", "kind": "prefetch", "age_s": 42.0,
+             "beat_age_s": 400.0, "units_done": 120, "stalled": True},
+        ],
+        "recent": [{"label": "bad-job", "kind": "job", "status": "fail",
+                    "age_s": 1.0, "beat_age_s": 1.0}],
+        "counters": {"frames_decoded": 4800, "frames_encoded": 2400,
+                     "bytes_encoded": 1.5e9},
+    }
+
+
+def test_chain_top_render_shows_progress_and_stalls():
+    out = chain_top_render(_toy_status())
+    assert "p03" in out and "eta 9.0m" in out and "25.0%" in out
+    assert ">p03" in out  # current-stage marker
+    assert "avpvs P2SXC01_SRC000_HRC001" in out
+    assert "STALLED" in out
+    assert "decoded 4800 frames" in out
+    assert "recent failures" in out and "bad-job" in out
+
+
+def test_chain_top_once_from_status_file(tmp_path, capsys):
+    from processing_chain_tpu.tools import chain_top
+
+    path = tmp_path / "status.json"
+    path.write_text(json.dumps(_toy_status()))
+    assert chain_top.main([str(path), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "chain-top" in out and "p03" in out
+
+
+def test_chain_top_once_from_live_server(capsys):
+    from processing_chain_tpu.tools import chain_top
+
+    with live_mod.LiveServer(0) as srv:
+        assert chain_top.main([srv.url, "--once"]) == 0
+    assert "stages" in capsys.readouterr().out
+
+
+def test_chain_top_unreachable_source_raises(tmp_path):
+    from processing_chain_tpu.tools import chain_top
+
+    with pytest.raises(chain_top.StatusSourceError):
+        chain_top.fetch_status(str(tmp_path / "absent.json"))
+    with pytest.raises(chain_top.StatusSourceError):
+        chain_top.fetch_status("http://127.0.0.1:9/")  # discard port
+
+
+def chain_top_render(status):
+    from processing_chain_tpu.tools import chain_top
+
+    return chain_top.render(status)
+
+
+# --------------------------------------------------------------- satellites
+
+
+def test_shell_timeout_kills_and_reports():
+    from processing_chain_tpu.utils.runner import ChainError, shell
+
+    t0 = time.monotonic()
+    with pytest.raises(ChainError, match="timed out after"):
+        shell(["python", "-c", "import time; time.sleep(30)"], timeout=0.5)
+    assert time.monotonic() - t0 < 10  # the child was killed, not waited out
+
+
+def test_shell_failure_carries_stderr_tail():
+    from processing_chain_tpu.utils.runner import ChainError, shell
+
+    with pytest.raises(ChainError, match="exit 3.*the-diagnosis"):
+        shell(["python", "-c",
+               "import sys; sys.stderr.write('the-diagnosis\\n'); sys.exit(3)"])
+    # check=False keeps the CompletedProcess contract
+    result = shell(["python", "-c", "import sys; sys.exit(3)"], check=False)
+    assert result.returncode == 3
+
+
+def test_barrier_emits_missing_peers_and_names_them(monkeypatch, tmp_path):
+    from processing_chain_tpu.parallel import distributed as dist
+
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    monkeypatch.setenv("PC_RUN_ID", "obs1")
+    with pytest.raises(TimeoutError, match=r"missing.*host1"):
+        dist.fs_barrier("p02", str(tmp_path), timeout_s=0.4, poll_s=0.02,
+                        report_every_s=0.1)
+    waits = [r for r in tm.EVENTS.records() if r["event"] == "barrier_wait"]
+    assert waits and waits[0]["missing"] == [".barrier_obs1_p02.host1"]
+    assert waits[0]["stage"] == "p02" and waits[0]["host"] == 0
+
+
+def test_barrier_beat_age_grows_while_peers_missing(monkeypatch, tmp_path):
+    """The barrier must NOT refresh its beat on every poll — only on
+    arrivals — or the watchdog could never see a barrier stuck on a
+    dead host (beat age would reset each poll_s)."""
+    from processing_chain_tpu.parallel import distributed as dist
+
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    monkeypatch.setenv("PC_RUN_ID", "obs3")
+    ages = []
+
+    def waiter():
+        try:
+            dist.fs_barrier("p04", str(tmp_path), timeout_s=1.2, poll_s=0.02)
+        except TimeoutError:
+            pass
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        live = [h for h in HEARTBEATS.live() if h.kind == "barrier"]
+        if live:
+            ages.append(time.monotonic() - live[0].t_beat)
+            if ages[-1] > 0.5:
+                break
+        time.sleep(0.05)
+    t.join(timeout=5.0)
+    # dozens of 0.02s polls happened, yet the beat age kept growing well
+    # past poll_s: the watchdog would have seen this barrier
+    assert ages and max(ages) > 0.5
+
+
+def test_barrier_watchdog_cancellation_aborts_wait(monkeypatch, tmp_path):
+    from processing_chain_tpu.parallel import distributed as dist
+
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    monkeypatch.setenv("PC_RUN_ID", "obs2")
+    errs = []
+
+    def waiter():
+        try:
+            dist.fs_barrier("p03", str(tmp_path), timeout_s=60, poll_s=0.02)
+        except TimeoutError as exc:
+            errs.append(exc)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    hb = None
+    while hb is None and time.monotonic() < deadline:
+        live = [h for h in HEARTBEATS.live() if h.kind == "barrier"]
+        hb = live[0] if live else None
+    assert hb is not None, "barrier never registered a heartbeat"
+    hb.cancelled = True  # the watchdog hard path
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    (err,) = errs
+    assert "watchdog hard timeout" in str(err) and "host1" in str(err)
+
+
+def test_event_stream_persists_before_crash(tmp_path):
+    path = str(tmp_path / "events_live-1-1.jsonl")
+    tm.EVENTS.open_stream(path)
+    tm.emit("run_start", name="p01", argv=[])
+    tm.emit("job_start", job="j1", output="o.avi")
+    # no close, no write_jsonl: simulate a SIGKILL — records must already
+    # be on disk
+    records = tm.read_jsonl(path)
+    kinds = [r["event"] for r in records]
+    assert kinds == ["log_meta", "run_start", "job_start"]
+    assert records[0]["streaming"] is True
+    tm.EVENTS.close_stream()
+
+
+def test_event_stream_outlives_the_memory_cap(tmp_path):
+    """The disk stream is forensics for long runs: it must keep
+    recording after the in-memory log overflows (the tail of the run —
+    watchdog stalls, the crash — is exactly what matters)."""
+    from processing_chain_tpu.telemetry.events import EventLog
+
+    log = EventLog(max_events=2)
+    log.enabled = True
+    path = str(tmp_path / "events_cap-1-1.jsonl")
+    log.open_stream(path)
+    for i in range(5):
+        log.emit("tick", i=i)
+    log.emit("task_stalled", task="late", stacks="...")
+    assert len(log.records()) == 2 and log.drops == 4
+    streamed = tm.read_jsonl(path)
+    assert [r.get("i") for r in streamed if r["event"] == "tick"] == list(range(5))
+    assert streamed[-1]["event"] == "task_stalled"
+    log.close_stream()
+
+
+def test_run_report_partial_run(tmp_path, capsys):
+    stamp = "part-1-1"
+    tm.EVENTS.open_stream(str(tmp_path / f"events_{stamp}.jsonl"))
+    tm.emit("run_start", name="p03", argv=["-c", "db.yaml"])
+    tm.emit("stage_start", stage="p03")
+    tm.emit("job_start", job="avpvs X", output="x.avi")
+    tm.emit("job_start", job="avpvs Y", output="y.avi")
+    tm.emit("job_end", job="avpvs Y", status="ok", duration_s=1.0)
+    tm.emit("task_stalled", task="avpvs X", kind="job", beat_age_s=400.0,
+            soft_s=300.0, stacks="--- thread MainThread ---")
+    tm.EVENTS.close_stream()
+    run = report_mod.load_run(str(tmp_path))
+    assert run.partial and run.stamp == stamp
+    assert report_mod.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "RUN DID NOT COMPLETE" in out
+    assert "avpvs X" in out and "never finished" in out
+    assert "avpvs Y" not in out.split("never finished")[1].split("watchdog")[0]
+    assert "task_stalled" in out
+    assert "started at" in out  # stage p03 started and never ended
+
+
+def test_run_report_complete_run_still_wins(tmp_path, capsys):
+    """A stamp with BOTH artifacts renders the normal full report."""
+    tm.emit("run_start", name="p01", argv=[])
+    tm.emit("run_end", status="ok", duration_s=1.0)
+    tm.write_outputs(str(tmp_path))
+    run = report_mod.load_run(str(tmp_path))
+    assert not run.partial
+    assert report_mod.main([str(tmp_path)]) == 0
+    assert "DID NOT COMPLETE" not in capsys.readouterr().out
+
+
+# ------------------------------------------------------------ CLI lifecycle
+
+
+def test_cli_flags_parse():
+    from processing_chain_tpu.utils.parse_args import parse_args
+
+    args = parse_args("p01", 1, [
+        "-c", "db.yaml", "--live-port", "0", "--status-file", "/tmp/s.json",
+        "--watchdog-soft", "60", "--watchdog-hard", "600",
+    ])
+    assert args.live_port == 0
+    assert args.status_file == "/tmp/s.json"
+    assert args.watchdog_soft == 60.0 and args.watchdog_hard == 600.0
+
+
+def test_cli_live_lifecycle(monkeypatch, tmp_path, chain_log):
+    """The CLI brings the whole live surface up for the run and tears it
+    down after: mid-stage the endpoint answers (ephemeral --live-port 0,
+    discovered from the log line), the status file carries the run meta,
+    and the final snapshot reflects the run's end."""
+    import re
+
+    from processing_chain_tpu import cli as cli_mod
+    from processing_chain_tpu.stages import p01_generate_segments
+
+    seen = {}
+
+    def fake_stage(args, test_config=None):
+        (line,) = [
+            r.getMessage() for r in chain_log.records
+            if "live status" in r.getMessage()
+        ]
+        url = re.search(r"(http://[^/]+)", line).group(1)
+        seen["health"] = json.loads(
+            urllib.request.urlopen(url + "/healthz", timeout=5).read()
+        )
+        seen["status"] = json.loads(
+            urllib.request.urlopen(url + "/status", timeout=5).read()
+        )
+        return None
+
+    monkeypatch.setattr(p01_generate_segments, "run", fake_stage)
+    status_file = tmp_path / "status.json"
+    rc = cli_mod.main([
+        "p01", "-c", str(tmp_path / "db.yaml"), "--skip-requirements",
+        "--live-port", "0", "--status-file", str(status_file),
+        "--watchdog-soft", "60",
+    ])
+    assert rc == 0
+    assert seen["health"]["status"] == "ok"
+    assert seen["status"]["run"] == {"name": "p01", "argv": [
+        "-c", str(tmp_path / "db.yaml"), "--skip-requirements",
+        "--live-port", "0", "--status-file", str(status_file),
+        "--watchdog-soft", "60",
+    ]}
+    final = json.loads(status_file.read_text())  # stop() wrote a last snapshot
+    assert final["run"]["name"] == "p01" and final["tasks"] == []

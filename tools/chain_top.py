@@ -1,0 +1,21 @@
+"""Refreshing terminal view of a live chain run, from a checkout.
+
+    python tools/chain_top.py http://host:8080 [-i SECONDS] [--once]
+    python tools/chain_top.py /path/status.json --once
+
+All logic lives in processing_chain_tpu.tools.chain_top (also exposed
+as `tools chain-top` through the package CLI); see docs/TELEMETRY.md
+"Live monitoring".
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from processing_chain_tpu.tools.chain_top import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())
