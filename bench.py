@@ -949,6 +949,93 @@ def _last_json(text: str) -> dict | None:
     return None
 
 
+def host_bench() -> dict:
+    """Host frame-path microbench (`bench.py --host-bench`): batched
+    chunk-granular native I/O vs the per-frame fallback on the SAME
+    synthetic FFV1 clip — decode fps, encode fps, byte parity, and the
+    buffer-pool hit rate. This is the tracked metric for the e2e gap
+    (BENCH_r05: kernel 107x baseline, e2e 0.08x — the difference lives
+    entirely in this path). CI runs it as a correctness gate (parity +
+    nonzero pool recycling), not a timing gate."""
+    import tempfile
+
+    from processing_chain_tpu.io import bufpool
+    from processing_chain_tpu.io.video import VideoReader, VideoWriter
+
+    # the microbench's job is to COMPARE the two paths: an inherited
+    # PC_HOST_BATCH=0 would silently turn the "batched" legs into
+    # re-measurements of the per-frame path (and zero the pool hit rate)
+    os.environ["PC_HOST_BATCH"] = "1"
+    n = int(os.environ.get("PC_HOST_BENCH_FRAMES", "96"))
+    w, h = 640, 360
+    chunk = 32
+    rng = np.random.default_rng(0)
+    # moving gradient + grain rows (same rationale as the e2e SRC: pure
+    # noise over-costs FFV1, flat frames under-cost it)
+    xx = np.arange(w, dtype=np.float32)[None, :]
+    yy = np.arange(h, dtype=np.float32)[:, None]
+    frames = []
+    for i in range(n):
+        y = ((np.sin((xx + 5 * i) / 23.0) + np.cos((yy - 2 * i) / 17.0))
+             * 52 + 120).astype(np.uint8)
+        y[::5] += rng.integers(0, 11, (1, w), np.uint8)
+        u = np.full((h // 2, w // 2), 120, np.uint8)
+        v = ((y[::2, ::2] >> 2) + 90).astype(np.uint8)
+        frames.append((y, u, v))
+    stacked = [np.stack([f[p] for f in frames]) for p in range(3)]
+    out: dict = {"metric": "host frame path (batched vs per-frame I/O)",
+                 "frames": n, "chunk": chunk}
+
+    with tempfile.TemporaryDirectory(prefix="pc_host_bench_") as root:
+        def writer(path):
+            return VideoWriter(path, "ffv1", w, h, "yuv420p", (24, 1),
+                               threads=1,
+                               opts="level=3:coder=1:context=1:slicecrc=1")
+
+        # encode: per-frame vs one batched crossing per chunk
+        p_ser = os.path.join(root, "ser.avi")
+        t0 = time.perf_counter()
+        with writer(p_ser) as wr:
+            for y, u, v in frames:
+                wr.write(y, u, v)
+        out["encode_fps"] = round(n / (time.perf_counter() - t0), 2)
+        p_bat = os.path.join(root, "bat.avi")
+        t0 = time.perf_counter()
+        with writer(p_bat) as wr:
+            for k in range(0, n, chunk):
+                wr.write_batch(*(s[k: k + chunk] for s in stacked))
+        out["encode_batch_fps"] = round(n / (time.perf_counter() - t0), 2)
+        with open(p_ser, "rb") as f1, open(p_bat, "rb") as f2:
+            out["encode_parity"] = f1.read() == f2.read()
+
+        # decode: per-frame fallback vs pooled batch chunks
+        t0 = time.perf_counter()
+        with VideoReader(p_ser) as r:
+            ref = [
+                [pl.copy() for pl in ch]
+                for ch in r._iter_chunks_per_frame(chunk)
+            ]
+        out["decode_fps"] = round(n / (time.perf_counter() - t0), 2)
+        pool = bufpool.BufferPool()
+        t0 = time.perf_counter()
+        with VideoReader(p_ser) as r:
+            got = []
+            for ch in r.iter_chunks(chunk, pool=pool):
+                got.append([pl.copy() for pl in ch])
+                pool.release(*ch)
+        out["decode_batch_fps"] = round(n / (time.perf_counter() - t0), 2)
+        out["decode_parity"] = len(got) == len(ref) and all(
+            np.array_equal(a, b)
+            for ca, cb in zip(got, ref) for a, b in zip(ca, cb)
+        )
+        stats = pool.stats()
+        out["pool_hits"] = stats["hits"]
+        out["pool_misses"] = stats["misses"]
+        out["pool_hit_rate"] = round(stats["hit_rate"], 3)
+    out["host"] = _host_fingerprint()
+    return out
+
+
 def main() -> None:
     cpu_env = {"JAX_PLATFORMS": "cpu"}
 
@@ -1171,6 +1258,8 @@ if __name__ == "__main__":
         if _errors:
             _out["e2e_errors"] = " | ".join(_errors)[-400:]
         print(json.dumps(_out))
+    elif "--host-bench" in sys.argv:
+        print(json.dumps(host_bench()))
     elif "--pin-baseline" in sys.argv:
         print(json.dumps(pin_baseline(), indent=1))
     else:

@@ -28,6 +28,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 import numpy as np
 
 from .. import telemetry as tm
+from ..io import bufpool
 from ..telemetry.heartbeat import HEARTBEATS, NULL_HEARTBEAT, TaskCancelled
 
 _SENTINEL = object()
@@ -196,11 +197,22 @@ class AsyncWriter:
     """Background writeback onto a `VideoWriter`: `put` enqueues a chunk of
     stacked planes ([T, H, W] per plane, host arrays or device arrays —
     device arrays are fetched on the writer thread so the main loop never
-    blocks on a transfer); the worker writes frame-by-frame. `close()`
-    drains the queue, closes the writer, and re-raises any worker error."""
+    blocks on a transfer); the worker hands whole chunks to the writer's
+    batched encode (one native crossing) when it has one, else writes
+    frame-by-frame. `close()` drains the queue, closes the writer, and
+    re-raises any worker error.
 
-    def __init__(self, writer, depth: int = 4) -> None:
+    `put(..., recycle=blocks)` returns the given pooled host blocks to
+    `pool` (default: the shared bufpool.DEFAULT_POOL — pass the same pool
+    the blocks were acquired from, or the release is a no-op) AFTER the
+    chunk is encoded — the fetch of the device outputs forces completion
+    of the computation that consumed those blocks, so this is the
+    earliest point reuse is provably safe (a device_put may alias host
+    memory on the CPU backend)."""
+
+    def __init__(self, writer, depth: int = 4, pool=None) -> None:
         self._writer = writer
+        self._pool = pool or bufpool.DEFAULT_POOL
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._err: Optional[BaseException] = None
 
@@ -211,6 +223,13 @@ class AsyncWriter:
             # which. A hard timeout turns further work into a drain.
             hb = HEARTBEATS.register("encode-writeback", kind="writeback")
             status = "ok"
+            # PC_HOST_BATCH=0 must bypass the batched encode too — the
+            # switch is the whole-path kill switch AND the per-frame
+            # parity baseline the chain-level tests diff against
+            write_batch = (
+                getattr(self._writer, "write_batch", None)
+                if bufpool.host_batch_enabled() else None
+            )
             while True:
                 try:
                     item = self._q.get(timeout=0.5)
@@ -225,12 +244,25 @@ class AsyncWriter:
                 if item is _SENTINEL:
                     hb.finish(status)
                     return
+                chunk, recycle = item
                 if self._err is not None:
-                    continue  # drain without writing after a failure
+                    # drain without writing after a failure; recycle
+                    # blocks are DROPPED, not released — their consuming
+                    # computation was never synced, so handing them out
+                    # again could alias in-flight device reads (the run
+                    # is aborting; weakref bookkeeping reclaims them)
+                    continue
                 try:
-                    planes = [np.asarray(p) for p in item]
-                    for i in range(planes[0].shape[0]):
-                        self._writer.write(*(p[i] for p in planes))
+                    planes = [np.asarray(p) for p in chunk]
+                    if write_batch is not None:
+                        write_batch(*planes)
+                    else:
+                        for i in range(planes[0].shape[0]):
+                            self._writer.write(*(p[i] for p in planes))
+                    # outputs are on the host now, so any computation that
+                    # read the recycled input blocks has completed
+                    if recycle:
+                        self._pool.release(*recycle)
                     hb.beat(advance=1)
                     if tm.enabled():
                         _FRAMES_ENCODED.inc(planes[0].shape[0])
@@ -243,16 +275,17 @@ class AsyncWriter:
         self._thread.start()
         self._depth_sampler = _DepthSampler(_Q_ENCODE, "encode")
 
-    def put(self, planes_chunk) -> None:
+    def put(self, planes_chunk, recycle=None) -> None:
         if self._err is not None:
             self._finish()
+        item = (list(planes_chunk), list(recycle) if recycle else None)
         if tm.enabled():
             self._depth_sampler.sample(self._q.qsize())
             t0 = time.perf_counter()
-            self._q.put(list(planes_chunk))
+            self._q.put(item)
             _WAIT_PRODUCER.inc(time.perf_counter() - t0)
         else:
-            self._q.put(list(planes_chunk))
+            self._q.put(item)
 
     def write_audio(self, samples: np.ndarray) -> None:
         """Audio goes straight through (written once, before video)."""
@@ -400,25 +433,29 @@ class MultiSegmentPrefetcher:
         self.close()
 
 
-def iter_plane_chunks(reader, chunk: int = 64) -> Iterator[list[np.ndarray]]:
+def iter_plane_chunks(
+    reader, chunk: int = 64, pool=None,
+) -> Iterator[list[np.ndarray]]:
     """Stream a `VideoReader` as per-plane [T, H, W] stacks of up to
-    `chunk` frames, without materializing the whole clip."""
-    buf: list = []
-    for frame in reader:
-        buf.append(frame)
-        if len(buf) == chunk:
-            _FRAMES_DECODED.inc(chunk)
-            yield [
-                np.stack([f.planes[p] for f in buf])
-                for p in range(len(buf[0].planes))
-            ]
-            buf = []
-    if buf:
-        _FRAMES_DECODED.inc(len(buf))
-        yield [
-            np.stack([f.planes[p] for f in buf])
-            for p in range(len(buf[0].planes))
-        ]
+    `chunk` frames, without materializing the whole clip.
+
+    Batch-capable readers (VideoReader.iter_chunks) decode each chunk
+    through ONE native crossing into pooled blocks — the chunks arrive
+    already stacked, so the per-frame allocation + np.stack copy of the
+    fallback path never happens. Consumers hand pooled chunks back via
+    `AsyncWriter.put(..., recycle=chunk)` or `bufpool` release; a chunk
+    that is never released costs one allocation, not correctness.
+    Any other iterable of Frames takes the per-frame fallback."""
+    it = getattr(reader, "iter_chunks", None)
+    if it is not None and bufpool.host_batch_enabled():
+        chunks = it(chunk, pool=pool)
+    else:
+        from ..io.video import iter_stacked_frame_chunks
+
+        chunks = iter_stacked_frame_chunks(reader, chunk)
+    for planes in chunks:
+        _FRAMES_DECODED.inc(planes[0].shape[0])
+        yield planes
 
 
 def stream_monotonic_gather(

@@ -1,11 +1,14 @@
-from . import framesizes, medialib, probe
+from . import bufpool, framesizes, medialib, probe
+from .bufpool import BufferPool
 from .medialib import MediaError
 from .video import Frame, VideoReader, VideoWriter
 
 __all__ = [
+    "bufpool",
     "framesizes",
     "medialib",
     "probe",
+    "BufferPool",
     "MediaError",
     "Frame",
     "VideoReader",

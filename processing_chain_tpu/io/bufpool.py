@@ -1,0 +1,152 @@
+"""Recycling pool of pre-allocated host plane blocks.
+
+The host frame path moves [T, H, W] chunk blocks between the native
+decoder, the device, and the native encoder (BENCH_r05: the e2e chain is
+host-bound, not device-bound). Allocating those blocks fresh per chunk
+costs an mmap + page-fault sweep per ~100 MB block on the hot path; this
+pool recycles them: `acquire` hands back a previously-released block of
+the same (shape, dtype) when one is free, else allocates.
+
+Ownership protocol (deliberately simple):
+
+  * `acquire(shape, dtype)` transfers ownership to the caller.
+  * `release(*arrays)` returns ownership; ONLY the exact array object
+    returned by `acquire` recycles (views are ignored), so a producer
+    that hands a consumer a trimmed tail view `block[:n]` never has the
+    backing block yanked while other views of it are still alive.
+  * Releasing a foreign or already-released array is a safe no-op —
+    consumers may call `release` on mixed pooled/unpooled chunks.
+  * Dropping a pooled block without releasing it is a leak of one
+    allocation, not of pool bookkeeping: outstanding blocks are tracked
+    by weakref, so the entry vanishes with the array.
+
+Thread-safe; the default pool is shared by the decode prefetch threads,
+the main device loop, and the encode writeback thread.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+
+import numpy as np
+
+from .. import telemetry as tm
+
+_HITS = tm.counter(
+    "chain_bufpool_hits_total", "pool acquisitions served from a recycled block"
+)
+_MISSES = tm.counter(
+    "chain_bufpool_misses_total", "pool acquisitions that had to allocate"
+)
+_RECYCLED_BYTES = tm.counter(
+    "chain_bufpool_recycled_bytes_total",
+    "bytes served from recycled blocks instead of fresh allocations",
+)
+
+
+def host_batch_enabled() -> bool:
+    """Master switch for the batched host frame path (chunked native I/O +
+    buffer pooling). PC_HOST_BATCH=0 restores the per-frame fallback —
+    the parity baseline, and the escape hatch for anything the batch
+    path misbehaves on."""
+    return os.environ.get("PC_HOST_BATCH", "1").strip().lower() not in (
+        "0", "off", "false",
+    )
+
+
+class BufferPool:
+    """Keyed free lists of C-contiguous ndarrays. See module docstring
+    for the ownership protocol."""
+
+    def __init__(self, max_free_per_key: int = 4) -> None:
+        # cap per (shape, dtype): chunk blocks run ~100 MB at 1080p×64f,
+        # so an unbounded free list would quietly pin the high-water mark
+        self._max_free = max_free_per_key
+        self._lock = threading.Lock()
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self._outstanding: dict[int, weakref.ref] = {}
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(shape, dtype) -> tuple:
+        return (tuple(int(s) for s in shape), np.dtype(dtype).str)
+
+    def acquire(self, shape, dtype=np.uint8) -> np.ndarray:
+        key = self._key(shape, dtype)
+        with self._lock:
+            free = self._free.get(key)
+            arr = free.pop() if free else None
+            if arr is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+        if arr is None:
+            arr = np.empty(shape, dtype)  # allocate outside the lock
+            if tm.enabled():
+                _MISSES.inc()
+        elif tm.enabled():
+            _HITS.inc()
+            _RECYCLED_BYTES.inc(arr.nbytes)
+        self._track(arr)
+        return arr
+
+    def _track(self, arr: np.ndarray) -> None:
+        key = id(arr)
+
+        def _dropped(_ref, *, _self=weakref.ref(self), _key=key):
+            # deliberately LOCK-FREE: a GC cycle collection can fire this
+            # callback on any allocation — including ones made while this
+            # same thread already holds the pool lock (e.g. inside
+            # release()) — and the plain Lock would then deadlock the
+            # whole pipeline. dict.pop on a single key is GIL-atomic, and
+            # no other path touches this key while the weakref is live
+            # (release() holds a strong ref to the array it resolves).
+            pool = _self()
+            if pool is not None:
+                pool._outstanding.pop(_key, None)
+
+        with self._lock:
+            self._outstanding[key] = weakref.ref(arr, _dropped)
+
+    def release(self, *arrays: np.ndarray) -> None:
+        for arr in arrays:
+            if not isinstance(arr, np.ndarray):
+                continue
+            with self._lock:
+                ref = self._outstanding.get(id(arr))
+                if ref is None or ref() is not arr:
+                    continue  # foreign array, a view, or double release
+                del self._outstanding[id(arr)]
+                free = self._free.setdefault(
+                    self._key(arr.shape, arr.dtype), []
+                )
+                if len(free) < self._max_free:
+                    free.append(arr)
+
+    def owns(self, arr) -> bool:
+        """True when `arr` is exactly an outstanding block of this pool
+        (views and foreign arrays are not owned — same identity rule as
+        release). Lets producers decide whether slicing an array would
+        strand a recyclable block."""
+        if not isinstance(arr, np.ndarray):
+            return False
+        with self._lock:
+            ref = self._outstanding.get(id(arr))
+            return ref is not None and ref() is arr
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": self.hits / max(1, self.hits + self.misses),
+                "free_blocks": sum(len(v) for v in self._free.values()),
+                "outstanding": len(self._outstanding),
+            }
+
+
+#: process-wide default pool, shared by the decode/compute/encode stages
+DEFAULT_POOL = BufferPool()

@@ -204,6 +204,34 @@ def ensure_loaded() -> ct.CDLL:
         lib.mp_decoder_close.restype = None
         lib.mp_decoder_close.argtypes = [ct.c_void_p]
         try:
+            # the chunk-granular host-path symbols land together: a .so
+            # missing one is from before the batch boundary existed
+            lib.mp_decoder_open_t.restype = ct.c_void_p
+            lib.mp_decoder_open_t.argtypes = [
+                ct.c_char_p, ct.c_double, ct.c_double, ct.c_int,
+                ct.c_char_p, ct.c_int,
+            ]
+            lib.mp_decoder_next_batch.restype = ct.c_long
+            lib.mp_decoder_next_batch.argtypes = [
+                ct.c_void_p, u8p, u8p, u8p, u8p, ct.c_long,
+                ct.POINTER(ct.c_double), ct.c_char_p, ct.c_int,
+            ]
+            lib.mp_encoder_write_video_batch.restype = ct.c_long
+            lib.mp_encoder_write_video_batch.argtypes = [
+                ct.c_void_p, u8p, u8p, u8p, u8p, ct.c_long,
+                ct.c_char_p, ct.c_int,
+            ]
+            lib.mp_sws_scale_frames.restype = ct.c_int
+            lib.mp_sws_scale_frames.argtypes = [
+                u8p, ct.c_int, ct.c_int, u8p, ct.c_int, ct.c_int,
+                ct.c_long, ct.c_int, ct.c_char_p, ct.c_int,
+            ]
+        except AttributeError as exc:
+            raise MediaError(
+                f"libpcmedia.so predates the batched frame I/O boundary; "
+                f"rebuild with `make -B -C {_NATIVE_DIR}`"
+            ) from exc
+        try:
             lib.mp_decode_audio_s16_ch.restype = ct.c_long
             lib.mp_decode_audio_s16_ch.argtypes = [
                 ct.c_char_p, ct.c_double, ct.c_double, ct.c_int, i16p,
@@ -403,6 +431,25 @@ def sws_scale_plane(
     if ret < 0:
         raise MediaError(f"sws_scale_plane: {err.value.decode()}")
     return dst
+
+
+def sws_scale_frames(
+    src: np.ndarray, dw: int, dh: int, flags: int = SWS_LANCZOS,
+) -> np.ndarray:
+    """Scale a [N, H, W] uint8 plane stack in ONE native call through one
+    shared SwsContext (filter tables built once per chunk)."""
+    lib = ensure_loaded()
+    assert src.dtype == np.uint8 and src.ndim == 3
+    src = np.ascontiguousarray(src)
+    out = np.empty((src.shape[0], dh, dw), np.uint8)
+    err = _err_buf()
+    ret = lib.mp_sws_scale_frames(
+        _np_u8p(src), src.shape[2], src.shape[1], _np_u8p(out), dw, dh,
+        src.shape[0], flags, err, 512,
+    )
+    if ret < 0:
+        raise MediaError(f"sws_scale_frames: {err.value.decode()}")
+    return out
 
 
 def sws_scale_yuv(

@@ -13,8 +13,17 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from . import medialib
+from .. import telemetry as tm
+from . import bufpool, medialib
 from .medialib import MediaError, MPVideoDesc
+
+_IO_BATCH = tm.counter(
+    "chain_io_batch_calls_total",
+    "chunk-granular native I/O crossings (one GIL release per chunk)",
+    ("op",),
+)
+_IO_BATCH_DECODE = _IO_BATCH.labels(op="decode")
+_IO_BATCH_ENCODE = _IO_BATCH.labels(op="encode")
 
 
 @dataclass
@@ -41,6 +50,30 @@ class Frame:
         return self.planes[2] if len(self.planes) > 2 else None
 
 
+def iter_stacked_frame_chunks(
+    frames, chunk: int,
+) -> Iterator[list[np.ndarray]]:
+    """Per-frame fallback chunker: accumulate Frames and np.stack each
+    plane into [T, H, W] blocks of up to `chunk`. The single definition
+    behind VideoReader's PC_HOST_BATCH=0 path AND engine.prefetch's
+    generic-iterable path — the parity baseline the batched decode is
+    tested against."""
+    buf: list = []
+    for frame in frames:
+        buf.append(frame)
+        if len(buf) == chunk:
+            yield [
+                np.stack([f.planes[p] for f in buf])
+                for p in range(len(buf[0].planes))
+            ]
+            buf = []
+    if buf:
+        yield [
+            np.stack([f.planes[p] for f in buf])
+            for p in range(len(buf[0].planes))
+        ]
+
+
 #: single-plane interleaved formats the chain can encounter (the PC CPVS
 #: default is uyvy422) mapped to their (y, u, v) byte offsets within each
 #: 4-byte macropixel (y repeats every 2 bytes, u/v every 4); gray etc.
@@ -57,11 +90,19 @@ class VideoReader:
     replacement for the reference's `ffmpeg -ss X -t D -i …` decode commands
     (lib/ffmpeg.py:877, :948, :1037)."""
 
-    def __init__(self, path: str, start: float = 0.0, duration: float = 0.0) -> None:
+    def __init__(self, path: str, start: float = 0.0, duration: float = 0.0,
+                 threads: int = 0) -> None:
+        """threads: decoder thread_count (0 = auto = one per core). Frame
+        threading overlaps the codec's per-frame work inside the batched
+        decode loop; pin to 1 for strictly serial decode."""
         self.path = path
+        self._start = float(start)
+        self._window = float(duration)
         lib = medialib.ensure_loaded()
         err = ct.create_string_buffer(512)
-        self._h = lib.mp_decoder_open(path.encode(), start, duration, err, 512)
+        self._h = lib.mp_decoder_open_t(
+            path.encode(), start, duration, threads, err, 512
+        )
         if not self._h:
             raise MediaError(f"open {path}: {err.value.decode()}")
         desc = MPVideoDesc()
@@ -146,6 +187,83 @@ class VideoReader:
             np.ascontiguousarray(raw[..., v_off::4]),
         )
 
+    def _deinterleave_chunk(self, raw: np.ndarray, out: list) -> None:
+        """Chunk-wise packed-422 deinterleave: one strided pass per plane
+        over the whole [N, h, 2w] block into pre-allocated planar blocks
+        (the per-frame path pays 3 allocations + 3 passes per FRAME)."""
+        y_off, u_off, v_off = self._packed_offsets
+        np.copyto(out[0], raw[..., y_off::2])
+        np.copyto(out[1], raw[..., u_off::4])
+        np.copyto(out[2], raw[..., v_off::4])
+
+    def _decode_batch_into(self, blocks: list, max_frames: int):
+        """ONE native crossing: decode up to `max_frames` frames into the
+        caller's raw-geometry plane blocks ([N, h, w] C-contiguous, one
+        per decoder plane). Returns (n_decoded, pts[n_decoded])."""
+        if not self._h:
+            raise MediaError(f"{self.path}: reader is closed")
+        lib = medialib.ensure_loaded()
+        err = ct.create_string_buffer(512)
+        u8p = ct.POINTER(ct.c_uint8)
+        for b, shape in zip(blocks, self._raw_plane_shapes):
+            assert b.flags["C_CONTIGUOUS"] and b.dtype == self.dtype
+            assert b.shape[0] >= max_frames and b.shape[1:] == shape
+        pts = np.zeros(max_frames, np.float64)
+        ptrs = [b.ctypes.data_as(u8p) for b in blocks]
+        ptrs += [None] * (4 - len(ptrs))
+        n = lib.mp_decoder_next_batch(
+            self._h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], max_frames,
+            pts.ctypes.data_as(ct.POINTER(ct.c_double)), err, 512,
+        )
+        if n < 0:
+            raise MediaError(f"decode {self.path}: {err.value.decode()}")
+        if tm.enabled():
+            _IO_BATCH_DECODE.inc()
+        return int(n), pts[: int(n)]
+
+    def iter_chunks(
+        self, chunk: int = 64, pool: Optional[bufpool.BufferPool] = None,
+    ) -> Iterator[list]:
+        """Stream the window as per-plane planar [T, H, W] stacks of up to
+        `chunk` frames, decoded chunk-at-a-time through ONE native call
+        each, into blocks from `pool`. Ownership of full blocks passes to
+        the consumer (release via `pool.release(*chunk)` when the
+        frames have been consumed — bufpool module docstring); the tail
+        chunk yields trimmed views, which release ignores."""
+        if not bufpool.host_batch_enabled():
+            yield from self._iter_chunks_per_frame(chunk)
+            return
+        pool = pool or bufpool.DEFAULT_POOL
+        packed = self._packed_offsets is not None
+        while True:
+            raw_blocks = [
+                pool.acquire((chunk,) + shape, self.dtype)
+                for shape in self._raw_plane_shapes
+            ]
+            n, _pts = self._decode_batch_into(raw_blocks, chunk)
+            if n == 0:
+                pool.release(*raw_blocks)
+                return
+            if packed:
+                planar = [
+                    pool.acquire((n,) + shape, self.dtype)
+                    for shape in self.plane_shapes
+                ]
+                self._deinterleave_chunk(raw_blocks[0][:n], planar)
+                pool.release(*raw_blocks)
+                yield planar
+            else:
+                yield raw_blocks if n == chunk else [
+                    b[:n] for b in raw_blocks
+                ]
+            if n < chunk:
+                return
+
+    def _iter_chunks_per_frame(self, chunk: int) -> Iterator[list]:
+        """Per-frame fallback (PC_HOST_BATCH=0): the parity baseline the
+        batch path is tested against."""
+        yield from iter_stacked_frame_chunks(self, chunk)
+
     def __iter__(self) -> Iterator[Frame]:
         lib = medialib.ensure_loaded()
         err = ct.create_string_buffer(512)
@@ -170,9 +288,77 @@ class VideoReader:
                 planes = self._deinterleave(planes[0])
             yield Frame(planes=planes, pts=pts.value, pix_fmt=self.pix_fmt)
 
+    def _estimated_frames(self) -> int:
+        """Best-effort frame count of the decode window (sizes read_all's
+        output stacks; wrong estimates only cost a rare grow-copy)."""
+        if self.fps <= 0:
+            return 0
+        window = self._window
+        if window <= 0:
+            window = max(0.0, self.duration - self._start)
+        return int(round(window * self.fps)) if window > 0 else 0
+
     def read_all(self) -> tuple[list[np.ndarray], list[float]]:
         """Decode every frame in the window; returns (per-plane stacked
-        [T, H, W] arrays, pts list)."""
+        [T, H, W] arrays, pts list). Streams chunk-wise native decodes
+        STRAIGHT into pre-sized output stacks — the old implementation
+        held every per-frame array AND the stacked copies simultaneously
+        (2x peak RSS for long windows)."""
+        if not bufpool.host_batch_enabled():
+            return self._read_all_per_frame()
+        est = self._estimated_frames()
+        # never trust container metadata with the whole allocation: a
+        # corrupt/overstated duration header would drive a multi-GB
+        # upfront np.empty (and a hard MemoryError under strict
+        # overcommit) for a file the per-frame path reads fine — cap the
+        # pre-size and let the grow path extend for genuinely long reads
+        cap = min(est + 2, 1024) if est > 0 else 64
+        step = 64
+        packed = self._packed_offsets is not None
+        out = [
+            np.empty((cap,) + shape, self.dtype)
+            for shape in self.plane_shapes
+        ]
+        scratch = (
+            [np.empty((step,) + self._raw_plane_shapes[0], self.dtype)]
+            if packed else None
+        )
+        total = 0
+        pts_parts: list[np.ndarray] = []
+        while True:
+            if total == cap:  # estimate fell short: grow by half
+                cap += max(step, cap // 2)
+                out = [
+                    np.concatenate([o, np.empty((cap - total,) + o.shape[1:],
+                                                self.dtype)])
+                    for o in out
+                ]
+            take = min(step, cap - total)
+            if packed:
+                n, pts = self._decode_batch_into(scratch, take)
+                if n:
+                    self._deinterleave_chunk(
+                        scratch[0][:n], [o[total: total + n] for o in out]
+                    )
+            else:
+                n, pts = self._decode_batch_into(
+                    [o[total: total + take] for o in out], take
+                )
+            if n == 0:
+                break
+            pts_parts.append(pts)
+            total += n
+            if n < take:
+                break
+        if total == 0:
+            return [], []
+        return (
+            [o[:total] for o in out],
+            list(np.concatenate(pts_parts)),
+        )
+
+    def _read_all_per_frame(self) -> tuple[list[np.ndarray], list[float]]:
+        """Per-frame fallback (PC_HOST_BATCH=0): the parity baseline."""
         frames = list(self)
         if not frames:
             return [], []
@@ -255,6 +441,36 @@ class VideoWriter:
         ptrs = [a.ctypes.data_as(u8p) for a in arrs] + [None] * (4 - len(arrs))
         if lib.mp_encoder_write_video(self._h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], err, 512) < 0:
             raise MediaError(f"encode {self.path}: {err.value.decode()}")
+
+    def write_batch(self, *planes: np.ndarray) -> None:
+        """Encode a [T, h, w] stack per plane in ONE native crossing (one
+        GIL release per chunk instead of per frame; in fp mode the whole
+        chunk streams through the worker pool without Python in the
+        loop). Byte-identical to T calls of `write` — the encoder walks
+        the same per-frame path."""
+        if not self._h:
+            raise MediaError(f"{self.path}: writer is closed")
+        lib = medialib.ensure_loaded()
+        err = ct.create_string_buffer(512)
+        u8p = ct.POINTER(ct.c_uint8)
+        arrs = [np.ascontiguousarray(p) for p in planes if p is not None]
+        if not arrs:
+            return
+        t = int(arrs[0].shape[0])
+        if any(int(a.shape[0]) != t for a in arrs):
+            raise MediaError(
+                f"{self.path}: write_batch plane stacks disagree on frame "
+                f"count: {[a.shape[0] for a in arrs]}"
+            )
+        if t == 0:
+            return
+        ptrs = [a.ctypes.data_as(u8p) for a in arrs] + [None] * (4 - len(arrs))
+        if lib.mp_encoder_write_video_batch(
+            self._h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], t, err, 512,
+        ) < 0:
+            raise MediaError(f"encode {self.path}: {err.value.decode()}")
+        if tm.enabled():
+            _IO_BATCH_ENCODE.inc()
 
     def write_audio(self, samples: np.ndarray) -> None:
         """samples: int16 [n, channels] interleaved."""

@@ -44,7 +44,36 @@ from ..utils import fsio
 from ..utils.log import get_logger
 from . import frames as fr
 
-CHUNK = 64  # frames per device batch
+CHUNK = 64  # frames per device batch (accelerator default; see chunk_frames)
+
+
+def _env_int(name: str) -> Optional[int]:
+    """Integer env knob, loudly rejected on a typo (a silently-ignored
+    value would erase the advertised behavior with no signal); None when
+    unset/empty."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer") from None
+
+
+def chunk_frames() -> int:
+    """Effective frames per pipeline chunk. PC_CHUNK_FRAMES pins it;
+    default CHUNK (64) on accelerator backends (launch efficiency and
+    transfer amortization dominate), 16 on the CPU backend — there the
+    decode → compute → encode pipeline only overlaps at chunk
+    granularity, and a short clip in one 64-frame chunk serializes the
+    whole run (the BENCH_r05 e2e shape: 24 frames = 1 chunk = zero
+    overlap), while per-chunk dispatch costs ~nothing on host."""
+    pinned = _env_int("PC_CHUNK_FRAMES")
+    if pinned is not None:
+        return max(1, pinned)
+    import jax
+
+    return CHUNK if jax.default_backend() != "cpu" else 16
 
 
 def _decode_workers() -> int:
@@ -148,11 +177,66 @@ def set_default_fp_workers(pool_width: int) -> None:
     oversubscribe the host, so the spare cores are divided across the
     pool. Called by every stage that runs intra writebacks `-p`-wide
     (p03 renders, p04 previews)."""
-    if "PC_FFV1_WORKERS" in os.environ:
-        return
-    ncpu = os.cpu_count() or 1
-    per_job = (ncpu - 1) // max(1, pool_width) if ncpu > 2 else 0
-    os.environ["PC_FFV1_WORKERS"] = str(max(0, min(per_job, 8)))
+    if "PC_FFV1_WORKERS" not in os.environ:
+        ncpu = os.cpu_count() or 1
+        per_job = (ncpu - 1) // max(1, pool_width) if ncpu > 2 else 0
+        os.environ["PC_FFV1_WORKERS"] = str(max(0, min(per_job, 8)))
+    if "PC_FFV1_THREADS" not in os.environ:
+        # the serial writers' slice-threading default (one thread per
+        # core) must also divide across the pool: when the fp default
+        # resolves to 0, `pool_width` concurrent serial writers each
+        # opening cpu_count() codec threads would thrash the scheduler
+        ncpu = os.cpu_count() or 1
+        os.environ["PC_FFV1_THREADS"] = str(
+            max(1, ncpu // max(1, pool_width))
+        )
+
+
+#: slice counts the FFV1 encoder accepts (ffv1enc slice tiling table)
+FFV1_SLICE_COUNTS = (4, 6, 9, 12, 16, 24, 30)
+
+
+def ffv1_coding_threads() -> int:
+    """Slice-threading width for serial (non-fp) FFV1 writes. Default:
+    one per core (the reference pins `-threads 4`, lib/ffmpeg.py:1047 —
+    which WASTES cores above 4 and oversubscribes below);
+    PC_FFV1_THREADS pins it."""
+    pinned = _env_int("PC_FFV1_THREADS")
+    if pinned is not None:
+        return max(1, pinned)
+    return os.cpu_count() or 1
+
+
+def ffv1_slices(threads: int) -> int:
+    """Slices per FFV1 frame: the smallest count the encoder accepts that
+    keeps every slice thread busy (slice threading tops out at
+    slices-per-frame). PC_FFV1_SLICES pins it (must be a valid count)."""
+    pinned = _env_int("PC_FFV1_SLICES")
+    if pinned is not None:
+        if pinned not in FFV1_SLICE_COUNTS:
+            raise ValueError(
+                f"PC_FFV1_SLICES={pinned}: ffv1 accepts {FFV1_SLICE_COUNTS}"
+            )
+        return pinned
+    for s in FFV1_SLICE_COUNTS:
+        if s >= threads:
+            return s
+    return FFV1_SLICE_COUNTS[-1]
+
+
+def ffv1_effective_coding() -> dict:
+    """The FFV1 writeback configuration `_ffv1_writer` will actually use,
+    resolved once so the writer and store provenance cannot drift. These
+    knobs change the BYTE STREAM but never the decoded frames (slices
+    tile, threads parallelize, fp workers reorder nothing) — like
+    fp_workers they stay out of plan hashes and are recorded in
+    provenance so artifacts remain attributable."""
+    workers = ffv1_workers()
+    if workers > 0:
+        return {"fp_workers": workers, "threads": 1, "slices": 0}
+    threads = ffv1_coding_threads()
+    return {"fp_workers": 0, "threads": threads,
+            "slices": ffv1_slices(threads)}
 
 
 def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
@@ -178,16 +262,20 @@ def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
                 (frac.numerator, frac.denominator), **audio,
             )
     # FFV1 level 3 + slicecrc stream integrity (reference :1047: -level 3
-    # -coder 1 -context 1 -slicecrc 1); -threads 4 parity. With fp
-    # workers, parallelism moves from slices to whole frames (gop=1) and
-    # per-context threading drops to 1.
-    workers = ffv1_workers()
+    # -coder 1 -context 1 -slicecrc 1). Serial writes get real codec
+    # threading (slices sized to the thread count — the reference's
+    # fixed `-threads 4` with the default single slice never scaled).
+    # With fp workers, parallelism moves from slices to whole frames
+    # (gop=1) and per-context threading drops to 1.
+    eff = ffv1_effective_coding()
     opts = "level=3:coder=1:context=1:slicecrc=1"
-    if workers > 0:
-        opts += f":pc_fp_workers={workers}"
+    if eff["fp_workers"] > 0:
+        opts += f":pc_fp_workers={eff['fp_workers']}"
+    else:
+        opts += f":slices={eff['slices']}"
     return VideoWriter(
         path, "ffv1", w, h, pix_fmt, (frac.numerator, frac.denominator),
-        threads=1 if workers > 0 else 4, opts=opts, **audio,
+        threads=eff["threads"], opts=opts, **audio,
     )
 
 
@@ -205,7 +293,7 @@ def _segment_canvas_chunks(seg, rate: float):
             reader,
             lambda k: int(np.floor(k / rate * seg_fps + 0.5)),
             n_out,
-            CHUNK,
+            chunk_frames(),
         ):
             got_any = True
             yield chunk
@@ -226,9 +314,9 @@ def _short_rate_chunks(
         60.0 if force_60_fps else seg_fps
     )
     chunks = (
-        pf.stream_fps_resample(reader, seg_fps, rate, CHUNK)
+        pf.stream_fps_resample(reader, seg_fps, rate, chunk_frames())
         if rate != seg_fps
-        else pf.iter_plane_chunks(reader, CHUNK)
+        else pf.iter_plane_chunks(reader, chunk_frames())
     )
     return rate, chunks
 
@@ -364,19 +452,27 @@ def _wo_buffer_plan(
 
 def _wo_buffer_provenance(pvs: Pvs, w: int, h: int, pix_fmt: str) -> dict:
     codec = effective_avpvs_codec(pix_fmt)
-    workers = ffv1_workers() if codec == "ffv1" else 0
+    if codec == "ffv1":
+        # record the EFFECTIVE codec-threading knobs (fp workers, slice
+        # threading, slices): they shape the byte stream, so an artifact
+        # must stay attributable to the writer configuration that
+        # produced it — while plan hashes keep tracking semantic content
+        # only (decoded frames are identical across these knobs)
+        eff = ffv1_effective_coding()
+        tuning = (
+            f"fp_workers={eff['fp_workers']}" if eff["fp_workers"]
+            else f"threads={eff['threads']},slices={eff['slices']}"
+        )
+        codec_desc = f"ffv1(level3,slicecrc,{tuning})"
+    else:
+        codec_desc = "rawvideo"
     return {
         "pvs": pvs.pvs_id,
         "pipeline": {
             "canvas": [w, h],
             "pix_fmt": pix_fmt,
             "segments": [s.filename for s in pvs.segments],
-            "codec": (
-                "ffv1(level3,slicecrc"
-                + (f",fp_workers={workers}" if workers else "")
-                + ")"
-                if codec == "ffv1" else "rawvideo"
-            ),
+            "codec": codec_desc,
         },
     }
 
@@ -395,14 +491,24 @@ def create_avpvs_wo_buffer(
 
     def _pump_ready(ready, writer: pf.AsyncWriter, feat: SiTiAccumulator) -> None:
         """Already-prefetched host chunks → device resize (+ on-device
-        SI/TI features) → async encode."""
+        SI/TI features) → async encode. Transfers are double-buffered
+        (pipeline.iter_device_ahead): chunk k+1's device_put is issued
+        while chunk k's compute is in flight, and the pooled decode
+        blocks ride to the AsyncWriter, which recycles them once the
+        encoded outputs prove the compute consumed them."""
+        import jax
+
+        from ..parallel.pipeline import iter_device_ahead
+
         sub = fr.chroma_subsampling(pix_fmt)
         ten_bit = "10" in pix_fmt
-        for chunk in ready:
-            scaled = fr.scale_yuv_frames(chunk, h, w, "bicubic", sub)
+        for chunk, dev in iter_device_ahead(
+            ready, lambda c: [jax.device_put(p) for p in c]
+        ):
+            scaled = fr.scale_yuv_frames(dev, h, w, "bicubic", sub)
             quant = fr.quantize_device(scaled, ten_bit)
             feat.update(quant[0])
-            writer.put(quant)
+            writer.put(quant, recycle=chunk)
 
     def _pump(chunks, writer: pf.AsyncWriter, feat: SiTiAccumulator) -> None:
         with pf.Prefetcher(chunks, depth=2) as pre:
@@ -658,7 +764,7 @@ def create_avpvs_wo_buffer_batch(
                             lanes, mesh, dh, dw, "bicubic",
                             fr.chroma_subsampling(pix_fmt),
                             ten_bit="10" in pix_fmt,
-                            chunk=CHUNK,
+                            chunk=chunk_frames(),
                         )
                 except BaseException:
                     # the writers were opened (files created/truncated): a
@@ -872,8 +978,9 @@ def apply_stalling(
         ) as writer:
             if audio is not None and audio.size:
                 writer.write_audio(audio)
+            chunk = chunk_frames()
             chunks = pf.stream_monotonic_gather(
-                reader, lambda k: int(plan.src_idx[k]), plan.n_out, CHUNK
+                reader, lambda k: int(plan.src_idx[k]), plan.n_out, chunk
             )
             import jax
 
@@ -899,7 +1006,7 @@ def apply_stalling(
                 grain = mesh.shape["pvs"]
             with pf.Prefetcher(chunks, depth=2) as pre:
                 for chunk_no, gathered in enumerate(pre):
-                    start = chunk_no * CHUNK
+                    start = chunk_no * chunk
                     sel_len = gathered[0].shape[0]
                     stall = plan.stall_mask[start: start + sel_len]
                     black = plan.black_mask[start: start + sel_len]

@@ -27,9 +27,8 @@ from ..io.video import VideoReader, VideoWriter
 from ..ops import pad as pad_ops
 from ..ops import pixfmt as pf
 from ..store import keys as store_keys
+from . import avpvs
 from . import frames as fr
-
-CHUNK = 64
 
 
 def normalize_rms(samples: np.ndarray, target_dbfs: float = -23.0) -> np.ndarray:
@@ -59,13 +58,14 @@ def normalize_rms(samples: np.ndarray, target_dbfs: float = -23.0) -> np.ndarray
 
 
 def _avpvs_chunks(reader: VideoReader, dst_rate: Optional[float] = None):
-    """Stream an open AVPVS reader as CHUNK-frame plane stacks, resampled
-    to dst_rate when it differs (ffmpeg `fps=` semantics, streaming).
-    O(CHUNK) memory for arbitrarily long PVSes — never the whole AVPVS
-    (a 3-min 1080p60 10-bit one is ~30 GB stacked)."""
+    """Stream an open AVPVS reader as chunk_frames()-sized plane stacks,
+    resampled to dst_rate when it differs (ffmpeg `fps=` semantics,
+    streaming). O(chunk) memory for arbitrarily long PVSes — never the
+    whole AVPVS (a 3-min 1080p60 10-bit one is ~30 GB stacked)."""
+    chunk = avpvs.chunk_frames()
     if dst_rate is not None and dst_rate != reader.fps:
-        return pfe.stream_fps_resample(reader, reader.fps, dst_rate, CHUNK)
-    return pfe.iter_plane_chunks(reader, CHUNK)
+        return pfe.stream_fps_resample(reader, reader.fps, dst_rate, chunk)
+    return pfe.iter_plane_chunks(reader, chunk)
 
 
 def _limit_frames(chunks, n_max: int):
@@ -388,7 +388,8 @@ def create_preview(pvs: Pvs) -> Optional[Job]:
                 if aud:
                     writer.write_audio(audio)
                 with pfe.Prefetcher(
-                    pfe.iter_plane_chunks(reader, CHUNK), depth=2
+                    pfe.iter_plane_chunks(reader, avpvs.chunk_frames()),
+                    depth=2
                 ) as pre:
                     for chunk in pre:
                         writer.put(preview_chunk(chunk))

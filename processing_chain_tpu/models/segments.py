@@ -22,9 +22,8 @@ from ..io import medialib
 from ..ops import fps as fps_ops
 from ..store import keys as store_keys
 from ..utils.log import get_logger
+from . import avpvs
 from . import frames as fr
-
-CHUNK = 64  # frames per decode/scale batch
 
 #: encoder name → libav encoder + default private options
 _ENCODERS = {
@@ -236,8 +235,9 @@ def encode_segment(segment: Segment) -> Optional[Job]:
             fps_ops.select_table(src_fps, target_fps)
 
         def scaled_chunks():
-            """Decode window → fps select → device scale, in CHUNK-frame
-            batches (O(CHUNK) memory for any window length; the reference's
+            """Decode window → fps select → device scale, in
+            chunk_frames()-sized batches (O(chunk) memory for any window
+            length; the reference's
             ffmpeg process streams the same way). 2-pass encodes consume
             this twice — two decodes, exactly like the reference's two
             ffmpeg invocations."""
@@ -245,7 +245,7 @@ def encode_segment(segment: Segment) -> Optional[Job]:
                 segment.src.file_path, segment.start_time, segment.duration
             ) as reader:
                 decoded_any = False
-                stream = pfe.iter_plane_chunks(reader, CHUNK)
+                stream = pfe.iter_plane_chunks(reader, avpvs.chunk_frames())
                 if target_fps is not None and target_fps != src_fps:
                     stream = fps_ops.stream_select(stream, src_fps, target_fps)
                 for chunk in stream:

@@ -64,6 +64,90 @@ double ts_to_sec(int64_t ts, AVRational tb) {
     return ts * av_q2d(tb);
 }
 
+// ---------------------------------------------------------------------------
+// FFmpeg 4.x/5.x compatibility. The AVChannelLayout API landed in lavc 59;
+// on lavc 58 hosts (FFmpeg 4.x) the same call sites map onto the legacy
+// channels/channel_layout fields. CI pins lavc 59 (python:3.10-bookworm),
+// where the < 59 branches compile away entirely.
+// ---------------------------------------------------------------------------
+
+int pc_find_best_stream(AVFormatContext* fmt, enum AVMediaType type,
+                        const AVCodec** out_codec) {
+#if LIBAVFORMAT_VERSION_MAJOR < 59
+    AVCodec* c = nullptr;
+    int idx = av_find_best_stream(fmt, type, -1, -1,
+                                  out_codec ? &c : nullptr, 0);
+    if (out_codec) *out_codec = c;
+    return idx;
+#else
+    return av_find_best_stream(fmt, type, -1, -1, out_codec, 0);
+#endif
+}
+
+#if LIBAVCODEC_VERSION_MAJOR < 59
+
+int pc_par_channels(const AVCodecParameters* par) { return par->channels; }
+int pc_ctx_channels(const AVCodecContext* c) { return c->channels; }
+
+void pc_ctx_default_layout(AVCodecContext* c, int channels) {
+    c->channels = channels;
+    c->channel_layout = (uint64_t)av_get_default_channel_layout(channels);
+}
+
+void pc_frame_copy_layout(AVFrame* f, const AVCodecContext* c) {
+    f->channels = c->channels;
+    f->channel_layout = c->channel_layout;
+}
+
+// Allocate + configure an SwrContext: input layout/fmt from `in_ctx`,
+// output = default layout of `out_channels` (0 = same layout as input).
+int pc_swr_setup(SwrContext** swr, AVCodecContext* in_ctx, int out_channels,
+                 AVSampleFormat out_fmt, AVSampleFormat in_fmt, int rate) {
+    uint64_t in_layout =
+        in_ctx->channel_layout
+            ? in_ctx->channel_layout
+            : (uint64_t)av_get_default_channel_layout(in_ctx->channels);
+    uint64_t out_layout =
+        out_channels > 0 ? (uint64_t)av_get_default_channel_layout(out_channels)
+                         : in_layout;
+    *swr = swr_alloc_set_opts(nullptr, (int64_t)out_layout, out_fmt, rate,
+                              (int64_t)in_layout, in_fmt, rate, 0, nullptr);
+    return *swr ? 0 : -1;
+}
+
+#else
+
+int pc_par_channels(const AVCodecParameters* par) {
+    return par->ch_layout.nb_channels;
+}
+int pc_ctx_channels(const AVCodecContext* c) {
+    return c->ch_layout.nb_channels;
+}
+
+void pc_ctx_default_layout(AVCodecContext* c, int channels) {
+    av_channel_layout_default(&c->ch_layout, channels);
+}
+
+void pc_frame_copy_layout(AVFrame* f, const AVCodecContext* c) {
+    av_channel_layout_copy(&f->ch_layout, &c->ch_layout);
+}
+
+int pc_swr_setup(SwrContext** swr, AVCodecContext* in_ctx, int out_channels,
+                 AVSampleFormat out_fmt, AVSampleFormat in_fmt, int rate) {
+    AVChannelLayout out_layout;
+    if (out_channels > 0) {
+        av_channel_layout_default(&out_layout, out_channels);
+    } else if (av_channel_layout_copy(&out_layout, &in_ctx->ch_layout) < 0) {
+        return -1;
+    }
+    int ret = swr_alloc_set_opts2(swr, &out_layout, out_fmt, rate,
+                                  &in_ctx->ch_layout, in_fmt, rate, 0, nullptr);
+    av_channel_layout_uninit(&out_layout);
+    return ret;
+}
+
+#endif
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -157,7 +241,7 @@ EXPORT int mp_probe(const char* path, MPFormatInfo* fmt_out,
             si->avg_fps_den = st->avg_frame_rate.den;
         } else {
             si->sample_rate = par->sample_rate;
-            si->channels = par->ch_layout.nb_channels;
+            si->channels = pc_par_channels(par);
             const char* sf =
                 av_get_sample_fmt_name((AVSampleFormat)par->format);
             snprintf(si->sample_fmt, sizeof(si->sample_fmt), "%s", sf ? sf : "?");
@@ -358,8 +442,13 @@ static int fill_video_desc(MPDecoder* d, MPVideoDesc* out) {
     return 0;
 }
 
-EXPORT MPDecoder* mp_decoder_open(const char* path, double start_s, double dur_s,
-                                  char* err, int errlen) {
+// threads: decoder thread_count (0 = auto = one per core; 1 = serial).
+// Frame threading hides the codec's per-frame latency behind the batch
+// loop in mp_decoder_next_batch — the decode-side analog of the
+// encoder's slice/frame threading knobs.
+EXPORT MPDecoder* mp_decoder_open_t(const char* path, double start_s,
+                                    double dur_s, int threads, char* err,
+                                    int errlen) {
     auto* d = new MPDecoder();
     int ret = avformat_open_input(&d->fmt, path, nullptr, nullptr);
     if (ret < 0) {
@@ -374,7 +463,7 @@ EXPORT MPDecoder* mp_decoder_open(const char* path, double start_s, double dur_s
         return nullptr;
     }
     const AVCodec* codec = nullptr;
-    d->sidx = av_find_best_stream(d->fmt, AVMEDIA_TYPE_VIDEO, -1, -1, &codec, 0);
+    d->sidx = pc_find_best_stream(d->fmt, AVMEDIA_TYPE_VIDEO, &codec);
     if (d->sidx < 0 || !codec) {
         set_err(err, errlen, "no video stream");
         avformat_close_input(&d->fmt);
@@ -383,7 +472,7 @@ EXPORT MPDecoder* mp_decoder_open(const char* path, double start_s, double dur_s
     }
     d->dec = avcodec_alloc_context3(codec);
     avcodec_parameters_to_context(d->dec, d->fmt->streams[d->sidx]->codecpar);
-    d->dec->thread_count = 0;  // auto
+    d->dec->thread_count = threads >= 0 ? threads : 0;
     if ((ret = avcodec_open2(d->dec, codec, nullptr)) < 0) {
         set_err(err, errlen, "avcodec_open2: " + av_errstr(ret));
         avcodec_free_context(&d->dec);
@@ -420,6 +509,15 @@ EXPORT MPDecoder* mp_decoder_open(const char* path, double start_s, double dur_s
     return d;
 }
 
+// Legacy entry point (auto threading), kept so an OLDER Python package
+// keeps loading a .so built from this newer source (the reverse —
+// newer Python on a pre-batch .so — fails loudly at symbol bind in
+// medialib.ensure_loaded, same policy as mp_decode_audio_s16_ch).
+EXPORT MPDecoder* mp_decoder_open(const char* path, double start_s, double dur_s,
+                                  char* err, int errlen) {
+    return mp_decoder_open_t(path, start_s, dur_s, 0, err, errlen);
+}
+
 EXPORT int mp_decoder_desc(MPDecoder* d, MPVideoDesc* out) {
     return fill_video_desc(d, out);
 }
@@ -427,9 +525,8 @@ EXPORT int mp_decoder_desc(MPDecoder* d, MPVideoDesc* out) {
 // Decode the next frame inside the trim window into caller-provided plane
 // buffers (contiguous, sized plane_w*plane_h*bytes_per_sample each; pass
 // nullptr for unused planes). Returns 1 on frame, 0 on EOF, < 0 on error.
-EXPORT int mp_decoder_next(MPDecoder* d, uint8_t* p0, uint8_t* p1, uint8_t* p2,
-                           uint8_t* p3, double* pts_out, char* err, int errlen) {
-    uint8_t* planes[4] = {p0, p1, p2, p3};
+static int decoder_next_into(MPDecoder* d, uint8_t* planes[4],
+                             double* pts_out, char* err, int errlen) {
     AVRational tb = d->fmt->streams[d->sidx]->time_base;
     const AVPixFmtDescriptor* desc = av_pix_fmt_desc_get(d->dec->pix_fmt);
     for (;;) {
@@ -546,6 +643,41 @@ EXPORT int mp_decoder_next(MPDecoder* d, uint8_t* p0, uint8_t* p1, uint8_t* p2,
     }
 }
 
+EXPORT int mp_decoder_next(MPDecoder* d, uint8_t* p0, uint8_t* p1, uint8_t* p2,
+                           uint8_t* p3, double* pts_out, char* err, int errlen) {
+    uint8_t* planes[4] = {p0, p1, p2, p3};
+    return decoder_next_into(d, planes, pts_out, err, errlen);
+}
+
+// Batched decode: up to `max_frames` frames in ONE call (one ctypes
+// crossing, one GIL release) into caller-provided contiguous plane BLOCKS
+// laid out [N, plane_h, plane_w] — frame i's plane p lands at
+// base_p + i * plane_h[p] * row_bytes[p] (the open-time geometry, so the
+// blocks a Python [N, h, w] ndarray describes are addressed exactly).
+// pts_out receives one timestamp per decoded frame. Returns the number of
+// frames decoded (0 = EOF / window end), or < 0 on error.
+EXPORT long mp_decoder_next_batch(MPDecoder* d, uint8_t* p0, uint8_t* p1,
+                                  uint8_t* p2, uint8_t* p3, long max_frames,
+                                  double* pts_out, char* err, int errlen) {
+    uint8_t* bases[4] = {p0, p1, p2, p3};
+    size_t fsize[4];
+    for (int p = 0; p < 4; p++)
+        fsize[p] = (size_t)d->buf_rows[p] * (size_t)d->buf_row_bytes[p];
+    long n = 0;
+    while (n < max_frames) {
+        uint8_t* planes[4];
+        for (int p = 0; p < 4; p++)
+            planes[p] = bases[p] ? bases[p] + (size_t)n * fsize[p] : nullptr;
+        double pts = 0.0;
+        int ret = decoder_next_into(d, planes, &pts, err, errlen);
+        if (ret < 0) return ret;
+        if (ret == 0) break;
+        if (pts_out) pts_out[n] = pts;
+        n++;
+    }
+    return n;
+}
+
 EXPORT void mp_decoder_close(MPDecoder* d) {
     if (!d) return;
     av_packet_free(&d->pkt);
@@ -587,7 +719,7 @@ EXPORT long mp_decode_audio_s16_ch(const char* path, double start_s,
         return -1;
     }
     const AVCodec* codec = nullptr;
-    int sidx = av_find_best_stream(fmt, AVMEDIA_TYPE_AUDIO, -1, -1, &codec, 0);
+    int sidx = pc_find_best_stream(fmt, AVMEDIA_TYPE_AUDIO, &codec);
     if (sidx < 0 || !codec) {
         set_err(err, errlen, "no audio stream");
         avformat_close_input(&fmt);
@@ -602,20 +734,14 @@ EXPORT long mp_decode_audio_s16_ch(const char* path, double start_s,
         return -1;
     }
     int channels = out_channels > 0 ? out_channels
-                                    : dec->ch_layout.nb_channels;
+                                    : pc_ctx_channels(dec);
     int rate = dec->sample_rate;
     if (sample_rate_out) *sample_rate_out = rate;
     if (channels_out) *channels_out = channels;
 
     SwrContext* swr = nullptr;
-    AVChannelLayout out_layout;
-    if (out_channels > 0) {
-        av_channel_layout_default(&out_layout, out_channels);
-    } else {
-        av_channel_layout_copy(&out_layout, &dec->ch_layout);
-    }
-    ret = swr_alloc_set_opts2(&swr, &out_layout, AV_SAMPLE_FMT_S16, rate,
-                              &dec->ch_layout, dec->sample_fmt, rate, 0, nullptr);
+    ret = pc_swr_setup(&swr, dec, out_channels, AV_SAMPLE_FMT_S16,
+                       dec->sample_fmt, rate);
     if (ret < 0 || swr_init(swr) < 0) {
         set_err(err, errlen, "swr_init failed");
         avcodec_free_context(&dec);
@@ -932,6 +1058,11 @@ EXPORT MPEncoder* mp_encoder_open(
         return nullptr;
     }
     e->venc = avcodec_alloc_context3(vc);
+    // the reference's encode/mux commands carry `-strict -2`
+    // (lib/downloader.py:859) — also what FFmpeg 4.x needs to open
+    // libaom-av1, which it still marks experimental
+    e->venc->strict_std_compliance = FF_COMPLIANCE_EXPERIMENTAL;
+    e->fmt->strict_std_compliance = FF_COMPLIANCE_EXPERIMENTAL;
     e->venc->width = width;
     e->venc->height = height;
     e->venc->time_base = AVRational{fps_den, fps_num};
@@ -1110,7 +1241,7 @@ EXPORT MPEncoder* mp_encoder_open(
         }
         e->aenc = avcodec_alloc_context3(ac);
         e->aenc->sample_rate = sample_rate;
-        av_channel_layout_default(&e->aenc->ch_layout, channels);
+        pc_ctx_default_layout(e->aenc, channels);
         e->aenc->sample_fmt = ac->sample_fmts ? ac->sample_fmts[0] : AV_SAMPLE_FMT_S16;
         // prefer s16 when the codec supports it (flac/pcm)
         if (ac->sample_fmts) {
@@ -1134,10 +1265,8 @@ EXPORT MPEncoder* mp_encoder_open(
         e->astream->time_base = e->aenc->time_base;
         avcodec_parameters_from_context(e->astream->codecpar, e->aenc);
         if (e->aenc->sample_fmt != AV_SAMPLE_FMT_S16) {
-            ret = swr_alloc_set_opts2(&e->swr, &e->aenc->ch_layout,
-                                      e->aenc->sample_fmt, sample_rate,
-                                      &e->aenc->ch_layout, AV_SAMPLE_FMT_S16,
-                                      sample_rate, 0, nullptr);
+            ret = pc_swr_setup(&e->swr, e->aenc, 0, e->aenc->sample_fmt,
+                               AV_SAMPLE_FMT_S16, sample_rate);
             if (ret < 0 || swr_init(e->swr) < 0) {
                 set_err(err, errlen, "audio swr_init failed");
                 fail_cleanup();
@@ -1183,10 +1312,8 @@ EXPORT MPEncoder* mp_encoder_open(
 }
 
 // Encode one video frame from contiguous plane buffers.
-EXPORT int mp_encoder_write_video(MPEncoder* e, const uint8_t* p0,
-                                  const uint8_t* p1, const uint8_t* p2,
-                                  const uint8_t* p3, char* err, int errlen) {
-    const uint8_t* planes[4] = {p0, p1, p2, p3};
+static int write_video_frame(MPEncoder* e, const uint8_t* planes[4],
+                             char* err, int errlen) {
     int ret;
     if (e->fp_workers > 0) {
         // frame-parallel path: hand the frame to the worker pool; mux
@@ -1249,6 +1376,51 @@ EXPORT int mp_encoder_write_video(MPEncoder* e, const uint8_t* p0,
     return 0;
 }
 
+EXPORT int mp_encoder_write_video(MPEncoder* e, const uint8_t* p0,
+                                  const uint8_t* p1, const uint8_t* p2,
+                                  const uint8_t* p3, char* err, int errlen) {
+    const uint8_t* planes[4] = {p0, p1, p2, p3};
+    return write_video_frame(e, planes, err, errlen);
+}
+
+// Batched encode: `n` frames from contiguous [N, plane_h, plane_w] plane
+// blocks in ONE call (one ctypes crossing, one GIL release per chunk
+// instead of per frame). Frame i's plane p is read at
+// base_p + i * plane_h[p] * row_bytes[p] of the encoder's open geometry.
+// In fp mode the whole chunk streams through the worker pool with the
+// caller thread muxing — Python stays out of the loop entirely. Returns n
+// on success, < 0 on error (err describes the failing frame).
+EXPORT long mp_encoder_write_video_batch(MPEncoder* e, const uint8_t* p0,
+                                         const uint8_t* p1, const uint8_t* p2,
+                                         const uint8_t* p3, long n, char* err,
+                                         int errlen) {
+    const uint8_t* bases[4] = {p0, p1, p2, p3};
+    const AVPixFmtDescriptor* desc = av_pix_fmt_desc_get(e->venc->pix_fmt);
+    if (!desc) {
+        set_err(err, errlen, "batch encode: unknown encoder pix_fmt");
+        return -1;
+    }
+    int nplanes = av_pix_fmt_count_planes(e->venc->pix_fmt);
+    int bps = desc->comp[0].depth > 8 ? 2 : 1;
+    size_t fsize[4] = {0, 0, 0, 0};
+    for (int p = 0; p < nplanes && p < 4; p++) {
+        int is_chroma = (p == 1 || p == 2);
+        int ph = is_chroma
+                     ? AV_CEIL_RSHIFT(e->venc->height, desc->log2_chroma_h)
+                     : e->venc->height;
+        fsize[p] = (size_t)ph * (size_t)plane_row_bytes(
+                                    e->venc->pix_fmt, e->venc->width, p, desc,
+                                    bps);
+    }
+    for (long i = 0; i < n; i++) {
+        const uint8_t* planes[4];
+        for (int p = 0; p < 4; p++)
+            planes[p] = bases[p] ? bases[p] + (size_t)i * fsize[p] : nullptr;
+        if (write_video_frame(e, planes, err, errlen) < 0) return -1;
+    }
+    return n;
+}
+
 // Append interleaved s16 audio samples (n per channel).
 EXPORT int mp_encoder_write_audio(MPEncoder* e, const int16_t* samples, long n,
                                   char* err, int errlen) {
@@ -1256,13 +1428,13 @@ EXPORT int mp_encoder_write_audio(MPEncoder* e, const int16_t* samples, long n,
         set_err(err, errlen, "no audio stream configured");
         return -1;
     }
-    int channels = e->aenc->ch_layout.nb_channels;
+    int channels = pc_ctx_channels(e->aenc);
     e->abuf.insert(e->abuf.end(), samples, samples + (size_t)n * channels);
     int frame_size = e->aenc->frame_size > 0 ? e->aenc->frame_size : 4096;
     while ((long)(e->abuf.size() / channels) >= frame_size) {
         e->aframe->nb_samples = frame_size;
         e->aframe->format = e->aenc->sample_fmt;
-        av_channel_layout_copy(&e->aframe->ch_layout, &e->aenc->ch_layout);
+        pc_frame_copy_layout(e->aframe, e->aenc);
         av_frame_get_buffer(e->aframe, 0);
         if (e->swr) {
             const uint8_t* in = (const uint8_t*)e->abuf.data();
@@ -1324,12 +1496,12 @@ EXPORT int mp_encoder_close(MPEncoder* e, char* err, int errlen) {
         if (enc_write_packets(e, e->venc, e->vstream) < 0) rc = -1;
         if (e->aenc) {
             // flush remaining partial audio frame
-            int channels = e->aenc->ch_layout.nb_channels;
+            int channels = pc_ctx_channels(e->aenc);
             long rem = e->abuf.size() / channels;
             if (rem > 0) {
                 e->aframe->nb_samples = (int)rem;
                 e->aframe->format = e->aenc->sample_fmt;
-                av_channel_layout_copy(&e->aframe->ch_layout, &e->aenc->ch_layout);
+                pc_frame_copy_layout(e->aframe, e->aenc);
                 av_frame_get_buffer(e->aframe, 0);
                 if (e->swr) {
                     const uint8_t* in = (const uint8_t*)e->abuf.data();
@@ -1392,6 +1564,35 @@ EXPORT int mp_sws_scale_plane(const uint8_t* src, int sw, int sh, uint8_t* dst,
     uint8_t* dst_planes[1] = {dst};
     int dst_stride[1] = {dw};
     sws_scale(ctx, src_planes, src_stride, 0, sh, dst_planes, dst_stride);
+    sws_freeContext(ctx);
+    return 0;
+}
+
+// Batched single-plane scale: n gray8 frames from one contiguous
+// [N, sh, sw] block into a contiguous [N, dh, dw] block through ONE
+// SwsContext (filter tables built once per chunk, one ctypes crossing,
+// one GIL release). This is the CPU-backend resize fast path
+// (ops/resize.resize_frames): with SWS_ACCURATE_RND|SWS_BITEXACT it runs
+// the same deterministic C reference the XLA _swscale_exact path
+// emulates — identical bytes, SIMD-free but still ~10x the XLA
+// emulation's throughput on the host.
+EXPORT int mp_sws_scale_frames(const uint8_t* src, int sw, int sh,
+                               uint8_t* dst, int dw, int dh, long n,
+                               int flags, char* err, int errlen) {
+    SwsContext* ctx = sws_getContext(sw, sh, AV_PIX_FMT_GRAY8, dw, dh,
+                                     AV_PIX_FMT_GRAY8, flags, nullptr,
+                                     nullptr, nullptr);
+    if (!ctx) {
+        set_err(err, errlen, "sws_getContext failed");
+        return -1;
+    }
+    for (long i = 0; i < n; i++) {
+        const uint8_t* src_planes[1] = {src + (size_t)i * sw * sh};
+        int src_stride[1] = {sw};
+        uint8_t* dst_planes[1] = {dst + (size_t)i * dw * dh};
+        int dst_stride[1] = {dw};
+        sws_scale(ctx, src_planes, src_stride, 0, sh, dst_planes, dst_stride);
+    }
     sws_freeContext(ctx);
     return 0;
 }

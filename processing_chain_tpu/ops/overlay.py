@@ -47,6 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.device import shard_map as _shard_map
+
 
 # ---------------------------------------------------------------------------
 # Host: timeline planning
@@ -364,7 +366,7 @@ def make_sharded_stall_renderer(
 
     frame_spec = P("pvs", None, None)
     mask_spec = P("pvs")
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn, mesh=mesh,
         in_specs=(frame_spec, frame_spec, frame_spec,
                   mask_spec, mask_spec, mask_spec),

@@ -521,6 +521,81 @@ def resize_plane(
 
 
 @functools.partial(jax.jit, static_argnames=("dst_h", "dst_w", "kernel", "method"))
+def _resize_frames_jit(
+    frames: jnp.ndarray,
+    dst_h: int,
+    dst_w: int,
+    kernel: str = "lanczos",
+    method: str = "auto",
+) -> jnp.ndarray:
+    return resize_plane(frames, dst_h, dst_w, kernel, method=method)
+
+
+_SWS_KERNEL_FLAGS = {"lanczos": "SWS_LANCZOS", "bicubic": "SWS_BICUBIC"}
+
+
+def _native_swscale_eligible(frames, dst_h: int, dst_w: int, kernel: str) -> bool:
+    """True when the CONCRETE u8 stack can take the native libswscale
+    fast path: CPU backend only (on an accelerator the device kernels
+    win), eager callers only (inside a trace the array is abstract and
+    native code unreachable), and within the bit-exactness envelope the
+    XLA golden path itself honors. PC_RESIZE_METHOD pins a method — the
+    operator asked to measure THAT path, so native stays out; the
+    PC_HOST_BATCH=0 fallback switch disables it too (the pooled-vs-
+    per-frame parity tests diff the two whole pipelines)."""
+    import jax.core
+
+    if isinstance(frames, jax.core.Tracer):
+        return False
+    if getattr(frames, "ndim", 0) != 3 or frames.dtype != jnp.uint8:
+        return False
+    src_h, src_w = frames.shape[-2], frames.shape[-1]
+    if (src_h, src_w) == (dst_h, dst_w):
+        return False
+    if kernel not in _SWS_KERNEL_FLAGS:
+        return False
+    if not swscale_exact_applicable(src_h, src_w, dst_h, dst_w, kernel):
+        return False
+    if os.environ.get("PC_RESIZE_METHOD"):
+        return False
+    if jax.default_backend() != "cpu":
+        return False
+    from ..io import bufpool
+
+    if not bufpool.host_batch_enabled():
+        return False
+    try:
+        from ..io import medialib
+
+        medialib.ensure_loaded()
+    except Exception:
+        return False
+    return True
+
+
+def _native_swscale_frames(
+    frames, dst_h: int, dst_w: int, kernel: str
+) -> np.ndarray:
+    """[T, H, W] u8 resize through in-process libswscale with
+    SWS_ACCURATE_RND|SWS_BITEXACT — the very C reference path the XLA
+    `_swscale_exact` emulation is golden-tested bit-exact against
+    (tests/test_ops.py::test_resize_golden_vs_swscale_noise_bitexact), so
+    swapping it in changes no output byte. One native crossing per chunk,
+    one SwsContext (filter tables amortized over the stack); ~10x the
+    XLA emulation's host throughput, which BENCH_r05 showed gating the
+    whole e2e chain on CPU-backend hosts."""
+    from ..io import medialib
+
+    flags = (
+        getattr(medialib, _SWS_KERNEL_FLAGS[kernel])
+        | medialib.SWS_ACCURATE_RND
+        | medialib.SWS_BITEXACT
+    )
+    return medialib.sws_scale_frames(
+        np.asarray(frames), dst_w, dst_h, flags
+    )
+
+
 def resize_frames(
     frames: jnp.ndarray,
     dst_h: int,
@@ -528,9 +603,16 @@ def resize_frames(
     kernel: str = "lanczos",
     method: str = "auto",
 ) -> jnp.ndarray:
-    """Batched resize of [T, H, W] (or [H, W]) planes — the jitted entry the
-    AVPVS pipeline uses per plane."""
-    return resize_plane(frames, dst_h, dst_w, kernel, method=method)
+    """Batched resize of [T, H, W] (or [H, W]) planes — the entry the
+    AVPVS pipeline uses per plane. method="auto" on the CPU backend
+    routes concrete u8 stacks through in-process libswscale (bit-exact
+    with the XLA golden path, ~10x faster on host); everything else goes
+    through the jitted device path."""
+    if method == "auto" and _native_swscale_eligible(
+        frames, dst_h, dst_w, kernel
+    ):
+        return _native_swscale_frames(frames, dst_h, dst_w, kernel)
+    return _resize_frames_jit(frames, dst_h, dst_w, kernel, method)
 
 
 def resize_yuv(

@@ -39,10 +39,14 @@ from typing import Callable, Iterable, Iterator, Optional
 import numpy as np
 
 from .. import telemetry as tm
+from ..io import bufpool
+from ..utils.device import shard_map as _shard_map
 
 _XFER_SECONDS = tm.counter(
     "chain_device_transfer_seconds_total",
-    "host<->device transfer wall time in the batch driver", ("direction",),
+    "host<->device transfer time in the batch driver (put = assemble + "
+    "dispatch — the copy itself overlaps the in-flight step; get = fetch "
+    "of ready outputs)", ("direction",),
 )
 _XFER_BYTES = tm.counter(
     "chain_device_transfer_bytes_total",
@@ -69,19 +73,50 @@ class Lane:
     emit_features: Optional[Callable[[np.ndarray, np.ndarray], None]] = None
 
 
-def _rechunk(chunks: Iterable[list], t_step: int) -> Iterator[tuple[list, int]]:
+def _rechunk(
+    chunks: Iterable[list], t_step: int, pool=None,
+) -> Iterator[tuple[list, int]]:
     """Re-chunk a variable-size chunk stream into exact t_step blocks.
     Yields (planes, valid): the tail block pads by repeating the last
-    frame, valid < t_step."""
+    frame, valid < t_step.
+
+    Chunks already sized t_step (the aligned fast path: decode CHUNK ==
+    t_step) pass through untouched, so a pooled decode block reaches the
+    wave assembler without a copy; misaligned streams accumulate via
+    concatenate, with consumed source chunks released back to the pool
+    (release ignores views and foreign arrays — bufpool protocol)."""
+    pool = pool or bufpool.DEFAULT_POOL
     buf: Optional[list] = None
     for ch in chunks:
         ch = [np.asarray(p) for p in ch]
-        buf = ch if buf is None else [
-            np.concatenate([b, c]) for b, c in zip(buf, ch)
-        ]
-        while buf[0].shape[0] >= t_step:
-            yield [b[:t_step] for b in buf], t_step
-            buf = [b[t_step:] for b in buf]
+        if buf is None:
+            if ch[0].shape[0] == t_step:
+                yield ch, t_step
+                continue
+            if any(pool.owns(p) for p in ch):
+                # misaligned pooled chunk: slicing it into views below
+                # would strand the block (release ignores views) — take
+                # a private copy and recycle the block now; the copy is
+                # the same cost class as the concatenate path this
+                # stream is already on
+                buf = [np.array(p) for p in ch]
+                pool.release(*ch)
+            else:
+                buf = ch
+        else:
+            merged = [np.concatenate([b, c]) for b, c in zip(buf, ch)]
+            # buf is never pool-owned here (the first-chunk branch above
+            # copies-and-releases pooled arrivals); ch can be — a full
+            # pooled block landing while a remainder is buffered
+            pool.release(*ch)
+            buf = merged
+        while buf is not None and buf[0].shape[0] >= t_step:
+            if buf[0].shape[0] == t_step:
+                yield buf, t_step
+                buf = None
+            else:
+                yield [b[:t_step] for b in buf], t_step
+                buf = [b[t_step:] for b in buf]
     if buf is not None and buf[0].shape[0] > 0:
         n = buf[0].shape[0]
         pad = t_step - n
@@ -93,7 +128,7 @@ def _rechunk(chunks: Iterable[list], t_step: int) -> Iterator[tuple[list, int]]:
 @functools.cache
 def _sharded_resize_step(
     mesh, dst_h: int, dst_w: int, kernel: str,
-    sub_h: int, sub_w: int, ten_bit: bool,
+    sub_h: int, sub_w: int, ten_bit: bool, donate: bool = False,
 ):
     """Jit the _pump math (models/avpvs) over the (pvs, time) mesh:
     [B, T, H, W] u8/u16 planes -> scaled + quantized planes PLUS per-frame
@@ -152,11 +187,17 @@ def _sharded_resize_step(
     spec = P("pvs", "time", None, None)
     prev_spec = P("pvs", None, None)     # replicated over "time"
     feat_spec = P("pvs", "time")
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn, mesh=mesh,
         in_specs=(spec, spec, spec, prev_spec, P()),
         out_specs=(spec, spec, spec, feat_spec, feat_spec),
     )
+    if donate:
+        # the prev carry is re-uploaded every block and never read after
+        # the step: donating its buffer lets XLA reuse the HBM pages
+        # instead of holding both generations live (no-op on backends
+        # without donation support — gated by the caller)
+        return jax.jit(mapped, donate_argnums=(3,))
     return jax.jit(mapped)
 
 
@@ -193,8 +234,11 @@ def run_bucket(
     t_step = t_loc * n_time
     sub_h, sub_w = chroma_sub
     sharding = batch_sharding(mesh)
+    # donation is a no-op (plus a warning per trace) on backends without
+    # buffer donation — only ask for it where it means something
+    donate = all(d.platform in ("tpu", "gpu") for d in mesh.devices.flat)
     step = _sharded_resize_step(
-        mesh, dst_h, dst_w, kernel, sub_h, sub_w, ten_bit
+        mesh, dst_h, dst_w, kernel, sub_h, sub_w, ten_bit, donate
     )
 
     from contextlib import ExitStack
@@ -219,19 +263,33 @@ def run_bucket(
 
 
 def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
-                dst_h: int, dst_w: int, ten_bit: bool) -> None:
+                dst_h: int, dst_w: int, ten_bit: bool, pool=None) -> None:
+    """Fully overlapped wave loop: while the jitted step for block k is in
+    flight, the next t_step blocks are pulled from the lane prefetchers,
+    assembled into the OTHER of two pooled [B, T, H, W] wave buffers, and
+    their device_put is issued — so host decode, H2D transfer, and device
+    compute run concurrently instead of strictly alternating. Two wave
+    buffers suffice: buffer A is only overwritten (at k+2) after block
+    k's outputs have been fetched, which proves the compute that read A
+    finished — safe even where device_put aliases host memory (CPU)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    pool = pool or bufpool.DEFAULT_POOL
     prev_sharding = NamedSharding(mesh, P("pvs", None, None))
     done = [False] * len(wave)
-    zero_block: Optional[list] = None
     # cross-block TI carry stays at container depth (the quantized luma a
     # decoder of the artifact would see; u8/u16 device_put, not f32)
     prev = np.zeros((n_pvs, dst_h, dst_w),
                     np.uint16 if ten_bit else np.uint8)
     first = True
-    while not all(done):
+    wave_bufs: dict[int, list] = {}  # parity -> pooled [B, T, H, W] planes
+    state = {"parity": 0}
+
+    def gather_put():
+        """Pull one block per live lane, assemble into this parity's wave
+        buffer, issue the device_put. Returns (dev_planes, valids) or
+        None once every lane is exhausted."""
         blocks: list[Optional[list]] = []
         valids: list[int] = []
         for i, it in enumerate(iters):
@@ -243,61 +301,73 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
             else:
                 blocks.append(blk[0])
                 valids.append(blk[1])
-                if zero_block is None:
-                    zero_block = [np.zeros_like(p) for p in blk[0]]
         if all(v == 0 for v in valids):
-            break
-        assert zero_block is not None
-        filled = [b if b is not None else zero_block for b in blocks]
-        # pad the wave's batch axis up to the mesh's pvs size
-        while len(filled) < n_pvs:
-            filled.append(zero_block)
+            return None
+        tmpl = next(b for b in blocks if b is not None)
+        parity = state["parity"]
+        state["parity"] ^= 1
+        bufs = wave_bufs.get(parity)
+        if bufs is None:
+            bufs = [
+                pool.acquire((n_pvs,) + tuple(p.shape), p.dtype)
+                for p in tmpl
+            ]
+            wave_bufs[parity] = bufs
+        t_put = time.perf_counter() if tm.enabled() else 0.0
+        for p in range(3):
+            dst = bufs[p]
+            for i in range(n_pvs):
+                blk = blocks[i] if i < len(blocks) else None
+                if blk is None:
+                    dst[i] = 0  # exhausted lane / batch-axis padding
+                else:
+                    np.copyto(dst[i], blk[p])
+        # lane blocks are copied out: recycle them for the decoders
+        for blk in blocks:
+            if blk is not None:
+                pool.release(*blk)
+        dev = [jax.device_put(bufs[p], sharding) for p in range(3)]
         if tm.enabled():
-            # interleave stack/device_put like the untimed branch (holding
-            # all three stacked host copies alive through the step would
-            # raise peak RSS by a full wave); block before each timer stops
-            # so async dispatch can't shift device compute into the
-            # transfer counters
-            t_put = time.perf_counter()
-            put_bytes = prev.nbytes
-            planes = []
-            for p in range(3):
-                s = np.stack([blk[p] for blk in filled])
-                put_bytes += s.nbytes
-                planes.append(jax.device_put(s, sharding))
-            prev_dev = jax.device_put(prev, prev_sharding)
-            jax.block_until_ready(planes)
             _XFER_PUT_S.inc(time.perf_counter() - t_put)
-            _XFER_PUT_B.inc(put_bytes)
-            oy, ou, ov, si, ti = jax.block_until_ready(
-                step(*planes, prev_dev, first)
-            )
+            _XFER_PUT_B.inc(sum(b.nbytes for b in bufs) + prev.nbytes)
+        return dev, valids
+
+    nxt = gather_put()
+    while nxt is not None:
+        planes, valids = nxt
+        out = step(*planes, jax.device_put(prev, prev_sharding), first)
+        # overlap: decode + assemble + upload block k+1 while the
+        # step for block k runs (dispatch above is async)
+        nxt = gather_put()
+        if tm.enabled():
+            out = jax.block_until_ready(out)
             t_get = time.perf_counter()
-            host = [np.asarray(o) for o in (oy, ou, ov)]
-            si_h, ti_h = np.asarray(si), np.asarray(ti)
+            host = [np.asarray(o) for o in out[:3]]
+            si_h, ti_h = np.asarray(out[3]), np.asarray(out[4])
             _XFER_GET_S.inc(time.perf_counter() - t_get)
             _XFER_GET_B.inc(sum(h.nbytes for h in host))
         else:
-            planes = [
-                jax.device_put(np.stack([blk[p] for blk in filled]), sharding)
-                for p in range(3)
-            ]
-            oy, ou, ov, si, ti = step(
-                *planes, jax.device_put(prev, prev_sharding), first
-            )
-            host = [np.asarray(o) for o in (oy, ou, ov)]
-            si_h, ti_h = np.asarray(si), np.asarray(ti)
+            host = [np.asarray(o) for o in out[:3]]
+            si_h, ti_h = np.asarray(out[3]), np.asarray(out[4])
         for i, ln in enumerate(wave):
             if valids[i]:
                 ln.emit([h[i][: valids[i]] for h in host])
                 if ln.emit_features is not None:
-                    ln.emit_features(si_h[i][: valids[i]], ti_h[i][: valids[i]])
-        # inter-block TI carry: the tail-repeat padding means [:, -1] is
-        # the lane's last REAL frame even on a partial block
-        # .copy(): a view would pin the whole previous output block in
-        # host memory across the next iteration
+                    ln.emit_features(
+                        si_h[i][: valids[i]], ti_h[i][: valids[i]]
+                    )
+        # inter-block TI carry: the tail-repeat padding means [:, -1]
+        # is the lane's last REAL frame even on a partial block
+        # .copy(): a view would pin the whole previous output block
+        # in host memory across the next iteration
         prev = host[0][:, -1].copy()
         first = False
+    # clean exit only: on an exception a device_put/step may still be
+    # reading a wave buffer (its outputs never fetched), so the buffers
+    # are deliberately DROPPED, not released — same rule as AsyncWriter's
+    # failure path (weakref bookkeeping reclaims them)
+    for bufs in wave_bufs.values():
+        pool.release(*bufs)
 
 
 def wave_count(n_lanes: int, mesh) -> int:

@@ -34,6 +34,7 @@ from ..telemetry.heartbeat import HEARTBEATS
 from ..ops import metrics as metrics_ops
 from ..ops import resize as resize_ops
 from ..ops import siti as siti_ops
+from ..utils.device import shard_map as _shard_map
 
 _STEP_SECONDS = tm.histogram(
     "chain_device_step_seconds",
@@ -79,6 +80,28 @@ def _instrument_step(fn, step: str):
         return out
 
     return call
+
+
+def iter_device_ahead(blocks, put):
+    """One-deep host→device transfer pipeline: yield `(host_item,
+    device_item)` pairs with the NEXT item's `put` (a `jax.device_put`
+    wrapper) already ISSUED before the current pair is handed to the
+    consumer — so transfer k+1 rides the DMA engines while the consumer's
+    dispatched compute on k is still in flight, instead of serializing
+    decode → transfer → compute per chunk.
+
+    The host item is yielded alongside the device item so the consumer
+    can hand it to `AsyncWriter.put(..., recycle=...)` — pooled blocks
+    must not be reused until the compute that read them completes, and
+    the writer's output fetch is the provable completion point."""
+    pending = None
+    for item in blocks:
+        dev = put(item)
+        if pending is not None:
+            yield pending
+        pending = (item, dev)
+    if pending is not None:
+        yield pending
 
 
 def avpvs_siti_step(
@@ -158,7 +181,7 @@ def make_sharded_step(mesh: Mesh, dst_h: int, dst_w: int, kernel: str = "lanczos
         si, ti = siti_ops.siti_batch(up_y, prev_last)
         return up_y, up_u, up_v, si, ti
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -189,7 +212,7 @@ def make_batch_metrics_step(mesh: Mesh):
         ssim = jax.vmap(metrics_ops.ssim_frame)(r, d).reshape(b, t)
         return psnr, ssim
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P("pvs", "time", None, None), P("pvs", "time", None, None)),

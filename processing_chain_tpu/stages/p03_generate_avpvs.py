@@ -49,8 +49,10 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     ffv1_workers = getattr(cli_args, "ffv1_workers", None)
     if ffv1_workers is not None:
         os.environ["PC_FFV1_WORKERS"] = str(max(0, ffv1_workers))
-    else:
-        av.set_default_fp_workers(pvs_par)
+    # always install the pool-aware defaults for whatever is NOT pinned:
+    # an explicit --ffv1-workers 0 must still divide the serial writers'
+    # slice-threading (PC_FFV1_THREADS) across the `-p` pool width
+    av.set_default_fp_workers(pvs_par)
     avpvs_codec = getattr(cli_args, "avpvs_codec", None)
     if avpvs_codec:
         os.environ["PC_AVPVS_CODEC"] = avpvs_codec

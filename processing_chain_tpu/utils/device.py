@@ -105,6 +105,19 @@ def ensure_backend(probe_timeout_s: float = PROBE_TIMEOUT_S) -> str:
             raise RuntimeError(f"no usable JAX backend: {exc2}") from exc2
 
 
+def shard_map(*args, **kwargs):
+    """`jax.shard_map`, falling back to `jax.experimental.shard_map` on
+    jax < 0.5 where the public alias does not exist yet (same signature).
+    All sharded-step factories route through here so one import-site
+    difference cannot strand the batch paths on older jax builds."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:  # jax 0.4.x
+        from jax.experimental.shard_map import shard_map as fn
+    return fn(*args, **kwargs)
+
+
 def device_count() -> int:
     import jax
 

@@ -1,0 +1,225 @@
+"""Tests for the recycling buffer pool (io/bufpool) and the pooled-block
+lifecycle through the prefetch pipeline (AsyncWriter recycle)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu.engine import prefetch as pf
+from processing_chain_tpu.io import bufpool
+
+
+def test_pool_recycles_exact_blocks():
+    pool = bufpool.BufferPool()
+    a = pool.acquire((4, 8), np.uint8)
+    assert a.shape == (4, 8) and a.dtype == np.uint8
+    pool.release(a)
+    b = pool.acquire((4, 8), np.uint8)
+    assert b is a  # recycled, not reallocated
+    assert pool.stats()["hits"] == 1 and pool.stats()["misses"] == 1
+
+
+def test_pool_keys_by_shape_and_dtype():
+    pool = bufpool.BufferPool()
+    a = pool.acquire((4, 8), np.uint8)
+    pool.release(a)
+    assert pool.acquire((4, 8), np.uint16) is not a
+    assert pool.acquire((8, 4), np.uint8) is not a
+    assert pool.acquire((4, 8), np.uint8) is a
+
+
+def test_pool_release_ignores_views_and_foreign_arrays():
+    """Exact-identity release: a consumer holding a trimmed tail view
+    must never yank the backing block back into circulation while other
+    views of it are alive; foreign arrays and double releases no-op."""
+    pool = bufpool.BufferPool()
+    a = pool.acquire((6, 4), np.uint8)
+    view = a[:3]
+    pool.release(view)  # no-op: not the block itself
+    assert pool.acquire((6, 4), np.uint8) is not a
+    pool.release(a)
+    pool.release(a)  # double release: no-op
+    assert pool.stats()["free_blocks"] == 1
+    pool.release(np.zeros((6, 4), np.uint8))  # foreign: no-op
+    assert pool.stats()["free_blocks"] == 1
+    pool.release("not an array")  # type: ignore[arg-type]
+
+
+def test_pool_free_list_is_capped():
+    pool = bufpool.BufferPool(max_free_per_key=2)
+    blocks = [pool.acquire((4,), np.uint8) for _ in range(5)]
+    pool.release(*blocks)
+    assert pool.stats()["free_blocks"] == 2
+
+
+def test_pool_dropped_block_does_not_leak_bookkeeping():
+    """A pooled block dropped without release vanishes from the
+    outstanding set (weakref tracking) — one lost allocation, no
+    unbounded bookkeeping growth."""
+    import gc
+
+    pool = bufpool.BufferPool()
+    a = pool.acquire((4,), np.uint8)
+    assert pool.stats()["outstanding"] == 1
+    del a
+    gc.collect()
+    assert pool.stats()["outstanding"] == 0
+
+
+def test_pool_thread_safety_hammer():
+    """Concurrent acquire/release from several threads: every acquire
+    must hand out a block no other thread currently owns."""
+    pool = bufpool.BufferPool(max_free_per_key=8)
+    errors = []
+    owned_lock = threading.Lock()
+    owned: set = set()
+
+    def worker():
+        try:
+            for _ in range(300):
+                arr = pool.acquire((16, 16), np.uint8)
+                with owned_lock:
+                    assert id(arr) not in owned, "double ownership"
+                    owned.add(id(arr))
+                arr[0, 0] = 1
+                with owned_lock:
+                    owned.discard(id(arr))
+                pool.release(arr)
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    stats = pool.stats()
+    assert stats["hits"] + stats["misses"] == 1200
+    assert stats["hits"] > 0
+
+
+def test_async_writer_recycles_after_write():
+    """`put(..., recycle=blocks)` returns pooled blocks only after the
+    chunk is written — the block must NOT be reusable while the chunk
+    (device computation + encode) is still in flight."""
+    pool = bufpool.DEFAULT_POOL
+    block = pool.acquire((2, 4, 4), np.uint8)
+    block[:] = 7
+    gate = threading.Event()
+    written = []
+
+    class SlowWriter:
+        def write(self, *planes):
+            assert gate.wait(timeout=5.0)
+            written.append([p.copy() for p in planes])
+
+        def close(self):
+            pass
+
+    with pf.AsyncWriter(SlowWriter(), depth=2) as w:
+        w.put([block * 2], recycle=[block])
+        # while the write is gated in flight, the pool must not hand the
+        # recycled block to anyone else
+        other = pool.acquire((2, 4, 4), np.uint8)
+        assert other is not block
+        pool.release(other)
+        gate.set()
+    assert len(written) == 2  # SlowWriter has no write_batch: per-frame
+    # after close (writer drained) the block is recyclable again
+    reused = pool.acquire((2, 4, 4), np.uint8)
+    assert reused is block
+    pool.release(reused)
+
+
+def test_async_writer_failure_drops_recycle_blocks():
+    """After a write failure, in-flight recycle blocks are DROPPED, not
+    recycled — their consuming computation was never synced, so reuse
+    could alias in-flight reads. Dropping them must clear the pool's
+    bookkeeping (weakref tracking), not leak it."""
+    import gc
+
+    pool = bufpool.BufferPool()
+
+    class FailingWriter:
+        def write(self, *planes):
+            raise IOError("disk full")
+
+        def close(self):
+            pass
+
+    b1 = pool.acquire((1, 2, 2), np.uint8)
+    b2 = pool.acquire((1, 2, 2), np.uint8)
+    w = pf.AsyncWriter(FailingWriter(), depth=2, pool=pool)
+    w.put([np.zeros((1, 2, 2), np.uint8)], recycle=[b1])
+    w.put([np.zeros((1, 2, 2), np.uint8)], recycle=[b2])
+    with pytest.raises(IOError, match="disk full"):
+        w.close()
+    assert pool.stats()["free_blocks"] == 0  # never recycled
+    del b1, b2
+    gc.collect()
+    deadline = time.monotonic() + 2.0
+    while pool.stats()["outstanding"] and time.monotonic() < deadline:
+        gc.collect()
+        time.sleep(0.01)
+    assert pool.stats()["outstanding"] == 0  # bookkeeping reclaimed
+
+
+def test_iter_device_ahead_pairs_and_order():
+    """The transfer pipeline yields every (host, device) pair in order,
+    with the NEXT put issued before the current pair is yielded."""
+    from processing_chain_tpu.parallel.pipeline import iter_device_ahead
+
+    put_log = []
+    seen = []
+    for host, dev in iter_device_ahead(
+        iter([1, 2, 3]), lambda x: put_log.append(x) or x * 10
+    ):
+        # by the time item k is yielded, put(k+1) has been issued
+        # (except for the very last item)
+        if host < 3:
+            assert put_log[-1] == host + 1
+        seen.append((host, dev))
+    assert seen == [(1, 10), (2, 20), (3, 30)]
+    assert list(iter_device_ahead(iter([]), lambda x: x)) == []
+
+
+def test_rechunk_misaligned_recycles_pooled_blocks():
+    """When t_step does not divide the decode chunk, _rechunk must not
+    strand pooled blocks behind yielded views — it copies once, releases
+    the block, and the pool keeps recycling."""
+    from processing_chain_tpu.parallel import p03_batch
+
+    pool = bufpool.BufferPool()
+
+    def chunks():
+        for i in range(4):
+            b = pool.acquire((10, 4, 4), np.uint8)
+            b[:] = i + 1
+            yield [b]
+
+    out = list(p03_batch._rechunk(chunks(), 7, pool=pool))
+    assert [v for _, v in out] == [7, 7, 7, 7, 7, 5]
+    total = np.concatenate([blk[0][:v] for blk, v in out])
+    want = np.concatenate(
+        [np.full((10, 4, 4), i + 1, np.uint8) for i in range(4)]
+    )
+    np.testing.assert_array_equal(total, want)
+    stats = pool.stats()
+    assert stats["outstanding"] == 0  # every pooled block recycled
+    assert stats["hits"] > 0
+
+
+def test_rechunk_aligned_passes_pooled_blocks_through():
+    """The aligned fast path hands the pooled block itself downstream
+    (zero copies), transferring ownership to the consumer."""
+    from processing_chain_tpu.parallel import p03_batch
+
+    pool = bufpool.BufferPool()
+    blocks = [pool.acquire((8, 4, 4), np.uint8) for _ in range(2)]
+    out = list(p03_batch._rechunk(iter([[b] for b in blocks]), 8, pool=pool))
+    assert [v for _, v in out] == [8, 8]
+    assert out[0][0][0] is blocks[0] and out[1][0][0] is blocks[1]
+    assert pool.owns(blocks[0]) and pool.owns(blocks[1])

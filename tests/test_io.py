@@ -52,7 +52,10 @@ def write_test_video(path, codec="libx264", n=24, w=192, h=108, fps=(24, 1),
 
 
 def test_version_loads():
-    assert "lavc 59" in medialib.version()
+    # lavc 59 is the CI-pinned ABI (python:3.10-bookworm, FFmpeg 5.1);
+    # lavc 58 (FFmpeg 4.x) builds through the media.cpp compat shim —
+    # behavior on both is gated by the golden tests below, not the pin
+    assert any(f"lavc {v}" in medialib.version() for v in (58, 59))
 
 
 def test_ffv1_lossless_roundtrip(tmp_path):
@@ -405,6 +408,180 @@ def test_prores_frame_parallel_matches_serial(tmp_path):
     assert ser[0].shape[0] == fp[0].shape[0] == n
     for p, q in zip(ser, fp):
         assert np.array_equal(p, q)
+
+
+def _decode_per_frame(path, **kw):
+    with VideoReader(path, **kw) as r:
+        planes, pts = r._read_all_per_frame()
+    return planes, pts
+
+
+def test_batch_decode_matches_per_frame(tmp_path):
+    """Chunk-granular decode (mp_decoder_next_batch) must be
+    byte-identical to the per-frame path — including across B-frame
+    reordering and a chunk size that straddles the stream tail."""
+    from processing_chain_tpu.io import bufpool
+
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx264", n=24, gop=8, bframes=2)
+    ref, ref_pts = _decode_per_frame(path)
+    pool = bufpool.BufferPool()
+    with VideoReader(path) as r:
+        got = []
+        for ch in r.iter_chunks(chunk=7, pool=pool):
+            got.append([p.copy() for p in ch])
+            pool.release(*ch)
+    stacked = [np.concatenate([c[p] for c in got]) for p in range(3)]
+    for a, b in zip(stacked, ref):
+        np.testing.assert_array_equal(a, b)
+    assert pool.stats()["hits"] > 0  # blocks actually recycled
+
+    with VideoReader(path) as r:
+        planes, pts = r.read_all()
+    for a, b in zip(planes, ref):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_allclose(pts, ref_pts)
+
+
+def test_batch_decode_trim_window_matches_per_frame(tmp_path):
+    """Batch decode honors the [start, start+duration) trim exactly like
+    the per-frame path (read_all's streaming pre-size must not change
+    the window)."""
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx264", gop=6, n=48)
+    ref, ref_pts = _decode_per_frame(path, start=1.0, duration=0.5)
+    with VideoReader(path, start=1.0, duration=0.5) as r:
+        planes, pts = r.read_all()
+    assert len(pts) == len(ref_pts) == 12
+    for a, b in zip(planes, ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_batch_decode_packed_uyvy_matches_per_frame(tmp_path):
+    """Packed 422 goes through the chunk-wise deinterleave (one strided
+    pass per plane per CHUNK into pooled planar blocks) — planes must
+    equal the per-frame _deinterleave output exactly."""
+    from processing_chain_tpu.io import bufpool
+    from processing_chain_tpu.ops import pixfmt as pxf
+
+    rng = np.random.default_rng(3)
+    h, w, n = 32, 64, 11
+    path = str(tmp_path / "packed.avi")
+    with VideoWriter(path, "rawvideo", w, h, "uyvy422", (24, 1)) as wr:
+        for i in range(n):
+            wr.write(np.asarray(pxf.pack_uyvy422(
+                rng.integers(16, 235, (h, w), np.uint8),
+                rng.integers(16, 240, (h, w // 2), np.uint8),
+                rng.integers(16, 240, (h, w // 2), np.uint8),
+            )))
+    ref, _ = _decode_per_frame(path)
+    pool = bufpool.BufferPool()
+    with VideoReader(path) as r:
+        got = [[p.copy() for p in ch] for ch in r.iter_chunks(4, pool=pool)]
+    stacked = [np.concatenate([c[p] for c in got]) for p in range(3)]
+    for a, b in zip(stacked, ref):
+        np.testing.assert_array_equal(a, b)
+    with VideoReader(path) as r:
+        planes, _ = r.read_all()
+    for a, b in zip(planes, ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_read_all_streams_without_estimate(tmp_path):
+    """A container whose duration underestimates the frame count forces
+    read_all's grow path; the output must still be exact."""
+    path = str(tmp_path / "t.avi")
+    ys, us, vs = write_test_video(path, codec="ffv1", opts="", n=70)
+    with VideoReader(path) as r:
+        r._window = 0.0
+        r.duration = 0.1  # poison the estimate: forces growth
+        planes, pts = r.read_all()
+    assert planes[0].shape[0] == 70 and len(pts) == 70
+    np.testing.assert_array_equal(planes[0], np.stack(ys))
+    np.testing.assert_array_equal(planes[2], np.stack(vs))
+
+
+def test_write_batch_matches_per_frame_lossy_codec(tmp_path):
+    """Batched encode must hand the codec the same frames in the same
+    order as per-frame writes — identical output bytes even for a
+    stateful inter-coded stream (x264)."""
+    ys, us, vs = synth_frames(30)
+
+    def enc(path, batched):
+        with VideoWriter(path, "libx264", 192, 108, "yuv420p", (24, 1),
+                         gop=8, bframes=2, threads=1,
+                         opts="crf=28:preset=ultrafast") as wr:
+            if batched:
+                for k in range(0, 30, 9):
+                    wr.write_batch(np.stack(ys[k:k + 9]),
+                                   np.stack(us[k:k + 9]),
+                                   np.stack(vs[k:k + 9]))
+            else:
+                for y, u, v in zip(ys, us, vs):
+                    wr.write(y, u, v)
+
+    p1, p2 = str(tmp_path / "a.mp4"), str(tmp_path / "b.mp4")
+    enc(p1, batched=False)
+    enc(p2, batched=True)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+
+
+def test_write_batch_through_fp_workers(tmp_path):
+    """write_batch composes with the frame-parallel FFV1 pool: the whole
+    chunk streams through the worker pool in one native call, decoding
+    back frame-exact and all-intra."""
+    path = str(tmp_path / "fp.avi")
+    h, w, n = 96, 128, 40
+    rng = np.random.default_rng(5)
+    ys = rng.integers(0, 256, (n, h, w), np.uint8)
+    us = rng.integers(0, 256, (n, h // 2, w // 2), np.uint8)
+    vs = rng.integers(0, 256, (n, h // 2, w // 2), np.uint8)
+    with VideoWriter(
+        path, "ffv1", w, h, "yuv420p", (24, 1), threads=1,
+        opts="level=3:coder=1:slicecrc=1:pc_fp_workers=3",
+    ) as wr:
+        for k in range(0, n, 16):
+            wr.write_batch(ys[k:k + 16], us[k:k + 16], vs[k:k + 16])
+    with VideoReader(path) as r:
+        planes, _ = r.read_all()
+    np.testing.assert_array_equal(planes[0], ys)
+    np.testing.assert_array_equal(planes[1], us)
+    np.testing.assert_array_equal(planes[2], vs)
+    assert all(int(k) == 1 for k in medialib.scan_packets(path, "video")["key"])
+
+
+def test_reader_threads_param(tmp_path):
+    """Decoder thread_count plumbs through: pinned-serial and threaded
+    decode produce identical frames (threading must never reorder)."""
+    path = str(tmp_path / "t.mp4")
+    write_test_video(path, codec="libx264", n=24, gop=8, bframes=2)
+    a, _ = _decode_per_frame(path, threads=1)
+    with VideoReader(path, threads=2) as r:
+        b, _ = r.read_all()
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_iter_plane_chunks_delegates_to_batch_reader(tmp_path, monkeypatch):
+    """engine.prefetch.iter_plane_chunks routes VideoReaders through the
+    batched decode, and PC_HOST_BATCH=0 restores the per-frame path —
+    both yielding identical chunks."""
+    from processing_chain_tpu.engine import prefetch as pf
+
+    path = str(tmp_path / "t.avi")
+    ys, _, _ = write_test_video(path, codec="ffv1", opts="", n=20)
+    with VideoReader(path) as r:
+        batched = [[p.copy() for p in c] for c in pf.iter_plane_chunks(r, 8)]
+    monkeypatch.setenv("PC_HOST_BATCH", "0")
+    with VideoReader(path) as r:
+        legacy = [[p.copy() for p in c] for c in pf.iter_plane_chunks(r, 8)]
+    assert [c[0].shape for c in batched] == [c[0].shape for c in legacy]
+    for cb, cl in zip(batched, legacy):
+        for a, b in zip(cb, cl):
+            np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        np.concatenate([c[0] for c in batched]), np.stack(ys)
+    )
 
 
 def test_ffv1_frame_parallel_zero_and_one_frames(tmp_path):

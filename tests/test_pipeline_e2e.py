@@ -1457,3 +1457,30 @@ def test_p03_fp_worker_pool_aware_default(tmp_path, monkeypatch):
                    "-p", "2"])
     assert rc == 0
     assert os.environ["PC_FFV1_WORKERS"] == "1"  # env respected
+
+
+def test_p03_pooled_batch_io_matches_per_frame_io(tmp_path, monkeypatch):
+    """The whole pooled/batched host frame path (chunk decode into pooled
+    blocks, double-buffered transfers, batched FFV1 writeback) must
+    produce a byte-identical AVPVS + feature sidecar to the per-frame
+    fallback (PC_HOST_BATCH=0) on the same toy chain."""
+    yaml_path = write_db(
+        tmp_path, "P2SXM77", minimal_short_yaml("P2SXM77"),
+        {"SRC000.avi": dict(n=48)},
+    )
+    db = os.path.dirname(yaml_path)
+    rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+    out = os.path.join(db, "avpvs", "P2SXM77_SRC000_HRC000.avi")
+
+    monkeypatch.setenv("PC_HOST_BATCH", "0")
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+    ref_bytes = open(out, "rb").read()
+    ref_sidecar = open(out + ".siti.csv").read()
+
+    monkeypatch.delenv("PC_HOST_BATCH")
+    rc = cli_main(["p03", "-c", yaml_path, "--skip-requirements", "--force"])
+    assert rc == 0
+    assert open(out, "rb").read() == ref_bytes
+    assert open(out + ".siti.csv").read() == ref_sidecar
