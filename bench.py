@@ -939,14 +939,9 @@ def _run_child(env_extra: dict, timeout_s: float) -> tuple[dict | None, str]:
 
 
 def _last_json(text: str) -> dict | None:
-    for line in reversed((text or "").splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    return None
+    from processing_chain_tpu.utils.fsio import last_json_line
+
+    return last_json_line(text)
 
 
 def host_bench() -> dict:

@@ -62,6 +62,7 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
     if store is not None:
         log_mod.get_logger().info("artifact store: %s", store.root)
     telemetry_dir = getattr(args, "telemetry", None)
+    profile_dir = getattr(args, "profile", None)
     live_port = getattr(args, "live_port", None)
     status_file = getattr(args, "status_file", None)
     wd_soft = getattr(args, "watchdog_soft", None)
@@ -69,7 +70,7 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
     live_on = (
         telemetry_dir is not None or live_port is not None
         or status_file is not None or wd_soft is not None
-        or wd_hard is not None
+        or wd_hard is not None or profile_dir is not None
     )
     run_stamp = None
     if live_on:
@@ -134,6 +135,25 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
         )
     tracing_on = getattr(args, "trace", None) is not None
     profiler = tracing.DeviceProfiler(args.trace or None) if tracing_on else None
+    chain_profiler = None
+    if profile_dir is not None:
+        # the performance-attribution capture: resource-monitor thread +
+        # merged host/device timeline, persisted under the run stamp so
+        # run-report and `tools chain-profile` can join the artifacts
+        from .telemetry import profiling as profiling_mod
+
+        if run_stamp is None:
+            run_stamp = telemetry.unique_stamp()
+        chain_profiler = profiling_mod.Profiler(
+            profile_dir,
+            # jax.profiler is one process-wide session: when --trace DIR
+            # requests its own device capture, it owns it — the merged
+            # host timeline here is unaffected
+            device_trace=False if (tracing_on and args.trace) else None,
+        ).start(run_stamp)
+        log_mod.get_logger().info(
+            "profiling to %s (stamp %s)", profile_dir, run_stamp
+        )
     test_config = None
     status = "ok"
     t0 = time.perf_counter()
@@ -179,6 +199,17 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
             live_server.stop()
         if profiler is not None:
             profiler.stop()
+        if chain_profiler is not None:
+            paths = chain_profiler.stop(run_stamp)
+            if paths.get("trace"):
+                log_mod.get_logger().info(
+                    "profile: %s (+ %s)%s — view in chrome://tracing / "
+                    "Perfetto; `tools chain-profile %s` for the summary",
+                    paths["trace"], paths.get("resources", ""),
+                    f" + device trace {paths['device_trace_dir']}"
+                    if paths.get("device_trace_dir") else "",
+                    profile_dir,
+                )
         if store is not None:
             # persist the stat-keyed input digest cache (best-effort by
             # contract) so the next run's plan hashing pays stats, not reads
@@ -218,7 +249,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
     """`tools <name> …` subcommands (reference util/ scripts)."""
     tools = (
         "src-analysis", "complexity", "plots", "metrics", "clean-logs",
-        "run-report", "store", "chain-top",
+        "run-report", "store", "chain-top", "chain-profile", "bench-compare",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -238,6 +269,14 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import chain_top
 
             return chain_top.main(rest)
+        if name == "chain-profile":
+            from .tools import chain_profile
+
+            return chain_profile.main(rest)
+        if name == "bench-compare":
+            from .tools import bench_compare
+
+            return bench_compare.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

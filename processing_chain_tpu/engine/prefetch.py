@@ -23,15 +23,64 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
 from .. import telemetry as tm
 from ..io import bufpool
+from ..telemetry import profiling
 from ..telemetry.heartbeat import HEARTBEATS, NULL_HEARTBEAT, TaskCancelled
 
 _SENTINEL = object()
+_EXHAUSTED = object()
+
+# Live bounded-queue registry: the resource monitor samples current
+# depths by NAME (telemetry/profiling.sample_resources) without holding
+# any pipeline object alive. Entries self-prune via the weakref callback
+# when their queue dies — a run that never reads the depths must not
+# leak one entry per finished pipeline object for the process lifetime.
+_QUEUE_REGISTRY: dict[int, tuple[str, "weakref.ref"]] = {}
+_QUEUE_REG_LOCK = threading.Lock()
+
+
+def _register_queue(name: str, q: queue.Queue) -> None:
+    key = id(q)
+
+    def _gone(_ref, *, _key=key):
+        # lock-free like bufpool's weakref callback: a GC cycle sweep can
+        # fire this on a thread already holding the registry lock, and a
+        # single-key dict.pop is GIL-atomic
+        _QUEUE_REGISTRY.pop(_key, None)
+
+    with _QUEUE_REG_LOCK:
+        _QUEUE_REGISTRY[key] = (name, weakref.ref(q, _gone))
+
+
+def live_queue_depths() -> dict[str, dict]:
+    """{queue name: {"queues": live instances, "depth": summed qsize}} of
+    every registered pipeline queue still alive."""
+    out: dict[str, dict] = {}
+    with _QUEUE_REG_LOCK:
+        # the lock-free callback can pop mid-iteration — retry the (rare)
+        # race instead of excluding it
+        for _ in range(4):
+            try:
+                entries = list(_QUEUE_REGISTRY.values())
+                break
+            except RuntimeError:
+                continue
+        else:
+            entries = []
+    for name, ref in entries:
+        q = ref()
+        if q is None:
+            continue  # callback will prune it
+        entry = out.setdefault(name, {"queues": 0, "depth": 0})
+        entry["queues"] += 1
+        entry["depth"] += q.qsize()
+    return out
 
 # Telemetry handles, bound once at import: every mutation below starts
 # with the registry's enabled check, and the hot loops additionally
@@ -126,6 +175,7 @@ class Prefetcher:
         transform: Optional[Callable[[Any], Any]] = None,
     ) -> None:
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        _register_queue("decode", self._q)
         self._stop = threading.Event()
         self._err: Optional[BaseException] = None
 
@@ -137,7 +187,14 @@ class Prefetcher:
             hb = HEARTBEATS.register("decode-prefetch", kind="prefetch")
             status = "ok"
             try:
-                for item in source:
+                src = iter(source)
+                while True:
+                    # under --profile each pull (the decode of one chunk)
+                    # lands in the span timeline as the decode lane
+                    with profiling.maybe_span("prefetch:decode"):
+                        item = next(src, _EXHAUSTED)
+                    if item is _EXHAUSTED:
+                        break
                     if self._stop.is_set():
                         return
                     hb.check_cancelled()
@@ -214,6 +271,7 @@ class AsyncWriter:
         self._writer = writer
         self._pool = pool or bufpool.DEFAULT_POOL
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        _register_queue("encode", self._q)
         self._err: Optional[BaseException] = None
 
         def worker() -> None:
@@ -253,12 +311,13 @@ class AsyncWriter:
                     # is aborting; weakref bookkeeping reclaims them)
                     continue
                 try:
-                    planes = [np.asarray(p) for p in chunk]
-                    if write_batch is not None:
-                        write_batch(*planes)
-                    else:
-                        for i in range(planes[0].shape[0]):
-                            self._writer.write(*(p[i] for p in planes))
+                    with profiling.maybe_span("writeback:encode"):
+                        planes = [np.asarray(p) for p in chunk]
+                        if write_batch is not None:
+                            write_batch(*planes)
+                        else:
+                            for i in range(planes[0].shape[0]):
+                                self._writer.write(*(p[i] for p in planes))
                     # outputs are on the host now, so any computation that
                     # read the recycled input blocks has completed
                     if recycle:
@@ -340,6 +399,8 @@ class MultiSegmentPrefetcher:
         self._queues = [
             queue.Queue(maxsize=max(1, depth)) for _ in range(self._n)
         ]
+        for q in self._queues:
+            _register_queue("decode", q)
         self._errs: list[Optional[BaseException]] = [None] * self._n
         self._stop = threading.Event()
         self._next = 0  # next unclaimed stream index
@@ -360,7 +421,15 @@ class MultiSegmentPrefetcher:
                         self._next = idx + 1
                     q = self._queues[idx]
                     try:
-                        for item in self._factories[idx]():
+                        src = iter(self._factories[idx]())
+                        while True:
+                            # same decode-lane span as Prefetcher: the
+                            # multiseg path must not read as an idle
+                            # decode lane in a --profile timeline
+                            with profiling.maybe_span("prefetch:decode"):
+                                item = next(src, _EXHAUSTED)
+                            if item is _EXHAUSTED:
+                                break
                             if _put_until_stop(q, item, self._stop, hb):
                                 hb.beat()  # chunk-level liveness
                             if self._stop.is_set():

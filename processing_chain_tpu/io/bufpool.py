@@ -139,12 +139,33 @@ class BufferPool:
 
     def stats(self) -> dict:
         with self._lock:
+            # outstanding bytes resolve the weakrefs on demand (a ~1 Hz
+            # resource-monitor call, never a hot path): refs whose arrays
+            # were dropped without release count as gone, matching the
+            # pool's leak-of-one-allocation accounting. The lock excludes
+            # acquire/release, but the deliberately LOCK-FREE weakref
+            # callback can still pop concurrently — retry the iteration
+            # the (rare) time it mutates the dict under us.
+            for _ in range(4):
+                try:
+                    live = [ref() for ref in list(self._outstanding.values())]
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                live = []
             return {
                 "hits": self.hits,
                 "misses": self.misses,
                 "hit_rate": self.hits / max(1, self.hits + self.misses),
                 "free_blocks": sum(len(v) for v in self._free.values()),
+                "free_bytes": sum(
+                    a.nbytes for v in self._free.values() for a in v
+                ),
                 "outstanding": len(self._outstanding),
+                "outstanding_bytes": sum(
+                    a.nbytes for a in live if a is not None
+                ),
             }
 
 

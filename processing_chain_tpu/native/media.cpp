@@ -1320,15 +1320,32 @@ static int write_video_frame(MPEncoder* e, const uint8_t* planes[4],
         // whatever finished, in order, on this (caller) thread. ctypes
         // released the GIL for this call, so workers and the Python
         // producer genuinely overlap.
+        //
+        // Any error on this path must ALSO latch fp_error: a caller that
+        // keeps writing after a -1 would otherwise enqueue later seqs and
+        // park on fp_cv_done waiting for a seq that was never enqueued;
+        // with the flag latched, every subsequent write fails fast at the
+        // fp_error checks below instead of hanging behind the gap.
+        auto fp_fail = [&](const std::string& msg) {
+            set_err(err, errlen, msg);
+            std::lock_guard<std::mutex> flk(e->fp_mu);
+            if (!e->fp_error) {
+                e->fp_error = true;
+                e->fp_error_msg = msg;
+            }
+            e->fp_cv_done.notify_all();
+            return -1;
+        };
         AVFrame* f = av_frame_alloc();
+        if (!f)
+            return fp_fail("fp frame alloc: out of memory");
         f->format = e->vframe->format;
         f->width = e->vframe->width;
         f->height = e->vframe->height;
         if ((ret = av_frame_get_buffer(f, 0)) < 0 ||
             (ret = fill_vframe(f, planes)) < 0) {
             av_frame_free(&f);
-            set_err(err, errlen, "fp frame alloc/fill: " + av_errstr(ret));
-            return -1;
+            return fp_fail("fp frame alloc/fill: " + av_errstr(ret));
         }
         f->pts = e->vpts++;
         f->pict_type = AV_PICTURE_TYPE_I;

@@ -40,6 +40,7 @@ import numpy as np
 
 from .. import telemetry as tm
 from ..io import bufpool
+from ..telemetry import profiling
 from ..utils.device import shard_map as _shard_map
 
 _XFER_SECONDS = tm.counter(
@@ -314,19 +315,20 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
             ]
             wave_bufs[parity] = bufs
         t_put = time.perf_counter() if tm.enabled() else 0.0
-        for p in range(3):
-            dst = bufs[p]
-            for i in range(n_pvs):
-                blk = blocks[i] if i < len(blocks) else None
-                if blk is None:
-                    dst[i] = 0  # exhausted lane / batch-axis padding
-                else:
-                    np.copyto(dst[i], blk[p])
-        # lane blocks are copied out: recycle them for the decoders
-        for blk in blocks:
-            if blk is not None:
-                pool.release(*blk)
-        dev = [jax.device_put(bufs[p], sharding) for p in range(3)]
+        with profiling.maybe_span("transfer:device_put"):
+            for p in range(3):
+                dst = bufs[p]
+                for i in range(n_pvs):
+                    blk = blocks[i] if i < len(blocks) else None
+                    if blk is None:
+                        dst[i] = 0  # exhausted lane / batch-axis padding
+                    else:
+                        np.copyto(dst[i], blk[p])
+            # lane blocks are copied out: recycle them for the decoders
+            for blk in blocks:
+                if blk is not None:
+                    pool.release(*blk)
+            dev = [jax.device_put(bufs[p], sharding) for p in range(3)]
         if tm.enabled():
             _XFER_PUT_S.inc(time.perf_counter() - t_put)
             _XFER_PUT_B.inc(sum(b.nbytes for b in bufs) + prev.nbytes)
@@ -340,10 +342,12 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
         # step for block k runs (dispatch above is async)
         nxt = gather_put()
         if tm.enabled():
-            out = jax.block_until_ready(out)
+            with profiling.maybe_span("device:wave_step"):
+                out = jax.block_until_ready(out)
             t_get = time.perf_counter()
-            host = [np.asarray(o) for o in out[:3]]
-            si_h, ti_h = np.asarray(out[3]), np.asarray(out[4])
+            with profiling.maybe_span("transfer:device_get"):
+                host = [np.asarray(o) for o in out[:3]]
+                si_h, ti_h = np.asarray(out[3]), np.asarray(out[4])
             _XFER_GET_S.inc(time.perf_counter() - t_get)
             _XFER_GET_B.inc(sum(h.nbytes for h in host))
         else:

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import telemetry as tm
+from ..telemetry import profiling
 from ..telemetry.heartbeat import HEARTBEATS
 from ..ops import metrics as metrics_ops
 from ..ops import resize as resize_ops
@@ -66,7 +67,13 @@ def _instrument_step(fn, step: str):
         hb = HEARTBEATS.register(step, kind="device_step")
         t0 = time.perf_counter()
         try:
-            out = jax.block_until_ready(fn(*args, **kwargs))
+            # under --profile, the device:<step> span lands in the merged
+            # timeline on the tracer's perf_counter clock (same domain as
+            # every host span) and TraceAnnotation labels the dispatch
+            # inside a live jax.profiler capture; both no-op otherwise
+            with profiling.maybe_span(f"device:{step}"), \
+                    profiling.device_annotation(step):
+                out = jax.block_until_ready(fn(*args, **kwargs))
         except BaseException:
             hb.finish("fail")
             raise

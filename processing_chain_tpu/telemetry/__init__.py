@@ -123,8 +123,16 @@ def stage_span(stage: str, **fields) -> Iterator[None]:
     if not REGISTRY.enabled and not HEARTBEATS.enabled:
         yield
         return
+    from . import profiling as _profiling
+
     before = (
         FRAMES_DECODED.get(), FRAMES_ENCODED.get(), BYTES_ENCODED.get(),
+    )
+    # component seconds (decode/encode blocked time, device transfer,
+    # device step) diffed across the stage: the per-stage grounding of
+    # the attribution engine's bottleneck verdicts
+    before_comp = (
+        _profiling.components_from_live()[0] if REGISTRY.enabled else None
     )
     emit("stage_start", stage=stage, **fields)
     HEARTBEATS.stage_begin(stage)
@@ -139,6 +147,17 @@ def stage_span(stage: str, **fields) -> Iterator[None]:
         wall = time.perf_counter() - t0
         STAGE_SECONDS.labels(stage=stage).set(wall)
         HEARTBEATS.stage_end(stage, status)
+        extra = dict(fields)
+        if before_comp is not None:
+            # only components measured by the END of the stage get a
+            # delta (a series born mid-stage starts from 0); components
+            # with no series at all stay absent — the attribution engine
+            # reports them as unmeasured instead of zero
+            after_comp = _profiling.components_from_live()[0]
+            extra["components"] = {
+                comp: round(total - before_comp.get(comp, 0.0), 4)
+                for comp, total in after_comp.items()
+            }
         emit(
             "stage_end",
             stage=stage,
@@ -147,7 +166,7 @@ def stage_span(stage: str, **fields) -> Iterator[None]:
             frames_decoded=FRAMES_DECODED.get() - before[0],
             frames_encoded=FRAMES_ENCODED.get() - before[1],
             bytes_encoded=BYTES_ENCODED.get() - before[2],
-            **fields,
+            **extra,
         )
 
 

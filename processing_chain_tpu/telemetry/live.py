@@ -62,6 +62,15 @@ def build_status() -> dict:
         "frames_encoded": FRAMES_ENCODED.get(),
         "bytes_encoded": BYTES_ENCODED.get(),
     }
+    # current resources (RSS, pool bytes, queue depths) ride every status
+    # document even when the full --profile monitor is off, so chain-top
+    # can show memory on any live run; one cheap /proc + stats() sweep
+    try:
+        from . import profiling
+
+        doc["resources"] = profiling.sample_resources()
+    except Exception:  # noqa: BLE001 - /status must render on every platform
+        pass
     return doc
 
 

@@ -204,6 +204,31 @@ class MetricsRegistry:
             for metric in self._metrics.values():
                 metric._values.clear()
 
+    def sum_series(self, name: str, labels: Optional[dict] = None) -> Optional[float]:
+        """Sum of the current values (counter/gauge) or observation sums
+        (histogram) across one metric's series matching `labels` (all
+        series when None). Returns None when NO matching series has ever
+        recorded — callers that must distinguish 'never measured' from
+        'measured zero' (the attribution engine) need exactly that, and
+        a full snapshot() of every metric to read one name would stall
+        concurrent updates for nothing."""
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                return None
+            total, found = 0.0, False
+            for key, value in metric._values.items():
+                if labels is not None and dict(zip(metric.labelnames, key)) != {
+                    k: str(v) for k, v in labels.items()
+                }:
+                    continue
+                found = True
+                total += (
+                    float(value[1][0]) if metric.kind == "histogram"
+                    else float(value)
+                )
+            return total if found else None
+
     def snapshot(self) -> dict:
         """JSON-able view of every series."""
         out: dict = {}

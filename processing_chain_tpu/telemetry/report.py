@@ -38,6 +38,8 @@ class RunData:
     metrics: dict = field(default_factory=dict)
     events: list = field(default_factory=list)
     trace: dict = field(default_factory=dict)
+    #: resources_<ts>.json timeseries when the run carried --profile
+    resources: dict = field(default_factory=dict)
     #: events JSONL present but no metrics snapshot: the run crashed (or
     #: is still in flight) before telemetry.write_outputs persisted it
     partial: bool = False
@@ -105,6 +107,13 @@ def load_run(directory: str, stamp: Optional[str] = None) -> RunData:
     if os.path.isfile(trace_path):
         with open(trace_path) as f:
             run.trace = json.load(f)
+    resources_path = os.path.join(directory, f"resources_{stamp}.json")
+    if os.path.isfile(resources_path):
+        try:
+            with open(resources_path) as f:
+                run.resources = json.load(f)
+        except (OSError, ValueError):
+            pass  # a torn/unreadable profile sidecar must not sink the report
     return run
 
 
@@ -335,6 +344,116 @@ def _stall_section(run: RunData) -> list[str]:
     return lines
 
 
+def _host_path_section(run: RunData) -> list[str]:
+    """The PR 4 host frame path: buffer-pool recycling, chunk-granular
+    native I/O crossings, and host<->device transfer volume — the
+    metrics that explain whether the batched path was actually engaged."""
+    hits = _value(run, "chain_bufpool_hits_total")
+    misses = _value(run, "chain_bufpool_misses_total")
+    recycled = _value(run, "chain_bufpool_recycled_bytes_total")
+    io_calls = _by_label(run, "chain_io_batch_calls_total", "op")
+    xfer_s = _by_label(run, "chain_device_transfer_seconds_total", "direction")
+    xfer_b = _by_label(run, "chain_device_transfer_bytes_total", "direction")
+    if not (hits or misses or io_calls or xfer_s):
+        return []
+    lines = []
+    if hits or misses:
+        rate = hits / max(1.0, hits + misses)
+        lines.append(
+            f"  buffer pool: {int(hits)} hits / {int(misses)} misses "
+            f"(hit rate {rate:.2f}), {recycled / 1e6:.1f} MB recycled"
+        )
+        if rate < 0.25 and hits + misses >= 8:
+            lines.append(
+                "    note: low hit rate — chunk geometries churn faster "
+                "than the free lists recycle (mixed resolutions?)"
+            )
+    decoded = _value(run, "chain_frames_decoded_total")
+    encoded = _value(run, "chain_frames_encoded_total")
+    for op, s in sorted(io_calls.items()):
+        calls = float(s.get("value", 0.0))
+        if not calls:
+            continue
+        frames = decoded if op == "decode" else encoded
+        lines.append(
+            f"  native {op} crossings: {int(calls)} "
+            f"(~{frames / calls:.1f} frames per GIL release)"
+        )
+    if not io_calls and (decoded or encoded):
+        lines.append(
+            "  no batched native I/O crossings — per-frame fallback "
+            "(PC_HOST_BATCH=0 or a non-batch reader/writer)"
+        )
+    for direction, s in sorted(xfer_s.items()):
+        seconds = float(s.get("value", 0.0))
+        mb = float(xfer_b.get(direction, {}).get("value", 0.0)) / 1e6
+        if seconds or mb:
+            lines.append(
+                f"  device {direction}: {mb:.1f} MB in {seconds:.2f}s"
+                + (f" ({mb / seconds:.0f} MB/s)" if seconds > 1e-9 else "")
+            )
+    return lines
+
+
+def _attribution_section(run: RunData) -> list[str]:
+    """Per-stage bottleneck verdicts from the attribution engine
+    (telemetry/profiling.py): stage_end component deltas when present,
+    else one whole-run verdict from the global metrics."""
+    from .profiling import attribute_run
+
+    verdicts = attribute_run(run.metrics, run.events)
+    if not verdicts:
+        return []
+    lines = []
+    for stage, v in verdicts.items():
+        contributors = ", ".join(
+            f"{c['component']} {c['pct']}% ({c['seconds']:.2f}s)"
+            for c in v["contributors"]
+        )
+        if v.get("insufficient_data"):
+            lines.append(
+                f"  {stage}: balanced (insufficient data — measured "
+                f"components total {v['total_s']:.3f}s"
+                + (f"; {contributors}" if contributors else "") + ")"
+            )
+        else:
+            lines.append(f"  {stage}: {v['verdict']} — {contributors}")
+        if v.get("missing"):
+            lines.append(
+                f"    unmeasured: {', '.join(v['missing'])} (no series "
+                "recorded — component idle or instrumentation not on this "
+                "path)"
+            )
+    return lines
+
+
+def _resources_section(run: RunData) -> list[str]:
+    """Peaks from the --profile resource timeseries when present, else
+    the last-known resource gauges from the metrics snapshot."""
+    lines = []
+    res = run.resources
+    if res:
+        from .profiling import format_resource_peaks, resource_peaks
+
+        lines.append(
+            f"  {res.get('n_samples', 0)} samples @ "
+            f"{res.get('interval_s', '?')}s"
+        )
+        lines.extend(f"  {l}" for l in format_resource_peaks(resource_peaks(res)))
+        return lines
+    rss = _value(run, "chain_resource_rss_bytes")
+    if rss:
+        lines.append(f"  last rss: {rss / 1e6:.0f} MB")
+        pool_out = _value(run, "chain_bufpool_outstanding_bytes")
+        pool_free = _value(run, "chain_bufpool_free_bytes")
+        if pool_out or pool_free:
+            lines.append(
+                f"  pool bytes: {pool_out / 1e6:.0f} MB outstanding, "
+                f"{pool_free / 1e6:.0f} MB free"
+            )
+    return lines
+
+
 def _device_section(run: RunData) -> list[str]:
     compiles = _events(run, "device_step")
     steps = _by_label(run, "chain_device_step_seconds", "step")
@@ -370,6 +489,15 @@ def render_report(run: RunData) -> str:
         "top spans:\n" + "\n".join(f"  {l}" for l in _spans_section(run)),
         "pipeline:\n" + "\n".join(_stall_section(run)),
     ]
+    attribution = _attribution_section(run)
+    if attribution:
+        parts.append("bottleneck attribution:\n" + "\n".join(attribution))
+    host_path = _host_path_section(run)
+    if host_path:
+        parts.append("host frame path:\n" + "\n".join(host_path))
+    resources = _resources_section(run)
+    if resources:
+        parts.append("resources:\n" + "\n".join(resources))
     device = _device_section(run)
     if device:
         parts.append("\n".join(device))

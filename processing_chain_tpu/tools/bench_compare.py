@@ -1,0 +1,256 @@
+"""bench-compare: the bench-regression guard (docs/PERF.md).
+
+    python -m processing_chain_tpu tools bench-compare [--baseline PATH]
+    python tools/bench_compare.py --from measured.json      # offline diff
+
+Measures the host frame path fresh (`bench.py --host-bench`, the tracked
+e2e-gap metric), folds in the cached kernel number (BENCH_LIVE.json —
+the last measured-on-TPU figure this code reproduced), and diffs the
+flat measurement set against a committed baseline (BENCH_BASELINE.json)
+with per-metric tolerance bands. Exits nonzero on any regression, so CI
+can refuse a PR that silently gives back the PR 4/PR 5 wins.
+
+Band kinds (each baseline entry picks one):
+
+  floor_frac  pass while measured >= value * (1 - tolerance) — the fps
+              family; tolerances are generous because shared CI runners
+              jitter, and the gate exists for collapses, not noise
+  ceil_frac   pass while measured <= value * (1 + tolerance) — for
+              lower-is-better metrics (seconds, bytes)
+  floor_abs   pass while measured >= tolerance (absolute floor — e.g.
+              the pool must actually recycle)
+  exact       measured must equal value — the parity booleans
+
+Entries with "required": false are skipped with a note when the metric
+is absent (the kernel number needs a TPU-measured cache; a fresh CI
+checkout has none). `--update` rewrites the baseline's values from the
+current measurement, keeping every band.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Optional, Sequence
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_BASELINE = os.path.join(_REPO, "BENCH_BASELINE.json")
+
+#: host-bench JSON fields folded into the flat measurement set
+_HOST_FIELDS = (
+    "decode_fps", "decode_batch_fps", "encode_fps", "encode_batch_fps",
+    "decode_parity", "encode_parity", "pool_hit_rate",
+)
+
+
+class BenchCompareError(ValueError):
+    """Unusable baseline/measurement input."""
+
+
+def measure(timeout_s: float = 600.0) -> dict[str, object]:
+    """Fresh flat measurement set: `bench.py --host-bench` in a child
+    (pinned to the CPU backend — the host path is a host metric) plus
+    the cached kernel numbers when a live TPU capture exists."""
+    out: dict[str, object] = {}
+    bench = os.path.join(_REPO, "bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, bench, "--host-bench"],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=_REPO,
+    )
+    from ..utils.fsio import last_json_line
+
+    host = last_json_line(proc.stdout)
+    if proc.returncode != 0 or host is None:
+        raise BenchCompareError(
+            "bench.py --host-bench failed "
+            f"(rc={proc.returncode}): {(proc.stderr or '')[-400:]}"
+        )
+    for field in _HOST_FIELDS:
+        if field in host:
+            out[f"host.{field}"] = host[field]
+    live_path = os.environ.get(
+        "PC_BENCH_LIVE_FILE", os.path.join(_REPO, "BENCH_LIVE.json")
+    )
+    try:
+        with open(live_path) as f:
+            live = json.load(f)
+        # the live cache stores the raw per-step time; fps and the
+        # vs-baseline ratio derive exactly as bench.py main() does
+        if live.get("platform") == "tpu" and float(live.get("per_step", 0)) > 0:
+            fps = float(live.get("t", 8)) / float(live["per_step"])
+            out["kernel.fps_per_chip"] = round(fps, 2)
+            base_path = os.environ.get(
+                "PC_BASELINE_FILE", os.path.join(_REPO, "BASELINE_MEASURED.json")
+            )
+            with open(base_path) as f:
+                base8 = float(json.load(f)["baseline_8core_fps"])
+            if base8 > 0:
+                out["kernel.vs_baseline"] = round(fps / base8, 2)
+    except (OSError, ValueError, KeyError):
+        pass  # no cached kernel measurement on this host — optional metrics
+    return out
+
+
+def compare_one(spec: dict, measured: object) -> tuple[bool, str]:
+    """(passed, band description) for one metric against its baseline
+    entry. Raises on a malformed spec — a broken gate must fail loudly,
+    not pass silently."""
+    kind = spec.get("kind", "floor_frac")
+    value = spec.get("value")
+    tol = float(spec.get("tolerance", 0.0))
+    if kind == "exact":
+        return measured == value, f"== {value!r}"
+    m = float(measured)  # bool parity never reaches here
+    if kind == "floor_frac":
+        floor = float(value) * (1.0 - tol)
+        return m >= floor, f">= {floor:.4g} ({value} -{tol * 100:.0f}%)"
+    if kind == "ceil_frac":
+        ceil = float(value) * (1.0 + tol)
+        return m <= ceil, f"<= {ceil:.4g} ({value} +{tol * 100:.0f}%)"
+    if kind == "floor_abs":
+        return m >= tol, f">= {tol:.4g} (absolute)"
+    raise BenchCompareError(f"unknown band kind {kind!r}")
+
+
+def compare(baseline: dict, measured: dict) -> dict:
+    """Full diff: {rows: [...], failures: n, skipped: n, checked: n}."""
+    metrics = baseline.get("metrics")
+    if not isinstance(metrics, dict) or not metrics:
+        raise BenchCompareError("baseline has no metrics section")
+    rows = []
+    failures = skipped = gated = 0
+    for name in sorted(metrics):
+        spec = metrics[name]
+        if name not in measured:
+            if spec.get("required", True):
+                gated += 1
+                failures += 1
+                rows.append((name, spec.get("value"), "MISSING", "-", "FAIL"))
+            else:
+                skipped += 1
+                rows.append((name, spec.get("value"), "absent", "-", "skip"))
+            continue
+        got = measured[name]
+        try:
+            ok, band = compare_one(spec, got)
+        except (TypeError, ValueError) as exc:
+            raise BenchCompareError(f"metric {name}: {exc}") from exc
+        gated += 1
+        if not ok:
+            failures += 1
+        rows.append((name, spec.get("value"), got, band, "ok" if ok else "FAIL"))
+    return {
+        "rows": rows, "failures": failures, "skipped": skipped,
+        "checked": gated - failures, "gated": gated,
+    }
+
+
+def render(result: dict) -> str:
+    header = ("metric", "baseline", "measured", "band", "status")
+    rows = [tuple(str(c) for c in r) for r in result["rows"]]
+    widths = [len(h) for h in header]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+
+    out = [line(header), line(tuple("-" * w for w in widths))]
+    out.extend(line(r) for r in rows)
+    verdict = (
+        f"bench-compare: REGRESSION — {result['failures']} of "
+        f"{result['gated']} gated metrics out of band"
+        if result["failures"]
+        else f"bench-compare: OK ({result['gated']} metrics in band, "
+        f"{result['skipped']} optional skipped)"
+    )
+    out.append(verdict)
+    return "\n".join(out) + "\n"
+
+
+def update_baseline(baseline: dict, measured: dict) -> dict:
+    """New baseline document: measured values swapped in, bands kept."""
+    out = json.loads(json.dumps(baseline))  # deep copy
+    for name, spec in out.get("metrics", {}).items():
+        if name in measured:
+            spec["value"] = measured[name]
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Diff a fresh bench measurement against the committed "
+        "baseline; exit nonzero on regression"
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="baseline JSON with per-metric tolerance bands",
+    )
+    parser.add_argument(
+        "--from", dest="from_file", default=None, metavar="FILE",
+        help="compare a pre-measured flat JSON instead of benching now "
+        "(offline diffs, the CI injected-regression self-test)",
+    )
+    parser.add_argument(
+        "--save", default=None, metavar="FILE",
+        help="also write the flat measurement set to FILE",
+    )
+    parser.add_argument(
+        "--update", action="store_true",
+        help="rewrite the baseline's values from this measurement "
+        "(bands kept) instead of gating",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable result instead of the table",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"bench-compare: cannot load baseline {args.baseline}: {exc}")
+        return 2
+    try:
+        if args.from_file:
+            with open(args.from_file) as f:
+                measured = json.load(f)
+        else:
+            measured = measure()
+    except (OSError, ValueError, subprocess.TimeoutExpired,
+            BenchCompareError) as exc:
+        print(f"bench-compare: measurement failed: {exc}")
+        return 2
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(measured, f, indent=1, sort_keys=True)
+    if args.update:
+        doc = update_baseline(baseline, measured)
+        with open(args.baseline, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"bench-compare: baseline {args.baseline} updated")
+        return 0
+    try:
+        result = compare(baseline, measured)
+    except BenchCompareError as exc:
+        print(f"bench-compare: {exc}")
+        return 2
+    if args.as_json:
+        print(json.dumps(result, indent=1, default=str))
+    else:
+        print(render(result), end="")
+    return 1 if result["failures"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -144,6 +144,24 @@ def render(status: dict, note: str = "") -> str:
             f"encoded {int(counters.get('frames_encoded', 0))} frames "
             f"({counters.get('bytes_encoded', 0) / 1e6:.1f} MB)"
         )
+    resources = status.get("resources", {})
+    if resources:
+        rss = resources.get("rss_bytes") or 0
+        parts = [f"rss {rss / 1e6:.0f} MB"]
+        if resources.get("cpu_percent") is not None:
+            parts.append(f"cpu {resources['cpu_percent']:.0f}%")
+        parts.append(
+            f"pool {resources.get('pool_outstanding_bytes', 0) / 1e6:.0f}"
+            f"+{resources.get('pool_free_bytes', 0) / 1e6:.0f} MB"
+        )
+        if resources.get("open_fds") is not None:
+            parts.append(f"fds {resources['open_fds']}")
+        for queue, depth in sorted(resources.get("queues", {}).items()):
+            parts.append(f"q:{queue} {depth}")
+        dev = resources.get("device_memory", {})
+        if dev.get("bytes_in_use") is not None:
+            parts.append(f"hbm {dev['bytes_in_use'] / 1e6:.0f} MB")
+        lines.append("resources: " + "  ".join(parts))
     recent = status.get("recent", [])
     failed = [r for r in recent if r.get("status") not in ("ok", "")]
     if failed:

@@ -2,8 +2,9 @@
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Callable
+from typing import Callable, Optional
 
 
 def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
@@ -19,3 +20,18 @@ def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
         if os.path.isfile(tmp):
             os.unlink(tmp)
         raise
+
+
+def last_json_line(text: Optional[str]) -> Optional[dict]:
+    """Last parseable JSON-object line of mixed stdout — the contract of
+    tools that print one JSON record after arbitrary logging (bench.py,
+    its bench-compare consumer). One home so producer and consumer can
+    never drift apart on the framing."""
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None

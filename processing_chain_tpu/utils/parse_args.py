@@ -133,6 +133,15 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
         "and trace_<ts>.json into DIR (render with tools/run_report.py)",
     )
     parser.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="performance-attribution capture (docs/TELEMETRY.md "
+        "'Profiling & attribution'): sample RSS/CPU/pool/queue/device-"
+        "memory resources and write a merged host+device Chrome trace "
+        "(profile_<ts>.trace.json, view in chrome://tracing or Perfetto) "
+        "plus resources_<ts>.json into DIR. Implies telemetry collection; "
+        "pair with --telemetry DIR for bottleneck verdicts in run-report",
+    )
+    parser.add_argument(
         "--live-port", default=None, type=int, metavar="PORT",
         help="serve live observability on PORT while the run is in "
         "flight: /healthz, /metrics (Prometheus, live), /status (JSON "

@@ -41,13 +41,20 @@ class Span:
 class Tracer:
     """Thread-safe span recorder. Spans nest per-thread (depth tracks the
     nesting so reports can indent); recording is cheap enough to leave on —
-    a report is only materialized on demand."""
+    a report is only materialized on demand.
 
-    def __init__(self) -> None:
+    Bounded like the event log: the per-chunk lane spans a `--profile`
+    capture adds (prefetch/writeback/transfer/device) accrue for the
+    whole process, and a week-long profiled run must degrade to dropped
+    spans + a counter in the report, never to unbounded host memory."""
+
+    def __init__(self, max_spans: int = 200_000) -> None:
         self._lock = threading.Lock()
         self._spans: list[Span] = []
         self._local = threading.local()
         self._t0 = time.perf_counter()
+        self.max_spans = max_spans
+        self.dropped = 0
         self.enabled = True
 
     @contextmanager
@@ -64,16 +71,19 @@ class Tracer:
             dur = time.perf_counter() - start
             self._local.depth = depth
             with self._lock:
-                self._spans.append(
-                    Span(
-                        name=name,
-                        start=start - self._t0,
-                        duration=dur,
-                        thread=threading.current_thread().name,
-                        depth=depth,
-                        meta=meta,
+                if len(self._spans) >= self.max_spans:
+                    self.dropped += 1
+                else:
+                    self._spans.append(
+                        Span(
+                            name=name,
+                            start=start - self._t0,
+                            duration=dur,
+                            thread=threading.current_thread().name,
+                            depth=depth,
+                            meta=meta,
+                        )
                     )
-                )
 
     def spans(self) -> list[Span]:
         with self._lock:
@@ -82,6 +92,7 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self.dropped = 0
         self._t0 = time.perf_counter()
 
     def summary(self) -> dict[str, dict]:
@@ -112,6 +123,7 @@ class Tracer:
         path = os.path.join(logs_dir, f"trace_{stamp}.json")
         payload = {
             "summary": self.summary(),
+            **({"dropped_spans": self.dropped} if self.dropped else {}),
             "spans": [
                 {
                     "name": s.name,

@@ -1,0 +1,590 @@
+"""Profiling & performance-attribution tests (docs/TELEMETRY.md
+"Profiling & attribution"): the bottleneck classifier on synthetic
+component snapshots (every verdict class, balanced, missing-metric
+degradation), resource-monitor start/stop + bounded buffer, merged
+Chrome-trace validity (valid JSON, host and device events in one clock
+domain), bench-compare pass/fail/tolerance edges, and the satellites
+(per-stage component deltas in stage_end, /status resources section,
+host-frame-path report section)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.telemetry import profiling
+from processing_chain_tpu.telemetry import report as report_mod
+from processing_chain_tpu.tools import bench_compare as bc
+from processing_chain_tpu.tools import chain_profile as cp
+from processing_chain_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    tm.reset()
+    tm.enable()
+    yield
+    tm.disable()
+    tm.reset()
+
+
+# ------------------------------------------------------------- classifier
+
+
+def _verdict(components, **kw):
+    return profiling.classify_components(components, **kw)["verdict"]
+
+
+def test_classifier_each_bound_class():
+    base = {"decode": 0.5, "encode": 0.5, "transfer": 0.5, "compute": 0.5}
+    for comp in ("decode", "encode", "transfer", "compute"):
+        components = dict(base)
+        components[comp] = 20.0
+        assert _verdict(components) == f"{comp}_bound", comp
+
+
+def test_classifier_balanced_when_no_dominator():
+    # two near-equal contributors: top holds >40% but lacks the 1.5x lead
+    assert _verdict({"decode": 1.0, "encode": 1.1}) == "balanced"
+    # flat four-way split
+    assert _verdict(
+        {"decode": 1.0, "encode": 1.0, "transfer": 1.0, "compute": 1.0}
+    ) == "balanced"
+
+
+def test_classifier_dominance_and_lead_edges():
+    # exactly at the dominance threshold with a clear lead -> bound
+    out = profiling.classify_components(
+        {"a": 4.0, "b": 2.0, "c": 2.0, "d": 2.0},
+        dominance=0.4, lead=1.5,
+    )
+    assert out["verdict"] == "a_bound"
+    assert out["contributors"][0]["pct"] == 40.0
+    # same shares but a weaker lead requirement failure -> balanced
+    assert _verdict({"a": 4.0, "b": 3.0, "c": 2.0, "d": 1.0}) == "balanced"
+
+
+def test_classifier_insufficient_data_still_reports_contributors():
+    out = profiling.classify_components({"decode": 0.01, "encode": 0.002})
+    assert out["verdict"] == "balanced"
+    assert out["insufficient_data"] is True
+    # the percentages are still there for the report to print
+    assert out["contributors"][0]["component"] == "decode"
+
+
+def test_classifier_missing_metric_degradation():
+    # None entries and explicitly-missing components degrade, not crash
+    out = profiling.classify_components(
+        {"decode": 6.0, "compute": None}, missing=["transfer"]
+    )
+    assert out["verdict"] == "decode_bound"
+    assert set(out["missing"]) == {"compute", "transfer"}
+    # nothing measured at all
+    out = profiling.classify_components({}, missing=list(profiling.COMPONENT_METRICS))
+    assert out["verdict"] == "balanced" and out["insufficient_data"]
+
+
+def test_components_from_metrics_distinguishes_absent_from_zero():
+    snap = {
+        "chain_pipeline_wait_seconds_total": {"series": [
+            {"labels": {"side": "consumer"}, "value": 3.5},
+            {"labels": {"side": "producer"}, "value": 0.0},
+        ]},
+        # no device metrics at all -> transfer/compute MISSING
+    }
+    components, missing = profiling.components_from_metrics(snap)
+    assert components == {"decode": 3.5, "encode": 0.0}
+    assert set(missing) == {"transfer", "compute"}
+
+
+def test_stage_span_embeds_component_deltas():
+    wait = tm.counter(
+        "chain_pipeline_wait_seconds_total",
+        "time the pipeline spent blocked on a bounded queue, by side",
+        ("side",),
+    )
+    with tm.stage_span("pX"):
+        wait.labels(side="consumer").inc(2.5)
+        wait.labels(side="producer").inc(0.0)  # measured zero, not absent
+    end = [e for e in tm.EVENTS.records() if e["event"] == "stage_end"][-1]
+    assert end["components"]["decode"] == pytest.approx(2.5)
+    assert end["components"]["encode"] == 0.0
+    # never-recorded components stay ABSENT (reported as unmeasured by
+    # the attribution engine), never as measured zeros
+    assert "transfer" not in end["components"]
+    assert "compute" not in end["components"]
+    verdicts = profiling.attribute_run({}, [end])
+    assert set(verdicts["pX"]["missing"]) == {"transfer", "compute"}
+
+
+def test_components_from_live_distinguishes_absent_from_zero():
+    components, missing = profiling.components_from_live()
+    assert "decode" in missing  # clean registry: nothing recorded yet
+    tm.counter(
+        "chain_pipeline_wait_seconds_total",
+        "time the pipeline spent blocked on a bounded queue, by side",
+        ("side",),
+    ).labels(side="consumer").inc(1.5)
+    components, missing = profiling.components_from_live()
+    assert components["decode"] == pytest.approx(1.5)
+    assert "decode" not in missing and "compute" in missing
+
+
+def test_registry_sum_series_targeted_read():
+    hist = tm.histogram("chain_device_step_seconds_t", "t", ("step",))
+    assert tm.REGISTRY.sum_series("chain_device_step_seconds_t") is None
+    hist.labels(step="a").observe(1.0)
+    hist.labels(step="b").observe(2.0)
+    assert tm.REGISTRY.sum_series(
+        "chain_device_step_seconds_t"
+    ) == pytest.approx(3.0)
+    assert tm.REGISTRY.sum_series(
+        "chain_device_step_seconds_t", {"step": "a"}
+    ) == pytest.approx(1.0)
+    assert tm.REGISTRY.sum_series("no_such_metric") is None
+
+
+def test_attribute_run_prefers_stage_components_and_degrades():
+    events = [
+        {"event": "stage_end", "stage": "p03", "duration_s": 10.0,
+         "components": {"decode": 8.0, "encode": 0.5, "transfer": 0.2,
+                        "compute": 0.4}},
+    ]
+    verdicts = profiling.attribute_run({}, events)
+    assert verdicts["p03"]["verdict"] == "decode_bound"
+    # no component-carrying events: one whole-run verdict from metrics
+    snap = {
+        "chain_pipeline_wait_seconds_total": {"series": [
+            {"labels": {"side": "producer"}, "value": 9.0},
+            {"labels": {"side": "consumer"}, "value": 1.0},
+        ]},
+    }
+    verdicts = profiling.attribute_run(snap, [])
+    assert list(verdicts) == ["run"]
+    assert verdicts["run"]["verdict"] == "encode_bound"
+
+
+# -------------------------------------------------------- resource monitor
+
+
+def test_sample_resources_basics():
+    s = profiling.sample_resources()
+    assert s["rss_bytes"] is None or s["rss_bytes"] > 1_000_000
+    assert s["open_fds"] is None or s["open_fds"] > 0
+    assert s["pool_free_bytes"] >= 0 and s["pool_outstanding_bytes"] >= 0
+    assert isinstance(s["queues"], dict)
+
+
+def test_sample_resources_sees_pool_and_queues():
+    import numpy as np
+
+    from processing_chain_tpu.engine import prefetch as pf
+    from processing_chain_tpu.io.bufpool import BufferPool, DEFAULT_POOL
+
+    block = DEFAULT_POOL.acquire((4, 8, 8), np.uint8)
+    try:
+        # a live prefetcher registers its queue under "decode"
+        release = threading.Event()
+
+        def slow():
+            yield [np.zeros((2, 8, 8), np.uint8)]
+            release.wait(5.0)
+
+        with pf.Prefetcher(slow(), depth=2):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                s = profiling.sample_resources()
+                if "decode" in s["queues"]:
+                    break
+                time.sleep(0.01)
+            release.set()
+        assert "decode" in s["queues"]
+        assert s["pool_outstanding_bytes"] >= block.nbytes
+    finally:
+        release.set()
+        DEFAULT_POOL.release(block)
+    # gauges mirrored while enabled
+    snap = tm.REGISTRY.snapshot()
+    assert "chain_bufpool_outstanding_bytes" in snap
+
+
+def test_cpu_tracker_intervals_are_private_and_quantization_guarded():
+    a, b = profiling._CpuTracker(), profiling._CpuTracker()
+    assert a.percent() is None  # first call: no baseline yet
+    # an immediate re-poll is under the tick-quantization floor: None,
+    # and the baseline survives for the next honest interval
+    assert a.percent() is None
+    baseline_a = a._last
+    assert baseline_a is not None
+    assert a.percent() is None and a._last == baseline_a
+    # a second tracker keeps its own interval entirely
+    assert b.percent() is None
+    assert b._last != baseline_a
+
+
+def test_queue_registry_self_prunes_dead_queues():
+    # key-specific (not global counts): the shared registry holds entries
+    # from other tests whose queues gc.collect() may reap concurrently
+    import gc
+
+    from processing_chain_tpu.engine import prefetch as pf
+
+    p = pf.Prefetcher(iter([]), depth=1)
+    key = id(p._q)
+    assert key in pf._QUEUE_REGISTRY
+    p.close()
+    del p
+    gc.collect()
+    assert key not in pf._QUEUE_REGISTRY  # weakref callback pruned it
+
+
+def test_resource_monitor_start_stop_and_bounded_buffer():
+    mon = profiling.ResourceMonitor(interval_s=0.02, max_samples=7)
+    mon.start()
+    mon.start()  # idempotent
+    time.sleep(0.3)
+    mon.stop()
+    mon.stop()  # idempotent
+    n = len(mon.samples())
+    assert 1 <= n <= 7  # bounded despite ~15 ticks
+    ts = mon.to_timeseries()
+    assert ts["n_samples"] == n
+    json.dumps(ts)  # JSON-able
+    # restartable
+    mon.start()
+    mon.stop()
+
+
+def test_bufpool_stats_byte_accounting():
+    import numpy as np
+
+    from processing_chain_tpu.io.bufpool import BufferPool
+
+    pool = BufferPool()
+    a = pool.acquire((8, 16), np.uint8)
+    stats = pool.stats()
+    assert stats["outstanding_bytes"] == a.nbytes and stats["free_bytes"] == 0
+    pool.release(a)
+    stats = pool.stats()
+    assert stats["free_bytes"] == a.nbytes and stats["outstanding_bytes"] == 0
+
+
+def test_stale_queue_gauges_zeroed_when_queue_dies():
+    import gc
+
+    from processing_chain_tpu.engine import prefetch as pf
+
+    release = threading.Event()
+
+    def src():
+        yield 1
+        yield 2
+        release.wait(5.0)
+
+    p = pf.Prefetcher(src(), depth=2)
+    deadline = time.monotonic() + 5.0
+    depth = 0
+    while time.monotonic() < deadline and depth == 0:
+        depth = profiling.sample_resources()["queues"].get("decode", 0)
+        time.sleep(0.01)
+    assert depth > 0
+    release.set()
+    p.close()
+    del p
+    gc.collect()
+    profiling.sample_resources()  # queue gone: its gauge must read 0
+    assert tm.REGISTRY.sum_series(
+        "chain_resource_queue_depth", {"queue": "decode"}
+    ) == 0.0
+
+
+def test_tracer_span_cap_bounds_memory_and_reports_drops():
+    tracer = tracing.Tracer(max_spans=5)
+    for _ in range(9):
+        with tracer.span("x"):
+            pass
+    assert len(tracer.spans()) == 5 and tracer.dropped == 4
+    payload_path = tracer.write_report("/tmp/_trace_cap_test")
+    with open(payload_path) as f:
+        assert json.load(f)["dropped_spans"] == 4
+    tracer.clear()
+    assert tracer.dropped == 0
+
+
+def test_resource_peaks_prefers_stored_fields_and_recomputes():
+    stored = {"peak_rss_bytes": 5e9, "peak_queue_depths": {"decode": 7},
+              "samples": [{"rss_bytes": 1, "queues": {"decode": 1}}]}
+    peaks = profiling.resource_peaks(stored)
+    assert peaks["rss_bytes"] == 5e9
+    assert peaks["queue_depths"] == {"decode": 7}
+    raw = {"samples": [
+        {"rss_bytes": 10, "pool_outstanding_bytes": 3, "queues": {"encode": 2}},
+        {"rss_bytes": 30, "pool_outstanding_bytes": 1, "queues": {"encode": 5}},
+    ]}
+    peaks = profiling.resource_peaks(raw)
+    assert peaks["rss_bytes"] == 30
+    assert peaks["pool_outstanding_bytes"] == 3
+    assert peaks["queue_depths"] == {"encode": 5}
+
+
+# ------------------------------------------------------------ merged trace
+
+
+def test_chrome_trace_valid_and_single_clock_domain():
+    tracer = tracing.Tracer()
+    with tracer.span("job outer"):
+        with tracer.span("device:step_a"):
+            time.sleep(0.002)
+        with tracer.span("transfer:device_put"):
+            pass
+    events = [{"event": "stage_end", "t": 0.001, "stage": "p03"}]
+    samples = [{
+        "t_perf": tracer._t0 + 0.001, "rss_bytes": 1e9,
+        "pool_outstanding_bytes": 5e6, "queues": {"decode": 2},
+    }]
+    doc = profiling.build_chrome_trace(
+        tracer.spans(), events=events, resources=samples,
+        events_offset_s=0.0, tracer_t0_perf=tracer._t0,
+    )
+    json.loads(json.dumps(doc))  # valid JSON round trip
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    cats = {e["cat"] for e in xs}
+    assert {"host", "device", "transfer"} <= cats
+    # one clock domain: the device span nests inside its host parent
+    outer = next(e for e in xs if e["name"] == "job outer")
+    dev = next(e for e in xs if e["cat"] == "device")
+    assert outer["ts"] <= dev["ts"]
+    assert dev["ts"] + dev["dur"] <= outer["ts"] + outer["dur"] + 1000
+    # counters + instants present, timestamps never negative
+    assert any(e["ph"] == "C" for e in doc["traceEvents"])
+    assert any(e["ph"] == "i" for e in doc["traceEvents"])
+    assert all(e.get("ts", 0) >= 0 for e in doc["traceEvents"])
+
+
+def test_profiler_writes_artifacts(tmp_path):
+    prof = profiling.Profiler(str(tmp_path), interval_s=0.02)
+    prof.start("stamp1")
+    assert profiling.active()
+    with tracing.get_tracer().span("device:unit_step"):
+        time.sleep(0.01)
+    paths = prof.stop("stamp1")
+    assert not profiling.active()
+    assert os.path.isfile(paths["trace"]) and os.path.isfile(paths["resources"])
+    with open(paths["trace"]) as f:
+        doc = json.load(f)
+    assert any(
+        e.get("cat") == "device" for e in doc["traceEvents"]
+        if e.get("ph") == "X"
+    )
+    # chain-profile renders the capture
+    out = cp.render(cp.load_profile(str(tmp_path)))
+    assert "lanes" in out and "device" in out
+    assert cp.list_stamps(str(tmp_path)) == ["stamp1"]
+
+
+def test_chrome_trace_filters_unserializable_span_meta():
+    from pathlib import Path
+
+    tracer = tracing.Tracer()
+    with tracer.span("job x", path=Path("/tmp/x"), frames=48, label="a"):
+        pass
+    doc = profiling.build_chrome_trace(tracer.spans())
+    json.dumps(doc)  # the Path must not poison serialization
+    ev = next(e for e in doc["traceEvents"] if e.get("ph") == "X")
+    assert ev["args"] == {"frames": 48, "label": "a"}
+
+
+def test_chain_profile_tolerates_torn_sidecars_and_flags_torn_trace(tmp_path):
+    stamp = "s1"
+    (tmp_path / f"profile_{stamp}.trace.json").write_text(
+        json.dumps({"traceEvents": []})
+    )
+    (tmp_path / f"resources_{stamp}.json").write_text("{torn")
+    (tmp_path / f"metrics_{stamp}.json").write_text("{torn")
+    profile = cp.load_profile(str(tmp_path))  # sidecars dropped, no crash
+    assert "resources" not in profile and "metrics" not in profile
+    cp.render(profile)
+    # a torn TRACE takes the clean error path, not a raw traceback
+    (tmp_path / f"profile_{stamp}.trace.json").write_text("{torn")
+    with pytest.raises(cp.ProfileError):
+        cp.load_profile(str(tmp_path))
+    assert cp.main([str(tmp_path)]) == 1
+
+
+# ------------------------------------------------------------ bench-compare
+
+
+def _baseline(**metrics):
+    return {"schema": 1, "metrics": metrics}
+
+
+def test_bench_compare_pass_fail_and_edges():
+    base = _baseline(**{
+        "host.fps": {"value": 100.0, "kind": "floor_frac", "tolerance": 0.5},
+        "host.parity": {"value": True, "kind": "exact"},
+        "host.hit_rate": {"value": 0.9, "kind": "floor_abs", "tolerance": 0.2},
+        "host.seconds": {"value": 10.0, "kind": "ceil_frac", "tolerance": 0.3},
+    })
+    ok = bc.compare(base, {
+        "host.fps": 51.0, "host.parity": True,
+        "host.hit_rate": 0.2, "host.seconds": 13.0,
+    })
+    assert ok["failures"] == 0 and ok["checked"] == 4
+    # exactly AT the floor passes (band is inclusive)
+    edge = bc.compare(base, {
+        "host.fps": 50.0, "host.parity": True,
+        "host.hit_rate": 0.2, "host.seconds": 13.0,
+    })
+    assert edge["failures"] == 0
+    # below the floor / parity flip / ceil overrun all fail
+    bad = bc.compare(base, {
+        "host.fps": 49.9, "host.parity": False,
+        "host.hit_rate": 0.19, "host.seconds": 13.1,
+    })
+    assert bad["failures"] == 4
+    assert "REGRESSION" in bc.render(bad)
+
+
+def test_bench_compare_missing_required_vs_optional():
+    base = _baseline(**{
+        "a": {"value": 1.0, "kind": "floor_frac", "tolerance": 0.5},
+        "b": {"value": 2.0, "kind": "floor_frac", "tolerance": 0.5,
+              "required": False},
+    })
+    res = bc.compare(base, {})
+    assert res["failures"] == 1 and res["skipped"] == 1
+
+
+def test_bench_compare_malformed_inputs():
+    with pytest.raises(bc.BenchCompareError):
+        bc.compare({"metrics": {}}, {"a": 1})
+    with pytest.raises(bc.BenchCompareError):
+        bc.compare(
+            _baseline(a={"value": 1.0, "kind": "nonsense"}), {"a": 1.0}
+        )
+
+
+def test_bench_compare_update_keeps_bands():
+    base = _baseline(a={"value": 1.0, "kind": "floor_frac", "tolerance": 0.4})
+    doc = bc.update_baseline(base, {"a": 2.0})
+    assert doc["metrics"]["a"]["value"] == 2.0
+    assert doc["metrics"]["a"]["tolerance"] == 0.4
+    assert base["metrics"]["a"]["value"] == 1.0  # original untouched
+
+
+def test_bench_compare_cli_from_file(tmp_path):
+    base_path = tmp_path / "base.json"
+    base_path.write_text(json.dumps(_baseline(
+        a={"value": 10.0, "kind": "floor_frac", "tolerance": 0.5},
+    )))
+    meas = tmp_path / "meas.json"
+    meas.write_text(json.dumps({"a": 9.0}))
+    assert bc.main(["--baseline", str(base_path), "--from", str(meas)]) == 0
+    meas.write_text(json.dumps({"a": 2.0}))
+    assert bc.main(["--baseline", str(base_path), "--from", str(meas)]) == 1
+    assert bc.main(["--baseline", str(tmp_path / "nope.json"),
+                    "--from", str(meas)]) == 2
+
+
+# ----------------------------------------------------- report + /status
+
+
+def test_report_renders_attribution_host_path_and_resources(tmp_path):
+    wait = tm.counter(
+        "chain_pipeline_wait_seconds_total",
+        "time the pipeline spent blocked on a bounded queue, by side",
+        ("side",),
+    )
+    hits = tm.counter("chain_bufpool_hits_total", "pool hits")
+    misses = tm.counter("chain_bufpool_misses_total", "pool misses")
+    iocalls = tm.counter(
+        "chain_io_batch_calls_total", "native I/O crossings", ("op",)
+    )
+    with tm.stage_span("p03"):
+        wait.labels(side="consumer").inc(8.0)
+        wait.labels(side="producer").inc(0.5)
+        hits.inc(30)
+        misses.inc(10)
+        iocalls.labels(op="decode").inc(4)
+        tm.FRAMES_DECODED.inc(256)
+    paths = tm.write_outputs(str(tmp_path))
+    # a resource timeseries under the same stamp feeds the report too
+    with open(tmp_path / f"resources_{paths['stamp']}.json", "w") as f:
+        json.dump({
+            "schema": 1, "interval_s": 0.5, "n_samples": 2,
+            "peak_rss_bytes": 2.5e9,
+            "samples": [{"queues": {"decode": 3}}, {"queues": {"decode": 1}}],
+        }, f)
+    run = report_mod.load_run(str(tmp_path))
+    text = report_mod.render_report(run)
+    assert "bottleneck attribution:" in text
+    assert "p03: decode_bound" in text
+    assert "host frame path:" in text
+    assert "30 hits / 10 misses" in text
+    assert "~64.0 frames per GIL release" in text
+    assert "resources:" in text and "peak rss: 2500 MB" in text
+    assert "peak queue depth decode: 3" in text
+
+
+def test_cli_profile_e2e(tmp_path):
+    """`--profile DIR` on a real toy chain: one merged Chrome trace with
+    host spans (+ writeback/decode lanes), a resource timeseries, and a
+    run report whose attribution section renders — the acceptance
+    criterion of the profiling layer, on the CPU host-only fallback."""
+    from processing_chain_tpu.io import medialib
+
+    try:
+        medialib.ensure_loaded()
+    except Exception as exc:  # pragma: no cover - env-dependent
+        pytest.skip(f"native media boundary unavailable: {exc}")
+    from test_pipeline_e2e import minimal_short_yaml, write_db
+
+    from processing_chain_tpu.cli import main as cli_main
+
+    yaml_path = write_db(
+        tmp_path, "P2SXM92", minimal_short_yaml("P2SXM92"),
+        {"SRC000.avi": dict(n=48)},
+    )
+    out = tmp_path / "tele"
+    rc = cli_main([
+        "p00", "-c", yaml_path, "-str", "1234", "--skip-requirements",
+        "--telemetry", str(out), "--profile", str(out),
+    ])
+    assert rc == 0
+    assert not profiling.active()  # capture closed with the run
+    stamps = cp.list_stamps(str(out))
+    assert len(stamps) == 1
+    with open(out / f"profile_{stamps[0]}.trace.json") as f:
+        doc = json.load(f)
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "merged trace has no complete spans"
+    cats = {e["cat"] for e in xs}
+    assert "host" in cats  # jobs
+    assert {"decode", "encode"} & cats  # prefetch/writeback lanes
+    assert os.path.isfile(out / f"resources_{stamps[0]}.json")
+    # the run report prints per-stage bottleneck verdicts
+    run = report_mod.load_run(str(out))
+    text = report_mod.render_report(run)
+    assert "bottleneck attribution:" in text
+    assert any(f"p0{i}:" in text for i in (1, 2, 3, 4))
+    # and chain-profile summarizes the same capture
+    summary = cp.render(cp.load_profile(str(out)))
+    assert "lanes" in summary and "bottleneck verdicts:" in summary
+
+
+def test_status_document_has_resources_section():
+    from processing_chain_tpu.telemetry import live as live_mod
+
+    doc = live_mod.build_status()
+    assert "resources" in doc
+    res = doc["resources"]
+    assert "pool_outstanding_bytes" in res and "queues" in res
+    json.dumps(doc)  # still JSON-able end to end
+
+    from processing_chain_tpu.tools import chain_top
+
+    frame = chain_top.render(doc)
+    assert "resources:" in frame and "pool" in frame

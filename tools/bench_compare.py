@@ -1,0 +1,23 @@
+"""Bench-regression guard from a checkout without installing.
+
+    python tools/bench_compare.py [--baseline BENCH_BASELINE.json]
+    python tools/bench_compare.py --from measured.json
+
+Measures `bench.py --host-bench` (+ the cached kernel number) and diffs
+it against the committed baseline with per-metric tolerance bands;
+exits nonzero on regression. All logic lives in
+processing_chain_tpu.tools.bench_compare (also exposed as
+`tools bench-compare` through the package CLI); see docs/PERF.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from processing_chain_tpu.tools.bench_compare import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,10 +1,12 @@
 """Render a human-readable report from one run's `--telemetry DIR`.
 
-Joins metrics_<ts>.json + events_<ts>.jsonl + trace_<ts>.json under the
-latest (or --stamp'ed) run stamp and prints the stage-throughput table,
-job accounting, top spans, and a pipeline stall diagnosis. All logic
-lives in processing_chain_tpu.telemetry.report (see docs/TELEMETRY.md);
-this wrapper only makes it runnable from a checkout without installing.
+Joins metrics_<ts>.json + events_<ts>.jsonl + trace_<ts>.json (and the
+--profile resources_<ts>.json) under the latest (or --stamp'ed) run
+stamp and prints the stage-throughput table, job accounting, top spans,
+a pipeline stall diagnosis, per-stage bottleneck verdicts, the host
+frame path, and resource peaks. All logic lives in
+processing_chain_tpu.telemetry.report (see docs/TELEMETRY.md); this
+wrapper only makes it runnable from a checkout without installing.
 
 Usage: python tools/run_report.py DIR [--stamp STAMP] [--list]
 """
