@@ -250,6 +250,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
     tools = (
         "src-analysis", "complexity", "plots", "metrics", "clean-logs",
         "run-report", "store", "chain-top", "chain-profile", "bench-compare",
+        "chain-lint",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -277,6 +278,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import bench_compare
 
             return bench_compare.main(rest)
+        if name == "chain-lint":
+            from .tools.chainlint import cli as chainlint_cli
+
+            return chainlint_cli.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

@@ -75,6 +75,7 @@ def mark_inprogress(output_path: str) -> bool:
         # through (Job.run and the p03 batch lanes).
         if os.path.isfile(output_path) and os.stat(output_path).st_nlink > 1:
             os.unlink(output_path)
+        # chainlint: disable=atomic-write (crash sentinel: only its EXISTENCE is the signal — a zero-byte .inprogress is exactly as meaningful as any other)
         with open(output_path + ".inprogress", "w"):
             pass
         return True
@@ -301,9 +302,12 @@ class Job:
             **self.provenance,
         }
         os.makedirs(os.path.dirname(self.logfile_path), exist_ok=True)
-        with open(self.logfile_path, "w") as f:
-            for key, value in record.items():
-                f.write(f"{key}: {json.dumps(value) if not isinstance(value, str) else value}\n")
+        from ..utils.fsio import atomic_write_text
+
+        atomic_write_text(self.logfile_path, "".join(
+            f"{key}: {json.dumps(value) if not isinstance(value, str) else value}\n"
+            for key, value in record.items()
+        ))
 
     def run(self) -> Any:
         marked = mark_inprogress(self.output_path)

@@ -32,6 +32,7 @@ from .. import telemetry as tm
 from ..io import bufpool
 from ..telemetry import profiling
 from ..telemetry.heartbeat import HEARTBEATS, NULL_HEARTBEAT, TaskCancelled
+from ..utils import lockdebug
 
 _SENTINEL = object()
 _EXHAUSTED = object()
@@ -42,7 +43,7 @@ _EXHAUSTED = object()
 # when their queue dies — a run that never reads the depths must not
 # leak one entry per finished pipeline object for the process lifetime.
 _QUEUE_REGISTRY: dict[int, tuple[str, "weakref.ref"]] = {}
-_QUEUE_REG_LOCK = threading.Lock()
+_QUEUE_REG_LOCK = lockdebug.make_lock("queue_registry")
 
 
 def _register_queue(name: str, q: queue.Queue) -> None:
@@ -404,7 +405,7 @@ class MultiSegmentPrefetcher:
         self._errs: list[Optional[BaseException]] = [None] * self._n
         self._stop = threading.Event()
         self._next = 0  # next unclaimed stream index
-        self._claim_lock = threading.Lock()
+        self._claim_lock = lockdebug.make_lock("prefetch_claim")
 
         def worker() -> None:
             # planned stays None: streams are CLAIMED across workers, so

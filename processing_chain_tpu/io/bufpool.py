@@ -27,12 +27,12 @@ the main device loop, and the encode writeback thread.
 from __future__ import annotations
 
 import os
-import threading
 import weakref
 
 import numpy as np
 
 from .. import telemetry as tm
+from ..utils import lockdebug
 
 _HITS = tm.counter(
     "chain_bufpool_hits_total", "pool acquisitions served from a recycled block"
@@ -64,11 +64,11 @@ class BufferPool:
         # cap per (shape, dtype): chunk blocks run ~100 MB at 1080p×64f,
         # so an unbounded free list would quietly pin the high-water mark
         self._max_free = max_free_per_key
-        self._lock = threading.Lock()
-        self._free: dict[tuple, list[np.ndarray]] = {}
-        self._outstanding: dict[int, weakref.ref] = {}
-        self.hits = 0
-        self.misses = 0
+        self._lock = lockdebug.make_lock("bufpool")
+        self._free: dict[tuple, list[np.ndarray]] = {}  # guarded-by: _lock
+        self._outstanding: dict[int, weakref.ref] = {}  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
 
     @staticmethod
     def _key(shape, dtype) -> tuple:
@@ -106,6 +106,7 @@ class BufferPool:
             # (release() holds a strong ref to the array it resolves).
             pool = _self()
             if pool is not None:
+                # chainlint: disable=lock-guard (GC-reentrant callback: taking _lock here can deadlock — dict.pop on one key is GIL-atomic and no other path touches a live weakref's key; see comment above)
                 pool._outstanding.pop(_key, None)
 
         with self._lock:

@@ -128,8 +128,6 @@ def merge_superframes(vfi, sizes_col="size", dts_col="dts"):
     packet's size is added to the earlier and the row dropped (reference
     delete_packets, get_framesize.py:27-51). Operates on a pandas DataFrame,
     returns a new one with reindexed `index` per segment."""
-    import pandas as pd
-
     df = vfi.reset_index(drop=True)
     dts = df[dts_col].to_numpy(dtype=np.float64)
     close = np.abs(np.diff(dts)) < 0.0011

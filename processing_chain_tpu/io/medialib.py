@@ -9,16 +9,22 @@ from __future__ import annotations
 import ctypes as ct
 import os
 import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
+from ..utils import lockdebug
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libpcmedia.so")
+#: PC_MEDIA_LIB points the loader at an alternate build flavor — the CI
+#: sanitizer jobs load libpcmedia.asan.so / libpcmedia.tsan.so this way
+#: (native/Makefile; the process must LD_PRELOAD the matching runtime).
+_SO_PATH = os.environ.get(
+    "PC_MEDIA_LIB",
+    os.path.join(_NATIVE_DIR, "libpcmedia.so"),
+)
 
-_lock = threading.Lock()
-_lib: Optional[ct.CDLL] = None
+_lock = lockdebug.make_lock("medialib")
+_lib: Optional[ct.CDLL] = None  # guarded-by: _lock
 
 # swscale flag constants (libswscale/swscale.h)
 SWS_FAST_BILINEAR = 1
@@ -93,6 +99,13 @@ class MediaError(RuntimeError):
 
 def _build(force: bool = False) -> None:
     cmd = ["make", "-C", _NATIVE_DIR] + (["-B"] if force else [])
+    # a PC_MEDIA_LIB override selecting a sanitizer flavor in our own
+    # native dir gets ITS target rebuilt (make's default target only
+    # covers the production .so)
+    if os.path.dirname(os.path.abspath(_SO_PATH)) == _NATIVE_DIR and \
+            os.path.basename(_SO_PATH) != "libpcmedia.so":
+        cmd.append(os.path.basename(_SO_PATH))
+    # chainlint: disable=subprocess-hygiene (native bootstrap: the loader's degrade ladder keys on raw CalledProcessError vs OSError — runner.shell folds both into ChainError and would erase the distinction)
     subprocess.run(
         cmd,
         check=True,

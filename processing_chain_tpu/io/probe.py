@@ -69,12 +69,13 @@ class LibavProber:
                 )
             except medialib.MediaError:
                 sizes["a"] = 0
-            with open(sidecar_path, "w") as f:
-                yaml.safe_dump(
-                    {"md5sum": "-", "get_stream_size": sizes, "get_src_info": data},
-                    f,
-                    default_flow_style=False,
-                )
+            from ..utils.fsio import atomic_write_text
+
+            atomic_write_text(sidecar_path, yaml.safe_dump(
+                {"md5sum": "-", "get_stream_size": sizes,
+                 "get_src_info": data},
+                default_flow_style=False,
+            ))
         return data
 
     def duration(self, file_path: str, sidecar_path: Optional[str] = None) -> float:

@@ -8,7 +8,6 @@ test. vmapped over the frame axis; inputs are luma (or any single plane).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

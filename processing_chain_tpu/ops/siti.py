@@ -12,7 +12,6 @@ formula for parity with the shipped classifier.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

@@ -145,8 +145,11 @@ def fs_barrier(
     run_id = barrier_run_id()
     os.makedirs(sync_dir, exist_ok=True)
     own = os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{pid}")
-    with open(own, "w") as f:
-        f.write(str(time.time()))
+    from ..utils.fsio import atomic_write_text
+
+    # atomic: a peer polling for this marker must never observe a
+    # half-written file as an arrival (NFS sync dirs especially)
+    atomic_write_text(own, str(time.time()))
     want = [
         os.path.join(sync_dir, f".barrier_{run_id}_{stage}.host{i}")
         for i in range(num)

@@ -313,6 +313,7 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
                 pool.acquire((n_pvs,) + tuple(p.shape), p.dtype)
                 for p in tmpl
             ]
+            # chainlint: ownership-transfer (the wave_bufs double-buffer retains both parities for the whole wave; on exception exits they are deliberately DROPPED, not released — in-flight device DMA may still read them)
             wave_bufs[parity] = bufs
         t_put = time.perf_counter() if tm.enabled() else 0.0
         with profiling.maybe_span("transfer:device_put"):

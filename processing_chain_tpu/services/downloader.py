@@ -32,6 +32,7 @@ from typing import Any, Optional, Protocol, Sequence
 
 from ..utils.fsio import atomic_write
 from ..utils.log import get_logger
+from ..utils import lockdebug
 
 #: segment length sanity window, seconds (reference :118-126)
 _SEGMENT_LEN_RANGE = (7, 9)
@@ -252,12 +253,11 @@ class SftpStore:
             import paramiko  # type: ignore  # noqa: F401
         except ImportError as exc:
             raise RuntimeError("paramiko is not installed; SFTP store unavailable") from exc
-        import threading
 
         self._params = (host.split(":")[0], port, user, password)
         self._sftp = None
         self._transport = None
-        self._lock = threading.Lock()
+        self._lock = lockdebug.make_lock("downloader")
         self.root = root
 
     def _client(self):
@@ -400,10 +400,17 @@ def concat_chunks(chunk_dir: str, codec: str, out_path: str) -> str:
     """Binary-concatenate init + ordered chunks (what the reference's
     ffmpeg `concat:` protocol does, :819-825) into `out_path`."""
     init_element, parts = _collect_parts(os.listdir(chunk_dir), codec, chunk_dir)
-    with open(out_path, "wb") as out:
-        for name in [init_element, *parts]:
-            with open(os.path.join(chunk_dir, name), "rb") as f:
-                out.write(f.read())
+    from ..utils.fsio import atomic_write
+
+    def _write(tmp: str) -> None:
+        # atomic: a crash mid-concat must not leave a truncated media
+        # file that a later run's exists-check would adopt as complete
+        with open(tmp, "wb") as out:
+            for name in [init_element, *parts]:
+                with open(os.path.join(chunk_dir, name), "rb") as f:
+                    out.write(f.read())
+
+    atomic_write(out_path, _write)
     return out_path
 
 

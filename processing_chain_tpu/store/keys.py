@@ -27,8 +27,8 @@ import functools
 import hashlib
 import json
 import os
-import threading
 from typing import Any, Callable, Optional
+from ..utils import lockdebug
 
 #: bump when canonical_json / resolve_plan output shape changes
 KEY_SCHEMA_VERSION = 1
@@ -130,7 +130,7 @@ class DigestCache:
         self._path = path
         self._entries: dict[str, dict] = {}
         self._dirty = 0
-        self._lock = threading.Lock()
+        self._lock = lockdebug.make_lock("digest_cache")
         if path and os.path.isfile(path):
             try:
                 with open(path) as f:

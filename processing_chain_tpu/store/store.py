@@ -42,6 +42,7 @@ from .. import telemetry as tm
 from ..utils.fsio import atomic_write
 from ..utils.log import get_logger
 from . import keys
+from ..utils import lockdebug
 
 STORE_HITS = tm.counter(
     "chain_store_hits_total", "jobs served from the artifact store", ("runner",)
@@ -196,7 +197,7 @@ class ArtifactStore:
         #: adopt-vs-rebuild discriminator (see should_adopt)
         self._known_paths: Optional[set[str]] = None
         self._paths_path = os.path.join(self.root, "seen-paths.jsonl")
-        self._paths_lock = threading.Lock()
+        self._paths_lock = lockdebug.make_lock("store_paths")
         self._seen_paths: Optional[set[str]] = None  # lazy ledger cache
         #: incrementally-maintained gauge state ({"objects", "bytes"});
         #: None until the first update_gauges walk

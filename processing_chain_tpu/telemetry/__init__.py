@@ -22,7 +22,6 @@ one collision-safe <ts> stamp so tools/run_report.py can join them.
 from __future__ import annotations
 
 import os
-import threading
 import time
 from contextlib import contextmanager
 from typing import Iterator, Optional
@@ -41,6 +40,7 @@ from .heartbeat import (  # noqa: F401
     HeartbeatRegistry,
     TaskCancelled,
 )
+from ..utils import lockdebug
 from .metrics import (  # noqa: F401
     DEFAULT_DEPTH_BUCKETS,
     DEFAULT_LATENCY_BUCKETS,
@@ -88,7 +88,7 @@ def unique_stamp() -> str:
 
 
 _STAMP_SEQ = 0
-_STAMP_LOCK = threading.Lock()
+_STAMP_LOCK = lockdebug.make_lock("stamp")
 
 # Cross-layer counters the stage spans diff against. Frames/bytes are
 # incremented by the prefetch pipeline (engine/prefetch.py) where every

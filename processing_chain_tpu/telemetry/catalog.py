@@ -1,0 +1,94 @@
+"""The telemetry NAME catalog: every metric and event, declared once.
+
+Three surfaces ship these names — the live endpoint (PR 3), the
+persisted metrics/events artifacts (PR 1), and the attribution engine +
+docs tables (PR 5) — and nothing stopped a new call site from minting a
+name none of the others know about. This module is the single source of
+truth; chainlint's ``telemetry-name`` rule enforces that
+
+  * every ``tm.counter/gauge/histogram("…")`` literal in the tree is
+    declared here with the same kind, and
+  * every ``emit("…")`` literal is declared in ``EVENTS``, and
+  * every name here appears in docs/TELEMETRY.md (and every ``chain_*``
+    token in that doc appears here) — the doc can't silently drift.
+
+Adding a metric or event = add it at the call site, here, and in the
+doc table; chainlint fails until all three agree.
+
+Entries are ``name -> kind`` (kinds: counter/gauge/histogram). The
+registry itself stays permissive at runtime — tests mint ad-hoc names —
+so this is a static contract, not a runtime gate.
+"""
+
+from __future__ import annotations
+
+#: metric name -> prometheus kind
+METRICS: dict[str, str] = {
+    # engine/jobs.py — job accounting
+    "chain_jobs_planned_total": "counter",
+    "chain_jobs_skipped_total": "counter",
+    "chain_jobs_deduped_total": "counter",
+    "chain_jobs_failed_total": "counter",
+    "chain_jobs_redone_total": "counter",
+    "chain_job_duration_seconds": "histogram",
+    # utils/runner.py — host task execution
+    "chain_runner_in_flight": "gauge",
+    "chain_task_duration_seconds": "histogram",
+    # engine/prefetch.py + io/video.py — pipeline frame flow
+    "chain_frames_decoded_total": "counter",
+    "chain_frames_encoded_total": "counter",
+    "chain_bytes_encoded_total": "counter",
+    "chain_queue_depth": "histogram",
+    "chain_pipeline_wait_seconds_total": "counter",
+    # io — batched host frame path (PR 4)
+    "chain_io_batch_calls_total": "counter",
+    "chain_bufpool_hits_total": "counter",
+    "chain_bufpool_misses_total": "counter",
+    "chain_bufpool_recycled_bytes_total": "counter",
+    # parallel — device traffic
+    "chain_device_transfer_seconds_total": "counter",
+    "chain_device_transfer_bytes_total": "counter",
+    "chain_device_step_seconds": "histogram",
+    # stages
+    "chain_stage_wall_seconds": "gauge",
+    "chain_stage_items": "gauge",
+    # store (PR 2)
+    "chain_store_hits_total": "counter",
+    "chain_store_misses_total": "counter",
+    "chain_store_adoptions_total": "counter",
+    "chain_store_evictions_total": "counter",
+    "chain_store_corrupt_total": "counter",
+    "chain_store_object_bytes": "gauge",
+    "chain_store_objects": "gauge",
+    # telemetry/profiling.py — resource monitor (PR 5)
+    "chain_resource_rss_bytes": "gauge",
+    "chain_resource_open_fds": "gauge",
+    "chain_resource_cpu_percent": "gauge",
+    "chain_resource_queue_depth": "gauge",
+    "chain_bufpool_free_bytes": "gauge",
+    "chain_bufpool_outstanding_bytes": "gauge",
+    "chain_device_memory_bytes": "gauge",
+}
+
+#: structured event-log record names (docs/TELEMETRY.md "Event schema")
+EVENTS: frozenset = frozenset({
+    "log_meta",        # head record of every events_<ts>.jsonl
+    "run_start",
+    "run_end",
+    "stage_start",
+    "stage_end",
+    "job_planned",
+    "job_skip",
+    "job_redo",
+    "job_start",
+    "job_end",
+    "queue_depth",
+    "device_step",
+    "store_corrupt",
+    "store_evict",
+    "task_stalled",
+    "task_recovered",
+    "task_hard_timeout",
+    "barrier_wait",
+    "log",             # WARNING+ console records bridged into the log
+})

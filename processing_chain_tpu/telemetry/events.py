@@ -16,9 +16,9 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 import time
 from typing import Optional
+from ..utils import lockdebug
 
 
 class EventLog:
@@ -31,14 +31,14 @@ class EventLog:
     """
 
     def __init__(self, max_events: int = 200_000) -> None:
-        self._lock = threading.Lock()
-        self._events: list[dict] = []
+        self._lock = lockdebug.make_lock("events")
+        self._events: list[dict] = []  # guarded-by: _lock
         self.max_events = max_events
-        self.drops = 0
+        self.drops = 0  # guarded-by: _lock
         self.enabled = False
         self._t0 = time.time()
         self._t0_perf = time.perf_counter()
-        self._stream = None
+        self._stream = None  # guarded-by: _lock
 
     def open_stream(self, path: str) -> str:
         """Additionally append every record to `path` AS IT IS EMITTED,
@@ -47,6 +47,7 @@ class EventLog:
         partial run). write_jsonl to the same path at run end replaces
         the stream with the canonical complete file."""
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # chainlint: disable=atomic-write (live forensics stream: appended per record while the run is alive; read_jsonl tolerates a torn tail, and write_jsonl atomically replaces it with the canonical file at exit)
         f = open(path, "w")
         f.write(json.dumps({
             "event": "log_meta", "t": 0.0,
@@ -120,13 +121,18 @@ class EventLog:
                 stream.close()
             except OSError:
                 pass
-        with open(path, "w") as f:
-            f.write(json.dumps({
-                "event": "log_meta", "t": 0.0, "epoch_t0": round(t0, 3),
-                "n_events": len(events), "dropped": drops,
-            }) + "\n")
-            for record in events:
-                f.write(json.dumps(record) + "\n")
+        from ..utils.fsio import atomic_write
+
+        def _write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                f.write(json.dumps({
+                    "event": "log_meta", "t": 0.0, "epoch_t0": round(t0, 3),
+                    "n_events": len(events), "dropped": drops,
+                }) + "\n")
+                for record in events:
+                    f.write(json.dumps(record) + "\n")
+
+        atomic_write(path, _write)
         return path
 
 

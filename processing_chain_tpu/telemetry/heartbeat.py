@@ -30,11 +30,11 @@ returns a shared no-op heartbeat and every method is one attribute check.
 from __future__ import annotations
 
 import itertools
-import threading
 import time
 from contextlib import contextmanager
 from typing import Iterator, Optional
 
+from ..utils import lockdebug
 from .events import emit
 
 #: EWMA smoothing: ~the last ten beats dominate the rate estimate.
@@ -66,13 +66,17 @@ class Heartbeat:
         self.kind = kind
         self.stage = stage
         self.t_start = now
-        self.t_beat = now
-        self.units_done = 0.0
-        self.units_planned = planned
-        self.status = "running"
+        self.t_beat = now  # guarded-by: _lock
+        self.units_done = 0.0  # guarded-by: _lock
+        self.units_planned = planned  # guarded-by: _lock
+        self.status = "running"  # guarded-by: _lock
+        # deliberately NOT lock-guarded: a lock-free flag polled by
+        # cooperative wait loops (barrier, prefetch puts) — bool
+        # store/load is GIL-atomic and staleness only delays the abort
+        # by one poll
         self.cancelled = False
-        self.stall_flagged = False
-        self._rate = 0.0  # EWMA units/s
+        self.stall_flagged = False  # guarded-by: _lock
+        self._rate = 0.0  # EWMA units/s  # guarded-by: _lock
 
     # ------------------------------------------------------------ mutation
 
@@ -125,13 +129,15 @@ class Heartbeat:
                 "hard timeout (see task_hard_timeout event for forensics)"
             )
 
-    # -------------------------------------------------------------- views
+    # ------------------------------------------------- views (lock held)
 
+    # holds-lock: _lock
     def progress(self) -> Optional[float]:
         if not self.units_planned:
             return None
         return min(1.0, self.units_done / self.units_planned)
 
+    # holds-lock: _lock
     def eta_s(self) -> Optional[float]:
         """EWMA-extrapolated seconds to completion; None while the rate
         or the plan is unknown."""
@@ -142,6 +148,7 @@ class Heartbeat:
             return 0.0
         return remaining / self._rate
 
+    # holds-lock: _lock
     def as_dict(self, now: float) -> dict:
         d = {
             "label": self.label,
@@ -209,13 +216,13 @@ class HeartbeatRegistry:
     age tasks without sleeping."""
 
     def __init__(self, clock=time.monotonic) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdebug.make_lock("heartbeat")
         self._clock = clock
         self._ids = itertools.count(1)
-        self._live: dict[int, Heartbeat] = {}
-        self._recent: list[Heartbeat] = []
-        self._stages: dict[str, dict] = {}
-        self._current_stage: Optional[str] = None
+        self._live: dict[int, Heartbeat] = {}  # guarded-by: _lock
+        self._recent: list[Heartbeat] = []  # guarded-by: _lock
+        self._stages: dict[str, dict] = {}  # guarded-by: _lock
+        self._current_stage: Optional[str] = None  # guarded-by: _lock
         self.enabled = False
 
     # --------------------------------------------------------- lifecycle
@@ -317,45 +324,53 @@ class HeartbeatRegistry:
 
     def snapshot(self) -> dict:
         """JSON-able live view: per-stage progress/ETA + every in-flight
-        task with ages, plus the recently-finished tail."""
+        task with ages, plus the recently-finished tail.
+
+        The whole view is materialized UNDER the registry lock: the
+        previous copy-then-read shape let `/status` render a heartbeat
+        whose `units_done` had advanced but whose `t_beat`/`_rate` had
+        not (a torn progress/ETA pair) while worker threads beat
+        concurrently — exactly the class chainlint's lock-guard rule
+        now rejects. Snapshot cadence is operator-poll (~1 Hz), so
+        holding the lock for the render costs nothing measurable."""
         with self._lock:
             now = self._clock()
             live = sorted(self._live.values(), key=lambda h: h.t_start)
-            recent = list(self._recent)
-            stages = dict(self._stages)
+            stage_view = {}
             current = self._current_stage
-        stage_view = {}
-        for stage, entry in stages.items():
-            hb = entry["hb"]
-            d = {
-                "state": hb.status if hb.status != "running" else (
-                    "running" if stage == current else "done"
-                ),
-                "jobs_done": hb.units_done,
-                "wall_s": round(
-                    (hb.t_beat if hb.status != "running" else now)
-                    - hb.t_start, 3,
-                ),
+            for stage, entry in self._stages.items():
+                hb = entry["hb"]
+                d = {
+                    "state": hb.status if hb.status != "running" else (
+                        "running" if stage == current else "done"
+                    ),
+                    "jobs_done": hb.units_done,
+                    "wall_s": round(
+                        (hb.t_beat if hb.status != "running" else now)
+                        - hb.t_start, 3,
+                    ),
+                }
+                if hb.units_planned is not None:
+                    d["jobs_planned"] = hb.units_planned
+                progress = hb.progress()
+                if progress is not None:
+                    d["progress"] = round(progress, 4)
+                eta = hb.eta_s()
+                if eta is not None and hb.status == "running":
+                    d["eta_s"] = round(eta, 1)
+                if entry["items"] is not None:
+                    d["items"] = entry["items"]
+                stage_view[stage] = d
+            return {
+                "stages": stage_view,
+                "current_stage": current,
+                "tasks": [
+                    h.as_dict(now) for h in live if h.kind != "stage"
+                ],
+                "recent": [
+                    h.as_dict(now) for h in reversed(self._recent)
+                ],
             }
-            if hb.units_planned is not None:
-                d["jobs_planned"] = hb.units_planned
-            progress = hb.progress()
-            if progress is not None:
-                d["progress"] = round(progress, 4)
-            eta = hb.eta_s()
-            if eta is not None and hb.status == "running":
-                d["eta_s"] = round(eta, 1)
-            if entry["items"] is not None:
-                d["items"] = entry["items"]
-            stage_view[stage] = d
-        return {
-            "stages": stage_view,
-            "current_stage": current,
-            "tasks": [
-                h.as_dict(now) for h in live if h.kind != "stage"
-            ],
-            "recent": [h.as_dict(now) for h in reversed(recent)],
-        }
 
     def reset(self) -> None:
         with self._lock:

@@ -25,9 +25,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from bisect import bisect_left
 from typing import Iterable, Optional, Sequence
+from ..utils import lockdebug
 
 DEFAULT_LATENCY_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
@@ -121,8 +121,8 @@ class _Metric:
         self.buckets = tuple(sorted(buckets)) if kind == "histogram" else ()
         # counter/gauge: {label values: float}
         # histogram:     {label values: ([bucket counts..., +inf count], [sum, n])}
-        self._values: dict = {}
-        self._bound: dict[tuple, _Bound] = {}
+        self._values: dict = {}  # guarded-by: _registry._lock
+        self._bound: dict[tuple, _Bound] = {}  # guarded-by: _registry._lock
         self._nolabels = _Bound(self, ())
 
     def labels(self, **labels: str) -> _Bound:
@@ -134,6 +134,7 @@ class _Metric:
                 f"{tuple(sorted(labels))}"
             )
         key = tuple(str(labels[n]) for n in self.labelnames)
+        # chainlint: disable=lock-guard (deliberate lock-free fast path: dict.get is GIL-atomic and a miss falls through to the locked setdefault below — hot loops bind once, never see a torn entry)
         bound = self._bound.get(key)
         if bound is None:
             with self._registry._lock:
@@ -163,8 +164,8 @@ class MetricsRegistry:
     (two modules silently disagreeing on a metric is always a bug)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._metrics: dict[str, _Metric] = {}
+        self._lock = lockdebug.make_lock("metrics")
+        self._metrics: dict[str, _Metric] = {}  # guarded-by: _lock
         self.enabled = False
 
     def _get_or_create(
@@ -262,9 +263,10 @@ class MetricsRegistry:
         return out
 
     def write_json(self, path: str) -> str:
+        from ..utils.fsio import atomic_write_json
+
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.snapshot(), f, indent=1, sort_keys=True)
+        atomic_write_json(path, self.snapshot(), sort_keys=True)
         return path
 
     def render_prometheus(self) -> str:
@@ -297,9 +299,10 @@ class MetricsRegistry:
         return "\n".join(lines) + ("\n" if lines else "")
 
     def write_prometheus(self, path: str) -> str:
+        from ..utils.fsio import atomic_write_text
+
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "w") as f:
-            f.write(self.render_prometheus())
+        atomic_write_text(path, self.render_prometheus())
         return path
 
 

@@ -47,6 +47,7 @@ from collections import deque
 from typing import Iterable, Optional, Sequence
 
 from .metrics import REGISTRY, gauge
+from ..utils import lockdebug
 
 # --------------------------------------------------------------- gauges
 # Mirrored from every ResourceMonitor sample (and any sample_resources
@@ -161,7 +162,7 @@ class _CpuTracker:
     MIN_INTERVAL_S = 0.2
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdebug.make_lock("resource_monitor")
         self._last: Optional[tuple[float, float]] = None  # (perf_counter, ticks)
 
     def percent(self) -> Optional[float]:
@@ -260,7 +261,7 @@ def sample_resources(
 
 
 _SEEN_QUEUES: set = set()
-_SEEN_QUEUES_LOCK = threading.Lock()
+_SEEN_QUEUES_LOCK = lockdebug.make_lock("seen_queues")
 
 
 def format_resource_peaks(peaks: dict) -> list[str]:

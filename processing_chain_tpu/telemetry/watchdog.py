@@ -32,6 +32,7 @@ from typing import Optional
 
 from .events import emit
 from .heartbeat import HEARTBEATS, HeartbeatRegistry
+from ..utils import lockdebug
 
 #: Stack dumps are bounded so one stalled scan can't blow the event
 #: log's memory cap (events are capped in count, not record size).
@@ -114,21 +115,34 @@ class Watchdog:
         from ..utils.log import get_logger
 
         registry = self._registry
-        now = registry._clock()
         incidents: list[dict] = []
-        for hb in registry.live():
-            if hb.kind == "stage":
-                continue  # stages stall iff their jobs do; report those
-            age = now - hb.t_beat
-            if self.hard_s is not None and age > self.hard_s:
-                if hb.cancelled:
-                    continue  # already killed; waiting on its loop to see it
-                stacks = dump_all_stacks()
-                hb.cancelled = True
+        # Flag decisions happen UNDER the registry lock: the previous
+        # lock-free pass could set `stall_flagged` the instant after a
+        # beat() cleared it (ghost-stalling a just-recovered task) and
+        # read a `t_beat`/`units_done` pair mid-update. The expensive
+        # work — stack dumps, events, logging — stays outside the lock.
+        flagged: list[tuple] = []  # (incident, hb, age, units_done)
+        with registry._lock:
+            now = registry._clock()
+            for hb in registry._live.values():
+                if hb.kind == "stage":
+                    continue  # stages stall iff their jobs do; report those
+                age = now - hb.t_beat
+                if self.hard_s is not None and age > self.hard_s:
+                    if hb.cancelled:
+                        continue  # already killed; its loop will see it
+                    hb.cancelled = True
+                    flagged.append(("hard_timeout", hb, age, hb.units_done))
+                elif age > self.soft_s and not hb.stall_flagged:
+                    hb.stall_flagged = True
+                    flagged.append(("stalled", hb, age, hb.units_done))
+        for incident, hb, age, units_done in flagged:
+            stacks = dump_all_stacks()
+            if incident == "hard_timeout":
                 emit(
                     "task_hard_timeout", task=hb.label, kind=hb.kind,
                     stage=hb.stage, beat_age_s=round(age, 1),
-                    units_done=hb.units_done, hard_s=self.hard_s,
+                    units_done=units_done, hard_s=self.hard_s,
                     stacks=stacks,
                 )
                 if hb.kind in CANCELLABLE_KINDS:
@@ -146,17 +160,11 @@ class Watchdog:
                         "interrupted — forensics recorded, left running",
                         hb.kind, hb.label, age, self.hard_s,
                     )
-                incidents.append({
-                    "task": hb.label, "incident": "hard_timeout",
-                    "beat_age_s": age,
-                })
-            elif age > self.soft_s and not hb.stall_flagged:
-                hb.stall_flagged = True
-                stacks = dump_all_stacks()
+            else:
                 emit(
                     "task_stalled", task=hb.label, kind=hb.kind,
                     stage=hb.stage, beat_age_s=round(age, 1),
-                    units_done=hb.units_done, soft_s=self.soft_s,
+                    units_done=units_done, soft_s=self.soft_s,
                     stacks=stacks,
                 )
                 get_logger().warning(
@@ -164,15 +172,15 @@ class Watchdog:
                     "(soft threshold %.0fs) — stack dump in the event log",
                     hb.kind, hb.label, age, self.soft_s,
                 )
-                incidents.append({
-                    "task": hb.label, "incident": "stalled",
-                    "beat_age_s": age,
-                })
+            incidents.append({
+                "task": hb.label, "incident": incident,
+                "beat_age_s": age,
+            })
         return incidents
 
 
-_ACTIVE: Optional[Watchdog] = None
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: Optional[Watchdog] = None  # guarded-by: _ACTIVE_LOCK
+_ACTIVE_LOCK = lockdebug.make_lock("watchdog_slot")
 
 
 def start_watchdog(soft_s: float = DEFAULT_SOFT_S,

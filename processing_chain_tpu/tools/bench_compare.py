@@ -32,9 +32,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 from typing import Optional, Sequence
+
+from ..utils.fsio import atomic_write_json, last_json_line
+from ..utils.runner import ChainError, shell
 
 _REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -59,13 +61,10 @@ def measure(timeout_s: float = 600.0) -> dict[str, object]:
     out: dict[str, object] = {}
     bench = os.path.join(_REPO, "bench.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    proc = subprocess.run(
+    proc = shell(
         [sys.executable, bench, "--host-bench"],
-        capture_output=True, text=True, timeout=timeout_s, env=env,
-        cwd=_REPO,
+        check=False, timeout=timeout_s, env=env, cwd=_REPO,
     )
-    from ..utils.fsio import last_json_line
-
     host = last_json_line(proc.stdout)
     if proc.returncode != 0 or host is None:
         raise BenchCompareError(
@@ -226,18 +225,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 measured = json.load(f)
         else:
             measured = measure()
-    except (OSError, ValueError, subprocess.TimeoutExpired,
-            BenchCompareError) as exc:
+    except (OSError, ValueError, ChainError, BenchCompareError) as exc:
         print(f"bench-compare: measurement failed: {exc}")
         return 2
     if args.save:
-        with open(args.save, "w") as f:
-            json.dump(measured, f, indent=1, sort_keys=True)
+        atomic_write_json(args.save, measured, sort_keys=True)
     if args.update:
         doc = update_baseline(baseline, measured)
-        with open(args.baseline, "w") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
-            f.write("\n")
+        atomic_write_json(args.baseline, doc, sort_keys=True)
         print(f"bench-compare: baseline {args.baseline} updated")
         return 0
     try:

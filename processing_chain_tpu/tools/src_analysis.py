@@ -28,6 +28,7 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from ..io import probe as probe_mod
+from ..utils.fsio import atomic_write_text
 from ..utils.log import get_logger
 from ..utils.runner import ParallelRunner
 
@@ -77,8 +78,8 @@ def check_or_write_md5(video_path: str) -> Md5Result:
     if existing is not None:
         status = "ok" if existing == current else "BAD"
         return Md5Result(video_path, current, status)
-    with open(sidecar, "w") as f:
-        f.write(f"{current} {os.path.basename(video_path)}\n")
+    atomic_write_text(
+        sidecar, f"{current} {os.path.basename(video_path)}\n")
     return Md5Result(video_path, current, "written")
 
 
@@ -146,8 +147,8 @@ def analyse_src(video_path: str, with_siti: bool = False) -> str:
     data["md5sum"] = md5
     if with_siti:
         data["siti"] = src_siti_summary(video_path)
-    with open(sidecar, "w") as f:
-        yaml.safe_dump(data, f, default_flow_style=False)
+    atomic_write_text(
+        sidecar, yaml.safe_dump(data, default_flow_style=False))
     return sidecar
 
 
@@ -160,8 +161,8 @@ def backfill_siti(video_path: str) -> str:
     with open(sidecar) as f:
         data = yaml.safe_load(f) or {}
     data["siti"] = src_siti_summary(video_path)
-    with open(sidecar, "w") as f:
-        yaml.safe_dump(data, f, default_flow_style=False)
+    atomic_write_text(
+        sidecar, yaml.safe_dump(data, default_flow_style=False))
     return sidecar
 
 
@@ -232,8 +233,9 @@ def run(
         for r in out["md5"]:
             log.info("%s", r.summary())
         if summary_path:
-            with open(summary_path, "w") as fh:
-                fh.write("".join(r.summary() + "\n" for r in out["md5"]))
+            atomic_write_text(
+                summary_path,
+                "".join(r.summary() + "\n" for r in out["md5"]))
 
     if not skip_src and (files or backfill):
         runner = ParallelRunner(max_parallel=concurrency, name="src-info")

@@ -1,5 +1,11 @@
-from .log import get_logger, setup_custom_logger
-from .runner import ChainError, ParallelRunner, run_task, shell
+"""Utility package. The runner re-exports are LAZY (PEP 562): runner.py
+imports the telemetry package for its task metrics, so an eager
+`from .runner import …` here would close an import cycle the moment any
+telemetry module needs a sibling utility (lockdebug, fsio) at import
+time. Submodules (`utils.fsio`, `utils.lockdebug`, `utils.log`) stay
+importable without touching runner at all."""
+
+from .log import get_logger, setup_custom_logger  # noqa: F401
 
 __all__ = [
     "get_logger",
@@ -9,3 +15,13 @@ __all__ = [
     "run_task",
     "shell",
 ]
+
+_RUNNER_EXPORTS = ("ChainError", "ParallelRunner", "run_task", "shell")
+
+
+def __getattr__(name: str):
+    if name in _RUNNER_EXPORTS:
+        from . import runner
+
+        return getattr(runner, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -17,7 +17,6 @@ its own (lazy) backend initialization runs.
 from __future__ import annotations
 
 import os
-import subprocess
 import sys
 
 from .log import get_logger
@@ -30,12 +29,13 @@ def _probe_backend(timeout_s: float) -> str:
     """Initialize JAX in a throwaway subprocess; return the platform name
     it reached, or '' if it failed or hung past the deadline."""
     code = "import jax; print(jax.devices()[0].platform)"
+    from .runner import ChainError, shell
+
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout_s, capture_output=True, text=True,
+        proc = shell(
+            [sys.executable, "-c", code], check=False, timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
+    except ChainError:  # probe timed out — the wedge this probe exists for
         return ""
     if proc.returncode != 0:
         return ""

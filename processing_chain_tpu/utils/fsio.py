@@ -22,6 +22,30 @@ def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
         raise
 
 
+def atomic_write_text(path: str, text: str) -> None:
+    """atomic_write of one pre-rendered string — the shape nearly every
+    call site wants. Owns the open/close so no caller can forget the
+    flush-before-replace (an unclosed `open(tmp).write(...)` leaves the
+    rename racing the buffer)."""
+    def _write(tmp: str) -> None:
+        with open(tmp, "w") as f:
+            f.write(text)
+
+    atomic_write(path, _write)
+
+
+def atomic_write_json(path: str, obj, **json_kw) -> None:
+    """atomic_write of one JSON document (indent=1 default to match the
+    chain's artifact style)."""
+    json_kw.setdefault("indent", 1)
+
+    def _write(tmp: str) -> None:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, **json_kw)
+
+    atomic_write(path, _write)
+
+
 def last_json_line(text: Optional[str]) -> Optional[dict]:
     """Last parseable JSON-object line of mixed stdout — the contract of
     tools that print one JSON record after arbitrary logging (bench.py,

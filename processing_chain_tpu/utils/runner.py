@@ -174,14 +174,21 @@ def shell(
     cmd: Sequence[str] | str,
     check: bool = True,
     timeout: Optional[float] = None,
+    env: Optional[dict] = None,
+    cwd: Optional[str] = None,
 ) -> subprocess.CompletedProcess:
-    """Minimal subprocess helper (reference shell_call, cmd_utils.py:42-57).
+    """THE subprocess door (chainlint rule `subprocess-hygiene`): every
+    external command in the chain goes through here, with LIST argv.
 
-    Only used at the edges (e.g. `git describe` for versioning); media work
-    never goes through a shell in this framework. `timeout` bounds the
-    child's wall time so an edge call can never hang a run (the child is
-    killed on expiry), and both failure modes raise ChainError carrying
-    a bounded stderr tail instead of an opaque nonzero-exit notice.
+    Only used at the edges (e.g. `git describe` for versioning, the
+    backend health probe, the bench child); media work never goes
+    through a shell in this framework. `timeout` bounds the child's
+    wall time so an edge call can never hang a run (the child is killed
+    on expiry), and both failure modes raise ChainError carrying a
+    bounded stderr tail instead of an opaque nonzero-exit notice.
+    `env`/`cwd` pass through for children that need a pinned platform
+    or repo root. The string form exists for historical parity only —
+    chain code passes lists (the linter enforces it).
     """
     cmd_text = cmd if isinstance(cmd, str) else " ".join(map(str, cmd))
     try:
@@ -192,6 +199,8 @@ def shell(
             capture_output=True,
             text=True,
             timeout=timeout,
+            env=env,
+            cwd=cwd,
         )
     except subprocess.TimeoutExpired as exc:
         tail = _stderr_tail(exc.stderr)

@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
 from .log import get_logger
+from . import lockdebug
 
 
 @dataclass
@@ -49,12 +50,12 @@ class Tracer:
     spans + a counter in the report, never to unbounded host memory."""
 
     def __init__(self, max_spans: int = 200_000) -> None:
-        self._lock = threading.Lock()
-        self._spans: list[Span] = []
+        self._lock = lockdebug.make_lock("tracer")
+        self._spans: list[Span] = []  # guarded-by: _lock
         self._local = threading.local()
         self._t0 = time.perf_counter()
         self.max_spans = max_spans
-        self.dropped = 0
+        self.dropped = 0  # guarded-by: _lock
         self.enabled = True
 
     @contextmanager
@@ -121,9 +122,11 @@ class Tracer:
 
             stamp = telemetry.unique_stamp()
         path = os.path.join(logs_dir, f"trace_{stamp}.json")
+        with self._lock:
+            dropped = self.dropped
         payload = {
             "summary": self.summary(),
-            **({"dropped_spans": self.dropped} if self.dropped else {}),
+            **({"dropped_spans": dropped} if dropped else {}),
             "spans": [
                 {
                     "name": s.name,
@@ -136,8 +139,9 @@ class Tracer:
                 for s in self.spans()
             ],
         }
-        with open(path, "w") as f:
-            json.dump(payload, f, indent=1)
+        from .fsio import atomic_write_json
+
+        atomic_write_json(path, payload)
         return path
 
     def log_summary(self) -> None:

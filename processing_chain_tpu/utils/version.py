@@ -14,24 +14,23 @@ from . import log
 
 def get_processing_chain_version() -> str:
     import os
-    import subprocess
+
+    from .runner import ChainError, shell
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     try:
         # bounded: `git describe` can hang on a wedged network filesystem
         # or a lock-holding concurrent git process, and version reporting
         # must never hang a run — expiry degrades to the VERSION file
-        result = subprocess.run(
+        result = shell(
             ["git", "describe", "--always", "--dirty"],
-            cwd=pkg_root,
-            capture_output=True,
-            text=True,
             check=False,
             timeout=10,
+            cwd=pkg_root,
         )
         if result.returncode == 0 and result.stdout.strip():
             return result.stdout.strip()
-    except (OSError, subprocess.TimeoutExpired):
+    except (OSError, ChainError):
         pass
     # VERSION file maintained by release.sh (reference check_requirements
     # falls back from `git describe` to its VERSION file the same way)

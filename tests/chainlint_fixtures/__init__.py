@@ -1,0 +1,3 @@
+# Deliberately-violating fixture modules for tests/test_chainlint.py.
+# This directory is excluded from the shipped-tree lint (core.LintConfig)
+# and from ruff (pyproject extend-exclude): the violations are the point.

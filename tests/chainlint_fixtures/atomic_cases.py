@@ -1,0 +1,47 @@
+"""atomic-write fixtures."""
+import json
+import os
+
+from processing_chain_tpu.utils.fsio import atomic_write
+
+
+def bad_direct(path, data):
+    with open(path, "w") as f:  # BAD: in-place write of a trusted path
+        json.dump(data, f)
+
+
+def good_tmp_replace(path, data):
+    tmp = path + ".part"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+    os.replace(tmp, path)
+
+
+def good_atomic_lambda(path, text):
+    atomic_write(path, lambda p: open(p, "w").write(text))
+
+
+def good_atomic_def(path, data):
+    def _write(dest):
+        with open(dest, "w") as f:
+            json.dump(data, f)
+
+    atomic_write(path, _write)
+
+
+def good_append(path, line):
+    with open(path, "a") as f:  # ok: append streams are exempt
+        f.write(line)
+
+
+def my_wrapper(path, write_fn):
+    atomic_write(path, write_fn)
+
+
+def good_via_wrapper(path, text):
+    my_wrapper(path, lambda p: open(p, "w").write(text))
+
+
+def excused(path):
+    # chainlint: disable=atomic-write (fixture: lock file, existence only)
+    open(path, "w").close()

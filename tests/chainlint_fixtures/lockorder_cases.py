@@ -1,0 +1,17 @@
+"""lock-order fixtures: an A->B->A cycle across two functions."""
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def forward():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def inverted():
+    with LOCK_B:
+        with LOCK_A:
+            pass

@@ -1,0 +1,40 @@
+"""lock-guard fixtures: one good path per bad path."""
+import threading
+
+GLOBAL_STATE = []  # guarded-by: GLOBAL_LOCK
+GLOBAL_LOCK = threading.Lock()
+
+
+def global_bad():
+    GLOBAL_STATE.append(1)  # BAD: module-level guarded global, no lock
+
+
+def global_good():
+    with GLOBAL_LOCK:
+        GLOBAL_STATE.append(2)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}  # guarded-by: _lock
+        self.free = 0
+
+    def good(self, k, v):
+        with self._lock:
+            self._items[k] = v
+
+    def bad(self, k):
+        return self._items.get(k)  # BAD: unguarded read
+
+    # holds-lock: _lock
+    def assumes_held(self):
+        return len(self._items)  # ok: caller holds the lock by contract
+
+    def excused(self):
+        # chainlint: disable=lock-guard (single-threaded constructor path, reviewed)
+        return list(self._items)
+
+    def cross_object(self, other):
+        with other._lock:
+            return other._items  # ok: suffix match on other's lock

@@ -1,0 +1,61 @@
+"""bufpool-ownership fixtures."""
+
+
+def leak(pool, shape):
+    block = pool.acquire(shape)  # BAD: never released
+    return shape and None
+
+
+def conditional_only(pool, shape, flag):
+    block = pool.acquire(shape)
+    if flag:
+        pool.release(block)  # BAD: only the flag path releases
+
+
+def both_arms(pool, shape, flag):
+    block = pool.acquire(shape)
+    if flag:
+        pool.release(block)
+    else:
+        consume(block)
+        pool.release(block)  # ok: both arms sink
+
+
+def finally_release(pool, shape):
+    block = pool.acquire(shape)
+    try:
+        consume(block)
+    finally:
+        pool.release(block)  # ok: finally covers every path
+
+
+def yields_ownership(pool, shape):
+    block = pool.acquire(shape)
+    yield block  # ok: ownership passes to the consumer
+
+
+def recycle_kw(pool, writer, shape):
+    block = pool.acquire(shape)
+    writer.put(block, recycle=block)  # ok: recycle= sink
+
+
+def unbound(pool, bucket, shape):
+    bucket.append(pool.acquire(shape))  # BAD: owner invisible
+
+
+def annotated_transfer(pool, bucket, shape):
+    # chainlint: ownership-transfer (bucket drains into the writer which releases)
+    bucket.append(pool.acquire(shape))  # ok: documented hand-off
+
+
+def deferred(pool, shape, on_done):
+    block = pool.acquire(shape)
+
+    def _cb():
+        pool.release(block)
+
+    on_done(_cb)  # ok: captured for deferred release
+
+
+def consume(_b):
+    pass

@@ -1,0 +1,34 @@
+"""subprocess-hygiene fixtures."""
+import os
+import subprocess
+
+from processing_chain_tpu.utils.runner import shell
+
+
+def banned_direct(cmd):
+    subprocess.run(cmd)  # BAD
+
+
+def banned_system(cmd):
+    os.system(cmd)  # BAD
+
+
+def shell_true(cmd):
+    some_runner(cmd, shell=True)  # BAD: literal shell=True anywhere
+
+
+def string_argv(path):
+    shell(f"ffprobe {path}")  # BAD: interpolated command string
+
+
+def good(path):
+    shell(["ffprobe", path], timeout=30)  # ok: list argv
+
+
+def excused(cmd):
+    # chainlint: disable=subprocess-hygiene (fixture: documented exemption)
+    subprocess.run(cmd)
+
+
+def some_runner(cmd, shell=False):
+    return cmd, shell

@@ -1,0 +1,30 @@
+"""telemetry-name fixtures (checked against the real catalog)."""
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.telemetry import emit
+
+
+GOOD = tm.counter("chain_frames_decoded_total", "declared name")
+ROGUE = tm.counter("chain_rogue_widgets_total", "BAD: not in catalog")
+WRONG_KIND = tm.gauge("chain_frames_encoded_total", "BAD: declared counter")
+FOREIGN = tm.counter("test_only_counter", "ok: not a chain_* name")
+
+
+def emit_good():
+    emit("job_start", job="x")
+
+
+def emit_bad():
+    emit("job_teleported", job="x")  # BAD: unknown event
+
+
+def emit_dynamic(name):
+    emit(name, job="x")  # BAD: dynamic event name
+
+
+class Lane:
+    def emit(self, frames):
+        return frames
+
+
+def lane_emit_ok(lane):
+    lane.emit([1, 2, 3])  # ok: not the telemetry emit

@@ -19,6 +19,13 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # e2e tests: a store hit would skip Job.fn + provenance writes and the
 # suite would both misbehave and pollute the store with test artifacts
 os.environ.pop("PC_STORE_DIR", None)
+# runtime lock-order recorder (utils/lockdebug.py): ON for the whole
+# suite — the dynamic half of chainlint's lock-order rule. Must be set
+# BEFORE the package imports: make_lock() decides plain-vs-tracked at
+# lock construction time (that is what makes production truly
+# zero-overhead). PC_LOCK_DEBUG=0 in the environment wins for timing
+# runs of the suite.
+os.environ.setdefault("PC_LOCK_DEBUG", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -63,6 +70,26 @@ def pytest_configure(config):
         sys.stderr.write(
             "conftest: explicit node id(s) given — dropping the default "
             "-m 'not slow' filter so slow tests run when named\n"
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """End-of-suite lock-order gate: everything the whole run observed
+    under PC_LOCK_DEBUG must form an acyclic acquisition graph. A cycle
+    here is a deadlock two tests never happened to interleave into."""
+    from processing_chain_tpu.utils import lockdebug
+
+    if not lockdebug.enabled():
+        return
+    try:
+        summary = lockdebug.check()
+    except lockdebug.LockOrderViolation as exc:
+        sys.stderr.write(f"\nconftest: {exc}\n")
+        session.exitstatus = 1
+    else:
+        sys.stderr.write(
+            f"\nconftest: lock-order recorder: {summary['edges']} edges "
+            f"over {summary['nodes']} locks, acyclic\n"
         )
 
 

@@ -8,7 +8,6 @@ pixels generate tiny synthetic SRCs through the io layer.
 
 from __future__ import annotations
 
-import os
 import textwrap
 
 from processing_chain_tpu.config import StaticProber

@@ -15,7 +15,6 @@ downloading anything.
 """
 import json
 import logging
-import os
 import sys
 import tempfile
 import types

@@ -1,0 +1,486 @@
+"""chainlint test suite: per-checker fixtures, baseline add/expire,
+disable-comment handling, the runtime lock-order recorder, and the
+self-run gate asserting the shipped tree is clean against the committed
+baseline (the same invocation CI runs)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from processing_chain_tpu.tools.chainlint import baseline as bl
+from processing_chain_tpu.tools.chainlint import cli as lint_cli
+from processing_chain_tpu.tools.chainlint.core import LintConfig, run_lint
+from processing_chain_tpu.utils import lockdebug
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "chainlint_fixtures")
+
+
+def lint_fixture(name, rules=None):
+    cfg = LintConfig(
+        root=REPO,
+        targets=[os.path.join(FIXTURES, name)],
+        rules=set(rules) if rules else None,
+    )
+    return run_lint(cfg)
+
+
+def lint_source(tmp_path, source, rules=None, **cfg_kw):
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent(source))
+    cfg = LintConfig(
+        root=str(tmp_path), targets=[str(path)],
+        rules=set(rules) if rules else None, **cfg_kw,
+    )
+    return run_lint(cfg)
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ------------------------------------------------------------- lock-guard
+
+
+class TestLockGuard:
+    def test_fixture_positives_and_negatives(self):
+        findings = by_rule(lint_fixture("locks_cases.py"), "lock-guard")
+        symbols = {f.symbol for f in findings}
+        assert "Registry.bad" in symbols          # unguarded read fires
+        assert "global_bad" in symbols            # module-level global fires
+        assert "Registry.good" not in symbols     # with-lock access clean
+        assert "Registry.assumes_held" not in symbols  # holds-lock contract
+        assert "Registry.excused" not in symbols  # justified disable
+        assert "Registry.cross_object" not in symbols  # suffix match
+        assert len(findings) == 2
+
+    def test_init_of_declaring_class_exempt(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._data = []  # guarded-by: _lock
+                    self._data.append(0)
+            """, rules=["lock-guard"])
+        assert findings == []
+
+    def test_disable_without_reason_is_its_own_finding(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._data = []  # guarded-by: _lock
+
+                def bad(self):
+                    # chainlint: disable=lock-guard
+                    return self._data
+            """)
+        assert by_rule(findings, "lock-guard"), \
+            "a reasonless disable must not suppress"
+        assert by_rule(findings, "bad-disable")
+
+    def test_unknown_rule_in_disable_flagged(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            x = 1  # chainlint: disable=made-up-rule (because)
+            """)
+        assert by_rule(findings, "bad-disable")
+
+
+# ------------------------------------------------------------- lock-order
+
+
+class TestLockOrder:
+    def test_cycle_detected(self):
+        findings = by_rule(lint_fixture("lockorder_cases.py"), "lock-order")
+        assert len(findings) == 1
+        assert "LOCK_A" in findings[0].message
+        assert "LOCK_B" in findings[0].message
+
+    def test_consistent_order_clean(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def one():
+                with A:
+                    with B:
+                        pass
+
+            def two():
+                with A:
+                    with B:
+                        pass
+            """, rules=["lock-order"])
+        assert findings == []
+
+
+# ------------------------------------------------------ bufpool-ownership
+
+
+class TestBufpoolOwnership:
+    def test_fixture_matrix(self):
+        findings = by_rule(
+            lint_fixture("ownership_cases.py"), "bufpool-ownership")
+        symbols = {f.symbol for f in findings}
+        assert "leak" in symbols
+        assert "conditional_only" in symbols
+        assert "unbound" in symbols
+        for clean in ("both_arms", "finally_release", "yields_ownership",
+                      "recycle_kw", "annotated_transfer", "deferred"):
+            assert clean not in symbols, f"{clean} must be clean"
+        assert len(findings) == 3
+
+    def test_early_return_before_release_flagged(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            def f(pool, shape, flag):
+                block = pool.acquire(shape)
+                if flag:
+                    return None
+                pool.release(block)
+            """, rules=["bufpool-ownership"])
+        assert len(findings) == 1
+
+    def test_release_inside_same_loop_iteration_clean(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            def f(pool, shapes):
+                for shape in shapes:
+                    block = pool.acquire(shape)
+                    use(block)
+                    pool.release(block)
+            """, rules=["bufpool-ownership"])
+        assert findings == []
+
+
+# ----------------------------------------------------- subprocess-hygiene
+
+
+class TestSubprocessHygiene:
+    def test_fixture_matrix(self):
+        findings = by_rule(
+            lint_fixture("subproc_cases.py"), "subprocess-hygiene")
+        symbols = [f.symbol for f in findings]
+        assert "banned_direct" in symbols
+        assert "banned_system" in symbols
+        assert "shell_true" in symbols
+        assert "string_argv" in symbols
+        assert "good" not in symbols
+        assert "excused" not in symbols
+        assert len(findings) == 4
+
+    def test_runner_module_is_allowlisted(self):
+        cfg = LintConfig(
+            root=REPO,
+            targets=[os.path.join(
+                REPO, "processing_chain_tpu", "utils", "runner.py")],
+            rules={"subprocess-hygiene"},
+        )
+        assert run_lint(cfg) == []
+
+
+# ----------------------------------------------------------- atomic-write
+
+
+class TestAtomicWrite:
+    def test_fixture_matrix(self):
+        findings = by_rule(lint_fixture("atomic_cases.py"), "atomic-write")
+        symbols = [f.symbol for f in findings]
+        assert symbols == ["bad_direct"], \
+            f"only the in-place write should fire, got {symbols}"
+
+
+# -------------------------------------------------------- telemetry-name
+
+
+class TestTelemetryName:
+    def test_fixture_matrix(self):
+        findings = by_rule(
+            lint_fixture("telemetry_cases.py"), "telemetry-name")
+        messages = " | ".join(f.message for f in findings)
+        assert "chain_rogue_widgets_total" in messages
+        assert "chain_frames_encoded_total" in messages  # kind mismatch
+        assert "job_teleported" in messages
+        assert "dynamic event name" in messages
+        assert "chain_frames_decoded_total" not in messages
+        assert "test_only_counter" not in messages
+        # doc-drift findings about the real tree don't belong to this
+        # fixture run's assertions; the self-run covers those
+        local = [f for f in findings if f.path.endswith("telemetry_cases.py")]
+        assert len(local) == 4
+
+    def test_doc_drift_both_directions(self, tmp_path):
+        (tmp_path / "catalog.py").write_text(
+            'METRICS = {"chain_documented_total": "counter",\n'
+            '           "chain_undocumented_total": "counter"}\n'
+            "EVENTS = frozenset({\"run_start\"})\n"
+        )
+        (tmp_path / "TELEMETRY.md").write_text(
+            "| `chain_documented_total` | — |\n"
+            "| `chain_ghost_total` | only in the doc |\n"
+            "`run_start`\n"
+        )
+        (tmp_path / "mod.py").write_text("x = 1\n")
+        cfg = LintConfig(
+            root=str(tmp_path), targets=[str(tmp_path / "mod.py")],
+            rules={"telemetry-name"},
+            catalog_path="catalog.py", doc_path="TELEMETRY.md",
+        )
+        findings = run_lint(cfg)
+        messages = " | ".join(f.message for f in findings)
+        assert "chain_undocumented_total" in messages  # catalog -> doc
+        assert "chain_ghost_total" in messages         # doc -> catalog
+        assert "chain_documented_total" not in messages
+
+    def test_catalog_matches_live_registrations(self):
+        """Importing the full package must not register any metric the
+        catalog misses (the dynamic twin of the static check)."""
+        from processing_chain_tpu.telemetry import catalog
+        from processing_chain_tpu.telemetry.metrics import REGISTRY
+        import processing_chain_tpu.engine.prefetch    # noqa: F401
+        import processing_chain_tpu.engine.jobs        # noqa: F401
+        import processing_chain_tpu.io.bufpool         # noqa: F401
+        import processing_chain_tpu.store.store        # noqa: F401
+        import processing_chain_tpu.telemetry.profiling  # noqa: F401
+
+        live = {
+            name: m.kind for name, m in REGISTRY._metrics.items()
+            if name.startswith("chain_")
+        }
+        undeclared = set(live) - set(catalog.METRICS)
+        assert not undeclared, f"metrics missing from catalog: {undeclared}"
+        for name, kind in live.items():
+            assert catalog.METRICS[name] == kind, \
+                f"{name}: catalog says {catalog.METRICS[name]}, live {kind}"
+
+
+# --------------------------------------------------------------- baseline
+
+
+class TestBaseline:
+    def _one_finding(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            import subprocess
+
+            def f(cmd):
+                subprocess.run(cmd)
+            """, rules=["subprocess-hygiene"])
+        assert len(findings) == 1
+        return findings
+
+    def test_add_then_suppress_then_expire(self, tmp_path):
+        findings = self._one_finding(tmp_path)
+        path = str(tmp_path / "baseline.json")
+        # add
+        n = bl.write_baseline(path, findings, [], reason="grandfathered")
+        assert n == 1
+        entries = bl.load_baseline(path)
+        result = bl.apply_baseline(findings, entries)
+        assert result.new == [] and len(result.baselined) == 1
+        # the source gets fixed -> entry is stale
+        result = bl.apply_baseline([], entries)
+        assert len(result.stale) == 1
+        # expire: rewrite with no findings drops it
+        n = bl.write_baseline(path, [], [], reason="-")
+        assert n == 0
+        assert bl.load_baseline(path) == []
+
+    def test_reason_is_mandatory(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps({
+            "version": 1,
+            "entries": [{"rule": "atomic-write", "path": "x.py",
+                         "symbol": "f", "snippet": "open(p, 'w')",
+                         "reason": "  "}],
+        }))
+        with pytest.raises(bl.BaselineError, match="reason"):
+            bl.load_baseline(str(path))
+
+    def test_fingerprint_survives_line_shifts(self, tmp_path):
+        f1 = self._one_finding(tmp_path)[0]
+        shifted = lint_source(tmp_path, """
+            import subprocess
+
+            # a new comment shifting everything down
+
+
+            def f(cmd):
+                subprocess.run(cmd)
+            """, rules=["subprocess-hygiene"])
+        assert shifted[0].fingerprint() == f1.fingerprint()
+        assert shifted[0].line != f1.line
+
+
+# -------------------------------------------------------------------- CLI
+
+
+class TestCli:
+    def _write_bad(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import subprocess\n\n"
+                       "def f(c):\n    subprocess.run(c)\n")
+        return bad
+
+    def test_exit_codes(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        rc = lint_cli.main(["--root", str(tmp_path), str(bad),
+                            "--no-baseline"])
+        assert rc == 1
+        assert "subprocess-hygiene" in capsys.readouterr().out
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        assert lint_cli.main(["--root", str(tmp_path), str(ok),
+                              "--no-baseline"]) == 0
+        assert lint_cli.main(["--rules", "no-such-rule"]) == 2
+        assert lint_cli.main(["--update-baseline"]) == 2  # reason required
+
+    def test_update_baseline_roundtrip_and_stale_gate(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        base = str(tmp_path / "BL.json")
+        rc = lint_cli.main(["--root", str(tmp_path), str(bad),
+                            "--baseline", base, "--update-baseline",
+                            "--reason", "transition"])
+        assert rc == 0
+        # suppressed now
+        assert lint_cli.main(["--root", str(tmp_path), str(bad),
+                              "--baseline", base]) == 0
+        # fix the file -> stale entry gates (and --allow-stale relaxes)
+        bad.write_text("x = 1\n")
+        capsys.readouterr()
+        rc = lint_cli.main(["--root", str(tmp_path), str(bad),
+                            "--baseline", base])
+        assert rc == 1
+        assert "STALE" in capsys.readouterr().out
+        assert lint_cli.main(["--root", str(tmp_path), str(bad),
+                              "--baseline", base, "--allow-stale"]) == 0
+        # --update-baseline expires it
+        assert lint_cli.main(["--root", str(tmp_path), str(bad),
+                              "--baseline", base, "--update-baseline",
+                              "--reason", "-"]) == 0
+        assert bl.load_baseline(base) == []
+
+    def test_json_output(self, tmp_path, capsys):
+        bad = self._write_bad(tmp_path)
+        rc = lint_cli.main(["--root", str(tmp_path), str(bad),
+                            "--no-baseline", "--json"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["findings"][0]["rule"] == "subprocess-hygiene"
+        assert doc["findings"][0]["fingerprint"]
+
+
+# ------------------------------------------------------ runtime lock-order
+
+
+class TestLockdebug:
+    def test_disabled_returns_plain_lock(self, monkeypatch):
+        monkeypatch.setenv("PC_LOCK_DEBUG", "0")
+        lock = lockdebug.make_lock("x")
+        assert type(lock) is type(threading.Lock())
+
+    def test_enabled_returns_tracked(self, monkeypatch):
+        monkeypatch.setenv("PC_LOCK_DEBUG", "1")
+        lock = lockdebug.make_lock("x")
+        assert isinstance(lock, lockdebug._TrackedLock)
+
+    def test_find_cycle(self):
+        assert lockdebug.find_cycle({"a": {"b"}, "b": set()}) is None
+        cycle = lockdebug.find_cycle({"a": {"b"}, "b": {"a"}})
+        assert cycle is not None and cycle[0] == cycle[-1]
+
+    def test_inversion_detected_and_reset(self, monkeypatch):
+        monkeypatch.setenv("PC_LOCK_DEBUG", "1")
+        lockdebug.reset()
+        try:
+            a = lockdebug.make_lock("inv_a")
+            b = lockdebug.make_lock("inv_b")
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+            with pytest.raises(lockdebug.LockOrderViolation):
+                lockdebug.check()
+        finally:
+            # never leak the deliberate inversion into the
+            # pytest_sessionfinish gate
+            lockdebug.reset()
+        assert lockdebug.check()["edges"] == 0
+
+    def test_real_workload_is_acyclic(self, monkeypatch):
+        monkeypatch.setenv("PC_LOCK_DEBUG", "1")
+        import numpy as np
+
+        from processing_chain_tpu import telemetry
+        from processing_chain_tpu.io.bufpool import BufferPool
+
+        pool = BufferPool()
+        was_enabled = telemetry.enabled()
+        telemetry.enable()
+        try:
+            def hammer():
+                for _ in range(50):
+                    arr = pool.acquire((8, 8), np.uint8)
+                    telemetry.emit("job_start", job="lockdebug-hammer")
+                    telemetry.HEARTBEATS.register("hammer", kind="task") \
+                        .finish("ok")
+                    pool.release(arr)
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            if not was_enabled:
+                telemetry.disable()
+        summary = lockdebug.check()  # raises on any cycle/inversion
+        assert summary["nodes"] >= 0
+
+    def test_dump_writes_edge_graph(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PC_LOCK_DEBUG", "1")
+        out = str(tmp_path / "lockorder.json")
+        lockdebug.dump(out)
+        doc = json.loads(open(out).read())
+        assert "edges" in doc
+
+
+# ---------------------------------------------------------------- self-run
+
+
+class TestSelfRun:
+    def test_shipped_tree_is_clean_against_committed_baseline(self):
+        """The acceptance gate: `tools chain-lint` on the repo as shipped
+        exits 0, and every baseline entry both matches a real finding
+        (no stale) and carries a reason."""
+        cfg = LintConfig(root=REPO)
+        findings = run_lint(cfg)
+        entries = bl.load_baseline(
+            os.path.join(REPO, bl.DEFAULT_BASELINE))
+        result = bl.apply_baseline(findings, entries)
+        assert result.new == [], \
+            "\n".join(f.render() for f in result.new)
+        assert result.stale == [], \
+            f"stale baseline entries: {[e.as_dict() for e in result.stale]}"
+        for entry in entries:
+            assert entry.reason.strip()
+
+    def test_cli_entrypoint_from_subprocess(self):
+        """The exact CI invocation (no heavy deps needed)."""
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "processing_chain_tpu.tools.chainlint.cli"],
+            capture_output=True, text=True, cwd=REPO, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "chain-lint: OK" in proc.stdout

@@ -216,7 +216,6 @@ def test_fps_spec_grammar():
 def test_select_tables_match_reference():
     """The reference's hand-built select expressions (lib/ffmpeg.py:806-832)
     evaluated symbolically vs our phase tables."""
-    import math
 
     cases = {
         (60, 30): lambda n: (n + 1) % 2 != 0,

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Native-boundary stress driver for the CI sanitizer gates.
+
+Exercises exactly the code the PR 4/5 threading fixes hardened — the
+FFV1 fp worker pool, the batched encode/decode crossings
+(`mp_encoder_write_video_batch` / `mp_decoder_next_batch`), and the
+shared-context batch swscale — WITHOUT importing jax (TSan and the XLA
+runtime do not coexist; the host boundary is pure numpy + ctypes).
+
+Run under a sanitizer flavor (docs/LINT.md "Sanitizer builds"):
+
+    LD_PRELOAD=$(g++ -print-file-name=libasan.so) \
+    ASAN_OPTIONS=detect_leaks=0 \
+    PC_MEDIA_LIB=processing_chain_tpu/native/libpcmedia.asan.so \
+    python tools/native_stress.py
+
+    LD_PRELOAD=$(g++ -print-file-name=libtsan.so) \
+    TSAN_OPTIONS="suppressions=processing_chain_tpu/native/tsan.supp exitcode=66" \
+    OPENBLAS_NUM_THREADS=1 OMP_NUM_THREADS=1 \
+    PC_MEDIA_LIB=processing_chain_tpu/native/libpcmedia.tsan.so \
+    python tools/native_stress.py
+
+(single-threaded BLAS under TSan: OpenBLAS worker threads at fork time
+deadlock the `make` child the loader spawns).
+
+Exit 0 = parity held and the sanitizer stayed quiet; a sanitizer report
+turns into a nonzero exit via halt_on_error/exitcode, which is what the
+CI jobs gate on.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from processing_chain_tpu.io import medialib  # noqa: E402
+from processing_chain_tpu.io.video import VideoReader, VideoWriter  # noqa: E402
+
+W, H, T = 192, 108, 48
+
+
+def _frames(seed: int) -> list[np.ndarray]:
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 256, (T, H, W), np.uint8)
+    u = rng.integers(0, 256, (T, H // 2, W // 2), np.uint8)
+    v = rng.integers(0, 256, (T, H // 2, W // 2), np.uint8)
+    return [np.ascontiguousarray(p) for p in (y, u, v)]
+
+
+def roundtrip(tmp: str, tag: str, threads: int) -> None:
+    """fp worker-pool encode (batched) -> threaded batched decode ->
+    byte parity against the source frames (FFV1 is lossless)."""
+    path = os.path.join(tmp, f"stress_{tag}.avi")
+    # crc32, not hash(): PYTHONHASHSEED randomizes str hashes per
+    # process, and a CI parity failure must be reproducible by tag
+    src = _frames(seed=zlib.crc32(tag.encode()))
+    w = VideoWriter(path, "ffv1", W, H, pix_fmt="yuv420p", fps=(24, 1),
+                    threads=threads)
+    try:
+        w.write_batch(*src)
+    finally:
+        w.close()
+    r = VideoReader(path, threads=threads)
+    got = [[] for _ in range(3)]
+    try:
+        for chunk in r.iter_chunks(16):
+            for i, plane in enumerate(chunk):
+                got[i].append(np.asarray(plane).copy())
+    finally:
+        r.close()
+    for i, (want, parts) in enumerate(zip(src, got)):
+        have = np.concatenate(parts, axis=0)
+        assert have.shape == want.shape, \
+            f"{tag}: plane {i} shape {have.shape} != {want.shape}"
+        assert np.array_equal(have, want), \
+            f"{tag}: plane {i} decode mismatch (lossless roundtrip broke)"
+
+
+def sws_stress() -> None:
+    """Batch swscale through one shared context, concurrently with other
+    native work — the shared-SwsContext path must be race-free."""
+    src = _frames(seed=7)[0]
+    out = medialib.sws_scale_frames(src, W // 2, H // 2,
+                                    flags=medialib.SWS_BILINEAR)
+    assert out.shape == (T, H // 2, W // 2)
+
+
+def main() -> int:
+    medialib.ensure_loaded()
+    print(f"native_stress: {medialib.version()} "
+          f"(PC_MEDIA_LIB={os.environ.get('PC_MEDIA_LIB', '<default>')})",
+          flush=True)
+    with tempfile.TemporaryDirectory(prefix="pc_native_stress_") as tmp:
+        # three concurrent encode->decode roundtrips (each with its own
+        # fp worker pool) + a swscale lane: the cross-thread traffic the
+        # TSan gate watches
+        errors: list[BaseException] = []
+
+        def run(fn, *args):
+            try:
+                fn(*args)
+            except BaseException as exc:  # noqa: BLE001 - reported below
+                errors.append(exc)
+
+        workers = [
+            threading.Thread(target=run, args=(roundtrip, tmp, f"t{i}", 4))
+            for i in range(3)
+        ] + [threading.Thread(target=run, args=(sws_stress,))]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join()
+        if errors:
+            for exc in errors:
+                print(f"native_stress: FAIL: {exc!r}", flush=True)
+            return 1
+        # serial pass too: fp pool teardown/reopen in one thread
+        roundtrip(tmp, "serial", 4)
+    print("native_stress: OK (3 concurrent fp roundtrips + batch sws + "
+          "serial pass, parity held)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

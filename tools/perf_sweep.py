@@ -168,8 +168,9 @@ def main() -> None:
     line = json.dumps(res)
     print(line)
     if args.out:
-        with open(args.out, "w") as fh:
-            fh.write(line + "\n")
+        from processing_chain_tpu.utils.fsio import atomic_write_text
+
+        atomic_write_text(args.out, line + "\n")
 
 
 if __name__ == "__main__":
