@@ -250,7 +250,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
     tools = (
         "src-analysis", "complexity", "plots", "metrics", "clean-logs",
         "run-report", "store", "chain-top", "chain-profile", "bench-compare",
-        "chain-lint",
+        "chain-lint", "chain-serve", "serve-soak",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -282,6 +282,14 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools.chainlint import cli as chainlint_cli
 
             return chainlint_cli.main(rest)
+        if name == "chain-serve":
+            from .tools import chain_serve
+
+            return chain_serve.main(rest)
+        if name == "serve-soak":
+            from .tools import serve_soak
+
+            return serve_soak.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

@@ -116,6 +116,12 @@ class Job:
     #: companion files at their own absolute paths (multi-output jobs,
     #: e.g. p02's vfi/afi/buff next to the qchanges main output)
     extra_outputs: tuple = ()
+    #: serve-layer provenance: the request IDs this unit of work answers
+    #: (chain-serve attaches overlapping requests to ONE execution, so a
+    #: job may satisfy many); folded into provenance, store commits and
+    #: job_* events so an artifact can always be traced back to who
+    #: asked for it
+    request_ids: tuple = ()
     #: why should_run returned False
     #: ("output_exists" | "store_hit" | "store_adopted")
     skip_reason: Optional[str] = None
@@ -277,10 +283,13 @@ class Job:
         self._plan_hash = self._resolve_plan_hash(store)
         if self._plan_hash is None or not os.path.isfile(self.output_path):
             return
+        provenance = dict(self.provenance)
+        if self.request_ids:
+            provenance["requests"] = list(self.request_ids)
         try:
             store.commit(
                 self._plan_hash, self.output_path, producer=self.label,
-                provenance=self.provenance,
+                provenance=provenance,
                 sidecar_suffixes=self.sidecar_suffixes,
                 extra_outputs=self.extra_outputs,
             )
@@ -301,6 +310,8 @@ class Job:
             "job": self.label,
             **self.provenance,
         }
+        if self.request_ids:
+            record["requests"] = list(self.request_ids)
         os.makedirs(os.path.dirname(self.logfile_path), exist_ok=True)
         from ..utils.fsio import atomic_write_text
 
@@ -311,8 +322,11 @@ class Job:
 
     def run(self) -> Any:
         marked = mark_inprogress(self.output_path)
+        req_fields = (
+            {"requests": list(self.request_ids)} if self.request_ids else {}
+        )
         tm.emit("job_start", job=self.label,
-                output=os.path.basename(self.output_path))
+                output=os.path.basename(self.output_path), **req_fields)
         # live view: this job is in flight from here; its completion also
         # advances the enclosing stage's jobs-done progress (stage_span)
         hb = HEARTBEATS.register(self.label, kind="job")
@@ -341,7 +355,7 @@ class Job:
         HEARTBEATS.stage_advance(1)
         _JOB_SECONDS.observe(dur)
         tm.emit("job_end", job=self.label, status="ok",
-                duration_s=round(dur, 4))
+                duration_s=round(dur, 4), **req_fields)
         self.write_provenance()
         # commit before the sentinel clears: a crash inside the commit
         # leaves the sentinel, so the next run redoes the job instead of
@@ -385,7 +399,24 @@ class JobRunner:
         self.parallelism = parallelism
         self.name = name
         self.jobs: list[Job] = []
-        self._writers: dict[str, str] = {}
+        #: output path -> (label, plan fingerprint | None) of its writer
+        self._writers: dict[str, tuple] = {}
+
+    @staticmethod
+    def _plan_fingerprint(job: Job) -> Optional[str]:
+        """Canonical serialization of the UNRESOLVED plan (no file I/O):
+        cheap, deterministic, and exactly what distinguishes two plans
+        submitted under one label."""
+        if job.plan is None:
+            return None
+        from ..store import keys
+
+        try:
+            return keys.canonical_json(job.plan)
+        except keys.PlanError:
+            # an unhashable plan will surface at should_run/commit time;
+            # the dedup decision degrades to the legacy label compare
+            return None
 
     def add(self, job: Optional[Job]) -> None:
         """Plan a job. Two *different* jobs targeting one output file is a
@@ -394,20 +425,32 @@ class JobRunner:
         concurrency safety rests on task independence — SURVEY.md §5);
         here it fails loudly at plan time. The same job added twice (the
         reference's dedup case, e.g. one segment shared by many PVSes)
-        stays a silent dedup."""
+        stays a silent dedup — but "same" means same label AND same plan:
+        two different plans under one label targeting one output used to
+        dedup silently, hiding a real divergence."""
         if job is None:
             return
         if job.output_path:
+            fp = self._plan_fingerprint(job)
             prior = self._writers.get(job.output_path)
-            if prior == job.label:
-                _JOBS_DEDUPED.labels(runner=self.name).inc()
-                return  # same plan submitted again: dedup
             if prior is not None:
+                prior_label, prior_fp = prior
+                if prior_label == job.label and (
+                    fp is None or prior_fp is None or fp == prior_fp
+                ):
+                    _JOBS_DEDUPED.labels(runner=self.name).inc()
+                    return  # same plan submitted again: dedup
+                if prior_label == job.label:
+                    raise ChainError(
+                        f"{self.name}: job '{job.label}' submitted twice "
+                        f"with DIFFERENT plans for {job.output_path} — "
+                        "write-write race hidden under one label"
+                    )
                 raise ChainError(
-                    f"{self.name}: jobs '{prior}' and '{job.label}' both "
-                    f"write {job.output_path} — write-write race"
+                    f"{self.name}: jobs '{prior_label}' and '{job.label}' "
+                    f"both write {job.output_path} — write-write race"
                 )
-            self._writers[job.output_path] = job.label
+            self._writers[job.output_path] = (job.label, fp)
         if job.should_run(self.force, self.dry_run, runner=self.name):
             _JOBS_PLANNED.labels(runner=self.name).inc()
             # the live per-stage denominator: every planned job is one

@@ -1,0 +1,33 @@
+"""chain-serve: the always-on processing service.
+
+ROADMAP open item #2 — stop being a batch CLI, become a long-running
+daemon. The pieces, each its own module:
+
+    api.py        request grammar: tenant/priority validation, the
+                  database/SRC/HRC ID regexes (config/ids), grid
+                  expansion into per-PVS work units
+    queue.py      durable, dedup-aware job queue: one atomic JSON record
+                  per job (store tmp+rename idiom via utils/fsio),
+                  `.inprogress` sentinels that REQUEUE on restart,
+                  plan-hash attachment so overlapping requests share one
+                  execution by construction
+    scheduler.py  worker threads draining the queue through the engine's
+                  JobRunner: stride-scheduled weighted fairness across
+                  (tenant × priority class), singleflight claims, and
+                  cross-request device-wave packing (parallel/p03_batch
+                  bucket keys)
+    executors.py  what a unit of work IS: the Executor protocol plus the
+                  synthetic toy executor (CI/soak) and the device-wave
+                  executor (real shared waves on the mesh)
+    pressure.py   serve-side LRU pressure driving store/gc with the
+                  plans of unfinished requests pinned
+    service.py    the daemon: composes all of the above onto ONE
+                  LiveServer (telemetry/live route registry) — /healthz,
+                  /metrics, /status and /v1/* share a port
+
+Entry point: `tools chain-serve` (tools/chain_serve.py).
+API + durability + fairness semantics: docs/SERVE.md.
+"""
+
+from .api import RequestError, validate_request  # noqa: F401
+from .service import ChainServeService  # noqa: F401

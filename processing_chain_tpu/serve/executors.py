@@ -1,0 +1,238 @@
+"""What a serve unit of work IS: the Executor protocol + built-ins.
+
+An executor turns a validated per-PVS unit into (a) a *plan* — the
+JSON-able payload whose store hash is the unit's identity for dedup,
+warm hits and artifact addressing — and (b) bytes on disk, produced by
+`run_batch` for a whole wave of units at once. The batch signature is
+the point: units from DIFFERENT requests that share a `bucket_key`
+(geometry bucket, parallel/p03_batch semantics) are handed to one call
+so the executor can pack them into one device wave.
+
+Built-ins:
+
+  * `synthetic` — deterministic pseudo-artifacts (bytes derived from
+    the canonical plan), optional simulated work time. The toy-corpus
+    executor CI smoke, the soak driver and the kill/restart test run
+    against: cheap, exactly reproducible, and honest about identity
+    (different params ⇒ different plan hash ⇒ different artifact).
+  * `wave` — REAL shared device waves: builds a p03_batch.Lane per unit
+    (deterministic synthetic YUV), drives the whole bucket through
+    `run_bucket` on the process mesh, writes the scaled luma. Proof
+    that cross-request work actually lands in one compiled step.
+
+The production database executor (units backed by real SRC files and
+HRC event lists through the p01–p04 stages) plugs in through the same
+protocol — see docs/SERVE.md "Executors".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Optional, Protocol
+
+from .. import telemetry as tm
+from ..store import keys
+from ..utils.fsio import atomic_write
+from .api import Unit
+
+_WAVES = tm.counter(
+    "chain_serve_waves_total", "batched executions dispatched by the scheduler"
+)
+_WAVE_LANES = tm.histogram(
+    "chain_serve_wave_lanes", "units packed into each dispatched wave"
+)
+
+
+class Executor(Protocol):
+    """The serve execution contract (docs/SERVE.md "Executors")."""
+
+    kind: str
+
+    def plan(self, unit: Unit) -> dict:
+        """JSON-able identity payload: everything that determines the
+        artifact's bytes. Hashed by the store (plan-hash dedup key)."""
+        ...
+
+    def output_name(self, unit: Unit, plan_hash: str) -> str:
+        """Artifact filename under the serve artifacts root."""
+        ...
+
+    def bucket_key(self, unit: dict) -> Optional[tuple]:
+        """Geometry bucket for wave packing; None = cannot batch.
+        Called with the RECORD's unit dict (queue.JobRecord.unit)."""
+        ...
+
+    def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
+        """Produce every output. Called inside engine.Job (sentinels,
+        store commit, telemetry ride along)."""
+        ...
+
+
+def _unit_of(record_unit: dict) -> Unit:
+    return Unit(
+        database=record_unit["database"], src=record_unit["src"],
+        hrc=record_unit["hrc"], params=dict(record_unit.get("params", {})),
+    )
+
+
+def record_waves(n_units: int) -> None:
+    """Wave accounting shared by every executor dispatch path."""
+    _WAVES.inc()
+    _WAVE_LANES.observe(float(n_units))
+
+
+class SyntheticExecutor:
+    """Deterministic toy processing: artifact bytes are a SHA-256
+    stream over the canonical plan. Params (all optional):
+
+        size_bytes  artifact size (default 4096)
+        work_ms     simulated compute per unit (default 0)
+        geometry    [w, h] — units sharing it batch into one wave
+    """
+
+    kind = "synthetic"
+
+    def plan(self, unit: Unit) -> dict:
+        return {
+            "op": "serve.synthetic",
+            "schema": 1,
+            "database": unit.database,
+            "src": unit.src,
+            "hrc": unit.hrc,
+            "params": dict(unit.params),
+        }
+
+    def output_name(self, unit: Unit, plan_hash: str) -> str:
+        return f"{unit.pvs_id}_{plan_hash[:12]}.bin"
+
+    def bucket_key(self, record_unit: dict) -> Optional[tuple]:
+        geometry = record_unit.get("params", {}).get("geometry")
+        if not geometry:
+            return None
+        return ("synthetic", *(int(g) for g in geometry))
+
+    def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
+        record_waves(len(units))
+        for unit, output in zip(units, outputs):
+            params = unit.params
+            work_ms = float(params.get("work_ms", 0) or 0)
+            if work_ms > 0:
+                time.sleep(work_ms / 1000.0)
+            size = int(params.get("size_bytes", 4096) or 4096)
+            seed = keys.canonical_json(self.plan(unit)).encode()
+            chunks: list[bytes] = []
+            digest = hashlib.sha256(seed).digest()
+            produced = 0
+            while produced < size:
+                chunks.append(digest)
+                produced += len(digest)
+                digest = hashlib.sha256(digest).digest()
+            data = b"".join(chunks)[:size]
+
+            def _write(tmp: str, payload: bytes = data) -> None:
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+
+            atomic_write(output, _write)
+
+
+class DeviceWaveExecutor(SyntheticExecutor):
+    """Real cross-request device waves: every unit in the batch becomes
+    one p03_batch.Lane over deterministic synthetic YUV, and the whole
+    bucket runs through `run_bucket` on the process mesh — independent
+    requests literally share compiled device steps. Params:
+
+        frames            lane length (default 8)
+        src_h/src_w       source geometry (default 36x64)
+        dst_h/dst_w       target geometry (default 72x128)
+    """
+
+    kind = "wave"
+
+    _GEO = ("src_h", "src_w", "dst_h", "dst_w")
+    _DEFAULTS = {"frames": 8, "src_h": 36, "src_w": 64,
+                 "dst_h": 72, "dst_w": 128}
+
+    def _geometry(self, params: dict) -> dict:
+        geo = dict(self._DEFAULTS)
+        for key in ("frames", *self._GEO):
+            if key in params:
+                geo[key] = int(params[key])
+        return geo
+
+    def plan(self, unit: Unit) -> dict:
+        plan = super().plan(unit)
+        plan["op"] = "serve.wave"
+        plan["geometry"] = self._geometry(unit.params)
+        return plan
+
+    def bucket_key(self, record_unit: dict) -> Optional[tuple]:
+        geo = self._geometry(record_unit.get("params", {}))
+        return ("wave",) + tuple(geo[k] for k in self._GEO)
+
+    def _mesh(self):
+        from ..parallel.mesh import make_mesh
+
+        return make_mesh(time_parallel=1)
+
+    def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
+        import numpy as np
+
+        from ..parallel import p03_batch
+
+        record_waves(len(units))
+        geo = self._geometry(units[0].params)
+        sh, sw = geo["src_h"], geo["src_w"]
+        dh, dw = geo["dst_h"], geo["dst_w"]
+        collected: list[list] = [[] for _ in units]
+        lanes = []
+        for i, unit in enumerate(units):
+            n = self._geometry(unit.params)["frames"]
+            seed = int.from_bytes(
+                hashlib.sha256(
+                    keys.canonical_json(self.plan(unit)).encode()
+                ).digest()[:8], "big",
+            )
+            rng = np.random.default_rng(seed)
+            yuv = [
+                rng.integers(0, 255, size=(n, sh, sw), dtype=np.uint8),
+                rng.integers(0, 255, size=(n, sh // 2, sw // 2),
+                             dtype=np.uint8),
+                rng.integers(0, 255, size=(n, sh // 2, sw // 2),
+                             dtype=np.uint8),
+            ]
+            lanes.append(p03_batch.Lane(
+                chunks=iter([yuv]), emit=collected[i].append,
+                n_frames_hint=n,
+            ))
+        p03_batch.run_bucket(
+            lanes, self._mesh(), dh, dw, "bicubic", (2, 2), False, chunk=8,
+        )
+        for i, output in enumerate(outputs):
+            planes = [
+                np.concatenate([blk[p] for blk in collected[i]])
+                for p in range(3)
+            ]
+            data = b"".join(p.tobytes() for p in planes)
+
+            def _write(tmp: str, payload: bytes = data) -> None:
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+
+            atomic_write(output, _write)
+
+
+EXECUTORS = {
+    SyntheticExecutor.kind: SyntheticExecutor,
+    DeviceWaveExecutor.kind: DeviceWaveExecutor,
+}
+
+
+def make_executor(kind: str):
+    try:
+        return EXECUTORS[kind]()
+    except KeyError:
+        raise ValueError(
+            f"unknown serve executor {kind!r}; known: {sorted(EXECUTORS)}"
+        ) from None

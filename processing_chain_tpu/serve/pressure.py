@@ -1,0 +1,88 @@
+"""Serve-side LRU pressure on the artifact store.
+
+A daemon that never exits needs the batch CLI's `tools store gc` run
+FOR it: after completions, this hook checks the store's object bytes
+against the operator's budget and, over budget, runs the shared
+`store.gc.enforce_budget` pass with the plans of every UNFINISHED
+request passed as ephemeral pins — the cache can evict any completed
+cold artifact, but never one a queued request is about to claim.
+
+Throttled (`min_interval_s`) because the budget check walks objects/;
+eviction pressure is a trend, not a per-job emergency.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from .. import telemetry as tm
+from ..store import gc as store_gc
+from ..utils import lockdebug
+from ..utils.log import get_logger
+
+_GC_EVICTED = tm.counter(
+    "chain_serve_gc_evicted_bytes_total",
+    "bytes freed by serve-side store GC pressure",
+)
+
+
+class StorePressure:
+    """Budget enforcement hook wired to scheduler completions."""
+
+    def __init__(
+        self,
+        store,
+        budget_bytes: Optional[int],
+        active_plans: Callable[[], set],
+        min_interval_s: float = 5.0,
+    ) -> None:
+        self.store = store
+        self.budget_bytes = budget_bytes
+        self.active_plans = active_plans
+        self.min_interval_s = float(min_interval_s)
+        self._lock = lockdebug.make_lock("serve_pressure")
+        self._last = 0.0          # guarded-by: _lock
+        self._running = False     # guarded-by: _lock
+
+    def maybe_collect(self, force: bool = False) -> Optional[dict]:
+        """One throttled budget check; the GC pass itself runs OUTSIDE
+        the lock (it walks the store) with reentry suppressed. Returns
+        the gc summary when a pass ran, else None."""
+        if self.store is None or not self.budget_bytes:
+            return None
+        with self._lock:
+            now = time.monotonic()
+            if self._running:
+                return None
+            if not force and now - self._last < self.min_interval_s:
+                return None
+            self._last = now
+            self._running = True
+        try:
+            stats = self.store.stats()
+            if not force and stats["bytes"] <= self.budget_bytes:
+                return None
+            pins = set(self.active_plans())
+            summary = store_gc.enforce_budget(
+                self.store, self.budget_bytes, extra_pins=pins,
+            )
+            _GC_EVICTED.inc(summary["bytes_freed"])
+            tm.emit(
+                "serve_gc",
+                bytes_freed=summary["bytes_freed"],
+                objects_evicted=summary["objects_evicted"],
+                pins_honored=summary["pins_honored"],
+                kept_bytes=summary["kept_bytes"],
+            )
+            if summary["bytes_freed"]:
+                get_logger().info(
+                    "serve gc: freed %d bytes (%d objects), %d pin(s) "
+                    "honored, %d bytes kept",
+                    summary["bytes_freed"], summary["objects_evicted"],
+                    summary["pins_honored"], summary["kept_bytes"],
+                )
+            return summary
+        finally:
+            with self._lock:
+                self._running = False

@@ -1,0 +1,388 @@
+"""Durable, dedup-aware job queue: the serve daemon's crash-proof spine.
+
+One JSON record per job under `jobs/`, every state change an atomic
+rewrite (utils/fsio — the store's tmp+rename idiom), so a reader or a
+restarted daemon never sees a torn record. While a job executes, a
+`<record>.inprogress` sentinel sits next to it (the engine's crash
+discipline, applied to queue records): a daemon SIGKILLed mid-execution
+leaves the sentinel behind, and recovery REQUEUES the job instead of
+stranding it — the artifact-level sentinel inside engine.Job
+independently guarantees the half-written output is rebuilt, not
+trusted.
+
+Dedup is identity-by-plan-hash, the store's own key: enqueueing a unit
+whose plan hash already has a queued/running job ATTACHES the new
+request to that record instead of minting a second execution —
+overlapping requests from any number of tenants share one job by
+construction (singleflight). A plan whose job already completed is the
+caller's warm path (the store serves it); a failed or evicted plan
+re-arms the same record.
+
+States: queued → running → done | failed (failed/evicted re-arm to
+queued on the next enqueue). The record keeps every request ID it
+answers, `attempts`, and timing for forensics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import telemetry as tm
+from ..utils import lockdebug
+from ..utils.fsio import atomic_write_json
+from ..utils.log import get_logger
+
+_QUEUE_DEPTH = tm.gauge(
+    "chain_serve_queue_depth", "jobs waiting in the serve queue"
+)
+
+#: states a new request can attach to (the singleflight window)
+_ATTACHABLE = ("queued", "running")
+
+
+def _id_seq(job_id: str) -> int:
+    """Numeric tail of a j-prefixed job id; 0 for foreign names."""
+    try:
+        return int(job_id.lstrip("j"))
+    except ValueError:
+        return 0
+
+
+@dataclass
+class JobRecord:
+    """One durable unit of work, keyed by its plan hash."""
+
+    job_id: str
+    plan_hash: str
+    plan: dict
+    unit: dict            # {"database","src","hrc","params","pvs_id"}
+    tenant: str
+    priority: str
+    output: str           # path RELATIVE to the artifacts root
+    requests: list = field(default_factory=list)
+    state: str = "queued"
+    enqueued_at: float = 0.0
+    attempts: int = 0
+    error: Optional[str] = None
+    done_at: Optional[float] = None
+    warm: bool = False    # completed via store hit, not execution
+
+    def to_json(self) -> dict:
+        return {
+            "job": self.job_id,
+            "planHash": self.plan_hash,
+            "plan": self.plan,
+            "unit": self.unit,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "output": self.output,
+            "requests": list(self.requests),
+            "state": self.state,
+            "enqueuedAt": self.enqueued_at,
+            "attempts": self.attempts,
+            "error": self.error,
+            "doneAt": self.done_at,
+            "warm": self.warm,
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "JobRecord":
+        return cls(
+            job_id=data["job"],
+            plan_hash=data["planHash"],
+            plan=data["plan"],
+            unit=data["unit"],
+            tenant=data.get("tenant", ""),
+            priority=data.get("priority", "normal"),
+            output=data.get("output", ""),
+            requests=list(data.get("requests", [])),
+            state=data.get("state", "queued"),
+            enqueued_at=float(data.get("enqueuedAt", 0.0)),
+            attempts=int(data.get("attempts", 0)),
+            error=data.get("error"),
+            done_at=data.get("doneAt"),
+            warm=bool(data.get("warm", False)),
+        )
+
+
+class DurableQueue:
+    """Crash-recoverable on-disk job queue with plan-hash dedup.
+
+    Thread-safe: the scheduler's workers and the HTTP submit path hit it
+    concurrently. All disk writes happen UNDER the queue lock — the
+    record files are small and the atomic rewrite is one replace; a
+    torn in-memory/on-disk split would be worse than the contention."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        self.jobs_dir = os.path.join(self.root, "jobs")
+        os.makedirs(self.jobs_dir, exist_ok=True)
+        self._lock = lockdebug.make_lock("serve_queue")
+        self._jobs: dict[str, JobRecord] = {}     # guarded-by: _lock
+        self._by_plan: dict[str, str] = {}        # guarded-by: _lock
+        self._queued: dict[str, JobRecord] = {}   # guarded-by: _lock
+        self._running: dict[str, JobRecord] = {}  # guarded-by: _lock
+        self._next_id = 1                         # guarded-by: _lock
+        self.recovery: dict = {"jobs": 0, "requeued": 0, "done": 0,
+                               "failed": 0}
+        self._recover()
+
+    # ----------------------------------------------------------- layout
+
+    def _record_path(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, job_id + ".json")
+
+    def _sentinel_path(self, job_id: str) -> str:
+        return self._record_path(job_id) + ".inprogress"
+
+    # holds-lock: _lock
+    def _persist(self, record: JobRecord) -> None:
+        atomic_write_json(self._record_path(record.job_id),
+                          record.to_json(), sort_keys=True)
+
+    # holds-lock: _lock
+    def _set_depth_gauge(self) -> None:
+        _QUEUE_DEPTH.set(len(self._queued))
+
+    # --------------------------------------------------------- recovery
+
+    def _recover(self) -> None:
+        """Rebuild the in-memory view from disk. `.inprogress` sentinels
+        mark executions a dead daemon never finished: requeue them
+        (attempts+1) instead of stranding — the artifact store decides
+        at execution time whether the work actually completed (a commit
+        that landed before the kill is a warm hit, zero re-execution)."""
+        log = get_logger()
+        with self._lock:
+            try:
+                names = sorted(os.listdir(self.jobs_dir))
+            except OSError:
+                names = []
+            max_seq = 0
+            for name in names:
+                if not name.endswith(".json"):
+                    continue
+                path = os.path.join(self.jobs_dir, name)
+                try:
+                    with open(path) as f:
+                        record = JobRecord.from_json(json.load(f))
+                except (OSError, ValueError, KeyError) as exc:
+                    log.warning("serve queue: unreadable record %s (%s); "
+                                "skipping", path, exc)
+                    continue
+                seq = _id_seq(record.job_id)
+                max_seq = max(max_seq, seq)
+                requeue = False
+                if os.path.isfile(self._sentinel_path(record.job_id)):
+                    requeue = True
+                    try:
+                        os.unlink(self._sentinel_path(record.job_id))
+                    except OSError:
+                        pass
+                if record.state == "running":
+                    # state says running but no sentinel: the rewrite to
+                    # done/failed never landed either — same verdict
+                    requeue = True
+                if requeue:
+                    record.state = "queued"
+                    record.attempts += 1
+                    record.error = None
+                    self._persist(record)
+                    self.recovery["requeued"] += 1
+                    tm.emit("serve_requeued", job=record.job_id,
+                            plan=record.plan_hash,
+                            attempts=record.attempts)
+                self._jobs[record.job_id] = record
+                self.recovery["jobs"] += 1
+                if record.state == "queued":
+                    self._queued[record.job_id] = record
+                elif record.state == "done":
+                    self.recovery["done"] += 1
+                elif record.state == "failed":
+                    self.recovery["failed"] += 1
+                # index preference: a live (queued/running/done) record
+                # wins over a failed one for the same plan
+                prior = self._by_plan.get(record.plan_hash)
+                if prior is None or self._jobs[prior].state == "failed":
+                    self._by_plan[record.plan_hash] = record.job_id
+            self._next_id = max_seq + 1
+            self._set_depth_gauge()
+        if self.recovery["requeued"]:
+            log.warning(
+                "serve queue: requeued %d interrupted job(s) after restart",
+                self.recovery["requeued"],
+            )
+
+    # ---------------------------------------------------------- enqueue
+
+    def enqueue(
+        self,
+        plan_hash: str,
+        plan: dict,
+        unit: dict,
+        tenant: str,
+        priority: str,
+        request_id: str,
+        output: str,
+    ) -> tuple[JobRecord, str]:
+        """Enqueue one unit (or attach to its in-flight twin). Returns
+        (record, outcome) with outcome ∈ new | attached | done:
+        `attached` = a queued/running job with this plan hash already
+        exists and now also answers `request_id`; `done` = the record
+        completed earlier (the caller should serve from the store —
+        and re-enqueue via `rearm=True` if the store lost the bytes)."""
+        with self._lock:
+            existing_id = self._by_plan.get(plan_hash)
+            if existing_id is not None:
+                record = self._jobs[existing_id]
+                if record.state in _ATTACHABLE:
+                    if request_id not in record.requests:
+                        record.requests.append(request_id)
+                        self._persist(record)
+                    return record, "attached"
+                if record.state == "done":
+                    if request_id not in record.requests:
+                        record.requests.append(request_id)
+                        self._persist(record)
+                    return record, "done"
+                # failed: re-arm the same record for a fresh attempt —
+                # with a fresh attempt BUDGET (a plan that exhausted its
+                # retries last week must not inherit the spent counter)
+                record.state = "queued"
+                record.error = None
+                record.warm = False
+                record.attempts = 0
+                record.enqueued_at = time.time()
+                if request_id not in record.requests:
+                    record.requests.append(request_id)
+                self._persist(record)
+                self._queued[record.job_id] = record
+                self._set_depth_gauge()
+                return record, "new"
+            record = JobRecord(
+                job_id=f"j{self._next_id:06d}",
+                plan_hash=plan_hash,
+                plan=plan,
+                unit=unit,
+                tenant=tenant,
+                priority=priority,
+                output=output,
+                requests=[request_id],
+                state="queued",
+                enqueued_at=time.time(),
+            )
+            self._next_id += 1
+            self._persist(record)
+            self._jobs[record.job_id] = record
+            self._by_plan[plan_hash] = record.job_id
+            self._queued[record.job_id] = record
+            self._set_depth_gauge()
+            return record, "new"
+
+    def rearm(self, job_id: str) -> Optional[JobRecord]:
+        """Force a done-but-evicted record back to queued (the store no
+        longer holds its artifact and a request needs it again)."""
+        with self._lock:
+            record = self._jobs.get(job_id)
+            if record is None or record.state in _ATTACHABLE:
+                return record
+            record.state = "queued"
+            record.error = None
+            record.warm = False
+            record.attempts = 0
+            record.enqueued_at = time.time()
+            self._persist(record)
+            self._queued[record.job_id] = record
+            self._set_depth_gauge()
+            return record
+
+    # ------------------------------------------------------- scheduling
+
+    def queued_snapshot(self) -> list[JobRecord]:
+        with self._lock:
+            return sorted(self._queued.values(), key=lambda r: r.enqueued_at)
+
+    def claim(self, job_ids: list[str]) -> list[JobRecord]:
+        """Move jobs queued → running (sentinel down). Jobs another
+        worker claimed first are silently skipped — the returned list is
+        what THIS caller owns."""
+        owned: list[JobRecord] = []
+        with self._lock:
+            for job_id in job_ids:
+                record = self._queued.pop(job_id, None)
+                if record is None:
+                    continue
+                record.state = "running"
+                self._running[job_id] = record
+                # chainlint: disable=atomic-write (sentinel: only its EXISTENCE signals an unfinished execution — same contract as the engine's .inprogress)
+                with open(self._sentinel_path(job_id), "w"):
+                    pass
+                self._persist(record)
+                owned.append(record)
+            self._set_depth_gauge()
+        return owned
+
+    def complete(self, job_id: str, warm: bool = False) -> Optional[JobRecord]:
+        with self._lock:
+            record = self._jobs.get(job_id)
+            if record is None:
+                return None
+            self._running.pop(job_id, None)
+            self._queued.pop(job_id, None)
+            record.state = "done"
+            record.warm = warm
+            record.error = None
+            record.done_at = time.time()
+            self._persist(record)
+            self._clear_sentinel(job_id)
+            self._set_depth_gauge()
+            return record
+
+    def fail(self, job_id: str, error: str,
+             requeue: bool = False) -> Optional[JobRecord]:
+        with self._lock:
+            record = self._jobs.get(job_id)
+            if record is None:
+                return None
+            self._running.pop(job_id, None)
+            record.error = str(error)[:500]
+            if requeue:
+                record.state = "queued"
+                record.attempts += 1
+                self._queued[job_id] = record
+            else:
+                record.state = "failed"
+                record.done_at = time.time()
+            self._persist(record)
+            self._clear_sentinel(job_id)
+            self._set_depth_gauge()
+            return record
+
+    # holds-lock: _lock
+    def _clear_sentinel(self, job_id: str) -> None:
+        try:
+            os.unlink(self._sentinel_path(job_id))
+        except FileNotFoundError:
+            pass
+
+    # ------------------------------------------------------------ views
+
+    def record(self, job_id: str) -> Optional[JobRecord]:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def by_plan(self, plan_hash: str) -> Optional[JobRecord]:
+        with self._lock:
+            job_id = self._by_plan.get(plan_hash)
+            return self._jobs.get(job_id) if job_id else None
+
+    def counts(self) -> dict:
+        with self._lock:
+            states: dict[str, int] = {}
+            for record in self._jobs.values():
+                states[record.state] = states.get(record.state, 0) + 1
+            return states

@@ -1,0 +1,355 @@
+"""Queue-draining scheduler: weighted fairness, singleflight, waves.
+
+Worker threads pull from the DurableQueue and execute through the
+engine's JobRunner — so serve executions get exactly the batch chain's
+crash sentinels, store commits, provenance and telemetry, not a
+parallel implementation of them.
+
+Scheduling policy, in order:
+
+  1. **Fairness** — stride scheduling over (tenant × priority class)
+     flows. Each flow carries a virtual `pass`; dispatching from a flow
+     advances its pass by `SCALE / (tenant_weight × class_weight)`.
+     The next seed job always comes from the flow with the smallest
+     pass: an interactive flow (weight 16) drains ~16x the rate of a
+     bulk flow (weight 1) under contention, yet every flow's pass
+     eventually becomes the smallest — nothing starves. New flows join
+     at the current minimum pass, so arriving tenants neither wait out
+     history nor monopolize the near future.
+  2. **Wave packing** — after the fairness pick chooses WHO goes next,
+     the wave fills with other queued units sharing the seed's bucket
+     key (parallel/p03_batch geometry semantics) regardless of tenant
+     or request, up to `wave_width`: device sharing is free capacity,
+     not a fairness question.
+  3. **Singleflight** — `queue.claim` moves records queued→running
+     under the queue lock; a plan hash can never be executing twice,
+     and enqueue-time attachment (queue.py) means overlapping requests
+     were already riding the one record.
+
+Execution failures retry up to `max_attempts` (the store decides what
+actually completed: a commit that landed before a crash is a warm hit,
+never a re-execution).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from .. import telemetry as tm
+from ..engine.jobs import Job, JobRunner
+from ..store import runtime as store_runtime
+from ..utils import lockdebug
+from ..utils.log import get_logger
+from .api import PRIORITIES
+from .executors import _unit_of
+from .queue import DurableQueue, JobRecord
+
+_INFLIGHT = tm.gauge(
+    "chain_serve_inflight", "units currently executing in the serve scheduler"
+)
+
+#: stride virtual-time scale (anything ≫ max weight works; power of two
+#: keeps the passes exact in floats far past any realistic uptime)
+_SCALE = 1 << 20
+
+
+class StridePicker:
+    """Stride scheduling over (tenant, priority) flows. Not thread-safe
+    by itself — the scheduler serializes picks under its own lock."""
+
+    def __init__(self, tenant_weights: Optional[dict] = None) -> None:
+        self._weights = dict(tenant_weights or {})
+        self._pass: dict[tuple, float] = {}
+
+    def _stride(self, flow: tuple) -> float:
+        tenant, priority = flow
+        weight = max(float(self._weights.get(tenant, 1.0)), 1e-6)
+        return _SCALE / (weight * PRIORITIES.get(priority, 1))
+
+    def pick(self, queued: list[JobRecord]) -> JobRecord:
+        """Choose the next seed among queued records (must be non-empty)
+        and advance its flow's pass."""
+        flows: dict[tuple, JobRecord] = {}
+        for record in queued:  # queued is enqueue-ordered: first wins
+            flow = (record.tenant, record.priority)
+            if flow not in flows:
+                flows[flow] = record
+        floor = min(self._pass.values()) if self._pass else 0.0
+        for flow in flows:
+            if flow not in self._pass:
+                self._pass[flow] = floor
+        chosen = min(
+            flows,
+            key=lambda f: (self._pass[f], -PRIORITIES.get(f[1], 1), f[0]),
+        )
+        self._pass[chosen] += self._stride(chosen)
+        return flows[chosen]
+
+
+#: how long a wave member will wait for its siblings to ARRIVE at the
+#: barrier. All members are submitted to a pool exactly as wide as the
+#: wave, so arrival is thread-startup time (milliseconds) — a miss on
+#: this timeout means a sibling job died before reaching its fn, and
+#: waiting longer would deadlock the wave forever.
+_ARRIVAL_TIMEOUT_S = 60.0
+
+
+class _WaveBarrier:
+    """One shared execution for a batch of engine Jobs: every planned
+    job's fn arrives here; the LAST arrival (all sentinels down by then)
+    runs the executor's batch once; everyone returns together. A batch
+    failure surfaces in every member job, so the runner's fail-fast and
+    the per-job telemetry stay truthful.
+
+    Deadlock-proofing: waiters block UNBOUNDED only on the compute
+    phase (which is genuinely unbounded — a device wave takes as long
+    as it takes) but only BOUNDED on the arrival phase. If a sibling
+    dies before reaching produce() (any unexpected pre-fn failure), the
+    remaining members time out, fail their jobs, and the scheduler's
+    settle path re-queues against the store instead of hanging the
+    worker thread forever."""
+
+    def __init__(self, executor, units: list, outputs: list) -> None:
+        self._executor = executor
+        self._units = units
+        self._outputs = outputs
+        self._lock = lockdebug.make_lock("serve_wave")
+        self._expected: int = len(units)  # guarded-by: _lock
+        self._arrived: int = 0            # guarded-by: _lock
+        self._all_arrived = threading.Event()
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def produce(self) -> None:
+        with self._lock:
+            self._arrived += 1
+            run_it = self._arrived == self._expected
+        if run_it:
+            self._all_arrived.set()
+            try:
+                self._executor.run_batch(self._units, self._outputs)
+            except BaseException as exc:  # noqa: BLE001 - must release waiters
+                self._error = exc
+                raise
+            finally:
+                self._done.set()
+        else:
+            if not self._all_arrived.wait(timeout=_ARRIVAL_TIMEOUT_S):
+                with self._lock:
+                    arrived, expected = self._arrived, self._expected
+                raise RuntimeError(
+                    f"wave barrier: only {arrived}/{expected} members "
+                    "arrived — a sibling job died before reaching its fn; "
+                    "failing this member instead of deadlocking"
+                )
+            self._done.wait()
+            if self._error is not None:
+                raise RuntimeError(
+                    f"wave execution failed: {self._error!r}"
+                ) from self._error
+
+
+class Scheduler:
+    """Worker threads draining the queue (see module doc for policy)."""
+
+    def __init__(
+        self,
+        queue: DurableQueue,
+        executor,
+        artifacts_root: str,
+        workers: int = 2,
+        wave_width: int = 4,
+        tenant_weights: Optional[dict] = None,
+        max_attempts: int = 2,
+        on_done: Optional[Callable[[JobRecord], None]] = None,
+        on_failed: Optional[Callable[[JobRecord], None]] = None,
+    ) -> None:
+        self.queue = queue
+        self.executor = executor
+        self.artifacts_root = artifacts_root
+        self.workers = max(1, int(workers))
+        self.wave_width = max(1, int(wave_width))
+        self.max_attempts = max(1, int(max_attempts))
+        self.on_done = on_done or (lambda record: None)
+        self.on_failed = on_failed or (lambda record: None)
+        self._picker = StridePicker(tenant_weights)
+        self._lock = lockdebug.make_lock("serve_sched")
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> "Scheduler":
+        if not self._threads:
+            for i in range(self.workers):
+                t = threading.Thread(
+                    target=self._worker, name=f"chain-serve-worker-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads = []
+
+    def notify(self) -> None:
+        """New work arrived (submit path); wake idle workers now."""
+        self._wake.set()
+
+    # --------------------------------------------------------- main loop
+
+    def _worker(self) -> None:
+        log = get_logger()
+        while not self._stop.is_set():
+            batch = self._next_batch()
+            if not batch:
+                self._wake.wait(timeout=0.2)
+                self._wake.clear()
+                continue
+            try:
+                self._dispatch(batch)
+            except BaseException:  # noqa: BLE001 - a worker must survive anything
+                log.exception("serve scheduler: dispatch crashed")
+
+    def _next_batch(self) -> list[JobRecord]:
+        """Fairness seed + same-bucket fill, all claimed atomically. The
+        fill is `pack_waves` (parallel/p03_batch) — the one wave-packing
+        policy, shared with every other bucket consumer: the claimed
+        batch is exactly the packed wave containing the fairness seed."""
+        from ..parallel.p03_batch import pack_waves
+
+        with self._lock:
+            queued = self.queue.queued_snapshot()
+            if not queued:
+                return []
+            seed = self._picker.pick(queued)
+            waves = pack_waves(
+                queued, key_of=lambda r: self.executor.bucket_key(r.unit),
+                width=self.wave_width,
+            )
+            wave = next(
+                w for w in waves
+                if any(r.job_id == seed.job_id for r in w)
+            )
+            return self.queue.claim([r.job_id for r in wave])
+
+    # --------------------------------------------------------- execution
+
+    def _dispatch(self, batch: list[JobRecord]) -> None:
+        """Execute one claimed batch. EVERY claimed record leaves this
+        method settled — completed, requeued, or failed: an exception
+        anywhere (planning, a mid-loop persist error, the runner itself)
+        falls through to the settle path, because a claimed record left
+        in state 'running' with no owner would hang its requests forever
+        and soak up attaching newcomers."""
+        settled: set[str] = set()
+        _INFLIGHT.inc(len(batch))
+        try:
+            os.makedirs(self.artifacts_root, exist_ok=True)
+            runner = JobRunner(parallelism=len(batch), name="serve")
+            by_label: dict[str, JobRecord] = {}
+            out_of: dict[str, str] = {}
+            for record in batch:
+                label = f"serve:{record.unit['pvs_id']}:{record.plan_hash[:8]}"
+                by_label[label] = record
+                out_of[label] = os.path.join(
+                    self.artifacts_root, record.output
+                )
+                runner.add(Job(
+                    label=label,
+                    output_path=out_of[label],
+                    fn=None,  # bound below, once planning has spoken
+                    plan=record.plan,
+                    provenance={
+                        "tenant": record.tenant,
+                        "priority": record.priority,
+                        "executor": self.executor.kind,
+                    },
+                    request_ids=tuple(record.requests),
+                ))
+            planned = {job.label for job in runner.jobs}
+            # store warm path: should_run already verified+materialized
+            # the artifact for skipped jobs — complete them right now
+            for label, record in by_label.items():
+                if label not in planned:
+                    self._complete(record, settled, warm=True)
+            if not planned:
+                return
+            # the wave holds exactly the PLANNED members: a warm-skipped
+            # unit must neither be recomputed nor waited for
+            wave = _WaveBarrier(
+                self.executor,
+                [_unit_of(by_label[j.label].unit) for j in runner.jobs],
+                [out_of[j.label] for j in runner.jobs],
+            )
+            for job in runner.jobs:
+                job.fn = wave.produce
+            runner.run()
+            for label in planned:
+                self._complete(by_label[label], settled)
+        except Exception as exc:
+            self._settle_failure(batch, settled, exc)
+        finally:
+            _INFLIGHT.dec(len(batch))
+
+    def _complete(self, record: JobRecord, settled: set,
+                  warm: bool = False) -> None:
+        done = self.queue.complete(record.job_id, warm=warm)
+        settled.add(record.job_id)
+        if done is not None:
+            self.on_done(done)
+
+    def _settle_failure(self, batch: list[JobRecord], settled: set,
+                        exc: Exception) -> None:
+        """After a batch failure the STORE is the truth: members whose
+        commit landed are done; the rest retry (attempts budget) or
+        fail. A wave failure is collective, but completion is not.
+        Per-record settling is itself fenced — one record's persist
+        error must not strand its siblings in 'running'."""
+        log = get_logger()
+        store = store_runtime.active()
+        for record in batch:
+            if record.job_id in settled:
+                continue
+            try:
+                committed = False
+                if store is not None:
+                    try:
+                        committed = store.lookup(record.plan_hash) is not None
+                    except Exception:  # noqa: BLE001 - store probe is best-effort
+                        committed = False
+                if committed:
+                    self._complete(record, settled)
+                    continue
+                requeue = record.attempts + 1 < self.max_attempts
+                failed = self.queue.fail(
+                    record.job_id, error=repr(exc), requeue=requeue,
+                )
+                settled.add(record.job_id)
+                if failed is not None and not requeue:
+                    log.error("serve: job %s failed permanently: %r",
+                              record.job_id, exc)
+                    self.on_failed(failed)
+            except Exception:  # noqa: BLE001 - settle the rest regardless
+                log.exception("serve: could not settle job %s",
+                              record.job_id)
+        self._wake.set()  # requeued members should not wait out the idle poll
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Test/soak helper: True once nothing is queued or running."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            counts = self.queue.counts()
+            if not counts.get("queued") and not counts.get("running"):
+                return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,590 @@
+"""The chain-serve daemon: HTTP front door, queue, scheduler, store.
+
+One `ChainServeService` owns the whole serving stack rooted at one
+directory:
+
+    root/
+      queue/jobs/*.json        durable job records (+ .inprogress sentinels)
+      requests/*.json          request records (atomic rewrites)
+      artifacts/               materialized outputs (store-hardlinked)
+      store/                   the content-addressed artifact store
+      serve-info.json          {pid, port, url} for operators/scripts
+
+HTTP surface — ONE LiveServer (telemetry/live.py route registry), so
+the observability endpoints and the serving API share a port, a thread
+pool and a shutdown story:
+
+    GET  /healthz /metrics /status     the PR 3 observability triple
+         (/status?request=<id> scopes the serve section to one request)
+    POST /v1/requests                  submit a processing request
+    GET  /v1/requests                  list requests
+    GET  /v1/requests/<id>             one request with per-unit states
+    GET  /v1/artifacts/<plan_hash>     artifact bytes from the store
+
+Identity and dedup: a unit's plan hash (store/keys) is its name
+everywhere — queue dedup key, store commit key, artifact URL. Request
+overlap therefore collapses BEFORE execution: a unit already in the
+store answers warm in milliseconds; one queued or running attaches; and
+only genuinely novel plans execute, exactly once (docs/SERVE.md).
+
+The engine's global store slot (store/runtime) is configured to the
+serve store at construction: one service per process at a time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import time
+from typing import Optional
+
+from .. import telemetry as tm
+from ..store import runtime as store_runtime
+from ..store.store import StoreCorruption
+from ..telemetry import live
+from ..utils import lockdebug
+from ..utils.fsio import atomic_write_json
+from ..utils.log import get_logger
+from . import api
+from .executors import make_executor
+from .pressure import StorePressure
+from .queue import DurableQueue
+from .scheduler import Scheduler
+
+_REQ_TOTAL = tm.counter(
+    "chain_serve_requests_total", "serve requests by terminal disposition",
+    ("state",),
+)
+_UNITS = tm.counter(
+    "chain_serve_units_total", "per-PVS units by enqueue outcome",
+    ("outcome",),
+)
+_REQ_SECONDS = tm.histogram(
+    "chain_serve_request_seconds", "request accept-to-complete latency"
+)
+_WARM_REQ_SECONDS = tm.histogram(
+    "chain_serve_warm_request_seconds",
+    "latency of requests answered entirely from the store",
+)
+
+_HASH_LEN = 64  # sha256 hex
+
+
+class _DoneState:
+    """Stand-in for a queue record the queue no longer tracks: settled."""
+
+    state = "done"
+
+
+_DONE_SENTINEL = _DoneState()
+
+
+class ChainServeService:
+    """Composition root of the serve daemon (see module doc)."""
+
+    def __init__(
+        self,
+        root: str,
+        port: int = 0,
+        host: Optional[str] = None,
+        executor: str = "synthetic",
+        workers: int = 2,
+        wave_width: int = 4,
+        store_root: Optional[str] = None,
+        store_budget_bytes: Optional[int] = None,
+        tenant_weights: Optional[dict] = None,
+        max_attempts: int = 2,
+        request_retention: int = 10_000,
+    ) -> None:
+        self.root = os.path.abspath(root)
+        self.artifacts_root = os.path.join(self.root, "artifacts")
+        self.requests_dir = os.path.join(self.root, "requests")
+        for d in (self.root, self.artifacts_root, self.requests_dir):
+            os.makedirs(d, exist_ok=True)
+        # the serve surface IS telemetry: /metrics must render, job
+        # accounting must count — enable before anything registers
+        tm.enable()
+        self.executor = make_executor(executor)
+        self.store = store_runtime.configure(
+            store_root or os.path.join(self.root, "store")
+        )
+        self.queue = DurableQueue(os.path.join(self.root, "queue"))
+        self.request_retention = max(1, int(request_retention))
+        self._lock = lockdebug.make_lock("serve_service")
+        #: request docs; each active one carries a non-persisted
+        #: "_pending" set of plan hashes still outstanding, maintained by
+        #: submit/_on_job_done so completion checks never re-verify the
+        #: store under this lock
+        self._requests: dict[str, dict] = {}   # guarded-by: _lock
+        #: plan hash -> request ids still waiting on it
+        self._plan_waiters: dict[str, set] = {}  # guarded-by: _lock
+        self.pressure = StorePressure(
+            self.store, store_budget_bytes, self.active_plans
+        )
+        self.scheduler = Scheduler(
+            self.queue, self.executor, self.artifacts_root,
+            workers=workers, wave_width=wave_width,
+            tenant_weights=tenant_weights, max_attempts=max_attempts,
+            on_done=self._on_job_done, on_failed=self._on_job_failed,
+        )
+        routes = live.default_routes()
+        routes.add("/v1/requests", self._h_requests, methods=("GET", "POST"))
+        routes.add_prefix("/v1/requests/", self._h_request)
+        routes.add_prefix("/v1/artifacts/", self._h_artifact)
+        self.server = live.LiveServer(port, host=host, routes=routes)
+        self._recover_requests()
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> "ChainServeService":
+        live.STATUS_PROVIDERS["serve"] = self._status_section
+        self.server.start()
+        self.scheduler.start()
+        atomic_write_json(os.path.join(self.root, "serve-info.json"), {
+            "pid": os.getpid(),
+            "port": self.server.port,
+            "url": self.server.url,
+            "root": self.root,
+            "executor": self.executor.kind,
+        })
+        get_logger().info(
+            "chain-serve: %s (root %s, executor %s, queue: %s)",
+            self.server.url, self.root, self.executor.kind,
+            self.queue.recovery,
+        )
+        return self
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+        self.server.stop()
+        live.STATUS_PROVIDERS.pop("serve", None)
+        if self.store is not None:
+            self.store.digests.save()
+
+    def __enter__(self) -> "ChainServeService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---------------------------------------------------------- recovery
+
+    def _recover_requests(self) -> None:
+        """Reload persisted request records. Active ones re-arm their
+        plan waiters; units whose job record vanished (a crash between
+        request persist and unit enqueue) are re-enqueued; requests
+        whose every unit meanwhile completed are finalized now."""
+        try:
+            names = sorted(os.listdir(self.requests_dir))
+        except OSError:
+            names = []
+        recovered_active = []
+        with self._lock:
+            for name in names:
+                if not name.endswith(".json"):
+                    continue
+                path = os.path.join(self.requests_dir, name)
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    get_logger().warning(
+                        "serve: unreadable request record %s; skipping", path
+                    )
+                    continue
+                self._requests[doc["request"]] = doc
+                if doc.get("state") == "active":
+                    recovered_active.append(doc)
+            for doc in recovered_active:
+                req_id = doc["request"]
+                doc["_pending"] = set()
+                for unit_doc in doc["units"].values():
+                    plan_hash = unit_doc["plan"]
+                    if self._plan_is_done(plan_hash):
+                        continue
+                    doc["_pending"].add(plan_hash)
+                    self._plan_waiters.setdefault(plan_hash, set()).add(req_id)
+                    if self.queue.by_plan(plan_hash) is None:
+                        # enqueue lost to the crash: re-create it from the
+                        # request record (it carries the full unit payload)
+                        self.queue.enqueue(
+                            plan_hash,
+                            unit_doc["planPayload"],
+                            unit_doc["unit"],
+                            doc["tenant"], doc["priority"], req_id,
+                            unit_doc["output"],
+                        )
+        for doc in recovered_active:
+            self._check_request_done(doc["request"])
+
+    # ------------------------------------------------------- submissions
+
+    def submit(self, payload: object) -> dict:
+        """Validate + enqueue one request; returns the acceptance doc.
+        Raises api.RequestError on a bad document (handler → 400)."""
+        t0 = time.perf_counter()
+        try:
+            normalized = api.validate_request(payload)
+        except api.RequestError:
+            _REQ_TOTAL.labels(state="rejected").inc()
+            raise
+        units = api.expand_units(normalized)
+        req_id = "req-" + secrets.token_hex(5)
+        unit_docs: dict[str, dict] = {}
+        plans: dict[str, dict] = {}
+        for unit in units:
+            plan = self.executor.plan(unit)
+            plan_hash = self.store.plan_hash(plan)
+            unit_docs[unit.pvs_id] = {
+                "plan": plan_hash,
+                "planPayload": plan,
+                "output": self.executor.output_name(unit, plan_hash),
+                "unit": {
+                    "database": unit.database, "src": unit.src,
+                    "hrc": unit.hrc, "params": unit.params,
+                    "pvs_id": unit.pvs_id,
+                },
+            }
+            plans[plan_hash] = unit_docs[unit.pvs_id]
+        doc = {
+            "request": req_id,
+            "tenant": normalized["tenant"],
+            "priority": normalized["priority"],
+            "database": normalized["database"],
+            "created_at": time.time(),
+            "units": unit_docs,
+            "state": "active",
+            "done_at": None,
+            "latency_ms": None,
+            "warm": False,
+        }
+        # the request must be discoverable BEFORE its first unit can
+        # complete, or a fast job's on_done would miss the waiter
+        with self._lock:
+            doc["_pending"] = set(plans)
+            self._requests[req_id] = doc
+            for plan_hash in plans:
+                self._plan_waiters.setdefault(plan_hash, set()).add(req_id)
+        self._persist_request(doc)
+        outcomes = {"warm": 0, "enqueued": 0, "attached": 0}
+        for plan_hash, unit_doc in plans.items():
+            if self._plan_is_done(plan_hash):
+                _UNITS.labels(outcome="warm").inc()
+                outcomes["warm"] += 1
+                with self._lock:
+                    doc["_pending"].discard(plan_hash)
+                    waiters = self._plan_waiters.get(plan_hash)
+                    if waiters is not None:
+                        waiters.discard(req_id)
+                        if not waiters:
+                            self._plan_waiters.pop(plan_hash, None)
+                continue
+            record, outcome = self.queue.enqueue(
+                plan_hash, unit_doc["planPayload"], unit_doc["unit"],
+                normalized["tenant"], normalized["priority"], req_id,
+                unit_doc["output"],
+            )
+            if outcome == "done":
+                # the queue remembers a completion the store no longer
+                # holds (evicted): re-arm the same record
+                self.queue.rearm(record.job_id)
+                outcome = "new"
+            key = "enqueued" if outcome == "new" else "attached"
+            _UNITS.labels(outcome=key).inc()
+            outcomes[key] += 1
+        doc["warm"] = outcomes["warm"] == len(plans)
+        _REQ_TOTAL.labels(state="accepted").inc()
+        tm.emit("serve_request", request=req_id,
+                tenant=normalized["tenant"],
+                priority=normalized["priority"], units=len(unit_docs),
+                **outcomes)
+        self.scheduler.notify()
+        self._check_request_done(req_id, submit_t0=t0)
+        with self._lock:
+            state = self._requests[req_id]["state"]
+            latency_ms = self._requests[req_id]["latency_ms"]
+        return {
+            "request": req_id,
+            "state": state,
+            "units": len(unit_docs),
+            "outcomes": outcomes,
+            "latency_ms": latency_ms,
+            "url": f"/v1/requests/{req_id}",
+        }
+
+    # ------------------------------------------------------- completion
+
+    def _plan_is_done(self, plan_hash: str) -> bool:
+        """The store is the truth for artifact existence; a verified
+        manifest = warm. Corruption counts as a miss (the rebuild
+        path will re-execute)."""
+        if self.store is None:
+            return False
+        manifest = self.store.lookup(plan_hash)
+        if manifest is None:
+            return False
+        try:
+            self.store.verify_object(manifest.object)
+        except StoreCorruption:
+            return False
+        self.store.touch(manifest)
+        return True
+
+    def _on_job_done(self, record) -> None:
+        with self._lock:
+            waiters = self._plan_waiters.pop(record.plan_hash, set())
+            for req_id in waiters:
+                doc = self._requests.get(req_id)
+                if doc is not None:
+                    doc.get("_pending", set()).discard(record.plan_hash)
+        for req_id in sorted(waiters):
+            self._check_request_done(req_id)
+        self.pressure.maybe_collect()
+
+    def _on_job_failed(self, record) -> None:
+        with self._lock:
+            waiters = self._plan_waiters.pop(record.plan_hash, set())
+            docs = []
+            for req_id in sorted(waiters):
+                doc = self._requests.get(req_id)
+                if doc is None or doc["state"] != "active":
+                    continue
+                doc["state"] = "failed"
+                doc["done_at"] = time.time()
+                doc["error"] = record.error
+                docs.append(doc)
+        for doc in docs:
+            self._persist_request(doc)
+            _REQ_TOTAL.labels(state="failed").inc()
+            tm.emit("serve_request_done", request=doc["request"],
+                    status="failed", error=record.error)
+
+    def _check_request_done(self, req_id: str,
+                            submit_t0: Optional[float] = None) -> None:
+        """Finalize a request whose pending set drained. The set is
+        maintained incrementally (submit warm hits, _on_job_done), so
+        this is a dict lookup under the lock — NOT a per-unit store
+        re-verification, which on a mostly-warm many-unit request would
+        serialize submit and the whole observability surface behind
+        file I/O."""
+        with self._lock:
+            doc = self._requests.get(req_id)
+            if doc is None or doc["state"] != "active":
+                return
+            if doc.get("_pending"):
+                return
+            doc["state"] = "done"
+            doc["done_at"] = time.time()
+            if submit_t0 is not None:
+                doc["latency_ms"] = round(
+                    (time.perf_counter() - submit_t0) * 1e3, 3
+                )
+            else:
+                doc["latency_ms"] = round(
+                    (doc["done_at"] - doc["created_at"]) * 1e3, 3
+                )
+            warm = doc.get("warm", False)
+            latency_s = (doc["done_at"] - doc["created_at"])
+        self._persist_request(doc)
+        self._prune_finished()
+        _REQ_TOTAL.labels(state="completed").inc()
+        _REQ_SECONDS.observe(max(0.0, latency_s))
+        if warm:
+            _WARM_REQ_SECONDS.observe(max(0.0, latency_s))
+        tm.emit("serve_request_done", request=req_id, status="done",
+                duration_s=round(max(0.0, latency_s), 4), warm=warm)
+
+    def _persist_request(self, doc: dict) -> None:
+        atomic_write_json(
+            os.path.join(self.requests_dir, doc["request"] + ".json"),
+            # "_pending" (a set) is in-memory bookkeeping, rebuilt at
+            # recovery from the store + queue — never persisted
+            {k: v for k, v in doc.items() if not k.startswith("_")},
+            sort_keys=True,
+        )
+
+    def _prune_finished(self) -> None:
+        """Retention for an always-on daemon: keep the most recent
+        `request_retention` finished requests (memory AND disk); the
+        artifacts themselves live in the store under GC/budget rules."""
+        with self._lock:
+            finished = [
+                doc for doc in self._requests.values()
+                if doc["state"] != "active"
+            ]
+            excess = len(finished) - self.request_retention
+            victims = []
+            if excess > 0:
+                finished.sort(key=lambda d: d.get("done_at") or 0.0)
+                victims = finished[:excess]
+                for doc in victims:
+                    self._requests.pop(doc["request"], None)
+        for doc in victims:
+            try:
+                os.unlink(os.path.join(
+                    self.requests_dir, doc["request"] + ".json"
+                ))
+            except OSError:
+                pass
+
+    def active_plans(self) -> set:
+        """Plan hashes unfinished requests still need — the GC pressure
+        hook's ephemeral pins."""
+        with self._lock:
+            plans: set = set()
+            for doc in self._requests.values():
+                if doc["state"] != "active":
+                    continue
+                plans.update(u["plan"] for u in doc["units"].values())
+            return plans
+
+    # ------------------------------------------------------------- views
+
+    def request_status(self, req_id: str) -> Optional[dict]:
+        with self._lock:
+            doc = self._requests.get(req_id)
+            if doc is None:
+                return None
+            out = {
+                "request": doc["request"],
+                "tenant": doc["tenant"],
+                "priority": doc["priority"],
+                "state": doc["state"],
+                "created_at": doc["created_at"],
+                "done_at": doc["done_at"],
+                "latency_ms": doc["latency_ms"],
+                "warm": doc.get("warm", False),
+                "units": {},
+            }
+            if "error" in doc:
+                out["error"] = doc["error"]
+            pending = doc.get("_pending")
+            if pending is None:
+                # recovered finished request (no live bookkeeping): any
+                # unit the queue still knows as unfinished reports that
+                # state; the rest are settled
+                pending = {
+                    u["plan"] for u in doc["units"].values()
+                    if (self.queue.by_plan(u["plan"]) or
+                        _DONE_SENTINEL).state != "done"
+                }
+            for pvs_id, unit_doc in doc["units"].items():
+                if unit_doc["plan"] not in pending:
+                    # settled when it drained from the pending set — no
+                    # store re-verification per GET (eviction later just
+                    # 404s the artifact URL, by design)
+                    entry = {
+                        "plan": unit_doc["plan"], "state": "done",
+                        "artifact": f"/v1/artifacts/{unit_doc['plan']}",
+                    }
+                else:
+                    record = self.queue.by_plan(unit_doc["plan"])
+                    state = record.state if record is not None else "queued"
+                    entry = {"plan": unit_doc["plan"], "state": state}
+                    if record is not None and record.error:
+                        entry["error"] = record.error
+                out["units"][pvs_id] = entry
+            return out
+
+    def _request_summaries(self) -> list[dict]:
+        with self._lock:
+            return [
+                {
+                    "request": doc["request"],
+                    "tenant": doc["tenant"],
+                    "priority": doc["priority"],
+                    "state": doc["state"],
+                    "units": len(doc["units"]),
+                    "created_at": doc["created_at"],
+                }
+                for doc in sorted(
+                    self._requests.values(),
+                    key=lambda d: d["created_at"],
+                )[-1000:]  # most recent; full history is on disk
+            ]
+
+    def _status_section(self, query: dict) -> dict:
+        section = {
+            "executor": self.executor.kind,
+            "queue": self.queue.counts(),
+            "requests": {},
+        }
+        with self._lock:
+            for doc in self._requests.values():
+                state = doc["state"]
+                section["requests"][state] = (
+                    section["requests"].get(state, 0) + 1
+                )
+        req_id = query.get("request")
+        if req_id:
+            section["request"] = (
+                self.request_status(req_id) or {"error": "unknown request"}
+            )
+        return section
+
+    # ------------------------------------------------------------- HTTP
+
+    @staticmethod
+    def _json(code: int, doc: object):
+        return code, "application/json", json.dumps(doc)
+
+    def _h_requests(self, req: live.WebRequest):
+        if req.method == "GET":
+            return self._json(200, {"requests": self._request_summaries()})
+        try:
+            payload = json.loads(req.body.decode() or "null")
+        except (ValueError, UnicodeDecodeError):
+            _REQ_TOTAL.labels(state="rejected").inc()
+            return self._json(400, {"error": "body is not valid JSON"})
+        try:
+            return self._json(202, self.submit(payload))
+        except api.RequestError as exc:
+            return self._json(400, {"error": str(exc)})
+
+    def _h_request(self, req: live.WebRequest):
+        req_id = req.path[len("/v1/requests/"):]
+        doc = self.request_status(req_id)
+        if doc is None:
+            return self._json(404, {"error": f"unknown request {req_id!r}"})
+        return self._json(200, doc)
+
+    def _h_artifact(self, req: live.WebRequest):
+        key = req.path[len("/v1/artifacts/"):]
+        if len(key) != _HASH_LEN or any(
+            c not in "0123456789abcdef" for c in key
+        ):
+            return self._json(400, {"error": "artifact key must be a "
+                                             "64-hex plan hash"})
+        if self.store is None:
+            return self._json(404, {"error": "no store configured"})
+        manifest = self.store.lookup(key)
+        if manifest is None:
+            return self._json(404, {"error": "unknown artifact (expired "
+                                             "or never built; re-POST the "
+                                             "request to rebuild)"})
+        try:
+            self.store.verify_object(manifest.object)
+        except StoreCorruption:
+            return self._json(404, {"error": "artifact failed verification; "
+                                             "re-POST the request to rebuild"})
+        self.store.touch(manifest)
+        # streamed from disk (live.FileBody): artifacts are video-scale
+        return 200, "application/octet-stream", live.FileBody(
+            self.store.object_path(manifest.object["sha256"])
+        )
+
+    # ------------------------------------------------------ test helpers
+
+    def wait_request(self, req_id: str, timeout: float = 30.0) -> str:
+        """Block until the request leaves 'active' (or timeout); returns
+        its final (or current) state."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                doc = self._requests.get(req_id)
+                state = doc["state"] if doc else "unknown"
+            if state != "active":
+                return state
+            time.sleep(0.02)
+        return "active"

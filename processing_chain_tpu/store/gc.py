@@ -40,9 +40,19 @@ def collect(
     tmp_max_age_s: float = 3600.0,
     min_object_age_s: float = 3600.0,
     now: Optional[float] = None,
+    extra_pins: Optional[set] = None,
 ) -> dict:
-    """Run one mark-and-sweep pass; returns the report dict the
-    `tools store gc` command renders."""
+    """Run one mark-and-sweep pass; returns the summary dict that
+    `tools store gc` renders and serve's pressure hook consumes.
+
+    `extra_pins` are EPHEMERAL pins: plan hashes exempt from LRU
+    eviction for this pass only, without touching pins.json — the serve
+    daemon passes the plans referenced by unfinished requests so the
+    cache can never evict an artifact a queued request is about to
+    claim. Summary keys beyond the per-phase detail: `bytes_freed`
+    (orphans + evictions), `objects_evicted` (object files actually
+    unlinked), `pins_honored` (manifests the LRU pass skipped because
+    durable or ephemeral pins protect them)."""
     log = get_logger()
     now = time.time() if now is None else now
     report = {
@@ -54,6 +64,9 @@ def collect(
         "evicted_bytes": 0,
         "kept_manifests": 0,
         "kept_bytes": 0,
+        "bytes_freed": 0,
+        "objects_evicted": 0,
+        "pins_honored": 0,
     }
 
     # phase 1: crashed-writer leftovers in tmp/
@@ -72,7 +85,7 @@ def collect(
         pass
 
     # mark: manifests (with their LRU stamp) and the digests they hold live
-    pins = set(store.pins())
+    pins = set(store.pins()) | set(extra_pins or ())
     manifests: list[tuple[float, Manifest]] = []
     for m in store.iter_manifests():
         try:
@@ -110,6 +123,9 @@ def collect(
 
     if size_budget_bytes is not None:
         manifests.sort(key=lambda e: e[0])  # oldest last-used first
+        report["pins_honored"] = sum(
+            1 for _, m in manifests if m.plan_hash in pins
+        )
         while manifests and referenced_bytes(manifests) > size_budget_bytes:
             victim_i = next(
                 (i for i, (_, m) in enumerate(manifests)
@@ -126,13 +142,11 @@ def collect(
             survivors: set[str] = set()
             for _, m in manifests:
                 survivors.update(_manifest_digests(m))
-            freed = sum(
-                sizes.get(sha, 0)
-                for sha in _manifest_digests(victim) - survivors
-            )
+            doomed = _manifest_digests(victim) - survivors
+            freed = sum(sizes.get(sha, 0) for sha in doomed)
             if not dry_run:
                 store._drop_manifest(victim.plan_hash)
-                for sha in _manifest_digests(victim) - survivors:
+                for sha in doomed:
                     try:
                         os.unlink(store.object_path(sha))
                     except OSError:
@@ -142,9 +156,33 @@ def collect(
                         producer=victim.producer, freed_bytes=freed)
             report["evicted_manifests"].append(victim.plan_hash)
             report["evicted_bytes"] += freed
+            report["objects_evicted"] += len(doomed)
 
     report["kept_manifests"] = len(manifests)
     report["kept_bytes"] = referenced_bytes(manifests)
+    report["objects_evicted"] += report["orphans_removed"]
+    report["bytes_freed"] = report["orphan_bytes"] + report["evicted_bytes"]
     if not dry_run:
         store.update_gauges(full=True)
     return report
+
+
+def enforce_budget(
+    store: ArtifactStore,
+    size_budget_bytes: int,
+    extra_pins: Optional[set] = None,
+    dry_run: bool = False,
+) -> dict:
+    """The LRU size-budget path as a programmatic API: one collect()
+    pass tuned for a LONG-RUNNING caller (serve's pressure hook) — tmp
+    and orphan sweeps keep their crash-safety ages, eviction honors both
+    durable pins and the caller's ephemeral `extra_pins`. Returns the
+    same summary dict as collect(); `tools store gc` and the serve
+    pressure hook therefore share one implementation and one report
+    vocabulary (bytes_freed / objects_evicted / pins_honored)."""
+    return collect(
+        store,
+        size_budget_bytes=size_budget_bytes,
+        dry_run=dry_run,
+        extra_pins=extra_pins,
+    )

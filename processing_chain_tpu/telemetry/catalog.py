@@ -60,6 +60,16 @@ METRICS: dict[str, str] = {
     "chain_store_corrupt_total": "counter",
     "chain_store_object_bytes": "gauge",
     "chain_store_objects": "gauge",
+    # serve/ — the always-on processing service (docs/SERVE.md)
+    "chain_serve_requests_total": "counter",
+    "chain_serve_units_total": "counter",
+    "chain_serve_request_seconds": "histogram",
+    "chain_serve_warm_request_seconds": "histogram",
+    "chain_serve_queue_depth": "gauge",
+    "chain_serve_inflight": "gauge",
+    "chain_serve_waves_total": "counter",
+    "chain_serve_wave_lanes": "histogram",
+    "chain_serve_gc_evicted_bytes_total": "counter",
     # telemetry/profiling.py — resource monitor (PR 5)
     "chain_resource_rss_bytes": "gauge",
     "chain_resource_open_fds": "gauge",
@@ -90,5 +100,10 @@ EVENTS: frozenset = frozenset({
     "task_recovered",
     "task_hard_timeout",
     "barrier_wait",
+    "serve_request",       # serve/service.py — request accepted
+    "serve_request_done",  # serve/service.py — request completed/failed
+    "serve_requeued",      # serve/queue.py — interrupted job requeued
+    "serve_gc",            # serve/pressure.py — budget pass ran
+
     "log",             # WARNING+ console records bridged into the log
 })

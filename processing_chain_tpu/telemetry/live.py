@@ -1,7 +1,9 @@
 """Live status surface: HTTP endpoint + atomically-rewritten status file.
 
 `LiveServer` is a stdlib `ThreadingHTTPServer` (no new dependencies)
-exposing three read-only endpoints while a run is in flight:
+exposing read-only endpoints while a run is in flight — and, since the
+serve daemon (processing_chain_tpu/serve), a *route registry* so every
+HTTP surface of the chain shares this one server:
 
     /healthz   liveness: {"status": "ok", "uptime_s": ...}
     /metrics   MetricsRegistry.render_prometheus(), LIVE — the same
@@ -9,8 +11,15 @@ exposing three read-only endpoints while a run is in flight:
     /status    JSON: per-stage progress + ETA, in-flight tasks with
                beat ages, chain counters (schema below)
 
+Additional routes (e.g. chain-serve's `/v1/requests`,
+`/v1/artifacts/<key>`) register on a `RouteRegistry` — exact paths or
+prefixes, per-method — instead of forking a second server with its own
+port, thread and shutdown story. Handlers receive a `WebRequest`
+(method/path/query/body) and return `(code, content_type, body)` where
+body may be `str` or `bytes`.
+
 `StatusFileWriter` rewrites the same /status JSON to a file every
-`interval_s` via tmp + os.replace, so a reader (tools chain-top, a
+`interval_s` atomically (utils/fsio), so a reader (tools chain-top, a
 cron probe) never observes a torn write — the headless twin of the
 endpoint for batch hosts with no reachable port.
 
@@ -23,6 +32,10 @@ Status document schema (docs/TELEMETRY.md "Live monitoring"):
      "current_stage": ..., "tasks": [...], "recent": [...],
      "counters": {frames_decoded, frames_encoded, bytes_encoded}}
 
+Subsystems can contribute their own top-level sections through
+`STATUS_PROVIDERS` (name -> callable(query) -> dict): chain-serve adds a
+"serve" section, scopable per request via `/status?request=<id>`.
+
 Binding defaults to 127.0.0.1 (an operator forwarding the port owns the
 exposure decision); PC_LIVE_HOST overrides.
 """
@@ -33,9 +46,13 @@ import json
 import os
 import threading
 import time
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple, Union
+from urllib.parse import parse_qsl, urlsplit
 
+from ..utils import lockdebug
+from ..utils.fsio import atomic_write_json
 from .heartbeat import HEARTBEATS
 from .metrics import REGISTRY
 
@@ -44,8 +61,17 @@ _T0 = time.monotonic()
 #: Mutable run metadata merged into /status (the CLI sets name/argv).
 RUN_META: dict = {}
 
+#: Extra /status sections: name -> callable(query: dict) -> dict | None.
+#: A provider that raises or returns None is skipped — /status must
+#: render on every platform no matter what a subsystem is doing.
+STATUS_PROVIDERS: Dict[str, Callable[[dict], Optional[dict]]] = {}
 
-def build_status() -> dict:
+#: POST bodies past this are refused (413): every legitimate request
+#: document is a few KB of IDs; anything bigger is a mistake or abuse.
+_MAX_BODY = 1 << 20
+
+
+def build_status(query: Optional[dict] = None) -> dict:
     """One JSON-able status document from the live registries."""
     doc = {
         "schema": 1,
@@ -71,39 +97,210 @@ def build_status() -> dict:
         doc["resources"] = profiling.sample_resources()
     except Exception:  # noqa: BLE001 - /status must render on every platform
         pass
+    for name, provider in list(STATUS_PROVIDERS.items()):
+        try:
+            section = provider(query or {})
+        except Exception:  # noqa: BLE001 - a broken provider must not kill /status
+            continue
+        if section is not None:
+            doc[name] = section
     return doc
 
 
+# --------------------------------------------------------------- routing
+
+
+@dataclass
+class WebRequest:
+    """What a route handler sees: enough to act, nothing http.server."""
+
+    method: str
+    path: str                     # decoded path, query stripped
+    query: dict = field(default_factory=dict)
+    body: bytes = b""
+
+
+@dataclass
+class FileBody:
+    """A response body streamed from disk in chunks instead of being
+    materialized in memory — artifact downloads are video-scale, and an
+    always-on daemon answering several concurrent multi-GB GETs with
+    f.read() would OOM on exactly the load it exists to serve."""
+
+    path: str
+
+
+#: handler signature: WebRequest -> (status code, content type, body)
+Handler = Callable[[WebRequest], Tuple[int, str, Union[str, bytes, FileBody]]]
+
+
+class RouteRegistry:
+    """Exact-path and prefix routes with per-method dispatch. Thread-safe:
+    subsystems register while the server is already answering scrapes."""
+
+    def __init__(self) -> None:
+        self._lock = lockdebug.make_lock("live_routes")
+        self._exact: dict[str, dict[str, Handler]] = {}  # guarded-by: _lock
+        #: longest-prefix-first [(prefix, {method: handler})]
+        self._prefix: list[tuple[str, dict[str, Handler]]] = []  # guarded-by: _lock
+
+    def add(self, path: str, handler: Handler,
+            methods: tuple = ("GET",)) -> None:
+        with self._lock:
+            entry = self._exact.setdefault(path, {})
+            for m in methods:
+                entry[m.upper()] = handler
+
+    def add_prefix(self, prefix: str, handler: Handler,
+                   methods: tuple = ("GET",)) -> None:
+        with self._lock:
+            for i, (p, entry) in enumerate(self._prefix):
+                if p == prefix:
+                    for m in methods:
+                        entry[m.upper()] = handler
+                    return
+            self._prefix.append((prefix, {m.upper(): handler for m in methods}))
+            self._prefix.sort(key=lambda e: -len(e[0]))
+
+    def resolve(self, method: str, path: str
+                ) -> tuple[Optional[Handler], Optional[set]]:
+        """(handler, None) on a match; (None, allowed-methods) when the
+        path exists under another method (405); (None, None) for 404."""
+        with self._lock:
+            entry = self._exact.get(path)
+            if entry is None:
+                for prefix, e in self._prefix:
+                    if path.startswith(prefix):
+                        entry = e
+                        break
+        if entry is None:
+            return None, None
+        handler = entry.get(method.upper())
+        if handler is None:
+            return None, set(entry)
+        return handler, None
+
+    def paths(self) -> list[str]:
+        with self._lock:
+            return sorted(self._exact) + sorted(
+                p + "…" for p, _ in self._prefix
+            )
+
+
+def _healthz(req: WebRequest):
+    return 200, "application/json", json.dumps({
+        "status": "ok",
+        "pid": os.getpid(),
+        "uptime_s": round(time.monotonic() - _T0, 3),
+    })
+
+
+def _metrics(req: WebRequest):
+    return 200, "text/plain; version=0.0.4", REGISTRY.render_prometheus()
+
+
+def _status(req: WebRequest):
+    return 200, "application/json", json.dumps(build_status(req.query))
+
+
+def default_routes() -> RouteRegistry:
+    """A fresh registry holding the built-in observability endpoints —
+    the base every LiveServer (batch run or serve daemon) starts from."""
+    routes = RouteRegistry()
+    routes.add("/healthz", _healthz)
+    routes.add("/metrics", _metrics)
+    routes.add("/status", _status)
+    return routes
+
+
+class _Server(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the route registry for its handlers."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, routes: RouteRegistry) -> None:
+        super().__init__(addr, _Handler)
+        self.routes = routes
+
+    def handle_error(self, request, client_address) -> None:
+        # in-flight handlers racing stop() hit closed sockets; that is a
+        # shutdown artifact, not a report — never traceback-spam stderr
+        pass
+
+
 class _Handler(BaseHTTPRequestHandler):
-    server_version = "chain-live/1"
+    server_version = "chain-live/2"
+
+    def _dispatch(self, method: str) -> None:
+        split = urlsplit(self.path)
+        path = split.path
+        handler, allowed = self.server.routes.resolve(method, path)
+        if handler is None:
+            if allowed:
+                self.send_response(405)
+                self.send_header("Allow", ", ".join(sorted(allowed)))
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            self._reply(404, "text/plain",
+                        "not found: try /healthz /metrics /status\n")
+            return
+        body = b""
+        if method == "POST":
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            if length > _MAX_BODY:
+                self._reply(413, "application/json",
+                            json.dumps({"error": "body too large"}))
+                return
+            body = self.rfile.read(length) if length else b""
+        req = WebRequest(
+            method=method, path=path,
+            query=dict(parse_qsl(split.query)), body=body,
+        )
+        try:
+            code, ctype, payload = handler(req)
+        except Exception as exc:  # noqa: BLE001 - one bad handler must not kill the surface
+            code, ctype, payload = 500, "application/json", json.dumps(
+                {"error": repr(exc)[:300]}
+            )
+        self._reply(code, ctype, payload)
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
-        path = self.path.split("?", 1)[0]
-        if path == "/healthz":
-            self._reply(200, "application/json", json.dumps({
-                "status": "ok",
-                "pid": os.getpid(),
-                "uptime_s": round(time.monotonic() - _T0, 3),
-            }))
-        elif path == "/metrics":
-            self._reply(
-                200, "text/plain; version=0.0.4",
-                REGISTRY.render_prometheus(),
-            )
-        elif path == "/status":
-            self._reply(200, "application/json", json.dumps(build_status()))
-        else:
-            self._reply(404, "text/plain", "not found: try /healthz /metrics /status\n")
+        self._dispatch("GET")
 
-    def _reply(self, code: int, ctype: str, body: str) -> None:
-        data = body.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        self._dispatch("POST")
+
+    def do_DELETE(self) -> None:  # noqa: N802 - http.server API
+        self._dispatch("DELETE")
+
+    def _reply(self, code: int, ctype: str,
+               body: Union[str, bytes, FileBody]) -> None:
         try:
+            if isinstance(body, FileBody):
+                size = os.stat(body.path).st_size
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(size))
+                self.end_headers()
+                with open(body.path, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                return
+            data = body.encode() if isinstance(body, str) else body
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
             self.wfile.write(data)
-        except (BrokenPipeError, ConnectionResetError):  # impatient curl
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # impatient curl, or a handler racing stop()'s socket close
             pass
 
     def log_message(self, fmt: str, *args) -> None:  # noqa: A003
@@ -112,12 +309,15 @@ class _Handler(BaseHTTPRequestHandler):
 
 class LiveServer:
     """Threaded HTTP server on a daemon thread. Port 0 binds an
-    ephemeral port; `.port` is the bound one either way."""
+    ephemeral port; `.port` is the bound one either way. `routes`
+    defaults to the built-in observability endpoints; callers that need
+    more (the serve daemon) pass `default_routes()` plus their own."""
 
-    def __init__(self, port: int, host: Optional[str] = None) -> None:
+    def __init__(self, port: int, host: Optional[str] = None,
+                 routes: Optional[RouteRegistry] = None) -> None:
         self.host = host or os.environ.get("PC_LIVE_HOST", "127.0.0.1")
-        self._server = ThreadingHTTPServer((self.host, port), _Handler)
-        self._server.daemon_threads = True
+        self.routes = routes if routes is not None else default_routes()
+        self._server = _Server((self.host, port), self.routes)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -131,11 +331,15 @@ class LiveServer:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
         if self._thread is not None:
+            # shutdown() blocks on the serve_forever loop acknowledging;
+            # only meaningful (or safe) when the loop is actually running
+            self._server.shutdown()
+            self._server.server_close()
             self._thread.join(timeout=2.0)
             self._thread = None
+        else:
+            self._server.server_close()
 
     @property
     def url(self) -> str:
@@ -149,13 +353,12 @@ class LiveServer:
 
 
 def write_status_file(path: str) -> str:
-    """One atomic rewrite: readers see the old document or the new one,
-    never a torn half-write (tmp is thread/process-unique)."""
-    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    """One atomic rewrite (utils/fsio): readers see the old document or
+    the new one, never a torn half-write — and a failing json.dump can
+    no longer strand its temp file (the previous hand-rolled tmp+replace
+    leaked the .tmp when the dump raised mid-write)."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(tmp, "w") as f:
-        json.dump(build_status(), f, indent=1, sort_keys=True)
-    os.replace(tmp, path)
+    atomic_write_json(path, build_status(), sort_keys=True)
     return path
 
 

@@ -1,0 +1,207 @@
+"""`tools serve-soak` — the dedup/fairness/latency proof harness.
+
+Runs an in-process chain-serve service, fires N concurrent synthetic
+clients whose SRC×HRC grids deliberately OVERLAP, waits for every
+request to finish, then asserts the serving economics the design
+promises (ROADMAP open item #2, docs/SERVE.md):
+
+  * zero duplicate executions — `chain_jobs_planned_total{runner=serve}`
+    must equal the number of UNIQUE plan hashes across all requests;
+  * every request completes;
+  * a warm re-run of the same grids answers in milliseconds
+    (measured, reported, and gated against --warm-budget-ms).
+
+Prints one JSON report line (the `SERVE_SOAK_*.json` artifact committed
+with the PR) and exits nonzero on any violated invariant.
+
+    python -m processing_chain_tpu tools serve-soak
+        [--clients 8] [--srcs 6] [--hrcs 4] [--overlap 0.5]
+        [--executor synthetic] [--workers 4] [--wave-width 4]
+        [--warm-budget-ms 1000] [--out FILE] [--root DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import tempfile
+import threading
+import time
+from typing import Optional, Sequence
+
+from .. import telemetry as tm
+from ..utils.fsio import atomic_write_text
+from ..utils.log import get_logger
+
+
+def _grid(client: int, n_srcs: int, n_hrcs: int, overlap: float) -> dict:
+    """Client grids share a common core (the overlap fraction) and add a
+    per-client disjoint tail — the 'million users requesting overlapping
+    SRC×HRC grids' shape, miniaturized."""
+    shared = max(1, int(n_srcs * overlap))
+    srcs = [f"SRC{100 + i:03d}" for i in range(shared)]
+    srcs += [f"SRC{500 + client * 16 + i:03d}"
+             for i in range(n_srcs - shared)]
+    hrcs = [f"HRC{100 + i:03d}" for i in range(n_hrcs)]
+    return {"srcs": srcs, "hrcs": hrcs}
+
+
+def _planned_serve_jobs() -> int:
+    metric = tm.REGISTRY.snapshot().get("chain_jobs_planned_total")
+    if not metric:
+        return 0
+    return int(sum(
+        s.get("value", 0) for s in metric["series"]
+        if s.get("labels", {}).get("runner") == "serve"
+    ))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="tools serve-soak")
+    parser.add_argument("--clients", type=int, default=8)
+    parser.add_argument("--srcs", type=int, default=6)
+    parser.add_argument("--hrcs", type=int, default=4)
+    parser.add_argument("--overlap", type=float, default=0.5,
+                        help="fraction of each grid shared across clients")
+    parser.add_argument("--executor", default="synthetic")
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--wave-width", type=int, default=4)
+    parser.add_argument("--warm-budget-ms", type=float, default=1000.0,
+                        help="warm-hit request latency gate (per request)")
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON report here")
+    parser.add_argument("--root", default=None,
+                        help="serve root (default: a fresh temp dir)")
+    args = parser.parse_args(list(argv) if argv is not None else None)
+
+    from ..serve.service import ChainServeService
+
+    log = get_logger()
+    root = args.root or tempfile.mkdtemp(prefix="chain-serve-soak-")
+    service = ChainServeService(
+        root=root, port=0, executor=args.executor,
+        workers=args.workers, wave_width=args.wave_width,
+    ).start()
+    report: dict = {"clients": args.clients, "srcs": args.srcs,
+                    "hrcs": args.hrcs, "overlap": args.overlap,
+                    "executor": args.executor, "workers": args.workers,
+                    "wave_width": args.wave_width, "root": root}
+    failures: list[str] = []
+    try:
+        planned_before = _planned_serve_jobs()
+        tenants = [f"tenant{i % 3}" for i in range(args.clients)]
+        results: list[Optional[dict]] = [None] * args.clients
+        geometry = [64, 36]
+
+        def _client(i: int) -> None:
+            body = {
+                "tenant": tenants[i],
+                "priority": ("interactive", "normal", "bulk")[i % 3],
+                "database": "P2STR01",
+                **_grid(i, args.srcs, args.hrcs, args.overlap),
+                "params": {"geometry": geometry, "size_bytes": 2048},
+            }
+            results[i] = service.submit(body)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=_client, args=(i,))
+                   for i in range(args.clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        req_ids = [r["request"] for r in results if r]
+        states = {rid: service.wait_request(rid, timeout=120.0)
+                  for rid in req_ids}
+        cold_wall_s = time.perf_counter() - t0
+        incomplete = sorted(r for r, s in states.items() if s != "done")
+        if incomplete:
+            failures.append(f"requests never completed: {incomplete}")
+
+        # dedup invariant: executions == unique plans
+        unique_plans = set()
+        for rid in req_ids:
+            doc = service.request_status(rid)
+            unique_plans.update(u["plan"] for u in doc["units"].values())
+        planned = _planned_serve_jobs() - planned_before
+        report.update(
+            requests=len(req_ids),
+            units_total=sum(
+                len(service.request_status(rid)["units"]) for rid in req_ids
+            ),
+            unique_plans=len(unique_plans),
+            jobs_planned=planned,
+            cold_wall_s=round(cold_wall_s, 3),
+        )
+        if planned != len(unique_plans):
+            failures.append(
+                f"duplicate executions: {planned} jobs planned for "
+                f"{len(unique_plans)} unique plans"
+            )
+
+        # warm pass: same grids again — store hits, millisecond latency
+        warm_latencies = []
+        for i in range(args.clients):
+            body = {
+                "tenant": tenants[i], "priority": "interactive",
+                "database": "P2STR01",
+                **_grid(i, args.srcs, args.hrcs, args.overlap),
+                "params": {"geometry": geometry, "size_bytes": 2048},
+            }
+            t1 = time.perf_counter()
+            accepted = service.submit(body)
+            state = service.wait_request(accepted["request"], timeout=30.0)
+            warm_ms = (time.perf_counter() - t1) * 1e3
+            warm_latencies.append(round(warm_ms, 3))
+            if state != "done":
+                failures.append(
+                    f"warm request {accepted['request']} state {state}"
+                )
+            if not accepted.get("latency_ms"):
+                failures.append(
+                    f"warm request {accepted['request']} was not answered "
+                    "at submit time (latency_ms missing)"
+                )
+        planned_after_warm = _planned_serve_jobs() - planned_before
+        if planned_after_warm != planned:
+            failures.append(
+                f"warm pass executed {planned_after_warm - planned} job(s); "
+                "expected 0"
+            )
+        warm_sorted = sorted(warm_latencies)
+        report.update(
+            warm_request_ms={
+                "min": warm_sorted[0],
+                "p50": warm_sorted[len(warm_sorted) // 2],
+                "max": warm_sorted[-1],
+            },
+            warm_jobs_planned=planned_after_warm - planned,
+        )
+        if warm_sorted[-1] > args.warm_budget_ms:
+            failures.append(
+                f"warm request latency {warm_sorted[-1]:.1f} ms over the "
+                f"{args.warm_budget_ms:.0f} ms budget"
+            )
+    finally:
+        service.stop()
+    report["failures"] = failures
+    report["ok"] = not failures
+    line = json.dumps(report, sort_keys=True)
+    print(line)
+    if args.out:
+        atomic_write_text(args.out, line + "\n")
+    if failures:
+        for f in failures:
+            log.error("serve-soak: %s", f)
+        return 1
+    log.info(
+        "serve-soak: OK — %d requests, %d unique plans, %d executions, "
+        "warm p50 %.1f ms",
+        report["requests"], report["unique_plans"], report["jobs_planned"],
+        report["warm_request_ms"]["p50"],
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

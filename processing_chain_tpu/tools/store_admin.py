@@ -151,6 +151,9 @@ def _cmd_gc(store: ArtifactStore, max_bytes: Optional[int], dry_run: bool,
         print(f"{tag}  evict {ph[:12]}")
     print(f"{tag}kept:             {report['kept_manifests']} manifest(s), "
           f"{_human_bytes(report['kept_bytes'])}")
+    print(f"{tag}freed:            {_human_bytes(report['bytes_freed'])} "
+          f"({report['objects_evicted']} object(s)); "
+          f"{report['pins_honored']} pin(s) honored")
     return 0
 
 

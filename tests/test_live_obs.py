@@ -277,6 +277,115 @@ def test_live_server_endpoints():
     hb.finish("ok")
 
 
+def test_live_server_route_registry_and_methods():
+    """The serve daemon's extension point: exact + prefix routes on the
+    one shared server, per-method dispatch, 405 on a known path with the
+    wrong verb, POST bodies delivered to the handler."""
+    routes = live_mod.default_routes()
+    routes.add("/echo", lambda req: (200, "text/plain",
+                                     req.query.get("q", "")), )
+    routes.add("/echo", lambda req: (201, "text/plain",
+                                     req.body.decode()), methods=("POST",))
+    routes.add_prefix("/items/", lambda req: (
+        200, "text/plain", req.path[len("/items/"):]
+    ))
+    with live_mod.LiveServer(0, routes=routes) as srv:
+        code, body = _get(srv.url + "/healthz")  # builtins still there
+        assert code == 200
+        code, body = _get(srv.url + "/echo?q=hello")
+        assert (code, body) == (200, "hello")
+        code, body = _get(srv.url + "/items/abc/def")
+        assert (code, body) == (200, "abc/def")
+        req = urllib.request.Request(
+            srv.url + "/echo", data=b"payload", method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 201 and resp.read() == b"payload"
+        req = urllib.request.Request(srv.url + "/items/x", method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=5)
+        assert err.value.code == 405
+        assert "GET" in err.value.headers.get("Allow", "")
+
+
+def test_live_server_stop_races_inflight_scrapes():
+    """The serve-daemon hot path: stop() while scrape threads hammer
+    every endpoint must neither deadlock nor leak an exception into the
+    scrapers beyond clean connection errors — and the port must be
+    genuinely closed afterwards."""
+    HEARTBEATS.register("race-me", kind="task")
+    srv = live_mod.LiveServer(0).start()
+    url = srv.url
+    stop_flag = threading.Event()
+    oops: list = []
+
+    def hammer(path):
+        while not stop_flag.is_set():
+            try:
+                with urllib.request.urlopen(url + path, timeout=2) as resp:
+                    resp.read()
+            except (urllib.error.URLError, ConnectionError, OSError):
+                if stop_flag.is_set():
+                    return  # shutdown-window refusals are the point
+                # pre-shutdown failures are real bugs
+                if not stopping.is_set():
+                    oops.append(path)
+                    return
+
+    stopping = threading.Event()
+    threads = [
+        threading.Thread(target=hammer, args=(p,), daemon=True)
+        for p in ("/status", "/metrics", "/healthz") * 2
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)  # let the hammering overlap the shutdown for real
+    stopping.set()
+    srv.stop()  # must return despite in-flight handlers
+    stop_flag.set()
+    for t in threads:
+        t.join(timeout=5)
+        assert not t.is_alive()
+    assert oops == []
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(url + "/healthz", timeout=1)
+    # stop() is idempotent even though the loop is gone
+    srv.stop()
+
+
+def test_write_status_file_no_tmp_residue_when_dump_fails(tmp_path):
+    """Satellite: a json.dump failure mid-write must not strand a temp
+    file next to the status path (the pre-PR 7 hand-rolled tmp+replace
+    leaked it; fsio.atomic_write owns the cleanup now)."""
+    path = str(tmp_path / "status.json")
+    live_mod.write_status_file(path)  # healthy baseline
+    live_mod.RUN_META["poison"] = object()  # not JSON-serializable
+    try:
+        with pytest.raises(TypeError):
+            live_mod.write_status_file(path)
+    finally:
+        live_mod.RUN_META.clear()
+    leftovers = [f for f in os.listdir(tmp_path) if f != "status.json"]
+    assert leftovers == []
+    # the previous good document survived untouched
+    assert json.loads(open(path).read())["schema"] == 1
+
+
+def test_status_providers_extend_the_document():
+    live_mod.STATUS_PROVIDERS["extra"] = lambda query: {
+        "scoped": query.get("request", "all")
+    }
+    live_mod.STATUS_PROVIDERS["broken"] = lambda query: 1 / 0
+    try:
+        doc = live_mod.build_status({"request": "req-1"})
+        assert doc["extra"] == {"scoped": "req-1"}
+        assert "broken" not in doc  # a raising provider is skipped
+        assert live_mod.build_status()["extra"] == {"scoped": "all"}
+    finally:
+        live_mod.STATUS_PROVIDERS.pop("extra", None)
+        live_mod.STATUS_PROVIDERS.pop("broken", None)
+
+
 def test_status_file_atomic_rewrite(tmp_path):
     path = str(tmp_path / "status.json")
     HEARTBEATS.register("file-me", kind="task")

@@ -1,0 +1,671 @@
+"""chain-serve: durable queue, fairness, singleflight, HTTP API, GC
+pressure, and the kill/restart durability contract (docs/SERVE.md).
+
+In-process tests drive ChainServeService directly (ephemeral port); the
+durability test runs the real `tools chain-serve` daemon as a
+subprocess and SIGKILLs it mid-request — completed work must not
+re-execute after restart (store manifests keep their createdAt) and
+interrupted work must finish, not strand.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.engine.jobs import Job, JobRunner
+from processing_chain_tpu.parallel.p03_batch import pack_waves
+from processing_chain_tpu.serve import api
+from processing_chain_tpu.serve.executors import SyntheticExecutor
+from processing_chain_tpu.serve.pressure import StorePressure
+from processing_chain_tpu.serve.queue import DurableQueue, JobRecord
+from processing_chain_tpu.serve.scheduler import Scheduler, StridePicker
+from processing_chain_tpu.serve.service import ChainServeService
+from processing_chain_tpu.store import runtime as store_runtime
+from processing_chain_tpu.store.store import ArtifactStore
+from processing_chain_tpu.utils.runner import ChainError
+
+
+@pytest.fixture
+def serve_factory(tmp_path):
+    """Build ChainServeServices rooted in tmp dirs; teardown stops them
+    and clears the process-global store slot + telemetry enablement the
+    service switches on."""
+    created = []
+
+    def make(subdir="serve", **kw):
+        svc = ChainServeService(
+            root=str(tmp_path / subdir), port=0, **kw
+        ).start()
+        created.append(svc)
+        return svc
+
+    yield make
+    for svc in created:
+        svc.stop()
+    store_runtime.configure(None)
+    tm.disable()
+
+
+def _post(url: str, payload: dict) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+def _get(url: str) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(url) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+def _body(tenant="acme", priority="normal", srcs=("SRC100",),
+          hrcs=("HRC100",), **params) -> dict:
+    return {
+        "tenant": tenant, "priority": priority, "database": "P2STR01",
+        "srcs": list(srcs), "hrcs": list(hrcs),
+        "params": {"size_bytes": 512, **params},
+    }
+
+
+def _planned_serve_jobs() -> int:
+    metric = tm.REGISTRY.snapshot().get("chain_jobs_planned_total")
+    if not metric:
+        return 0
+    return int(sum(
+        s["value"] for s in metric["series"]
+        if s["labels"].get("runner") == "serve"
+    ))
+
+
+# ------------------------------------------------------------- request API
+
+
+def test_validate_request_rejects_bad_documents():
+    good = _body()
+    assert api.validate_request(good)["tenant"] == "acme"
+    for mutate in (
+        {"tenant": "bad tenant!"},
+        {"tenant": ""},
+        {"priority": "asap"},
+        {"database": "NOTADB"},
+        {"srcs": ["SRC1"]},          # too short for the grammar
+        {"srcs": []},
+        {"hrcs": ["HRC1"]},
+        {"params": "not-a-dict"},
+    ):
+        bad = {**good, **mutate}
+        with pytest.raises(api.RequestError):
+            api.validate_request(bad)
+    with pytest.raises(api.RequestError):
+        api.validate_request("not an object")
+    with pytest.raises(api.RequestError):
+        api.validate_request({**good, "srcs": None})
+
+
+def test_expand_units_is_the_grid_and_caps():
+    norm = api.validate_request(_body(
+        srcs=("SRC100", "SRC101"), hrcs=("HRC100", "HRC101", "HRC102"),
+    ))
+    units = api.expand_units(norm)
+    assert len(units) == 6
+    assert units[0].pvs_id == "P2STR01_SRC100_HRC100"
+    assert len({u.pvs_id for u in units}) == 6
+    big = _body(
+        srcs=tuple(f"SRC{i:03d}" for i in range(100, 200)),
+        hrcs=tuple(f"HRC{i:03d}" for i in range(100, 170)),
+    )
+    with pytest.raises(api.RequestError):
+        api.validate_request(big)  # 100*70 > MAX_UNITS
+
+
+# ---------------------------------------------------------- durable queue
+
+
+def _enqueue(queue, plan_hash, request_id, tenant="acme",
+             priority="normal"):
+    unit = {"database": "P2STR01", "src": "SRC100", "hrc": "HRC100",
+            "params": {}, "pvs_id": "P2STR01_SRC100_HRC100"}
+    return queue.enqueue(
+        plan_hash, {"op": "t", "k": plan_hash}, unit, tenant, priority,
+        request_id, f"{plan_hash[:8]}.bin",
+    )
+
+
+def test_queue_dedup_attaches_overlapping_requests(tmp_path):
+    queue = DurableQueue(str(tmp_path / "q"))
+    rec1, outcome1 = _enqueue(queue, "p" * 64, "req-1")
+    assert outcome1 == "new"
+    rec2, outcome2 = _enqueue(queue, "p" * 64, "req-2")
+    assert outcome2 == "attached"
+    assert rec2.job_id == rec1.job_id
+    assert rec2.requests == ["req-1", "req-2"]
+    _, outcome3 = _enqueue(queue, "q" * 64, "req-2")
+    assert outcome3 == "new"
+    assert len(queue.queued_snapshot()) == 2
+
+
+def test_queue_recovery_requeues_interrupted_jobs(tmp_path):
+    root = str(tmp_path / "q")
+    queue = DurableQueue(root)
+    rec_a, _ = _enqueue(queue, "a" * 64, "req-1")
+    rec_b, _ = _enqueue(queue, "b" * 64, "req-1")
+    rec_c, _ = _enqueue(queue, "c" * 64, "req-1")
+    claimed = queue.claim([rec_a.job_id])
+    assert [r.job_id for r in claimed] == [rec_a.job_id]
+    queue.complete(rec_c.job_id)
+    assert os.path.isfile(os.path.join(
+        root, "jobs", rec_a.job_id + ".json.inprogress"
+    ))
+    # daemon dies here; a new queue on the same root recovers
+    reloaded = DurableQueue(root)
+    assert reloaded.recovery["requeued"] == 1
+    rec_a2 = reloaded.record(rec_a.job_id)
+    assert rec_a2.state == "queued"
+    assert rec_a2.attempts == 1
+    assert reloaded.record(rec_b.job_id).state == "queued"
+    assert reloaded.record(rec_c.job_id).state == "done"
+    assert not os.path.isfile(os.path.join(
+        root, "jobs", rec_a.job_id + ".json.inprogress"
+    ))
+    # dedup index survived: attaching to the recovered job, not a twin
+    _, outcome = _enqueue(reloaded, "a" * 64, "req-9")
+    assert outcome == "attached"
+    # ids keep counting upward, never reused
+    rec_d, _ = _enqueue(reloaded, "d" * 64, "req-9")
+    assert rec_d.job_id not in {rec_a.job_id, rec_b.job_id, rec_c.job_id}
+
+
+# ------------------------------------------------------------- fairness
+
+
+def _records(tenant, priority, n, t0=0.0):
+    return [
+        JobRecord(
+            job_id=f"{tenant}-{priority}-{i}", plan_hash=f"{tenant}{i}",
+            plan={}, unit={}, tenant=tenant, priority=priority,
+            output="x", enqueued_at=t0 + i,
+        )
+        for i in range(n)
+    ]
+
+
+def _drain(picker, queued, n):
+    order = []
+    pool = list(queued)
+    for _ in range(n):
+        pick = picker.pick(pool)
+        order.append(pick)
+        pool.remove(pick)
+    return order
+
+
+def test_stride_picker_weighted_tenant_fairness():
+    picker = StridePicker(tenant_weights={"heavy": 4.0, "light": 1.0})
+    queued = _records("heavy", "normal", 50) + _records("light", "normal", 50)
+    first = _drain(picker, queued, 50)
+    heavy = sum(1 for r in first if r.tenant == "heavy")
+    # stride scheduling: 4:1 weight ratio → 40/10 of the first 50
+    assert heavy == 40
+    # nothing starves: light still dispatched regularly
+    assert any(r.tenant == "light" for r in first[:6])
+
+
+def test_stride_picker_priority_classes():
+    picker = StridePicker()
+    queued = (_records("t", "interactive", 40)
+              + _records("t2", "bulk", 40))
+    first = _drain(picker, queued, 17)
+    interactive = sum(1 for r in first if r.priority == "interactive")
+    # 16:1 class weights → 16 interactive for each bulk dispatch
+    assert interactive == 16
+
+
+def test_pack_waves_groups_by_bucket_across_sources():
+    items = [
+        {"id": i, "geo": (64, 36) if i % 2 == 0 else (128, 72)}
+        for i in range(10)
+    ] + [{"id": 10, "geo": None}]
+    waves = pack_waves(items, key_of=lambda it: it["geo"], width=4)
+    solo = [w for w in waves if len(w) == 1 and w[0]["geo"] is None]
+    assert len(solo) == 1
+    for wave in waves:
+        keys = {it["geo"] for it in wave}
+        assert len(keys) == 1  # never mixes geometries
+        assert len(wave) <= 4
+    packed = [w for w in waves if w[0]["geo"] is not None]
+    assert sorted(len(w) for w in packed) == [1, 1, 4, 4]
+
+
+# -------------------------------------------------- engine satellite
+
+
+def test_jobrunner_write_write_same_label_different_plans(tmp_path):
+    """Two DIFFERENT plans under one label targeting one output must
+    fail loudly, not dedup silently (the pre-PR 7 hole)."""
+    out = str(tmp_path / "x.bin")
+    runner = JobRunner(name="t")
+    runner.add(Job(label="j", output_path=out, fn=lambda: None,
+                   plan={"op": "a", "v": 1}))
+    # identical plan: silent dedup, as before
+    runner.add(Job(label="j", output_path=out, fn=lambda: None,
+                   plan={"v": 1, "op": "a"}))  # key order must not matter
+    assert len(runner.jobs) == 1
+    with pytest.raises(ChainError, match="DIFFERENT plans"):
+        runner.add(Job(label="j", output_path=out, fn=lambda: None,
+                       plan={"op": "a", "v": 2}))
+    # legacy planless jobs keep label-compare semantics
+    runner2 = JobRunner(name="t2")
+    runner2.add(Job(label="k", output_path=out, fn=lambda: None))
+    runner2.add(Job(label="k", output_path=out, fn=lambda: None))
+    assert len(runner2.jobs) == 1
+    with pytest.raises(ChainError, match="write-write"):
+        runner2.add(Job(label="other", output_path=out, fn=lambda: None))
+
+
+# ------------------------------------------------------------- service
+
+
+def test_service_overlapping_requests_execute_each_plan_once(serve_factory):
+    svc = serve_factory(workers=3, wave_width=4)
+    planned0 = _planned_serve_jobs()
+    grids = [
+        ("SRC100", "SRC101"), ("SRC101", "SRC102"), ("SRC100", "SRC102"),
+    ]
+    results = [None] * len(grids)
+
+    def client(i):
+        results[i] = svc.submit(_body(
+            tenant=f"t{i}", srcs=grids[i], hrcs=("HRC100", "HRC101"),
+            geometry=[64, 36],
+        ))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(grids))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    plans = set()
+    for r in results:
+        assert svc.wait_request(r["request"], timeout=60.0) == "done"
+        doc = svc.request_status(r["request"])
+        plans.update(u["plan"] for u in doc["units"].values())
+    # 3 SRC × 2 HRC = 6 unique plans across 12 requested units
+    assert len(plans) == 6
+    assert _planned_serve_jobs() - planned0 == 6
+
+
+def test_service_warm_requests_answer_in_milliseconds(serve_factory):
+    svc = serve_factory()
+    body = _body(srcs=("SRC100", "SRC101"), hrcs=("HRC100",))
+    first = svc.submit(body)
+    assert svc.wait_request(first["request"], timeout=60.0) == "done"
+    planned = _planned_serve_jobs()
+    t0 = time.perf_counter()
+    warm = svc.submit(body)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    assert warm["state"] == "done"          # answered at submit time
+    assert warm["outcomes"]["warm"] == 2
+    assert warm["latency_ms"] is not None
+    assert warm["latency_ms"] < 1000.0
+    assert wall_ms < 1000.0
+    assert _planned_serve_jobs() == planned  # zero executions
+
+
+def test_service_http_api_end_to_end(serve_factory):
+    svc = serve_factory()
+    url = svc.server.url
+    code, acc = _post(url + "/v1/requests", _body())
+    assert code == 202
+    assert svc.wait_request(acc["request"], timeout=60.0) == "done"
+    code, payload = _get(url + acc["url"])
+    doc = json.loads(payload)
+    assert code == 200 and doc["state"] == "done"
+    (unit,) = doc["units"].values()
+    assert unit["state"] == "done"
+    code, data = _get(url + unit["artifact"])
+    assert code == 200 and len(data) == 512
+    # deterministic artifact: same bytes on a re-fetch
+    assert _get(url + unit["artifact"])[1] == data
+    # listing shows the request
+    code, listing = _get(url + "/v1/requests")
+    assert code == 200
+    assert any(r["request"] == acc["request"]
+               for r in json.loads(listing)["requests"])
+    # scoped status section
+    code, status = _get(url + f"/status?request={acc['request']}")
+    section = json.loads(status)["serve"]
+    assert section["request"]["request"] == acc["request"]
+    assert section["queue"].get("done", 0) >= 1
+
+
+def test_service_http_rejections(serve_factory):
+    svc = serve_factory()
+    url = svc.server.url
+    code, err = _post(url + "/v1/requests", {"tenant": "x y"})
+    assert code == 400 and "error" in err
+    req = urllib.request.Request(
+        url + "/v1/requests", data=b"{not json", method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req)
+    assert exc_info.value.code == 400
+    assert _get(url + "/v1/requests/req-nope")[0] == 404
+    assert _get(url + "/v1/artifacts/deadbeef")[0] == 400
+    assert _get(url + "/v1/artifacts/" + "0" * 64)[0] == 404
+    # method discipline on the registry: DELETE on a GET/POST route
+    req = urllib.request.Request(url + "/v1/requests", method="DELETE")
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req)
+    assert exc_info.value.code == 405
+
+
+def test_scheduler_packs_cross_request_units_into_waves(tmp_path):
+    """Units from different requests sharing a geometry bucket ride one
+    executor batch (the device-wave contract), fairness picking the
+    seed; the batch log proves multi-lane dispatches happened."""
+    tm.enable()
+    try:
+        batches: list[int] = []
+
+        class Recording(SyntheticExecutor):
+            def run_batch(self, units, outputs):
+                batches.append(len(units))
+                super().run_batch(units, outputs)
+
+        queue = DurableQueue(str(tmp_path / "q"))
+        unit = {"database": "P2STR01", "src": "SRC100", "hrc": "HRC100",
+                "params": {"geometry": [64, 36], "size_bytes": 128}}
+        for i in range(5):
+            queue.enqueue(
+                f"{i:064d}", {"op": "t", "i": i},
+                {**unit, "pvs_id": f"P2STR01_SRC10{i}_HRC100"},
+                f"tenant{i % 2}", "normal", f"req-{i % 2}", f"u{i}.bin",
+            )
+        sched = Scheduler(
+            queue, Recording(), str(tmp_path / "a"),
+            workers=1, wave_width=4,
+        ).start()
+        try:
+            assert sched.wait_idle(timeout=30.0)
+        finally:
+            sched.stop()
+        assert sum(batches) == 5
+        assert max(batches) == 4  # one full cross-request wave + remainder
+    finally:
+        tm.disable()
+        store_runtime.configure(None)
+
+
+def test_scheduler_retries_then_fails_permanently(tmp_path):
+    tm.enable()
+    try:
+        class Failing(SyntheticExecutor):
+            calls = 0
+
+            def run_batch(self, units, outputs):
+                type(self).calls += 1
+                raise RuntimeError("boom")
+
+        failed = []
+        queue = DurableQueue(str(tmp_path / "q"))
+        queue.enqueue(
+            "f" * 64, {"op": "t"},
+            {"database": "P2STR01", "src": "SRC100", "hrc": "HRC100",
+             "params": {}, "pvs_id": "P2STR01_SRC100_HRC100"},
+            "acme", "normal", "req-1", "f.bin",
+        )
+        sched = Scheduler(
+            queue, Failing(), str(tmp_path / "a"), workers=1,
+            max_attempts=2, on_failed=failed.append,
+        ).start()
+        try:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and not failed:
+                time.sleep(0.02)
+        finally:
+            sched.stop()
+        assert len(failed) == 1
+        assert failed[0].state == "failed"
+        assert failed[0].attempts == 1      # one requeue happened
+        assert Failing.calls == 2           # initial + one retry
+        assert "boom" in failed[0].error
+        # a NEW request for the failed plan re-arms the record with a
+        # FRESH attempt budget — the spent counter must not leak into
+        # the retry economics of every future request for this plan
+        rearmed, outcome = queue.enqueue(
+            "f" * 64, {"op": "t"},
+            {"database": "P2STR01", "src": "SRC100", "hrc": "HRC100",
+             "params": {}, "pvs_id": "P2STR01_SRC100_HRC100"},
+            "acme", "normal", "req-2", "f.bin",
+        )
+        assert outcome == "new"
+        assert rearmed.state == "queued"
+        assert rearmed.attempts == 0
+    finally:
+        tm.disable()
+        store_runtime.configure(None)
+
+
+# ---------------------------------------------------------- GC pressure
+
+
+def test_store_pressure_evicts_lru_but_honors_active_pins(tmp_path):
+    tm.enable()
+    try:
+        store = ArtifactStore(str(tmp_path / "store"))
+        paths = {}
+        for i, tag in enumerate(("old", "mid", "hot")):
+            p = tmp_path / f"{tag}.bin"
+            p.write_bytes(bytes([i]) * 4096)
+            store.commit(tag * 21 + tag[0], str(p), producer=tag)
+            paths[tag] = p
+            time.sleep(0.05)  # distinct manifest mtimes for LRU order
+        active = {("hot" * 21 + "h")}
+        pressure = StorePressure(
+            store, budget_bytes=8192, active_plans=lambda: active,
+        )
+        summary = pressure.maybe_collect(force=True)
+        assert summary is not None
+        assert summary["bytes_freed"] > 0
+        assert summary["objects_evicted"] >= 1
+        assert summary["pins_honored"] >= 1
+        # the active (pinned) plan survived; the oldest cold one went
+        assert store.lookup("hot" * 21 + "h") is not None
+        assert store.lookup("old" * 21 + "o") is None
+        # throttle: an immediate second unforced pass is a no-op
+        assert pressure.maybe_collect() is None
+    finally:
+        tm.disable()
+
+
+def test_gc_collect_reports_summary_keys(tmp_path):
+    from processing_chain_tpu.store import gc as store_gc
+
+    store = ArtifactStore(str(tmp_path / "store"))
+    p = tmp_path / "a.bin"
+    p.write_bytes(b"x" * 1024)
+    store.commit("a" * 64, str(p), producer="t")
+    report = store_gc.enforce_budget(store, size_budget_bytes=1 << 30)
+    for key in ("bytes_freed", "objects_evicted", "pins_honored",
+                "kept_bytes", "kept_manifests"):
+        assert key in report
+    assert report["kept_manifests"] == 1
+    assert report["bytes_freed"] == 0
+
+
+# ------------------------------------------------- kill/restart (daemon)
+
+
+def _wait_for(predicate, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _spawn_daemon(root: str, extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PC_STORE_DIR", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "processing_chain_tpu", "tools",
+         "chain-serve", "--root", root, "--port", "0", "--workers", "1",
+         *extra],
+        env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    info_path = os.path.join(root, "serve-info.json")
+
+    def info_up():
+        if proc.poll() is not None:
+            raise AssertionError("daemon exited before serving")
+        try:
+            with open(info_path) as f:
+                info = json.load(f)
+            return info if info.get("pid") == proc.pid else None
+        except (OSError, ValueError):
+            return None
+
+    info = _wait_for(info_up, 90.0, "serve-info.json")
+    return proc, info["url"]
+
+
+def test_daemon_sigkill_recovery_no_lost_or_doubled_work(tmp_path):
+    """The acceptance invariant: SIGKILL a daemon mid-request, restart
+    it on the same root, and the queue finishes with no lost units and
+    no re-execution of work that completed before the kill."""
+    root = str(tmp_path / "serve")
+    os.makedirs(root, exist_ok=True)
+    proc, url = _spawn_daemon(root)
+    req_id = None
+    try:
+        body = _body(
+            srcs=("SRC100", "SRC101", "SRC102"),
+            hrcs=("HRC100", "HRC101"),
+            work_ms=250,  # slow enough to die mid-request
+        )
+        code, acc = _post(url + "/v1/requests", body)
+        assert code == 202
+        req_id = acc["request"]
+
+        def some_done():
+            code, payload = _get(url + f"/v1/requests/{req_id}")
+            if code != 200:
+                return None
+            doc = json.loads(payload)
+            done = [u for u in doc["units"].values()
+                    if u["state"] == "done"]
+            return doc if 1 <= len(done) < len(doc["units"]) else None
+
+        _wait_for(some_done, 60.0, "a partially-complete request")
+    finally:
+        proc.kill()  # SIGKILL: no shutdown grace, sentinels stay down
+        proc.wait(timeout=30)
+
+    store_dir = os.path.join(root, "store", "manifests")
+    before = {}
+    for name in os.listdir(store_dir):
+        if name.endswith(".json"):
+            with open(os.path.join(store_dir, name)) as f:
+                doc = json.load(f)
+            before[doc["planHash"]] = doc["createdAt"]
+    assert before, "nothing committed before the kill"
+
+    proc2, url2 = _spawn_daemon(root)
+    try:
+        def request_done():
+            code, payload = _get(url2 + f"/v1/requests/{req_id}")
+            if code != 200:
+                return None
+            doc = json.loads(payload)
+            return doc if doc["state"] == "done" else None
+
+        final = _wait_for(request_done, 90.0, "recovered request to finish")
+        assert len(final["units"]) == 6
+        assert all(u["state"] == "done" for u in final["units"].values())
+        # no doubled work: everything committed before the kill was NOT
+        # re-executed (its manifest is byte-for-byte the pre-kill one)
+        for name in os.listdir(store_dir):
+            if not name.endswith(".json"):
+                continue
+            with open(os.path.join(store_dir, name)) as f:
+                doc = json.load(f)
+            if doc["planHash"] in before:
+                assert doc["createdAt"] == before[doc["planHash"]], (
+                    f"plan {doc['planHash'][:12]} was re-executed after "
+                    "restart"
+                )
+        # artifacts all fetchable from the recovered daemon
+        for unit in final["units"].values():
+            code, data = _get(url2 + unit["artifact"])
+            assert code == 200 and len(data) == 512
+    finally:
+        proc2.terminate()
+        try:
+            proc2.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+            proc2.wait(timeout=30)
+
+
+def test_daemon_recovery_requeues_with_attempt_bump(tmp_path):
+    """Queue-level recovery invariant without daemon overhead: a record
+    claimed (sentinel down) by a process that died is requeued with its
+    attempt counter bumped and its dedup index intact."""
+    root = str(tmp_path / "q")
+    queue = DurableQueue(root)
+    rec, _ = _enqueue(queue, "k" * 64, "req-1")
+    queue.claim([rec.job_id])
+    # simulate death: drop the in-memory queue, keep the disk state
+    del queue
+    reloaded = DurableQueue(root)
+    assert reloaded.recovery == {"jobs": 1, "requeued": 1, "done": 0,
+                                 "failed": 0}
+    assert reloaded.record(rec.job_id).state == "queued"
+    assert reloaded.queued_snapshot()[0].attempts == 1
+
+
+def test_service_restart_resumes_unfinished_requests(tmp_path):
+    """In-process restart: a request persisted as active with its units
+    still queued must complete under a fresh service on the same root
+    (exercises _recover_requests + queue recovery end to end)."""
+    root = str(tmp_path / "serve")
+    svc = ChainServeService(root=root, port=0, workers=1)
+    try:
+        # do NOT start the scheduler: units stay queued, request active
+        body = _body(srcs=("SRC100", "SRC101"), hrcs=("HRC100",))
+        acc = svc.submit(body)
+        assert acc["state"] == "active"
+    finally:
+        svc.stop()  # never started: must still release the port cleanly
+        store_runtime.configure(None)
+    svc2 = ChainServeService(root=root, port=0, workers=1).start()
+    try:
+        assert svc2.wait_request(acc["request"], timeout=60.0) == "done"
+        doc = svc2.request_status(acc["request"])
+        assert all(u["state"] == "done" for u in doc["units"].values())
+    finally:
+        svc2.stop()
+        store_runtime.configure(None)
+        tm.disable()
