@@ -208,38 +208,6 @@ def sort_lanes(lanes: list[Lane]) -> list[Lane]:
     return sorted(lanes, key=lambda ln: -ln.n_frames_hint)
 
 
-def pack_waves(items: list, key_of: Callable, width: int,
-               length_of: Optional[Callable] = None) -> list[list]:
-    """Cross-source wave packing: group `items` by their geometry bucket
-    key and slice each bucket into waves of at most `width` lanes.
-
-    This is the serve scheduler's device-sharing primitive: independent
-    PVS units from *different* requests land in one wave exactly when
-    their bucket key matches (same compiled step — bucketing never pads
-    space), regardless of which tenant or request submitted them. Items
-    whose key is None cannot batch and ride solo waves. `length_of`
-    (frames hint) orders items longest-first inside a bucket, the same
-    policy as `sort_lanes`, so the ragged-lane waste stays bounded by
-    the length spread within a wave."""
-    width = max(1, int(width))
-    buckets: dict = {}
-    solo: list[list] = []
-    for item in items:
-        key = key_of(item)
-        if key is None:
-            solo.append([item])
-        else:
-            buckets.setdefault(key, []).append(item)
-    waves: list[list] = []
-    for key in sorted(buckets, key=repr):
-        group = buckets[key]
-        if length_of is not None:
-            group = sorted(group, key=lambda it: -length_of(it))
-        for i in range(0, len(group), width):
-            waves.append(group[i:i + width])
-    return waves + solo
-
-
 def run_bucket(
     lanes: list[Lane],
     mesh,

@@ -60,7 +60,16 @@ class Executor(Protocol):
 
     def bucket_key(self, unit: dict) -> Optional[tuple]:
         """Geometry bucket for wave packing; None = cannot batch.
-        Called with the RECORD's unit dict (queue.JobRecord.unit)."""
+        Called with the RECORD's unit dict (queue.JobRecord.unit).
+        MUST NOT raise: it runs inside every scheduler worker's packing
+        pass over the whole queued snapshot, so one unparseable record
+        would poison every worker — return None instead."""
+        ...
+
+    def validate_params(self, params: dict) -> None:
+        """Reject executor params this executor cannot execute
+        (raise ValueError). Called at the HTTP front door so a bad
+        request 400s instead of becoming a durable queue record."""
         ...
 
     def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
@@ -106,11 +115,37 @@ class SyntheticExecutor:
     def output_name(self, unit: Unit, plan_hash: str) -> str:
         return f"{unit.pvs_id}_{plan_hash[:12]}.bin"
 
+    def validate_params(self, params: dict) -> None:
+        geometry = params.get("geometry")
+        if geometry is not None:
+            try:
+                if isinstance(geometry, (str, bytes)):
+                    raise TypeError
+                [int(g) for g in geometry]
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "params.geometry must be a list of integers, got "
+                    f"{geometry!r}"
+                ) from None
+        for key, cast in (("work_ms", float), ("size_bytes", int)):
+            if params.get(key) is not None:
+                try:
+                    cast(params[key])
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"params.{key} must be a number, got {params[key]!r}"
+                    ) from None
+
     def bucket_key(self, record_unit: dict) -> Optional[tuple]:
-        geometry = record_unit.get("params", {}).get("geometry")
-        if not geometry:
+        try:
+            geometry = record_unit.get("params", {}).get("geometry")
+            if not geometry:
+                return None
+            return ("synthetic", *(int(g) for g in geometry))
+        except (AttributeError, TypeError, ValueError):
+            # a pre-validation durable record with garbage params (null,
+            # non-dict, unparseable geometry): unbatchable, never a raise
             return None
-        return ("synthetic", *(int(g) for g in geometry))
 
     def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
         record_waves(len(units))
@@ -167,8 +202,29 @@ class DeviceWaveExecutor(SyntheticExecutor):
         plan["geometry"] = self._geometry(unit.params)
         return plan
 
+    def validate_params(self, params: dict) -> None:
+        for key in ("frames", *self._GEO):
+            if key in params:
+                try:
+                    value = int(params[key])
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"params.{key} must be an integer, got "
+                        f"{params[key]!r}"
+                    ) from None
+                if value <= 0:
+                    raise ValueError(
+                        f"params.{key} must be positive, got {value}"
+                    )
+
     def bucket_key(self, record_unit: dict) -> Optional[tuple]:
-        geo = self._geometry(record_unit.get("params", {}))
+        # params=None stays unbatchable (not defaulted): _unit_of would
+        # reject the record at dispatch, and a solo wave confines that
+        # failure instead of letting it take healthy siblings down
+        try:
+            geo = self._geometry(record_unit.get("params", {}))
+        except (AttributeError, TypeError, ValueError):
+            return None  # pre-validation garbage record: unbatchable
         return ("wave",) + tuple(geo[k] for k in self._GEO)
 
     def _mesh(self):

@@ -309,19 +309,37 @@ class DurableQueue:
     def claim(self, job_ids: list[str]) -> list[JobRecord]:
         """Move jobs queued → running (sentinel down). Jobs another
         worker claimed first are silently skipped — the returned list is
-        what THIS caller owns."""
+        what THIS caller owns. A disk failure (ENOSPC/EIO on the
+        sentinel or the rewrite) mid-way through the list reverts THAT
+        record to queued and stops claiming: the caller still owns
+        everything claimed before it, so no record is ever stranded in
+        'running' with no owner while enqueue attaches newcomers to it."""
         owned: list[JobRecord] = []
         with self._lock:
             for job_id in job_ids:
                 record = self._queued.pop(job_id, None)
                 if record is None:
                     continue
-                record.state = "running"
-                self._running[job_id] = record
-                # chainlint: disable=atomic-write (sentinel: only its EXISTENCE signals an unfinished execution — same contract as the engine's .inprogress)
-                with open(self._sentinel_path(job_id), "w"):
-                    pass
-                self._persist(record)
+                try:
+                    record.state = "running"
+                    self._running[job_id] = record
+                    # chainlint: disable=atomic-write (sentinel: only its EXISTENCE signals an unfinished execution — same contract as the engine's .inprogress)
+                    with open(self._sentinel_path(job_id), "w"):
+                        pass
+                    self._persist(record)
+                except OSError:
+                    record.state = "queued"
+                    self._running.pop(job_id, None)
+                    self._queued[job_id] = record
+                    try:
+                        self._clear_sentinel(job_id)
+                    except OSError:  # the disk is already misbehaving
+                        pass         # recovery treats a stray sentinel as requeue
+                    get_logger().exception(
+                        "serve queue: claim of %s failed; reverted to "
+                        "queued", job_id,
+                    )
+                    break
                 owned.append(record)
             self._set_depth_gauge()
         return owned

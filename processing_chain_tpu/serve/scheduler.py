@@ -63,6 +63,13 @@ class StridePicker:
     def __init__(self, tenant_weights: Optional[dict] = None) -> None:
         self._weights = dict(tenant_weights or {})
         self._pass: dict[tuple, float] = {}
+        #: virtual time = the pass of the most recently dispatched flow.
+        #: Joining/rejoining flows enter at vtime, not at the minimum
+        #: over every flow EVER seen — a pass frozen while its flow sat
+        #: idle would otherwise hand the next arrival (or the returning
+        #: flow itself) a catch-up burst that starves every active
+        #: tenant until the stale gap is consumed.
+        self._vtime = 0.0
 
     def _stride(self, flow: tuple) -> float:
         tenant, priority = flow
@@ -77,14 +84,18 @@ class StridePicker:
             flow = (record.tenant, record.priority)
             if flow not in flows:
                 flows[flow] = record
-        floor = min(self._pass.values()) if self._pass else 0.0
         for flow in flows:
-            if flow not in self._pass:
-                self._pass[flow] = floor
+            if flow not in self._pass or self._pass[flow] < self._vtime:
+                # new flow, or one whose pass froze while it was idle
+                # and vtime moved on: (re)join at NOW. For flows that
+                # stayed active this is a no-op — vtime is the minimum
+                # pass by construction, so active passes never trail it.
+                self._pass[flow] = self._vtime
         chosen = min(
             flows,
             key=lambda f: (self._pass[f], -PRIORITIES.get(f[1], 1), f[0]),
         )
+        self._vtime = self._pass[chosen]
         self._pass[chosen] += self._stride(chosen)
         return flows[chosen]
 
@@ -210,36 +221,55 @@ class Scheduler:
     def _worker(self) -> None:
         log = get_logger()
         while not self._stop.is_set():
-            batch = self._next_batch()
-            if not batch:
-                self._wake.wait(timeout=0.2)
-                self._wake.clear()
-                continue
             try:
+                batch = self._next_batch()
+                if not batch:
+                    self._wake.wait(timeout=0.2)
+                    self._wake.clear()
+                    continue
                 self._dispatch(batch)
             except BaseException:  # noqa: BLE001 - a worker must survive anything
-                log.exception("serve scheduler: dispatch crashed")
+                # _next_batch is INSIDE the guard: a poisoned queue record
+                # (e.g. unparseable params reaching bucket_key) must not
+                # kill the worker. Back off briefly so a persistently bad
+                # record cannot turn the loop into a log-spinning hot path.
+                log.exception("serve scheduler: worker iteration crashed")
+                self._stop.wait(timeout=0.5)
 
     def _next_batch(self) -> list[JobRecord]:
-        """Fairness seed + same-bucket fill, all claimed atomically. The
-        fill is `pack_waves` (parallel/p03_batch) — the one wave-packing
-        policy, shared with every other bucket consumer: the claimed
-        batch is exactly the packed wave containing the fairness seed."""
-        from ..parallel.p03_batch import pack_waves
+        """Fairness seed + same-bucket fill, all claimed atomically:
+        the claimed batch is the seed plus up to `wave_width - 1` other
+        queued records sharing its bucket key (p03_batch geometry
+        semantics — same key ⟺ same compiled device step), in enqueue
+        order. The fill scans only until the wave is full, instead of
+        packing the entire snapshot into waves to keep one — a deep
+        queue must not cost O(queue) key calls under the scheduler lock
+        per dispatch."""
+
+        def safe_key(record: JobRecord):
+            # totality guaranteed HERE, not re-audited per executor: one
+            # record whose unit an executor's bucket_key cannot parse
+            # must degrade to unbatchable (solo wave), never abort the
+            # packing pass every worker runs over the queued snapshot
+            try:
+                return self.executor.bucket_key(record.unit)
+            except Exception:  # noqa: BLE001 - any key failure = unbatchable
+                return None
 
         with self._lock:
             queued = self.queue.queued_snapshot()
             if not queued:
                 return []
             seed = self._picker.pick(queued)
-            waves = pack_waves(
-                queued, key_of=lambda r: self.executor.bucket_key(r.unit),
-                width=self.wave_width,
-            )
-            wave = next(
-                w for w in waves
-                if any(r.job_id == seed.job_id for r in w)
-            )
+            wave = [seed]
+            seed_key = safe_key(seed)
+            if seed_key is not None:  # None = unbatchable: solo wave
+                for record in queued:
+                    if len(wave) >= self.wave_width:
+                        break
+                    if (record.job_id != seed.job_id
+                            and safe_key(record) == seed_key):
+                        wave.append(record)
             return self.queue.claim([r.job_id for r in wave])
 
     # --------------------------------------------------------- execution

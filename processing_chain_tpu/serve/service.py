@@ -205,7 +205,8 @@ class ChainServeService:
                         continue
                     doc["_pending"].add(plan_hash)
                     self._plan_waiters.setdefault(plan_hash, set()).add(req_id)
-                    if self.queue.by_plan(plan_hash) is None:
+                    record = self.queue.by_plan(plan_hash)
+                    if record is None:
                         # enqueue lost to the crash: re-create it from the
                         # request record (it carries the full unit payload)
                         self.queue.enqueue(
@@ -215,6 +216,15 @@ class ChainServeService:
                             doc["tenant"], doc["priority"], req_id,
                             unit_doc["output"],
                         )
+                    else:
+                        # the record may be 'failed' (crash before the
+                        # request saw the failure) or 'done' with the
+                        # artifact since evicted (the store check above
+                        # said not-done): re-arm it, mirroring submit —
+                        # otherwise nothing ever runs this plan and the
+                        # recovered request pins it in 'active' forever.
+                        # rearm is a no-op on queued/running records.
+                        self.queue.rearm(record.job_id)
         for doc in recovered_active:
             self._check_request_done(doc["request"])
 
@@ -226,9 +236,17 @@ class ChainServeService:
         t0 = time.perf_counter()
         try:
             normalized = api.validate_request(payload)
+            # executor-specific params validate at the front door too: a
+            # unit the executor cannot parse must 400 here, not become a
+            # durable queue record that poisons the scheduler's packing
+            # pass on every restart
+            self.executor.validate_params(normalized["params"])
         except api.RequestError:
             _REQ_TOTAL.labels(state="rejected").inc()
             raise
+        except ValueError as exc:
+            _REQ_TOTAL.labels(state="rejected").inc()
+            raise api.RequestError(str(exc)) from exc
         units = api.expand_units(normalized)
         req_id = "req-" + secrets.token_hex(5)
         unit_docs: dict[str, dict] = {}
@@ -396,13 +414,25 @@ class ChainServeService:
                 duration_s=round(max(0.0, latency_s), 4), warm=warm)
 
     def _persist_request(self, doc: dict) -> None:
-        atomic_write_json(
-            os.path.join(self.requests_dir, doc["request"] + ".json"),
-            # "_pending" (a set) is in-memory bookkeeping, rebuilt at
-            # recovery from the store + queue — never persisted
-            {k: v for k, v in doc.items() if not k.startswith("_")},
-            sort_keys=True,
-        )
+        # snapshot AND write under the lock (the queue's own discipline:
+        # the files are small, one atomic replace each). The lock stops
+        # two races at once: _on_job_failed inserting doc["error"] while
+        # the comprehension iterates (RuntimeError), and a stale snapshot
+        # from the submit thread landing AFTER a worker persisted the
+        # terminal state, reverting the on-disk record to 'active'.
+        # "_pending" (a set) is in-memory bookkeeping, rebuilt at
+        # recovery from the store + queue — never persisted.
+        with self._lock:
+            snapshot = {
+                k: v for k, v in doc.items() if not k.startswith("_")
+            }
+            atomic_write_json(
+                os.path.join(
+                    self.requests_dir, snapshot["request"] + ".json"
+                ),
+                snapshot,
+                sort_keys=True,
+            )
 
     def _prune_finished(self) -> None:
         """Retention for an always-on daemon: keep the most recent
@@ -569,9 +599,26 @@ class ChainServeService:
             return self._json(404, {"error": "artifact failed verification; "
                                              "re-POST the request to rebuild"})
         self.store.touch(manifest)
-        # streamed from disk (live.FileBody): artifacts are video-scale
+        # streamed from disk (live.FileBody): artifacts are video-scale.
+        # Open the fd HERE, not in the reply: the GC pressure hook can
+        # evict the object between this check and the streaming loop,
+        # and an open descriptor keeps the bytes alive for this response
+        # (a post-eviction GET is an honest 404, never a truncated 200).
+        path = self.store.object_path(manifest.object["sha256"])
+        try:
+            fileobj = open(path, "rb")
+        except FileNotFoundError:
+            return self._json(404, {"error": "artifact evicted; re-POST "
+                                             "the request to rebuild"})
+        except OSError as exc:
+            # NOT eviction (EMFILE under fd pressure, EACCES, …): a 404
+            # here would tell clients to re-POST and recompute bytes that
+            # are sitting in the store — say 500 so they retry the GET
+            get_logger().warning("serve: artifact open failed: %r", exc)
+            return self._json(500, {"error": "artifact temporarily "
+                                             "unavailable; retry"})
         return 200, "application/octet-stream", live.FileBody(
-            self.store.object_path(manifest.object["sha256"])
+            path, fileobj=fileobj
         )
 
     # ------------------------------------------------------ test helpers

@@ -48,11 +48,12 @@ import threading
 import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict, Optional, Tuple, Union
+from typing import BinaryIO, Callable, Dict, Optional, Tuple, Union
 from urllib.parse import parse_qsl, urlsplit
 
 from ..utils import lockdebug
 from ..utils.fsio import atomic_write_json
+from ..utils.log import get_logger
 from .heartbeat import HEARTBEATS
 from .metrics import REGISTRY
 
@@ -125,9 +126,16 @@ class FileBody:
     """A response body streamed from disk in chunks instead of being
     materialized in memory — artifact downloads are video-scale, and an
     always-on daemon answering several concurrent multi-GB GETs with
-    f.read() would OOM on exactly the load it exists to serve."""
+    f.read() would OOM on exactly the load it exists to serve.
+
+    Handlers that race a deleter (the serve GC pressure hook can evict
+    an artifact between the handler's check and the reply's streaming
+    loop) should open the file themselves and pass `fileobj`: the open
+    descriptor keeps the bytes alive for the whole response even if the
+    path is unlinked mid-stream. `_reply` closes it either way."""
 
     path: str
+    fileobj: Optional[BinaryIO] = None
 
 
 #: handler signature: WebRequest -> (status code, content type, body)
@@ -281,17 +289,23 @@ class _Handler(BaseHTTPRequestHandler):
                body: Union[str, bytes, FileBody]) -> None:
         try:
             if isinstance(body, FileBody):
-                size = os.stat(body.path).st_size
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(size))
-                self.end_headers()
-                with open(body.path, "rb") as f:
+                f = body.fileobj
+                try:
+                    if f is None:
+                        f = open(body.path, "rb")
+                    size = os.fstat(f.fileno()).st_size
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(size))
+                    self.end_headers()
                     while True:
                         chunk = f.read(1 << 20)
                         if not chunk:
                             break
                         self.wfile.write(chunk)
+                finally:
+                    if f is not None:
+                        f.close()
                 return
             data = body.encode() if isinstance(body, str) else body
             self.send_response(code)
@@ -299,9 +313,17 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
-        except (BrokenPipeError, ConnectionResetError, OSError):
+        except (BrokenPipeError, ConnectionResetError):
             # impatient curl, or a handler racing stop()'s socket close
             pass
+        except OSError:
+            # NOT a client disconnect: disk trouble mid-stream, or a
+            # FileBody path deleted before the handler pinned an fd —
+            # the client got a truncated/empty response; say so.
+            get_logger().warning(
+                "live: reply for %s failed mid-stream", self.path,
+                exc_info=True,
+            )
 
     def log_message(self, fmt: str, *args) -> None:  # noqa: A003
         pass  # never spam the chain's console per scrape

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from typing import Callable, Optional
 
 
@@ -11,8 +12,13 @@ def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
     """Write `path` via temp-then-os.replace so an interrupted run never
     leaves a truncated file that a later run's exists-check would trust
     (same-directory temp keeps the replace atomic). `write_fn` receives
-    the temp path; the temp is removed on failure."""
-    tmp = f"{path}.part.{os.getpid()}"
+    the temp path; the temp is removed on failure. The temp name is
+    pid- AND thread-unique: concurrent writers of the same path from
+    different threads (the serve daemon persists one request file from
+    both the submit thread and scheduler callbacks) must never share a
+    temp file, or one thread's os.replace promotes the other's
+    half-written bytes."""
+    tmp = f"{path}.part.{os.getpid()}.{threading.get_ident()}"
     try:
         write_fn(tmp)
         os.replace(tmp, path)

@@ -308,6 +308,30 @@ def test_live_server_route_registry_and_methods():
         assert "GET" in err.value.headers.get("Allow", "")
 
 
+def test_filebody_fd_pins_deleted_file(tmp_path):
+    """A handler that opens the file itself (FileBody.fileobj) keeps the
+    response intact even when a deleter — the serve GC pressure hook —
+    unlinks the path before the reply streams it: the open descriptor
+    pins the bytes for the duration of the response."""
+    blob = tmp_path / "blob.bin"
+    blob.write_bytes(b"x" * 4096)
+
+    def handler(req):
+        f = open(blob, "rb")
+        os.unlink(blob)  # the deleter wins the race AFTER the fd pin
+        return 200, "application/octet-stream", live_mod.FileBody(
+            str(blob), fileobj=f
+        )
+
+    routes = live_mod.default_routes()
+    routes.add("/blob", handler)
+    with live_mod.LiveServer(0, routes=routes) as srv:
+        with urllib.request.urlopen(srv.url + "/blob", timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.read() == b"x" * 4096
+    assert not blob.exists()
+
+
 def test_live_server_stop_races_inflight_scrapes():
     """The serve-daemon hot path: stop() while scrape threads hammer
     every endpoint must neither deadlock nor leak an exception into the

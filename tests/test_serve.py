@@ -23,7 +23,6 @@ import pytest
 
 from processing_chain_tpu import telemetry as tm
 from processing_chain_tpu.engine.jobs import Job, JobRunner
-from processing_chain_tpu.parallel.p03_batch import pack_waves
 from processing_chain_tpu.serve import api
 from processing_chain_tpu.serve.executors import SyntheticExecutor
 from processing_chain_tpu.serve.pressure import StorePressure
@@ -118,6 +117,36 @@ def test_validate_request_rejects_bad_documents():
         api.validate_request("not an object")
     with pytest.raises(api.RequestError):
         api.validate_request({**good, "srcs": None})
+
+
+def test_executor_param_validation_and_total_bucket_key():
+    """Executor params validate at the front door (ValueError → 400) and
+    bucket_key is TOTAL over garbage records: a pre-validation durable
+    record with unparseable params is unbatchable (None), never a raise
+    that would poison every scheduler worker's packing pass."""
+    from processing_chain_tpu.serve.executors import DeviceWaveExecutor
+
+    syn = SyntheticExecutor()
+    syn.validate_params({"geometry": [64, 36], "work_ms": 5,
+                         "size_bytes": 128})
+    for bad in ({"geometry": "1080p"}, {"geometry": 5},
+                {"geometry": [64, "x"]}, {"work_ms": "fast"},
+                {"size_bytes": []}):
+        with pytest.raises(ValueError):
+            syn.validate_params(bad)
+    assert syn.bucket_key({"params": {"geometry": "1080p"}}) is None
+    assert syn.bucket_key({"params": {"geometry": 5}}) is None
+    assert syn.bucket_key({"params": None}) is None      # corrupted record
+    assert syn.bucket_key({"params": {"geometry": [64, 36]}}) is not None
+
+    wave = DeviceWaveExecutor()
+    wave.validate_params({"frames": 4, "src_h": 36})
+    for bad in ({"src_h": "1080p"}, {"frames": 0}, {"dst_w": None}):
+        with pytest.raises(ValueError):
+            wave.validate_params(bad)
+    assert wave.bucket_key({"params": {"src_h": "1080p"}}) is None
+    assert wave.bucket_key({"params": None}) is None
+    assert wave.bucket_key({"params": {}}) is not None
 
 
 def test_expand_units_is_the_grid_and_caps():
@@ -238,20 +267,45 @@ def test_stride_picker_priority_classes():
     assert interactive == 16
 
 
-def test_pack_waves_groups_by_bucket_across_sources():
-    items = [
-        {"id": i, "geo": (64, 36) if i % 2 == 0 else (128, 72)}
-        for i in range(10)
-    ] + [{"id": 10, "geo": None}]
-    waves = pack_waves(items, key_of=lambda it: it["geo"], width=4)
-    solo = [w for w in waves if len(w) == 1 and w[0]["geo"] is None]
-    assert len(solo) == 1
-    for wave in waves:
-        keys = {it["geo"] for it in wave}
-        assert len(keys) == 1  # never mixes geometries
-        assert len(wave) <= 4
-    packed = [w for w in waves if w[0]["geo"] is not None]
-    assert sorted(len(w) for w in packed) == [1, 1, 4, 4]
+def test_stride_picker_idle_flow_rejoins_at_vtime_no_burst():
+    """A flow whose pass froze while it sat idle re-enters at the
+    CURRENT virtual time: no catch-up burst that would starve every
+    active tenant until the stale gap drains."""
+    picker = StridePicker()
+    a = _records("a", "normal", 30)
+    b = _records("b", "normal", 60)
+    first = picker.pick(a[:1] + b)
+    assert first.tenant == "a"   # equal pass/class: name tiebreak
+    for _ in range(50):          # 'a' idle; vtime advances far past it
+        picker.pick(b)
+    order = _drain(picker, a[1:] + b, 20)
+    a_count = sum(1 for r in order if r.tenant == "a")
+    assert 8 <= a_count <= 12    # ~fair alternation, not a 20/20 burst
+
+
+def test_queue_claim_disk_failure_reverts_instead_of_stranding(
+        tmp_path, monkeypatch):
+    """A persist failure mid-claim must revert that record to queued and
+    return the earlier claims — never leave ownerless 'running' records
+    that singleflight keeps attaching new requests to until restart."""
+    queue = DurableQueue(str(tmp_path / "q"))
+    r1, _ = _enqueue(queue, "1" * 64, "req-1")
+    r2, _ = _enqueue(queue, "2" * 64, "req-1")
+    real_persist = queue._persist
+
+    def failing(record):
+        if record.job_id == r2.job_id and record.state == "running":
+            raise OSError("disk full")
+        real_persist(record)
+
+    monkeypatch.setattr(queue, "_persist", failing)
+    owned = queue.claim([r1.job_id, r2.job_id])
+    assert [r.job_id for r in owned] == [r1.job_id]
+    assert queue.record(r2.job_id).state == "queued"
+    assert r2.job_id in {r.job_id for r in queue.queued_snapshot()}
+    assert not os.path.isfile(os.path.join(
+        str(tmp_path / "q"), "jobs", r2.job_id + ".json.inprogress"
+    ))
 
 
 # -------------------------------------------------- engine satellite
@@ -376,6 +430,104 @@ def test_service_http_rejections(serve_factory):
     with pytest.raises(urllib.error.HTTPError) as exc_info:
         urllib.request.urlopen(req)
     assert exc_info.value.code == 405
+
+
+def test_service_http_rejects_unparseable_executor_params(serve_factory):
+    """Params the executor cannot parse 400 at submit — they must never
+    become durable queue records (one such record used to kill every
+    scheduler worker permanently, surviving restarts)."""
+    svc = serve_factory()
+    url = svc.server.url
+    for bad in ({"geometry": "1080p"}, {"geometry": 5},
+                {"work_ms": "fast"}):
+        code, err = _post(url + "/v1/requests", {**_body(), "params": bad})
+        assert code == 400 and "error" in err, bad
+    assert svc.queue.counts() == {}  # nothing durable for rejected requests
+
+
+def test_scheduler_survives_poisoned_queue_record(tmp_path):
+    """Backstop for records that predate front-door param validation: a
+    durable record whose params bucket_key cannot parse must not kill
+    the worker pool — it packs solo and still executes."""
+    tm.enable()
+    try:
+        unit = {"database": "P2STR01", "src": "SRC100", "hrc": "HRC100",
+                "params": {"geometry": "1080p", "size_bytes": 64},
+                "pvs_id": "P2STR01_SRC100_HRC100"}
+        queue = DurableQueue(str(tmp_path / "q"))
+        queue.enqueue("0" * 64, {"op": "t", "v": 0}, unit,
+                      "acme", "normal", "req-bad", "bad.bin")
+        good = {**unit, "params": {"geometry": [64, 36], "size_bytes": 64},
+                "pvs_id": "P2STR01_SRC101_HRC100", "src": "SRC101"}
+        queue.enqueue("1" * 64, {"op": "t", "v": 1}, good,
+                      "acme", "normal", "req-good", "good.bin")
+        sched = Scheduler(
+            queue, SyntheticExecutor(), str(tmp_path / "a"), workers=2,
+        ).start()
+        try:
+            assert sched.wait_idle(timeout=30.0)
+        finally:
+            sched.stop()
+        assert queue.counts() == {"done": 2}
+    finally:
+        tm.disable()
+        store_runtime.configure(None)
+
+
+def test_scheduler_key_totality_survives_raising_bucket_key(tmp_path):
+    """Totality is guaranteed at the scheduler altitude, not re-audited
+    per executor: even a bucket_key that RAISES degrades the record to
+    unbatchable instead of aborting every worker's packing pass."""
+    tm.enable()
+    try:
+        class Hostile(SyntheticExecutor):
+            def bucket_key(self, record_unit):
+                raise RuntimeError("hostile key")
+
+        queue = DurableQueue(str(tmp_path / "q"))
+        _enqueue(queue, "e" * 64, "req-1")
+        sched = Scheduler(
+            queue, Hostile(), str(tmp_path / "a"), workers=1,
+        ).start()
+        try:
+            assert sched.wait_idle(timeout=30.0)
+        finally:
+            sched.stop()
+        assert queue.counts() == {"done": 1}
+    finally:
+        tm.disable()
+        store_runtime.configure(None)
+
+
+def test_recovery_rearms_failed_and_evicted_records(tmp_path):
+    """Recovery must re-arm queue records a crashed daemon left 'failed'
+    (the request never saw the failure) or 'done' with the artifact
+    missing from the store — otherwise the recovered request stays
+    active forever, its plans pinned against GC with nothing running."""
+    root = str(tmp_path / "serve")
+    svc = ChainServeService(root=root, port=0, workers=1)
+    try:
+        # scheduler never started: units stay queued, requests active
+        acc_f = svc.submit(_body(srcs=("SRC100",), hrcs=("HRC100",)))
+        acc_e = svc.submit(_body(srcs=("SRC101",), hrcs=("HRC100",)))
+        rec_f, rec_e = svc.queue.queued_snapshot()
+        svc.queue.claim([rec_f.job_id, rec_e.job_id])
+        # crash window: one job failed before the request was told, one
+        # was marked done but the store never got (or lost) the bytes
+        svc.queue.fail(rec_f.job_id, error="crashed", requeue=False)
+        svc.queue.complete(rec_e.job_id)
+    finally:
+        svc.stop()
+        store_runtime.configure(None)
+    svc2 = ChainServeService(root=root, port=0, workers=1).start()
+    try:
+        assert svc2.wait_request(acc_f["request"], timeout=60.0) == "done"
+        assert svc2.wait_request(acc_e["request"], timeout=60.0) == "done"
+        assert svc2.queue.counts() == {"done": 2}
+    finally:
+        svc2.stop()
+        store_runtime.configure(None)
+        tm.disable()
 
 
 def test_scheduler_packs_cross_request_units_into_waves(tmp_path):
