@@ -109,8 +109,7 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
         from .telemetry import live as live_mod
         from .telemetry import watchdog as watchdog_mod
 
-        live_mod.RUN_META.clear()
-        live_mod.RUN_META.update(name=name, argv=list(argv))
+        live_mod.set_run_meta(name=name, argv=list(argv))
         try:
             if live_port is not None:
                 live_server = live_mod.LiveServer(live_port).start()
@@ -250,7 +249,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
     tools = (
         "src-analysis", "complexity", "plots", "metrics", "clean-logs",
         "run-report", "store", "chain-top", "chain-profile", "bench-compare",
-        "chain-lint", "chain-serve", "serve-soak",
+        "chain-lint", "chain-serve", "serve-soak", "queue-crashcheck",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -290,6 +289,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import serve_soak
 
             return serve_soak.main(rest)
+        if name == "queue-crashcheck":
+            from .tools import queue_crashcheck
+
+            return queue_crashcheck.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

@@ -51,6 +51,7 @@ def host_batch_enabled() -> bool:
     buffer pooling). PC_HOST_BATCH=0 restores the per-frame fallback —
     the parity baseline, and the escape hatch for anything the batch
     path misbehaves on."""
+    # plan-exempt: (batched host I/O is byte-identical to the per-frame fallback; host-path-smoke CI parity gate)
     return os.environ.get("PC_HOST_BATCH", "1").strip().lower() not in (
         "0", "off", "false",
     )
@@ -96,7 +97,7 @@ class BufferPool:
     def _track(self, arr: np.ndarray) -> None:
         key = id(arr)
 
-        def _dropped(_ref, *, _self=weakref.ref(self), _key=key):
+        def _dropped(_ref, *, _self=weakref.ref(self), _key=key):  # noqa: B008 - definition-time capture is the point (GC-safe weakref, no cycle through self)
             # deliberately LOCK-FREE: a GC cycle collection can fire this
             # callback on any allocation — including ones made while this
             # same thread already holds the pool lock (e.g. inside

@@ -68,6 +68,7 @@ def chunk_frames() -> int:
     granularity, and a short clip in one 64-frame chunk serializes the
     whole run (the BENCH_r05 e2e shape: 24 frames = 1 chunk = zero
     overlap), while per-chunk dispatch costs ~nothing on host."""
+    # plan-exempt: (chunk granularity batches the identical frame stream; pinned by the batch-vs-single parity tests)
     pinned = _env_int("PC_CHUNK_FRAMES")
     if pinned is not None:
         return max(1, pinned)
@@ -83,6 +84,7 @@ def _decode_workers() -> int:
     decode is the bottleneck feeding the chips (SURVEY §7 hard part #2).
     1 restores strictly serial per-segment decode."""
     try:
+        # plan-exempt: (prefetch width; MultiSegmentPrefetcher preserves segment order, identical stream at any width)
         return max(1, int(os.environ.get("PC_DECODE_WORKERS", "2")))
     except ValueError:
         return 2
@@ -156,6 +158,7 @@ def ffv1_workers() -> int:
     independently on private contexts and scale with cores where slice
     threading (the reference's `-threads 4`, lib/ffmpeg.py:1047) tops
     out at slices-per-frame."""
+    # plan-exempt: (worker count schedules whole-frame encodes; the slices=0 regime it selects is recorded as ffv1_slices in the plan)
     raw = os.environ.get("PC_FFV1_WORKERS", "").strip()
     if raw:
         try:
@@ -177,10 +180,12 @@ def set_default_fp_workers(pool_width: int) -> None:
     oversubscribe the host, so the spare cores are divided across the
     pool. Called by every stage that runs intra writebacks `-p`-wide
     (p03 renders, p04 previews)."""
+    # plan-exempt: (presence probe for the pool-aware default; the byte-relevant outcome is the recorded ffv1_slices)
     if "PC_FFV1_WORKERS" not in os.environ:
         ncpu = os.cpu_count() or 1
         per_job = (ncpu - 1) // max(1, pool_width) if ncpu > 2 else 0
         os.environ["PC_FFV1_WORKERS"] = str(max(0, min(per_job, 8)))
+    # plan-exempt: (presence probe for the pool-aware default; the byte-relevant outcome is the recorded ffv1_slices)
     if "PC_FFV1_THREADS" not in os.environ:
         # the serial writers' slice-threading default (one thread per
         # core) must also divide across the pool: when the fp default
@@ -201,6 +206,7 @@ def ffv1_coding_threads() -> int:
     one per core (the reference pins `-threads 4`, lib/ffmpeg.py:1047 —
     which WASTES cores above 4 and oversubscribes below);
     PC_FFV1_THREADS pins it."""
+    # plan-exempt: (thread count does not alter encoded bytes; its effect on the default slice count is captured by the recorded ffv1_slices)
     pinned = _env_int("PC_FFV1_THREADS")
     if pinned is not None:
         return max(1, pinned)
@@ -226,17 +232,29 @@ def ffv1_slices(threads: int) -> int:
 
 def ffv1_effective_coding() -> dict:
     """The FFV1 writeback configuration `_ffv1_writer` will actually use,
-    resolved once so the writer and store provenance cannot drift. These
-    knobs change the BYTE STREAM but never the decoded frames (slices
-    tile, threads parallelize, fp workers reorder nothing) — like
-    fp_workers they stay out of plan hashes and are recorded in
-    provenance so artifacts remain attributable."""
+    resolved once so the writer, the plan payload and store provenance
+    cannot drift. The SLICE layout shapes the bitstream (decoded frames
+    stay identical), so the effective slice count is part of every
+    ffv1-writing plan hash via `ffv1_effective_slices` — the store
+    serves BYTES by plan hash, and two slice layouts are two byte
+    streams (store/plan_schema.py). Thread and fp-worker counts only
+    parallelize the layout the plan already records; they stay out of
+    the hash and land in provenance for attributability."""
     workers = ffv1_workers()
     if workers > 0:
         return {"fp_workers": workers, "threads": 1, "slices": 0}
     threads = ffv1_coding_threads()
     return {"fp_workers": 0, "threads": threads,
             "slices": ffv1_slices(threads)}
+
+
+def ffv1_effective_slices() -> int:
+    """The byte-relevant projection of the writeback knobs, for plan
+    payloads: the slice layout `_ffv1_writer` will emit (0 = the
+    frame-parallel single-slice regime). PC_FFV1_SLICES and the
+    PC_FFV1_THREADS-derived default both flow into cache keys through
+    THIS value — fold it into any plan whose artifact is FFV1-encoded."""
+    return ffv1_effective_coding()["slices"]
 
 
 def _ffv1_writer(path: str, w: int, h: int, pix_fmt: str, rate: float,
@@ -427,11 +445,15 @@ def _wo_buffer_plan(
     avpvs_src_fps: bool, force_60_fps: bool,
 ) -> dict:
     """Plan payload for the wo_buffer render: encoded segment digests,
-    the SRC (long tests mux its audio), canvas geometry, and the rate /
-    codec knobs. fp-worker count is deliberately absent — frame-parallel
-    FFV1 yields different bytes but identical decoded frames, and the
-    cache key tracks semantic content inputs, not byte-stream accidents."""
+    the SRC (long tests mux its audio), canvas geometry, and every
+    byte-affecting knob — the effective codec, its slice layout (FFV1
+    bitstream structure; store/plan_schema.py) and the resize-method
+    identity. fp-worker and thread COUNTS stay out: they parallelize
+    the recorded layout without changing the bytes (plan-exempt)."""
+    from ..ops import resize as resize_ops
+
     tc = pvs.test_config
+    codec = effective_avpvs_codec(pix_fmt)
     return {
         "op": "avpvs_wo_buffer",
         "segments": [store_keys.file_ref(s.file_path) for s in pvs.segments],
@@ -440,7 +462,9 @@ def _wo_buffer_plan(
         ),
         "canvas": [w, h],
         "pix_fmt": pix_fmt,
-        "codec": effective_avpvs_codec(pix_fmt),
+        "codec": codec,
+        "ffv1_slices": ffv1_effective_slices() if codec == "ffv1" else None,
+        "resize": resize_ops.plan_resize_method(),
         "rate": {
             "avpvs_src_fps": bool(avpvs_src_fps),
             "force_60_fps": bool(force_60_fps),
@@ -1089,6 +1113,11 @@ def apply_stalling(
         # run time, so a 10-bit ffv1 fallback over-invalidates on codec
         # flips rather than under-invalidating
         "codec": avpvs_codec(),
+        # unconditional (even for a requested rawvideo codec, whose
+        # 10-bit fallback writes ffv1): over-invalidating a rawvideo
+        # plan on a slice-knob flip is cheap; under-keying the fallback
+        # would poison the byte-addressed cache
+        "ffv1_slices": ffv1_effective_slices(),
     }
     return Job(
         label=f"stalling {pvs.pvs_id}",

@@ -305,10 +305,15 @@ def create_cpvs(
         return out_path
 
     # plan: the AVPVS digest covers every upstream knob transitively;
-    # the rest is this render's own decision surface (cpvs_plan's inputs)
+    # the rest is this render's own decision surface (cpvs_plan's
+    # inputs) plus the resize-method identity — the scale/pad path's
+    # pixel values depend on it (plan-purity, store/plan_schema.py)
+    from ..ops import resize as resize_ops
+
     plan = {
         "op": "cpvs",
         "input": store_keys.file_ref(pvs.get_avpvs_file_path()),
+        "resize": resize_ops.plan_resize_method(),
         "context": pp.processing_type,
         "display": [pp.display_width, pp.display_height],
         "coding": [pp.coding_width, pp.coding_height],

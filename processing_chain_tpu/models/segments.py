@@ -355,11 +355,16 @@ def encode_segment(segment: Segment) -> Optional[Job]:
     # encoded bytes — the SRC's content digest, the decode window, the
     # resolved scale/fps/encode surface. One flipped quality-level or
     # coding field changes the hash and invalidates exactly this segment.
+    from ..ops import resize as resize_ops
+
     plan = {
         "op": "encode_segment",
         "src": store_keys.file_ref(segment.src.file_path),
         "window": [segment.start_time, segment.duration],
         "scale": [target_w, target_h, "bicubic"],
+        # the resize-method identity: the decoded-then-rescaled pixels
+        # feeding the encoder depend on it (plan-purity)
+        "resize": resize_ops.plan_resize_method(),
         "fps": out_fps,
         "pix_fmt": segment.target_pix_fmt,
         "encoder": encoder,

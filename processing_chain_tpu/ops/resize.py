@@ -426,6 +426,23 @@ def _apply_axis(x: jnp.ndarray, idx: jnp.ndarray, w: jnp.ndarray, axis: int) -> 
     return out
 
 
+def plan_resize_method() -> str:
+    """The resize-method identity plan payloads record (plan-purity
+    rule, store/plan_schema.py). "gather" is bit-exact against the
+    swscale reference; "banded"/"fused" differ from it by up to one code
+    value per pixel — so the method IS a byte-affecting input and must
+    split cache keys. Returns the PC_RESIZE_METHOD override when set,
+    else "auto:<backend>": the auto default resolves per backend (TPU →
+    fused/banded, elsewhere gather), so artifacts built on different
+    backends must not share a plan hash either."""
+    env = os.environ.get("PC_RESIZE_METHOD")
+    if env:
+        return env.strip().lower()
+    import jax
+
+    return "auto:" + jax.default_backend()
+
+
 def resize_plane(
     x: jnp.ndarray,
     dst_h: int,

@@ -19,8 +19,12 @@ caller's warm path (the store serves it); a failed or evicted plan
 re-arms the same record.
 
 States: queued → running → done | failed (failed/evicted re-arm to
-queued on the next enqueue). The record keeps every request ID it
-answers, `attempts`, and timing for forensics.
+queued on the next enqueue). The machine is DECLARED below (STATES /
+INITIAL / TRANSITIONS) and that declaration is load-bearing: chainlint's
+`queue-transition` rule rejects any state write that is not an annotated
+declared edge, `tools queue-crashcheck` fault-injects every atomic-write
+boundary against it, and docs/SERVE.md renders it. The record keeps
+every request ID it answers, `attempts`, and timing for forensics.
 """
 
 from __future__ import annotations
@@ -39,6 +43,32 @@ from ..utils.log import get_logger
 _QUEUE_DEPTH = tm.gauge(
     "chain_serve_queue_depth", "jobs waiting in the serve queue"
 )
+
+# --------------------------------------------------------------------------
+# The record state machine, declared ONCE. Three consumers share this
+# table (docs/SERVE.md "State machine"): chainlint's `queue-transition`
+# rule verifies every `.state` write in serve code is an annotated,
+# declared edge; `tools queue-crashcheck` fault-injects every
+# atomic-write boundary and asserts recovery lands every record in a
+# declared state; docs/SERVE.md renders it between the
+# queue-transitions markers (`tools queue-crashcheck --render-table`).
+# Keep every entry a literal — the linter parses this by AST.
+
+#: every state a durable record can be in
+STATES = ("queued", "running", "done", "failed")
+
+#: the only state a record may be created in
+INITIAL = "queued"
+
+#: declared edges: (from, to)
+TRANSITIONS = frozenset({
+    ("queued", "running"),   # claim: sentinel down, execution owned
+    ("running", "done"),     # complete: store commit landed / warm hit
+    ("running", "failed"),   # fail: attempts budget exhausted
+    ("running", "queued"),   # fail(requeue) / claim revert / recovery
+    ("failed", "queued"),    # re-arm: a fresh request retries the plan
+    ("done", "queued"),      # re-arm: the store evicted the artifact
+})
 
 #: states a new request can attach to (the singleflight window)
 _ATTACHABLE = ("queued", "running")
@@ -188,7 +218,9 @@ class DurableQueue:
                     # done/failed never landed either — same verdict
                     requeue = True
                 if requeue:
-                    record.state = "queued"
+                    if record.state != "queued":
+                        # queue-transition: running -> queued (crash recovery: an interrupted execution re-arms)
+                        record.state = "queued"
                     record.attempts += 1
                     record.error = None
                     self._persist(record)
@@ -252,6 +284,7 @@ class DurableQueue:
                 # failed: re-arm the same record for a fresh attempt —
                 # with a fresh attempt BUDGET (a plan that exhausted its
                 # retries last week must not inherit the spent counter)
+                # queue-transition: failed -> queued (a fresh request retries the plan)
                 record.state = "queued"
                 record.error = None
                 record.warm = False
@@ -290,6 +323,7 @@ class DurableQueue:
             record = self._jobs.get(job_id)
             if record is None or record.state in _ATTACHABLE:
                 return record
+            # queue-transition: done|failed -> queued (re-arm: store evicted / retry requested)
             record.state = "queued"
             record.error = None
             record.warm = False
@@ -321,6 +355,7 @@ class DurableQueue:
                 if record is None:
                     continue
                 try:
+                    # queue-transition: queued -> running (claim: this worker owns the execution)
                     record.state = "running"
                     self._running[job_id] = record
                     # chainlint: disable=atomic-write (sentinel: only its EXISTENCE signals an unfinished execution — same contract as the engine's .inprogress)
@@ -328,6 +363,7 @@ class DurableQueue:
                         pass
                     self._persist(record)
                 except OSError:
+                    # queue-transition: running -> queued (claim revert: the disk refused the sentinel/rewrite)
                     record.state = "queued"
                     self._running.pop(job_id, None)
                     self._queued[job_id] = record
@@ -351,6 +387,7 @@ class DurableQueue:
                 return None
             self._running.pop(job_id, None)
             self._queued.pop(job_id, None)
+            # queue-transition: running -> done (execution or warm hit settled)
             record.state = "done"
             record.warm = warm
             record.error = None
@@ -369,10 +406,12 @@ class DurableQueue:
             self._running.pop(job_id, None)
             record.error = str(error)[:500]
             if requeue:
+                # queue-transition: running -> queued (retry: attempts budget not exhausted)
                 record.state = "queued"
                 record.attempts += 1
                 self._queued[job_id] = record
             else:
+                # queue-transition: running -> failed (attempts budget exhausted)
                 record.state = "failed"
                 record.done_at = time.time()
             self._persist(record)

@@ -311,7 +311,12 @@ class ChainServeService:
             key = "enqueued" if outcome == "new" else "attached"
             _UNITS.labels(outcome=key).inc()
             outcomes[key] += 1
-        doc["warm"] = outcomes["warm"] == len(plans)
+        # under the lock: `doc` is shared with worker callbacks the
+        # moment it entered self._requests above, and _persist_request
+        # snapshots it under this same lock — a bare mutation here would
+        # race that snapshot's iteration (snapshot-under-lock audit)
+        with self._lock:
+            doc["warm"] = outcomes["warm"] == len(plans)
         _REQ_TOTAL.labels(state="accepted").inc()
         tm.emit("serve_request", request=req_id,
                 tenant=normalized["tenant"],

@@ -58,7 +58,7 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
         os.environ["PC_AVPVS_CODEC"] = avpvs_codec
     shard = local_shard(test_config.pvses)
     eligible = []
-    for pvs_id, pvs in shard:
+    for _pvs_id, pvs in shard:
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue

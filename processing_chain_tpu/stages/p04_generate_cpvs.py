@@ -35,7 +35,7 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
         parallelism=pvs_par, name="p04",
     )
     n_items = 0
-    for pvs_id, pvs in local_shard(test_config.pvses):
+    for _pvs_id, pvs in local_shard(test_config.pvses):
         if cli_args.skip_online_services and pvs.is_online():
             log.warning("Skipping PVS %s because it is an online service", pvs)
             continue

@@ -1,0 +1,165 @@
+"""The cache-key INPUT schema: every hidden input, declared once.
+
+Plan-hash identity is the load-bearing wall of the system: the
+content-addressed store (docs/STORE.md) serves bytes by plan hash and
+chain-serve dedupes across tenants by it, so an input that influences
+artifact bytes but escapes the plan is a silent cache-poisoning bug —
+the same plan hash would name two different byte streams, and whichever
+got committed first is served to every overlapping request.
+
+This module is the single source of truth for which *environment*
+inputs exist and how each one is accounted for. chainlint's
+``plan-purity`` rule (tools/chainlint/planpurity.py) traces every
+``os.environ`` / ``os.getenv`` / env-wrapper read through the call
+graph and fails when a read that can reach artifact bytes is not
+declared here; the ``PC_PLAN_DEBUG`` runtime recorder
+(utils/plandebug.py) verifies the ``exempt`` claims dynamically by
+failing the suite when one plan hash ever commits two different byte
+streams.
+
+Entry statuses:
+
+  * ``plan``    — the input changes artifact bytes; its (effective) value
+    must be folded into the plan payload. The checker verifies the read
+    also reaches a plan-constructing function, so the declaration can't
+    go stale: deleting the plan field re-opens the finding.
+  * ``covered`` — byte-affecting, but folded into plans through a
+    DERIVED value the static pass cannot link to the env read (name it
+    in ``via``). The runtime recorder still guards the claim: if the
+    derivation ever stops covering the input, same-plan/different-bytes
+    fires.
+  * ``exempt``  — the input provably never alters encoded bytes (thread
+    counts, prefetch depths, chunk granularity). Every read site must
+    carry a ``# plan-exempt: (reason)`` annotation, and the claim stays
+    under the runtime recorder's same-plan/different-bytes gate.
+
+Adding an env knob that can touch an output path = add the read site,
+declare it here, and either fold it into the plan or annotate the read
+``# plan-exempt`` — chainlint fails until all agree (the same
+three-surface contract as telemetry/catalog.py).
+
+The registry is consumed by AST (never imported) so the linter works on
+any tree; keep every entry a literal.
+"""
+
+from __future__ import annotations
+
+#: env input -> {"status": "plan"|"exempt", "reason": …[, "plan_key": …]}
+ENV_INPUTS: dict[str, dict] = {
+    # ---------------------------------------------------- byte-affecting
+    "PC_AVPVS_CODEC": {
+        "status": "plan",
+        "plan_key": "codec",
+        "reason": "selects the AVPVS intermediate codec (ffv1 vs "
+                  "rawvideo): different container bytes by definition; "
+                  "models/avpvs records the EFFECTIVE codec in every "
+                  "avpvs plan",
+    },
+    "PC_FFV1_SLICES": {
+        "status": "plan",
+        "plan_key": "ffv1_slices",
+        "reason": "slices change FFV1 bitstream structure, hence bytes; "
+                  "the effective slice count is recorded in the avpvs "
+                  "plan payloads (ffv1_effective_slices)",
+    },
+    "PC_RESIZE_METHOD": {
+        "status": "plan",
+        "plan_key": "resize",
+        "reason": "banded/fused resize differs from the bit-exact gather "
+                  "path by up to one code value per pixel — different "
+                  "decoded frames, different bytes; plans record the "
+                  "effective method (ops/resize.plan_resize_method)",
+    },
+    "JAX_PLATFORMS": {
+        "status": "covered",
+        "via": "resize",
+        "reason": "backend selection changes the auto resize method "
+                  "(TPU fused/banded vs CPU gather — up to one code "
+                  "value per pixel); plans capture it through "
+                  "ops/resize.plan_resize_method's 'auto:<backend>' "
+                  "identity, derived from jax.default_backend() rather "
+                  "than this env read",
+    },
+    # ------------------------------------------------ never alters bytes
+    "PC_FFV1_THREADS": {
+        "status": "exempt",
+        "reason": "slice-threading width parallelizes the encode of the "
+                  "slice layout the plan already records (ffv1_slices "
+                  "captures its effect on the default slice count); the "
+                  "thread count itself does not alter encoded bytes",
+    },
+    "PC_FFV1_WORKERS": {
+        "status": "exempt",
+        "reason": "frame-parallel worker count schedules whole-frame "
+                  "encodes across private contexts; the slices=0 regime "
+                  "it selects is captured by the recorded ffv1_slices, "
+                  "and worker count itself does not alter encoded bytes",
+    },
+    "PC_CHUNK_FRAMES": {
+        "status": "exempt",
+        "reason": "frames-per-device-batch granularity; the emitted "
+                  "frame stream is identical at any chunking (pinned by "
+                  "the batch-vs-single parity tests)",
+    },
+    "PC_DECODE_WORKERS": {
+        "status": "exempt",
+        "reason": "segment-decode prefetch width; MultiSegmentPrefetcher "
+                  "preserves segment order, so the decoded stream is "
+                  "identical at any width",
+    },
+    "PC_HOST_BATCH": {
+        "status": "exempt",
+        "reason": "batched host I/O is byte-identical to the per-frame "
+                  "fallback (the host-path-smoke CI parity gate)",
+    },
+    "PC_STORE_DIR": {
+        "status": "exempt",
+        "reason": "names WHERE the store lives, never what any artifact "
+                  "contains",
+    },
+    "PC_RUN_ID": {
+        "status": "exempt",
+        "reason": "multi-process barrier namespace (parallel/distributed "
+                  "rendezvous files); no artifact byte depends on it",
+    },
+    "JAX_NUM_PROCESSES": {
+        "status": "exempt",
+        "reason": "process topology shards WHICH process renders each "
+                  "lane; per-artifact bytes are topology-invariant "
+                  "(distributed dryrun parity)",
+    },
+    "JAX_PROCESS_ID": {
+        "status": "exempt",
+        "reason": "process topology shards WHICH process renders each "
+                  "lane; per-artifact bytes are topology-invariant "
+                  "(distributed dryrun parity)",
+    },
+}
+
+#: module path prefixes whose env reads carry NO plan obligation: they
+#: drive benches, stress harnesses and operator CLIs — their outputs are
+#: not cache-addressed artifacts, so a knob there cannot poison the
+#: store. (Artifact-producing code must not live under these paths.)
+OUT_OF_SCOPE_MODULES = (
+    "bench.py",
+    "tools/",                        # repo-root harness scripts
+    "processing_chain_tpu/tools/",   # operator CLI surfaces
+)
+
+#: call-name tails treated as artifact-byte producers by the checker: a
+#: function that (transitively) issues one of these calls is part of the
+#: byte surface an undeclared env input must not reach.
+BYTE_SINK_CALLS = (
+    "VideoWriter",     # every encoded container write goes through it
+    "run_bucket",      # the p03 device-wave writeback
+    "write_batch",     # native batched encode
+    "concat_video",    # stream-copy assembly of tmp renders
+    "remux",           # container rewrite of an assembled artifact
+)
+
+#: function/method NAMES whose bodies are byte-producing by protocol
+#: even without a recognizable sink call (serve executors write artifact
+#: bytes through opaque helpers).
+BYTE_PRODUCER_DEFS = (
+    "run_batch",       # serve Executor protocol (docs/SERVE.md)
+)

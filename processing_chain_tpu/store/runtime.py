@@ -32,6 +32,7 @@ def configure_from_args(args) -> Optional[ArtifactStore]:
     orchestrators) never inherit a previous run's store by accident."""
     if getattr(args, "no_store", False):
         return configure(None)
+    # plan-exempt: (names WHERE the store lives, never what an artifact contains)
     root = getattr(args, "store", None) or os.environ.get("PC_STORE_DIR") or None
     return configure(root)
 

@@ -42,7 +42,7 @@ from .. import telemetry as tm
 from ..utils.fsio import atomic_write
 from ..utils.log import get_logger
 from . import keys
-from ..utils import lockdebug
+from ..utils import lockdebug, plandebug
 
 STORE_HITS = tm.counter(
     "chain_store_hits_total", "jobs served from the artifact store", ("runner",)
@@ -441,6 +441,11 @@ class ArtifactStore:
         )
         self._write_manifest(manifest)
         self._record_seen_path(output_path)
+        # plan-purity recorder (PC_PLAN_DEBUG, utils/plandebug): every
+        # commit binds plan hash -> content digest; two different byte
+        # streams under one hash fail the suite's sessionfinish gate
+        plandebug.record(plan_hash, digest["sha256"], producer=producer,
+                         scope=self.root)
         self.update_gauges()
         return manifest
 

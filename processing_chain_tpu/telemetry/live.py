@@ -59,8 +59,26 @@ from .metrics import REGISTRY
 
 _T0 = time.monotonic()
 
-#: Mutable run metadata merged into /status (the CLI sets name/argv).
+#: Mutable run metadata merged into /status. Guarded by _RUN_META_LOCK:
+#: the CLI replaces it via set_run_meta() while a StatusFileWriter tick
+#: or an HTTP /status handler may be snapshotting it from another
+#: thread — an unlocked dict(RUN_META) during the mutation is exactly
+#: the serialize-a-shared-doc race the atomic-write discipline exists
+#: to prevent (docs/LINT.md "atomic-write").
 RUN_META: dict = {}
+_RUN_META_LOCK = threading.Lock()
+
+
+def set_run_meta(**meta) -> None:
+    """Replace the run metadata atomically (the CLI's entry point)."""
+    with _RUN_META_LOCK:
+        RUN_META.clear()
+        RUN_META.update(meta)
+
+
+def _run_meta_snapshot() -> dict:
+    with _RUN_META_LOCK:
+        return dict(RUN_META)
 
 #: Extra /status sections: name -> callable(query: dict) -> dict | None.
 #: A provider that raises or returns None is skipped — /status must
@@ -79,7 +97,7 @@ def build_status(query: Optional[dict] = None) -> dict:
         "pid": os.getpid(),
         "generated_at": round(time.time(), 3),
         "uptime_s": round(time.monotonic() - _T0, 3),
-        "run": dict(RUN_META),
+        "run": _run_meta_snapshot(),
     }
     doc.update(HEARTBEATS.snapshot())
     from . import BYTES_ENCODED, FRAMES_DECODED, FRAMES_ENCODED
@@ -162,7 +180,7 @@ class RouteRegistry:
     def add_prefix(self, prefix: str, handler: Handler,
                    methods: tuple = ("GET",)) -> None:
         with self._lock:
-            for i, (p, entry) in enumerate(self._prefix):
+            for p, entry in self._prefix:
                 if p == prefix:
                     for m in methods:
                         entry[m.upper()] = handler

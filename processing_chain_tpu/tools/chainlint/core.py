@@ -22,6 +22,13 @@ Annotations (consumed by individual checkers, never suppressions):
                                       the lock already
   * ``# chainlint: ownership-transfer (<reason>)`` marks a statement that
     hands a pooled buffer to another owner
+  * ``# plan-exempt: (<reason>)``     marks an environment-input read whose
+    value never alters artifact bytes (plan-purity rule; the input must
+    also be declared ``exempt`` in store/plan_schema.py)
+  * ``# queue-transition: <from>[|<from>…] -> <to>`` declares which edge
+    of the serve queue state machine a ``.state`` assignment implements
+    (queue-transition rule; the edge must exist in serve/queue.py's
+    declared TRANSITIONS table)
 """
 
 from __future__ import annotations
@@ -44,6 +51,8 @@ ALL_RULES = (
     "subprocess-hygiene",
     "atomic-write",
     "telemetry-name",
+    "plan-purity",
+    "queue-transition",
     "bad-disable",
 )
 
@@ -57,6 +66,11 @@ _TRANSFER_RE = re.compile(
 )
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?P<lock>[A-Za-z_][\w.]*)")
 _HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(?P<lock>[A-Za-z_][\w.]*)")
+_PLAN_EXEMPT_RE = re.compile(r"#\s*plan-exempt:(?P<reason>\s*\(.*\))?")
+_QUEUE_EDGE_RE = re.compile(
+    r"#\s*queue-transition:\s*"
+    r"(?P<src>[a-z]+(?:\s*\|\s*[a-z]+)*)\s*->\s*(?P<dst>[a-z]+)"
+)
 
 
 @dataclass
@@ -111,6 +125,10 @@ class ModuleSource:
     holds_lock: dict[int, str] = field(default_factory=dict)
     #: lines carrying a valid ownership-transfer annotation
     transfer_lines: set = field(default_factory=set)
+    #: {line no -> reason} from valid # plan-exempt: (reason) annotations
+    plan_exempt: dict[int, str] = field(default_factory=dict)
+    #: {line no -> (sources tuple, destination)} from # queue-transition:
+    queue_edges: dict[int, tuple] = field(default_factory=dict)
 
     def line_text(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
@@ -202,6 +220,20 @@ def load_module(path: str, root: str) -> Optional[ModuleSource]:
         m = _HOLDS_RE.search(comment)
         if m:
             mod.holds_lock[eff] = m.group("lock")
+        m = _PLAN_EXEMPT_RE.search(comment)
+        if m:
+            reason = (m.group("reason") or "").strip("() \t")
+            if reason:
+                mod.plan_exempt[eff] = reason
+            else:
+                mod.bad_disables.append(
+                    (cline, comment.strip(), "missing (reason)"))
+        m = _QUEUE_EDGE_RE.search(comment)
+        if m:
+            sources = tuple(
+                s.strip() for s in m.group("src").split("|") if s.strip()
+            )
+            mod.queue_edges[eff] = (sources, m.group("dst"))
     return mod
 
 
@@ -224,6 +256,9 @@ class LintConfig:
     rules: Optional[set] = None  # None = all
     catalog_path: str = "processing_chain_tpu/telemetry/catalog.py"
     doc_path: str = "docs/TELEMETRY.md"
+    plan_schema_path: str = "processing_chain_tpu/store/plan_schema.py"
+    queue_module_path: str = "processing_chain_tpu/serve/queue.py"
+    serve_doc_path: str = "docs/SERVE.md"
 
     #: directories whose findings are skipped wholesale (fixtures carry
     #: deliberate violations; vendored/test trees are out of contract)
@@ -282,7 +317,10 @@ def symbol_of(tree: ast.Module, node: ast.AST) -> str:
 
 
 def build_checkers(cfg: LintConfig) -> list[Checker]:
-    from . import atomic, locks, ownership, subproc, telemetry_names
+    from . import (
+        atomic, locks, ownership, planpurity, queue_transitions, subproc,
+        telemetry_names,
+    )
 
     checkers: list[Checker] = [
         locks.LockGuardChecker(),
@@ -293,6 +331,13 @@ def build_checkers(cfg: LintConfig) -> list[Checker]:
         telemetry_names.TelemetryNameChecker(
             catalog_path=os.path.join(cfg.root, cfg.catalog_path),
             doc_path=os.path.join(cfg.root, cfg.doc_path),
+        ),
+        planpurity.PlanPurityChecker(
+            schema_path=os.path.join(cfg.root, cfg.plan_schema_path),
+        ),
+        queue_transitions.QueueTransitionChecker(
+            queue_path=os.path.join(cfg.root, cfg.queue_module_path),
+            doc_path=os.path.join(cfg.root, cfg.serve_doc_path),
         ),
     ]
     if cfg.rules is not None:

@@ -1,0 +1,77 @@
+"""Deliberate plan-purity violations + clean cases (test_chainlint.py).
+
+This directory is excluded from default lint runs (LintConfig
+EXCLUDE_PARTS); tests target this file explicitly with root=REPO so the
+real store/plan_schema.py registry applies. The file is parsed, never
+imported.
+"""
+
+import os
+
+from processing_chain_tpu.io.video import VideoWriter
+
+
+# --------------------------------------------------------------- violations
+
+def hidden_knob():
+    """UNDECLARED env input read by a byte-reaching path: must fire."""
+    return int(os.environ.get("PC_FIXTURE_HIDDEN_KNOB", "0"))
+
+
+def render_hidden(path):
+    return VideoWriter(path, "ffv1", 8, 8, "yuv420p", (30, 1),
+                       threads=hidden_knob())
+
+
+def _env_str(name):
+    """Wrapper whose env key is a parameter: call sites must be traced."""
+    return os.environ.get(name, "")
+
+
+def render_wrapped(path):
+    opts = _env_str("PC_FIXTURE_WRAPPED")
+    return VideoWriter(path, "ffv1", 8, 8, "yuv420p", (30, 1), opts=opts)
+
+
+def exempt_unannotated(path):
+    """Declared exempt (PC_FFV1_WORKERS) but the read site carries no
+    # plan-exempt annotation: must fire."""
+    workers = int(os.environ.get("PC_FFV1_WORKERS", "0") or 0)
+    return VideoWriter(path, "ffv1", 8, 8, "yuv420p", (30, 1),
+                       threads=workers)
+
+
+def plan_declared_but_unreachable(path):
+    """PC_RESIZE_METHOD is declared 'plan' in the registry, but in THIS
+    fixture run no plan construction reads it: the plan-coverage proof
+    fails and the checker must say so."""
+    method = os.environ.get("PC_RESIZE_METHOD", "auto")
+    return VideoWriter(path, "ffv1", 8, 8, method, (30, 1))
+
+
+# -------------------------------------------------------------- clean cases
+
+def codec_knob():
+    """Declared 'plan'; read by both the byte path and the plan below."""
+    return os.environ.get("PC_AVPVS_CODEC", "ffv1")
+
+
+def fixture_plan():
+    return {"op": "fixture", "codec": codec_knob()}
+
+
+def render_covered(path):
+    return VideoWriter(path, codec_knob(), 8, 8, "yuv420p", (30, 1))
+
+
+def exempt_annotated(path):
+    """Declared exempt AND annotated: clean."""
+    # plan-exempt: (fixture: thread counts do not alter encoded bytes)
+    threads = int(os.environ.get("PC_FFV1_THREADS", "1") or 1)
+    return VideoWriter(path, "ffv1", 8, 8, "yuv420p", (30, 1),
+                       threads=threads)
+
+
+def harmless_read():
+    """An env read that never reaches a byte sink: no obligation."""
+    return os.environ.get("PC_FIXTURE_HARMLESS", "")

@@ -1,0 +1,53 @@
+"""Deliberate queue-transition violations + clean cases
+(test_chainlint.py). In scope because it imports JobRecord; parsed,
+never imported."""
+
+from processing_chain_tpu.serve.queue import JobRecord
+
+
+# -------------------------------------------------------------- clean cases
+
+def good_complete(record):
+    # queue-transition: running -> done (fixture: the declared complete edge)
+    record.state = "done"
+
+
+def good_multi_source(record):
+    # queue-transition: done|failed -> queued (fixture: the declared re-arm edges)
+    record.state = "queued"
+
+
+def good_initial():
+    return JobRecord(job_id="j2", plan_hash="p", plan={}, unit={},
+                     tenant="t", priority="normal", output="o",
+                     state="queued")
+
+
+def suppressed_write(record):
+    # chainlint: disable=queue-transition (fixture: proves site suppression works)
+    record.state = "failed"
+
+
+# --------------------------------------------------------------- violations
+
+def undeclared_edge(record):
+    # queue-transition: queued -> done (no such edge in the table)
+    record.state = "done"
+
+
+def unannotated(record):
+    record.state = "failed"
+
+
+def unknown_state(record):
+    record.state = "exploded"
+
+
+def nonliteral(record, s):
+    record.state = s
+
+
+def wrong_initial():
+    return JobRecord(job_id="j1", plan_hash="p", plan={}, unit={},
+                     tenant="t", priority="normal", output="o",
+                     state="running")

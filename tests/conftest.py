@@ -26,6 +26,11 @@ os.environ.pop("PC_STORE_DIR", None)
 # zero-overhead). PC_LOCK_DEBUG=0 in the environment wins for timing
 # runs of the suite.
 os.environ.setdefault("PC_LOCK_DEBUG", "1")
+# runtime plan-purity recorder (utils/plandebug.py): every store commit
+# in the suite records plan hash -> artifact digest; the sessionfinish
+# gate below fails on same-plan/different-bytes — the dynamic proof of
+# the `# plan-exempt` claims chainlint's plan-purity rule accepts.
+os.environ.setdefault("PC_PLAN_DEBUG", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -74,23 +79,36 @@ def pytest_configure(config):
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """End-of-suite lock-order gate: everything the whole run observed
-    under PC_LOCK_DEBUG must form an acyclic acquisition graph. A cycle
-    here is a deadlock two tests never happened to interleave into."""
-    from processing_chain_tpu.utils import lockdebug
+    """End-of-suite runtime-invariant gates. Lock order: everything the
+    whole run observed under PC_LOCK_DEBUG must form an acyclic
+    acquisition graph — a cycle here is a deadlock two tests never
+    happened to interleave into. Plan purity: everything committed to
+    any store under PC_PLAN_DEBUG must be one-plan-one-byte-stream — a
+    conflict here is a hidden input that escaped the plan hash."""
+    from processing_chain_tpu.utils import lockdebug, plandebug
 
-    if not lockdebug.enabled():
-        return
-    try:
-        summary = lockdebug.check()
-    except lockdebug.LockOrderViolation as exc:
-        sys.stderr.write(f"\nconftest: {exc}\n")
-        session.exitstatus = 1
-    else:
-        sys.stderr.write(
-            f"\nconftest: lock-order recorder: {summary['edges']} edges "
-            f"over {summary['nodes']} locks, acyclic\n"
-        )
+    if lockdebug.enabled():
+        try:
+            summary = lockdebug.check()
+        except lockdebug.LockOrderViolation as exc:
+            sys.stderr.write(f"\nconftest: {exc}\n")
+            session.exitstatus = 1
+        else:
+            sys.stderr.write(
+                f"\nconftest: lock-order recorder: {summary['edges']} edges "
+                f"over {summary['nodes']} locks, acyclic\n"
+            )
+    if plandebug.enabled():
+        try:
+            summary = plandebug.check()
+        except plandebug.PlanPurityViolation as exc:
+            sys.stderr.write(f"\nconftest: {exc}\n")
+            session.exitstatus = 1
+        else:
+            sys.stderr.write(
+                f"conftest: plan-purity recorder: {summary['plans']} "
+                "plan(s) committed, no same-plan/different-bytes\n"
+            )
 
 
 @pytest.fixture(scope="session")

@@ -455,6 +455,281 @@ class TestLockdebug:
         assert "edges" in doc
 
 
+# -------------------------------------------------------------- plan-purity
+
+
+QUEUE_PATH = os.path.join(REPO, "processing_chain_tpu", "serve", "queue.py")
+SCHEMA_PATH = os.path.join(
+    REPO, "processing_chain_tpu", "store", "plan_schema.py")
+SERVE_DOC = os.path.join(REPO, "docs", "SERVE.md")
+
+
+class TestPlanPurity:
+    def test_fixture_matrix(self):
+        findings = by_rule(lint_fixture("planpurity_cases.py"), "plan-purity")
+        symbols = {f.symbol for f in findings}
+        assert "hidden_knob" in symbols          # undeclared input fires
+        assert "render_wrapped" in symbols       # wrapper param propagation
+        assert "exempt_unannotated" in symbols   # exempt needs annotation
+        assert "plan_declared_but_unreachable" in symbols
+        for clean in ("codec_knob", "render_covered", "exempt_annotated",
+                      "harmless_read", "fixture_plan"):
+            assert clean not in symbols, f"{clean} must be clean"
+        assert len(findings) == 4
+        messages = " | ".join(f.message for f in findings)
+        assert "PC_FIXTURE_HIDDEN_KNOB" in messages
+        assert "PC_FIXTURE_WRAPPED" in messages
+        assert "PC_FIXTURE_HARMLESS" not in messages
+
+    def test_seeded_ffv1_slices_violation_pre_fix(self, tmp_path):
+        """The PR's seeded true positive, reproduced as source: the
+        PRE-fix avpvs shape — PC_FFV1_SLICES feeding the FFV1 writer
+        while the plan records only the codec — must fire; adding the
+        ffv1_slices plan field (the shipped fix) must clear it."""
+        pre_fix = """
+            import os
+
+            from processing_chain_tpu.io.video import VideoWriter
+
+            def ffv1_slices():
+                return int(os.environ.get("PC_FFV1_SLICES", "4"))
+
+            def wo_buffer_plan():
+                return {"op": "avpvs_wo_buffer", "codec": "ffv1"}
+
+            def writer(path):
+                return VideoWriter(path, "ffv1", 8, 8, "yuv420p", (60, 1),
+                                   opts="slices=%d" % ffv1_slices())
+            """
+        findings = lint_source(
+            tmp_path, pre_fix, rules=["plan-purity"],
+            plan_schema_path=SCHEMA_PATH,
+        )
+        assert len(findings) == 1
+        assert "PC_FFV1_SLICES" in findings[0].message
+        assert "no plan construction reads it" in findings[0].message
+
+        post_fix = pre_fix.replace(
+            '"codec": "ffv1"}',
+            '"codec": "ffv1", "ffv1_slices": ffv1_slices()}',
+        )
+        assert lint_source(
+            tmp_path, post_fix, rules=["plan-purity"],
+            plan_schema_path=SCHEMA_PATH,
+        ) == []
+
+    def test_missing_registry_still_flags_undeclared(self, tmp_path):
+        """On a tree with no plan_schema.py at all, a hidden input that
+        reaches bytes is still a finding (self-tests rely on this)."""
+        findings = lint_source(tmp_path, """
+            import os
+
+            def knob():
+                return os.environ.get("PC_SECRET", "")
+
+            def render(path, VideoWriter):
+                return VideoWriter(path, knob())
+            """, rules=["plan-purity"])
+        assert len(findings) == 1
+        assert "PC_SECRET" in findings[0].message
+
+    def test_mutually_recursive_chain_still_tainted(self, tmp_path):
+        """Review-verified regression: a read inside a call CYCLE must
+        still taint the sink — memoized DFS with a cycle cut used to
+        record truncated answers for every node on the cycle and return
+        zero findings (the fixpoint pass fixes this)."""
+        findings = lint_source(tmp_path, """
+            import os
+
+            def helper(n):
+                if n > 0:
+                    return a_plan(n - 1)
+                return ""
+
+            def a_plan(n):
+                knob = os.environ.get("PC_CYCLE_SECRET", "")
+                return helper(n) + knob
+
+            def render(path, VideoWriter):
+                return VideoWriter(path, helper(3))
+            """, rules=["plan-purity"])
+        assert len(findings) >= 1
+        assert "PC_CYCLE_SECRET" in findings[0].message
+
+    def test_wrapper_call_site_disable_suppresses(self, tmp_path):
+        """Review-verified regression: the documented site-disable
+        grammar must also cover reads PROPAGATED from env-wrapper call
+        sites (the dominant pattern in models/avpvs), not just direct
+        reads."""
+        findings = lint_source(tmp_path, """
+            import os
+
+            def env(name):
+                return os.environ.get(name, "")
+
+            def render(path, VideoWriter):
+                # chainlint: disable=plan-purity (fixture: justified wrapper-site suppression)
+                return VideoWriter(path, env("PC_WRAPPED_SECRET"))
+            """, rules=["plan-purity"])
+        assert findings == []
+
+    def test_site_disable_suppresses(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            import os
+
+            def knob():
+                # chainlint: disable=plan-purity (fixture: justified site suppression)
+                return os.environ.get("PC_SECRET", "")
+
+            def render(path, VideoWriter):
+                return VideoWriter(path, knob())
+            """, rules=["plan-purity"])
+        assert findings == []
+
+    def test_reasonless_plan_exempt_is_bad_disable(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            import os
+
+            def knob():
+                # plan-exempt:
+                return os.environ.get("PC_SECRET", "")
+            """)
+        assert by_rule(findings, "bad-disable")
+
+    def test_registry_stale_declaration_flagged(self):
+        """Full-tree runs must flag a declared input nobody reads: run
+        over the real tree with one extra registry entry injected."""
+        from processing_chain_tpu.tools.chainlint import planpurity
+
+        checker = planpurity.PlanPurityChecker(schema_path=SCHEMA_PATH)
+        checker.env_inputs["PC_NO_SUCH_KNOB"] = {
+            "status": "exempt", "reason": "stale"}
+        from processing_chain_tpu.tools.chainlint.core import load_module
+        cfg = LintConfig(root=REPO)
+        for path in cfg.iter_files():
+            mod = load_module(path, REPO)
+            if mod is not None:
+                checker.visit_module(mod)
+        findings = checker.finalize()
+        assert any("PC_NO_SUCH_KNOB" in f.message and
+                   f.symbol == "schema-stale" for f in findings)
+        assert not any("PC_NO_SUCH_KNOB" not in f.message for f in findings), \
+            [f.render() for f in findings if "PC_NO_SUCH_KNOB" not in f.message]
+
+
+# ---------------------------------------------------------- queue-transition
+
+
+class TestQueueTransition:
+    def test_fixture_matrix(self):
+        findings = by_rule(
+            lint_fixture("queue_transition_cases.py"), "queue-transition")
+        symbols = {f.symbol for f in findings}
+        assert "undeclared_edge" in symbols
+        assert "unannotated" in symbols
+        assert "unknown_state" in symbols
+        assert "nonliteral" in symbols
+        assert "wrong_initial" in symbols
+        for clean in ("good_complete", "good_multi_source", "good_initial",
+                      "suppressed_write"):
+            assert clean not in symbols, f"{clean} must be clean"
+        assert len(findings) == 5
+
+    def test_out_of_scope_module_ignored(self, tmp_path):
+        """A module that never touches queue records may use `.state`
+        attributes freely (request docs, heartbeat states, …)."""
+        findings = lint_source(tmp_path, """
+            def flip(thing):
+                thing.state = "anything-at-all"
+            """, rules=["queue-transition"],
+            queue_module_path=QUEUE_PATH, serve_doc_path=SERVE_DOC)
+        assert findings == []
+
+    def test_annotation_dst_mismatch(self, tmp_path):
+        findings = lint_source(tmp_path, """
+            from processing_chain_tpu.serve.queue import JobRecord
+
+            def bad(record):
+                # queue-transition: running -> done (mismatched)
+                record.state = "failed"
+            """, rules=["queue-transition"],
+            queue_module_path=QUEUE_PATH, serve_doc_path=SERVE_DOC)
+        assert len(findings) == 1
+        assert "says '-> done'" in findings[0].message
+
+    def test_shipped_queue_implements_every_declared_edge(self):
+        """Against the real serve tree: zero findings AND full edge
+        coverage — a declared edge nothing implements is itself a
+        finding (stale-table hygiene), so this passing means the
+        declaration and the code agree exactly."""
+        cfg = LintConfig(
+            root=REPO,
+            targets=[os.path.join(REPO, "processing_chain_tpu", "serve")],
+        )
+        findings = by_rule(run_lint(cfg), "queue-transition")
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------- baseline interplay (new rules)
+
+
+class TestNewRuleBaselineInterplay:
+    """The add/suppress/expire/fingerprint matrix for both new rule
+    families — the baseline machinery must treat them exactly like the
+    PR 6 rules."""
+
+    _PURITY_SRC = textwrap.dedent("""\
+        import os
+
+
+        def knob():
+            return os.environ.get("PC_SECRET", "")
+
+
+        def render(path, VideoWriter):
+            return VideoWriter(path, knob())
+        """)
+
+    _QUEUE_SRC = textwrap.dedent("""\
+        from processing_chain_tpu.serve.queue import JobRecord
+
+
+        def bad(record):
+            record.state = "failed"
+        """)
+
+    def _purity_finding(self, tmp_path, pad=""):
+        return lint_source(tmp_path, pad + self._PURITY_SRC,
+                           rules=["plan-purity"])
+
+    def _queue_finding(self, tmp_path, pad=""):
+        return lint_source(
+            tmp_path, pad + self._QUEUE_SRC, rules=["queue-transition"],
+            queue_module_path=QUEUE_PATH, serve_doc_path=SERVE_DOC)
+
+    @pytest.mark.parametrize("maker", ["_purity_finding", "_queue_finding"])
+    def test_add_suppress_expire(self, tmp_path, maker):
+        findings = getattr(self, maker)(tmp_path)
+        assert len(findings) == 1
+        path = str(tmp_path / "BL.json")
+        assert bl.write_baseline(path, findings, [], reason="transition") == 1
+        entries = bl.load_baseline(path)
+        result = bl.apply_baseline(findings, entries)
+        assert result.new == [] and len(result.baselined) == 1
+        # fixed source -> stale entry -> expire
+        result = bl.apply_baseline([], entries)
+        assert len(result.stale) == 1
+        assert bl.write_baseline(path, [], [], reason="-") == 0
+
+    @pytest.mark.parametrize("maker", ["_purity_finding", "_queue_finding"])
+    def test_fingerprint_survives_line_shifts(self, tmp_path, maker):
+        f1 = getattr(self, maker)(tmp_path)[0]
+        shifted = getattr(self, maker)(
+            tmp_path, pad="# shifting comment\n# another\n\n")
+        assert shifted[0].fingerprint() == f1.fingerprint()
+        assert shifted[0].line != f1.line
+
+
 # ---------------------------------------------------------------- self-run
 
 

@@ -241,7 +241,7 @@ def test_framesize_h264_random_gop_bframes(tmp_path):
     exactly one size per frame and track container packet sizes for every
     reordering pattern, not just the fixed-case goldens."""
     rng = np.random.default_rng(42)
-    for i in range(4):
+    for _ in range(4):
         gop = int(rng.integers(1, 13))
         bframes = int(rng.integers(0, 4))
         path = str(tmp_path / f"g{gop}b{bframes}.mp4")
@@ -468,7 +468,7 @@ def test_batch_decode_packed_uyvy_matches_per_frame(tmp_path):
     h, w, n = 32, 64, 11
     path = str(tmp_path / "packed.avi")
     with VideoWriter(path, "rawvideo", w, h, "uyvy422", (24, 1)) as wr:
-        for i in range(n):
+        for _ in range(n):
             wr.write(np.asarray(pxf.pack_uyvy422(
                 rng.integers(16, 235, (h, w), np.uint8),
                 rng.integers(16, 240, (h, w // 2), np.uint8),

@@ -919,7 +919,7 @@ def test_p03_ffv1_frame_parallel_and_rawvideo_intermediate(tmp_path, monkeypatch
     assert medialib.probe(av)["streams"][0]["codec_name"] == "rawvideo"
 
     monkeypatch.setenv("PC_AVPVS_CODEC", "bogus")
-    with pytest.raises(Exception):
+    with pytest.raises(ValueError, match="PC_AVPVS_CODEC"):
         render()
 
 
@@ -1300,7 +1300,7 @@ def test_trace_dir_captures_device_profile(tmp_path):
                    "--trace", trace_dir])
     assert rc == 0
     found = []
-    for root, _dirs, files in os.walk(trace_dir):
+    for _root, _dirs, files in os.walk(trace_dir):
         found.extend(files)
     assert found, f"no profiler artifacts under {trace_dir}"
 

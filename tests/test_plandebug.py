@@ -1,0 +1,123 @@
+"""PC_PLAN_DEBUG runtime plan-purity recorder (utils/plandebug.py):
+the dynamic half of chainlint's plan-purity rule. Covers the unit
+surface (record/check/reset/dump), the same-plan/different-bytes
+failure mode — both directly and through real store commits — and the
+env-diff forensics a violation must carry."""
+
+import json
+import os
+
+import pytest
+
+from processing_chain_tpu.store.store import ArtifactStore
+from processing_chain_tpu.utils import plandebug
+
+PLAN_A = "a" * 64
+PLAN_B = "b" * 64
+
+
+@pytest.fixture(autouse=True)
+def _recorder(monkeypatch):
+    """Isolate every test's recordings AND never leak a deliberate
+    violation into (or wipe real recordings out of) the suite-wide
+    pytest_sessionfinish gate: run against a clean recorder, then
+    restore whatever the rest of the suite had recorded so far."""
+    monkeypatch.setenv("PC_PLAN_DEBUG", "1")
+    saved = plandebug.snapshot_state()
+    plandebug.reset()
+    yield
+    plandebug.restore_state(saved)
+
+
+def test_disabled_records_nothing(monkeypatch):
+    monkeypatch.setenv("PC_PLAN_DEBUG", "0")
+    plandebug.record(PLAN_A, "d1")
+    plandebug.record(PLAN_A, "d2")
+    monkeypatch.setenv("PC_PLAN_DEBUG", "1")
+    assert plandebug.check() == {"plans": 0, "violations": 0}
+
+
+def test_same_plan_same_bytes_is_clean():
+    plandebug.record(PLAN_A, "digest-1", producer="job-a")
+    plandebug.record(PLAN_A, "digest-1", producer="job-a-rebuild")
+    plandebug.record(PLAN_B, "digest-2")
+    assert plandebug.check() == {"plans": 2, "violations": 0}
+
+
+def test_same_plan_different_bytes_fails_with_env_diff(monkeypatch):
+    monkeypatch.setenv("PC_FIXTURE_SLICES", "4")
+    plandebug.record(PLAN_A, "digest-1", producer="first")
+    monkeypatch.setenv("PC_FIXTURE_SLICES", "16")
+    plandebug.record(PLAN_A, "digest-2", producer="second")
+    with pytest.raises(plandebug.PlanPurityViolation) as exc:
+        plandebug.check()
+    msg = str(exc.value)
+    assert PLAN_A[:16] in msg
+    assert "PC_FIXTURE_SLICES" in msg  # the hidden input is NAMED
+    assert "first" in msg and "second" in msg
+
+
+def test_no_env_diff_is_reported_honestly():
+    plandebug.record(PLAN_A, "digest-1")
+    plandebug.record(PLAN_A, "digest-2")
+    with pytest.raises(plandebug.PlanPurityViolation,
+                       match="no PC_\\*/JAX_\\* env key differed"):
+        plandebug.check()
+
+
+def test_reset_clears_violations():
+    plandebug.record(PLAN_A, "d1")
+    plandebug.record(PLAN_A, "d2")
+    plandebug.reset()
+    assert plandebug.check() == {"plans": 0, "violations": 0}
+
+
+def test_dump_persists_plans_and_violations(tmp_path):
+    plandebug.record(PLAN_A, "d1", producer="p1")
+    plandebug.record(PLAN_A, "d2", producer="p2")
+    out = str(tmp_path / "plandebug.json")
+    plandebug.dump(out)
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["plans"][PLAN_A]["sha256"] == "d1"
+    assert len(doc["violations"]) == 1
+    assert doc["violations"][0]["plan"] == PLAN_A
+    plandebug.reset()
+
+
+def _commit(store, plan_hash, path, data: bytes, producer=""):
+    with open(path, "wb") as f:
+        f.write(data)
+    store.commit(plan_hash, str(path), producer=producer)
+
+
+def test_store_commits_feed_the_recorder(tmp_path):
+    """The integration point: two real store commits of the same plan
+    hash with different bytes must trip check() — the exact
+    cache-poisoning scenario the recorder exists to catch (a hidden
+    input changed the artifact without changing the key)."""
+    store = ArtifactStore(str(tmp_path / "store"))
+    _commit(store, PLAN_A, tmp_path / "a1.bin", b"bytes-one", "cold")
+    _commit(store, PLAN_B, tmp_path / "b.bin", b"other", "cold")
+    assert plandebug.check()["plans"] == 2
+
+    # deterministic rebuild: same plan, same bytes — still clean
+    _commit(store, PLAN_A, tmp_path / "a2.bin", b"bytes-one", "rebuild")
+    assert plandebug.check()["plans"] == 2
+
+    # the poisoning case
+    _commit(store, PLAN_A, tmp_path / "a3.bin", b"bytes-DIFFER", "poisoned")
+    with pytest.raises(plandebug.PlanPurityViolation):
+        plandebug.check()
+    plandebug.reset()
+
+
+def test_zero_overhead_contract_when_disabled(monkeypatch):
+    """With the knob off, record() must not even snapshot the env —
+    the lockdebug-style production guarantee."""
+    monkeypatch.setenv("PC_PLAN_DEBUG", "")
+    calls = []
+    monkeypatch.setattr(plandebug, "_env_snapshot",
+                        lambda: calls.append(1) or {})
+    plandebug.record(PLAN_A, "d1")
+    assert calls == []

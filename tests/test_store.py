@@ -495,10 +495,18 @@ def test_dry_run_counts_hits_without_touching_anything(tmp_path):
     assert not os.path.exists(os.path.join(out_dir, "b.txt"))  # not materialized
 
 
-def test_rebuild_never_mutates_committed_bytes_through_hardlinks(tmp_path):
+def test_rebuild_never_mutates_committed_bytes_through_hardlinks(
+    tmp_path, monkeypatch
+):
     """Materialized outputs are hardlinks into objects/. A forced rebuild
     truncate-opens the output path; mark_inprogress must break the link
-    first so the store's bytes survive the rewrite."""
+    first so the store's bytes survive the rewrite.
+
+    The vandal below deliberately commits DIFFERENT bytes under an
+    unchanged plan — exactly the condition the PC_PLAN_DEBUG recorder
+    (utils/plandebug) exists to fail the suite on — so this test opts
+    out of the recorder for its duration."""
+    monkeypatch.setenv("PC_PLAN_DEBUG", "0")
     store = store_runtime.configure(str(tmp_path / "store"))
     out_dir = str(tmp_path / "db")
     os.makedirs(out_dir)
