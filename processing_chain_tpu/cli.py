@@ -250,6 +250,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
         "src-analysis", "complexity", "plots", "metrics", "clean-logs",
         "run-report", "store", "chain-top", "chain-profile", "bench-compare",
         "chain-lint", "chain-serve", "serve-soak", "queue-crashcheck",
+        "serve-chaos",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -293,6 +294,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import queue_crashcheck
 
             return queue_crashcheck.main(rest)
+        if name == "serve-chaos":
+            from .tools import serve_chaos
+
+            return serve_chaos.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

@@ -33,7 +33,8 @@ from typing import Optional, Protocol
 
 from .. import telemetry as tm
 from ..store import keys
-from ..utils.fsio import atomic_write
+from ..utils.fsio import atomic_write, atomic_write_text
+from ..utils.runner import ChainError
 from .api import Unit
 
 _WAVES = tm.counter(
@@ -98,6 +99,13 @@ class SyntheticExecutor:
         size_bytes  artifact size (default 4096)
         work_ms     simulated compute per unit (default 0)
         geometry    [w, h] — units sharing it batch into one wave
+        fail_times  fault injection: the first N execution attempts of
+                    this unit raise a TRANSIENT ChainError (a durable
+                    counter next to the output tracks attempts across
+                    replica restarts) — the chaos/soak harnesses' disk-
+                    error stand-in, exercising retry + backoff
+        poison      fault injection: every attempt raises a PERMANENT
+                    ChainError — exercises the quarantine path
     """
 
     kind = "synthetic"
@@ -127,7 +135,8 @@ class SyntheticExecutor:
                     "params.geometry must be a list of integers, got "
                     f"{geometry!r}"
                 ) from None
-        for key, cast in (("work_ms", float), ("size_bytes", int)):
+        for key, cast in (("work_ms", float), ("size_bytes", int),
+                          ("fail_times", int)):
             if params.get(key) is not None:
                 try:
                     cast(params[key])
@@ -135,6 +144,10 @@ class SyntheticExecutor:
                     raise ValueError(
                         f"params.{key} must be a number, got {params[key]!r}"
                     ) from None
+        if not isinstance(params.get("poison", False), bool):
+            raise ValueError(
+                f"params.poison must be a boolean, got {params['poison']!r}"
+            )
 
     def bucket_key(self, record_unit: dict) -> Optional[tuple]:
         try:
@@ -147,10 +160,37 @@ class SyntheticExecutor:
             # non-dict, unparseable geometry): unbatchable, never a raise
             return None
 
+    @staticmethod
+    def _inject_failures(params: dict, output: str) -> None:
+        """Scripted fault injection (chaos/soak harnesses only; see the
+        class docstring). Raises BEFORE any bytes are produced, so an
+        injected failure never leaves a half-made artifact behind."""
+        if params.get("poison"):
+            raise ChainError(
+                f"injected permanent failure for {output}",
+                kind="permanent",
+            )
+        fail_times = int(params.get("fail_times", 0) or 0)
+        if fail_times > 0:
+            marker = output + ".injected-failures"
+            try:
+                with open(marker) as f:
+                    injected = int(f.read().strip() or "0")
+            except (OSError, ValueError):
+                injected = 0
+            if injected < fail_times:
+                atomic_write_text(marker, str(injected + 1))
+                raise ChainError(
+                    f"injected transient failure {injected + 1}/"
+                    f"{fail_times} for {output}",
+                    kind="transient",
+                )
+
     def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
         record_waves(len(units))
         for unit, output in zip(units, outputs):
             params = unit.params
+            self._inject_failures(params, output)
             work_ms = float(params.get("work_ms", 0) or 0)
             if work_ms > 0:
                 time.sleep(work_ms / 1000.0)

@@ -1,14 +1,30 @@
-"""Durable, dedup-aware job queue: the serve daemon's crash-proof spine.
+"""Durable, dedup-aware job queue: the serve daemons' crash-proof spine.
 
 One JSON record per job under `jobs/`, every state change an atomic
-rewrite (utils/fsio — the store's tmp+rename idiom), so a reader or a
-restarted daemon never sees a torn record. While a job executes, a
-`<record>.inprogress` sentinel sits next to it (the engine's crash
-discipline, applied to queue records): a daemon SIGKILLed mid-execution
-leaves the sentinel behind, and recovery REQUEUES the job instead of
-stranding it — the artifact-level sentinel inside engine.Job
-independently guarantees the half-written output is rebuilt, not
-trusted.
+durable rewrite (utils/fsio — the store's tmp+rename idiom, plus fsync:
+queue records claim SIGKILL-proofness, so a power-loss crash must not
+promote an unflushed rename). The queue is safe for N concurrent
+replica daemons sharing one root:
+
+  * **Lease-fenced ownership** — while a job executes, a
+    `<record>.inprogress` LEASE (replica id, monotonically-increasing
+    epoch, expiry, pid/host) sits next to it, renewed by the owner's
+    heartbeat thread. A lease whose holder is demonstrably dead (same
+    host, pid gone) or whose expiry passed is reclaimable: any live
+    replica STEALS the record back to `queued` with the epoch bumped
+    (`serve_lease_stolen`). Every settle is epoch-fenced against the
+    on-disk record, so a zombie replica resumed after SIGSTOP cannot
+    settle a record it lost (`serve_settle_fenced`).
+  * **Cross-process atomicity** — every mutation holds an exclusive
+    flock on `<root>/queue.lock` (released automatically by the kernel
+    when a replica dies), so claim/steal/settle/enqueue from different
+    replicas never interleave mid-transition. Reads never need it:
+    records are whole-file atomic replaces.
+  * **Cross-replica visibility** — `poll()` merges peer record changes
+    into the in-memory view (stat-keyed rescans) and runs the steal
+    scan; enqueue-time dedup across replicas is eventual (a peer's
+    record for the same plan attaches after the next poll), and the
+    store's plan-hash commit keeps artifacts exactly-once regardless.
 
 Dedup is identity-by-plan-hash, the store's own key: enqueueing a unit
 whose plan hash already has a queued/running job ATTACHES the new
@@ -16,24 +32,33 @@ request to that record instead of minting a second execution —
 overlapping requests from any number of tenants share one job by
 construction (singleflight). A plan whose job already completed is the
 caller's warm path (the store serves it); a failed or evicted plan
-re-arms the same record.
+re-arms the same record. A QUARANTINED plan (permanent failure —
+docs/SERVE.md "Failure taxonomy") does not: new requests are refused
+until an operator re-arms it.
 
-States: queued → running → done | failed (failed/evicted re-arm to
-queued on the next enqueue). The machine is DECLARED below (STATES /
-INITIAL / TRANSITIONS) and that declaration is load-bearing: chainlint's
-`queue-transition` rule rejects any state write that is not an annotated
-declared edge, `tools queue-crashcheck` fault-injects every atomic-write
-boundary against it, and docs/SERVE.md renders it. The record keeps
-every request ID it answers, `attempts`, and timing for forensics.
+States: queued → running → done | failed | quarantined (failed/evicted
+re-arm to queued on the next enqueue; quarantined only via rearm). The
+machine is DECLARED below (STATES / INITIAL / TRANSITIONS) and that
+declaration is load-bearing: chainlint's `queue-transition` rule
+rejects any state write that is not an annotated declared edge, `tools
+queue-crashcheck` fault-injects every atomic-write boundary against it,
+and docs/SERVE.md renders it. The record keeps every request ID it
+answers, `attempts`, `epoch`, `not_before` (retry backoff) and timing
+for forensics.
 """
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
+import secrets
+import socket
+import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Iterator, Optional
 
 from .. import telemetry as tm
 from ..utils import lockdebug
@@ -42,6 +67,22 @@ from ..utils.log import get_logger
 
 _QUEUE_DEPTH = tm.gauge(
     "chain_serve_queue_depth", "jobs waiting in the serve queue"
+)
+_LEASE_STEALS = tm.counter(
+    "chain_serve_lease_steals_total",
+    "expired/dead leases reclaimed from peer replicas",
+)
+_FENCED_SETTLES = tm.counter(
+    "chain_serve_fenced_settles_total",
+    "settle attempts rejected because the caller's epoch was stale",
+)
+_CLAIM_REVERTS = tm.counter(
+    "chain_serve_claim_reverts_total",
+    "claims reverted to queued by a mid-claim disk failure",
+)
+_QUARANTINED = tm.counter(
+    "chain_serve_quarantined_total",
+    "plans quarantined after a permanent failure",
 )
 
 # --------------------------------------------------------------------------
@@ -55,23 +96,72 @@ _QUEUE_DEPTH = tm.gauge(
 # Keep every entry a literal — the linter parses this by AST.
 
 #: every state a durable record can be in
-STATES = ("queued", "running", "done", "failed")
+STATES = ("queued", "running", "done", "failed", "quarantined")
 
 #: the only state a record may be created in
 INITIAL = "queued"
 
 #: declared edges: (from, to)
 TRANSITIONS = frozenset({
-    ("queued", "running"),   # claim: sentinel down, execution owned
-    ("running", "done"),     # complete: store commit landed / warm hit
-    ("running", "failed"),   # fail: attempts budget exhausted
-    ("running", "queued"),   # fail(requeue) / claim revert / recovery
-    ("failed", "queued"),    # re-arm: a fresh request retries the plan
-    ("done", "queued"),      # re-arm: the store evicted the artifact
+    ("queued", "running"),        # claim: lease down, execution owned
+    ("running", "done"),          # complete: store commit landed / warm hit
+    ("running", "failed"),        # fail: attempts budget exhausted
+    ("running", "queued"),        # retry/steal/revert/recovery re-arm
+    ("running", "quarantined"),   # permanent failure: retrying is futile
+    ("failed", "queued"),         # re-arm: a fresh request retries the plan
+    ("done", "queued"),           # re-arm: the store evicted the artifact
+    ("quarantined", "queued"),    # re-arm: operator cleared the quarantine
 })
 
 #: states a new request can attach to (the singleflight window)
 _ATTACHABLE = ("queued", "running")
+
+#: states with no outstanding work (quarantine included: nothing will
+#: run it until an operator re-arms)
+TERMINAL = ("done", "failed", "quarantined")
+
+_HOST = socket.gethostname()
+
+#: replica ids of every OPEN DurableQueue in this process — the
+#: same-pid liveness oracle. A lease whose pid is ours but whose
+#: replica id is not here belongs to a previous (dead) incarnation:
+#: reclaim it immediately instead of waiting out the expiry, which is
+#: exactly what a single-replica daemon restart needs.
+_REPLICAS_LOCK = lockdebug.make_lock("serve_replicas")
+_LIVE_REPLICAS: set = set()  # guarded-by: _REPLICAS_LOCK
+
+
+def owner_stamp(replica: str) -> dict:
+    """The {replica, pid, host} liveness stamp persisted wherever a
+    replica claims durable ownership outside the queue (request docs):
+    peers probe it with `owner_process_dead` to adopt orphans."""
+    return {"replica": replica, "pid": os.getpid(), "host": _HOST}
+
+
+def owner_process_dead(owner) -> bool:
+    """Best-effort: is the process behind an `owner_stamp` demonstrably
+    dead? Same-host only (a pid probe means nothing across hosts —
+    cross-host orphans are adopted at the next replica restart, which
+    rescans everything). False on any doubt: adopting a LIVE peer's
+    work is the expensive mistake, waiting is merely slow."""
+    if not isinstance(owner, dict):
+        return False
+    if owner.get("host") != _HOST:
+        return False
+    try:
+        pid = int(owner.get("pid", 0) or 0)
+    except (TypeError, ValueError):
+        return False
+    if pid == os.getpid():
+        with _REPLICAS_LOCK:
+            return owner.get("replica") not in _LIVE_REPLICAS
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        pass
+    return False
 
 
 def _id_seq(job_id: str) -> int:
@@ -98,8 +188,13 @@ class JobRecord:
     enqueued_at: float = 0.0
     attempts: int = 0
     error: Optional[str] = None
+    error_kind: Optional[str] = None  # transient | permanent (taxonomy)
     done_at: Optional[float] = None
     warm: bool = False    # completed via store hit, not execution
+    epoch: int = 0        # bumped on every ownership change (claim/steal)
+    owner: Optional[str] = None       # replica id of the current claimant
+    not_before: float = 0.0           # retry backoff: claim-eligibility time
+    settled_epoch: Optional[int] = None  # epoch the terminal write carried
 
     def to_json(self) -> dict:
         return {
@@ -115,8 +210,13 @@ class JobRecord:
             "enqueuedAt": self.enqueued_at,
             "attempts": self.attempts,
             "error": self.error,
+            "errorKind": self.error_kind,
             "doneAt": self.done_at,
             "warm": self.warm,
+            "epoch": self.epoch,
+            "owner": self.owner,
+            "notBefore": self.not_before,
+            "settledEpoch": self.settled_epoch,
         }
 
     @classmethod
@@ -134,32 +234,111 @@ class JobRecord:
             enqueued_at=float(data.get("enqueuedAt", 0.0)),
             attempts=int(data.get("attempts", 0)),
             error=data.get("error"),
+            error_kind=data.get("errorKind"),
             done_at=data.get("doneAt"),
             warm=bool(data.get("warm", False)),
+            epoch=int(data.get("epoch", 0)),
+            owner=data.get("owner"),
+            not_before=float(data.get("notBefore", 0.0)),
+            settled_epoch=data.get("settledEpoch"),
         )
 
 
 class DurableQueue:
-    """Crash-recoverable on-disk job queue with plan-hash dedup.
+    """Crash-recoverable on-disk job queue with plan-hash dedup, safe
+    for N replica processes over one root (module doc).
 
-    Thread-safe: the scheduler's workers and the HTTP submit path hit it
-    concurrently. All disk writes happen UNDER the queue lock — the
-    record files are small and the atomic rewrite is one replace; a
-    torn in-memory/on-disk split would be worse than the contention."""
+    Thread-safe: the scheduler's workers, the heartbeat thread and the
+    HTTP submit path hit it concurrently. All disk MUTATIONS happen
+    under the in-process lock AND the cross-process flock — the record
+    files are small and each rewrite is one replace; a torn
+    in-memory/on-disk split (or a peer interleaving mid-transition)
+    would be worse than the contention."""
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, replica: Optional[str] = None,
+                 lease_s: float = 15.0) -> None:
         self.root = os.path.abspath(root)
         self.jobs_dir = os.path.join(self.root, "jobs")
         os.makedirs(self.jobs_dir, exist_ok=True)
+        self.replica = replica or (
+            f"{_HOST}-{os.getpid()}-{secrets.token_hex(3)}"
+        )
+        self.lease_s = max(0.05, float(lease_s))
         self._lock = lockdebug.make_lock("serve_queue")
+        # chainlint: disable=atomic-write (lock file: only its existence matters — flock state lives in the kernel, never in its bytes)
+        self._lockfd = os.open(
+            os.path.join(self.root, "queue.lock"),
+            os.O_CREAT | os.O_RDWR, 0o644,
+        )
         self._jobs: dict[str, JobRecord] = {}     # guarded-by: _lock
         self._by_plan: dict[str, str] = {}        # guarded-by: _lock
         self._queued: dict[str, JobRecord] = {}   # guarded-by: _lock
         self._running: dict[str, JobRecord] = {}  # guarded-by: _lock
+        #: job id -> epoch THIS replica claimed; the fencing token a
+        #: settle compares against the on-disk record. Kept on lease
+        #: loss (the evidence a zombie's settle is fenced WITH), popped
+        #: only when the settle verdict lands.
+        self._claimed: dict[str, int] = {}        # guarded-by: _lock
+        #: record-file stat signatures for the poll() rescan
+        self._stat: dict[str, tuple] = {}         # guarded-by: _lock
+        self._last_refresh = 0.0                  # guarded-by: _lock
         self._next_id = 1                         # guarded-by: _lock
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
         self.recovery: dict = {"jobs": 0, "requeued": 0, "done": 0,
-                               "failed": 0}
+                               "failed": 0, "quarantined": 0, "peer": 0}
+        with _REPLICAS_LOCK:
+            _LIVE_REPLICAS.add(self.replica)
         self._recover()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def close(self) -> None:
+        """Release this replica's liveness claims: stop the heartbeat,
+        unregister from the in-process liveness set, drop the lock fd.
+        After close() this replica's leases are reclaimable by peers
+        (and by a successor queue in this same process — the restart
+        path tests exercise). Idempotent; mutating calls after close
+        raise OSError."""
+        self.stop_heartbeat()
+        with _REPLICAS_LOCK:
+            _LIVE_REPLICAS.discard(self.replica)
+        fd, self._lockfd = self._lockfd, -1
+        if fd >= 0:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    def start_heartbeat(self, interval_s: Optional[float] = None) -> None:
+        """Renew this replica's leases periodically (lease_s/3 default).
+        Without a heartbeat a long execution outlives its lease and a
+        peer may steal it mid-flight — fine for single-replica tests,
+        wrong for a fleet."""
+        if self._hb_thread is not None:
+            return
+        interval = interval_s if interval_s is not None else \
+            max(0.05, self.lease_s / 3.0)
+        self._hb_stop.clear()
+
+        def _loop() -> None:
+            while not self._hb_stop.wait(timeout=interval):
+                try:
+                    self.renew_leases()
+                except Exception:  # noqa: BLE001 - heartbeat must survive disk hiccups
+                    get_logger().exception(
+                        "serve queue: lease renewal pass failed")
+
+        self._hb_thread = threading.Thread(
+            target=_loop, name="chain-serve-lease-heartbeat", daemon=True,
+        )
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+            self._hb_thread = None
 
     # ----------------------------------------------------------- layout
 
@@ -169,10 +348,183 @@ class DurableQueue:
     def _sentinel_path(self, job_id: str) -> str:
         return self._record_path(job_id) + ".inprogress"
 
+    @contextmanager
+    def _flock(self) -> Iterator[None]:
+        """Cross-process mutual exclusion for record transitions. Only
+        ever taken under self._lock (one fd per process: flock on the
+        same open file description is recursive, so in-process nesting
+        MUST be prevented by the thread lock, not the kernel). The
+        kernel releases it when the holder dies, so a SIGKILLed replica
+        can never wedge the fleet; a SIGSTOPped one stalls peers only
+        for the (sub-millisecond) critical sections, not for the length
+        of its executions — leases cover those."""
+        if self._lockfd < 0:
+            raise OSError("queue is closed")
+        fcntl.flock(self._lockfd, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(self._lockfd, fcntl.LOCK_UN)
+
+    @contextmanager
+    def exclusive(self) -> Iterator[None]:
+        """The queue's cross-process mutual exclusion, lent out for
+        fleet-level decisions that need the same fence — the service's
+        orphan-request adoption claims a dead peer's request doc under
+        it, so two surviving replicas cannot both adopt one orphan.
+        Keep the body to a read-check-write; peers' queue mutations
+        wait behind it."""
+        with self._lock:
+            with self._flock():
+                yield
+
     # holds-lock: _lock
     def _persist(self, record: JobRecord) -> None:
-        atomic_write_json(self._record_path(record.job_id),
-                          record.to_json(), sort_keys=True)
+        path = self._record_path(record.job_id)
+        atomic_write_json(path, record.to_json(), durable=True,
+                          sort_keys=True)
+        try:
+            st = os.stat(path)
+        except OSError:
+            return
+        self._stat[record.job_id] = (st.st_mtime_ns, st.st_size, st.st_ino)
+
+    # holds-lock: _lock
+    def _read_disk(self, job_id: str) -> Optional[JobRecord]:
+        """The on-disk record — the shared truth a settle is fenced
+        against. None when unreadable/missing (the in-memory copy then
+        stands in)."""
+        try:
+            with open(self._record_path(job_id)) as f:
+                return JobRecord.from_json(json.load(f))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    # ------------------------------------------------------------ leases
+
+    # holds-lock: _lock
+    def _write_lease(self, record: JobRecord) -> None:
+        now = time.time()
+        atomic_write_json(self._sentinel_path(record.job_id), {
+            "replica": self.replica,
+            "epoch": record.epoch,
+            "pid": os.getpid(),
+            "host": _HOST,
+            "acquiredAt": now,
+            "expiresAt": now + self.lease_s,
+        })
+
+    # holds-lock: _lock
+    def _read_lease(self, job_id: str) -> Optional[dict]:
+        """The lease next to a record: a dict, {} for a legacy empty
+        sentinel (pre-lease format: ownerless), None when absent."""
+        try:
+            with open(self._sentinel_path(job_id)) as f:
+                text = f.read()
+        except OSError:
+            return None
+        if not text.strip():
+            return {}
+        try:
+            lease = json.loads(text)
+        except ValueError:
+            return {}
+        return lease if isinstance(lease, dict) else {}
+
+    # holds-lock: _lock
+    def _lease_dead(self, lease: Optional[dict], now: float,
+                    job_id: str) -> bool:
+        """True when a lease no longer protects its record. Expiry is
+        the universal trigger (a live-but-stuck holder loses after
+        lease_s without renewal — the SIGSTOP-zombie case); same-host
+        holders that are demonstrably dead (pid gone, or a previous
+        incarnation in this very process) are reclaimed immediately so
+        a daemon restart never waits out its own stale lease."""
+        if not lease:  # absent or legacy empty sentinel: ownerless
+            return True
+        if lease.get("replica") == self.replica:
+            # our NAME — but a stable --replica-id survives restarts,
+            # so the name alone proves nothing: the lease is ours only
+            # if we hold the exact claim it records. A previous
+            # incarnation's lease under our name is dead NOW, not
+            # after expiry.
+            try:
+                lease_epoch = int(lease.get("epoch", -1))
+            except (TypeError, ValueError):
+                return True
+            return self._claimed.get(job_id) != lease_epoch
+        if now >= float(lease.get("expiresAt", 0.0) or 0.0):
+            return True
+        if lease.get("host") == _HOST:
+            try:
+                pid = int(lease.get("pid", 0) or 0)
+            except (TypeError, ValueError):
+                return True
+            if pid == os.getpid():
+                with _REPLICAS_LOCK:
+                    return lease.get("replica") not in _LIVE_REPLICAS
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return True
+            except OSError:
+                pass  # EPERM etc: the pid exists — trust the expiry
+        return False
+
+    def renew_leases(self) -> list[str]:
+        """One heartbeat pass: extend every lease this replica still
+        holds; report (and emit `serve_lease_lost` for) records whose
+        lease moved on — the settle for those will be fenced."""
+        lost: list[tuple] = []
+        with self._lock:
+            with self._flock():
+                for job_id, record in list(self._running.items()):
+                    lease = self._read_lease(job_id)
+                    if (lease and lease.get("replica") == self.replica
+                            and int(lease.get("epoch", -1))
+                            == self._claimed.get(job_id)):
+                        try:
+                            self._write_lease(record)
+                        except OSError:
+                            get_logger().warning(
+                                "serve queue: could not renew lease for %s",
+                                job_id)
+                    else:
+                        # stolen (or vandalized): we no longer own this
+                        # execution; keep _claimed so the settle fences
+                        self._running.pop(job_id, None)
+                        lost.append((job_id, record.plan_hash))
+        for job_id, plan in lost:
+            tm.emit("serve_lease_lost", job=job_id, plan=plan,
+                    replica=self.replica)
+        return [job_id for job_id, _ in lost]
+
+    # ---------------------------------------------------------- indexes
+
+    # holds-lock: _lock
+    def _absorb(self, record: JobRecord) -> None:
+        """Reconcile the in-memory view with one record instance (fresh
+        from disk or just persisted). Ownership bookkeeping: a record
+        stays in _running only while the epoch we claimed still matches
+        — an epoch that moved on means a peer stole it."""
+        job_id = record.job_id
+        self._jobs[job_id] = record
+        if record.state == "queued":
+            self._queued[job_id] = record
+        else:
+            self._queued.pop(job_id, None)
+        if (record.state == "running"
+                and self._claimed.get(job_id) == record.epoch):
+            self._running[job_id] = record
+        else:
+            self._running.pop(job_id, None)
+        cur_id = self._by_plan.get(record.plan_hash)
+        if cur_id is None or cur_id == job_id:
+            self._by_plan[record.plan_hash] = job_id
+        elif (self._jobs[cur_id].state in ("failed", "quarantined")
+                and record.state not in ("failed", "quarantined")):
+            # a live record for the plan beats a dead-ended one
+            self._by_plan[record.plan_hash] = job_id
 
     # holds-lock: _lock
     def _set_depth_gauge(self) -> None:
@@ -181,73 +533,195 @@ class DurableQueue:
     # --------------------------------------------------------- recovery
 
     def _recover(self) -> None:
-        """Rebuild the in-memory view from disk. `.inprogress` sentinels
-        mark executions a dead daemon never finished: requeue them
-        (attempts+1) instead of stranding — the artifact store decides
-        at execution time whether the work actually completed (a commit
-        that landed before the kill is a warm hit, zero re-execution)."""
+        """Rebuild the in-memory view from disk. A `running` record is
+        requeued (attempts+1) only when its lease is reclaimable — the
+        holder is dead or the lease expired; a record legitimately
+        owned by a LIVE peer replica stays running in our view (we are
+        one daemon of a fleet, not the only survivor). The artifact
+        store decides at execution time whether requeued work actually
+        completed (a commit that landed before the kill is a warm hit,
+        zero re-execution)."""
         log = get_logger()
+        events: list[dict] = []
         with self._lock:
-            try:
-                names = sorted(os.listdir(self.jobs_dir))
-            except OSError:
-                names = []
-            max_seq = 0
-            for name in names:
-                if not name.endswith(".json"):
-                    continue
-                path = os.path.join(self.jobs_dir, name)
+            with self._flock():
                 try:
-                    with open(path) as f:
-                        record = JobRecord.from_json(json.load(f))
-                except (OSError, ValueError, KeyError) as exc:
-                    log.warning("serve queue: unreadable record %s (%s); "
-                                "skipping", path, exc)
-                    continue
-                seq = _id_seq(record.job_id)
-                max_seq = max(max_seq, seq)
-                requeue = False
-                if os.path.isfile(self._sentinel_path(record.job_id)):
-                    requeue = True
+                    names = sorted(os.listdir(self.jobs_dir))
+                except OSError:
+                    names = []
+                max_seq = 0
+                now = time.time()
+                for name in names:
+                    if not name.endswith(".json"):
+                        continue
+                    path = os.path.join(self.jobs_dir, name)
                     try:
-                        os.unlink(self._sentinel_path(record.job_id))
-                    except OSError:
-                        pass
-                if record.state == "running":
-                    # state says running but no sentinel: the rewrite to
-                    # done/failed never landed either — same verdict
-                    requeue = True
-                if requeue:
-                    if record.state != "queued":
+                        with open(path) as f:
+                            record = JobRecord.from_json(json.load(f))
+                    except (OSError, ValueError, KeyError) as exc:
+                        log.warning("serve queue: unreadable record %s "
+                                    "(%s); skipping", path, exc)
+                        continue
+                    seq = _id_seq(record.job_id)
+                    max_seq = max(max_seq, seq)
+                    lease = self._read_lease(record.job_id)
+                    requeue = False
+                    if record.state == "running":
+                        # lease dead or missing: the execution died with
+                        # its daemon (a missing lease also covers a
+                        # crash between the record write and the lease
+                        # write). A live peer's lease keeps it running.
+                        if self._lease_dead(lease, now, record.job_id):
+                            requeue = True
+                        else:
+                            self.recovery["peer"] += 1
+                    elif lease is not None:
+                        # stray lease on a settled/queued record: the
+                        # settle's unlink raced a crash — clear it so
+                        # the steal scan never trips on it
+                        self._clear_sentinel(record.job_id)
+                    if requeue:
                         # queue-transition: running -> queued (crash recovery: an interrupted execution re-arms)
                         record.state = "queued"
-                    record.attempts += 1
-                    record.error = None
-                    self._persist(record)
-                    self.recovery["requeued"] += 1
-                    tm.emit("serve_requeued", job=record.job_id,
-                            plan=record.plan_hash,
-                            attempts=record.attempts)
-                self._jobs[record.job_id] = record
-                self.recovery["jobs"] += 1
-                if record.state == "queued":
-                    self._queued[record.job_id] = record
-                elif record.state == "done":
-                    self.recovery["done"] += 1
-                elif record.state == "failed":
-                    self.recovery["failed"] += 1
-                # index preference: a live (queued/running/done) record
-                # wins over a failed one for the same plan
-                prior = self._by_plan.get(record.plan_hash)
-                if prior is None or self._jobs[prior].state == "failed":
-                    self._by_plan[record.plan_hash] = record.job_id
-            self._next_id = max_seq + 1
-            self._set_depth_gauge()
+                        record.epoch += 1  # fence the dead owner's settle
+                        record.owner = None
+                        record.attempts += 1
+                        record.error = None
+                        self._persist(record)
+                        self._clear_sentinel(record.job_id)
+                        self.recovery["requeued"] += 1
+                        events.append(dict(job=record.job_id,
+                                           plan=record.plan_hash,
+                                           attempts=record.attempts))
+                    self.recovery["jobs"] += 1
+                    for state in ("done", "failed", "quarantined"):
+                        if record.state == state:
+                            self.recovery[state] += 1
+                    self._absorb(record)
+                    try:
+                        st = os.stat(path)
+                        self._stat[record.job_id] = (
+                            st.st_mtime_ns, st.st_size, st.st_ino)
+                    except OSError:
+                        pass
+                self._next_id = max_seq + 1
+                self._set_depth_gauge()
+        for fields in events:
+            tm.emit("serve_requeued", **fields)
         if self.recovery["requeued"]:
             log.warning(
                 "serve queue: requeued %d interrupted job(s) after restart",
                 self.recovery["requeued"],
             )
+
+    # ------------------------------------------------------------- poll
+
+    def poll(self) -> dict:
+        """Multi-replica maintenance tick: merge peer record changes
+        into the in-memory view, then reclaim records whose lease died
+        (work stealing). Cheap when nothing changed — one stat per
+        record file. Single-replica daemons may skip it entirely."""
+        with self._lock:
+            changed = self._refresh_locked()
+        stolen = self._steal_dead_leases()
+        return {"changed": changed, "stolen": stolen}
+
+    # holds-lock: _lock
+    def _refresh_locked(self) -> int:
+        changed = 0
+        self._last_refresh = time.time()
+        try:
+            names = os.listdir(self.jobs_dir)
+        except OSError:
+            return 0
+        seen: set = set()
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            job_id = name[:-5]
+            seen.add(job_id)
+            path = os.path.join(self.jobs_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            sig = (st.st_mtime_ns, st.st_size, st.st_ino)
+            if self._stat.get(job_id) == sig:
+                continue
+            try:
+                with open(path) as f:
+                    record = JobRecord.from_json(json.load(f))
+            except (OSError, ValueError, KeyError):
+                continue  # mid-replace or poisoned: next poll retries
+            self._stat[job_id] = sig
+            self._absorb(record)
+            if _id_seq(job_id) >= self._next_id:
+                self._next_id = _id_seq(job_id) + 1
+            changed += 1
+        # records whose file vanished (peer retention/cleanup) leave
+        # the view — except ones we have claimed, whose settle verdict
+        # is still owed
+        for job_id in list(self._jobs):
+            if job_id in seen or job_id in self._claimed:
+                continue
+            record = self._jobs.pop(job_id)
+            self._queued.pop(job_id, None)
+            self._running.pop(job_id, None)
+            self._stat.pop(job_id, None)
+            if self._by_plan.get(record.plan_hash) == job_id:
+                self._by_plan.pop(record.plan_hash, None)
+        if changed:
+            self._set_depth_gauge()
+        return changed
+
+    def _steal_dead_leases(self) -> int:
+        """Reclaim running records whose lease no longer protects them:
+        requeue with the epoch bumped, so the previous owner — dead, or
+        a zombie about to resume — can never settle what it lost."""
+        with self._lock:
+            candidates = [
+                job_id for job_id, rec in self._jobs.items()
+                if rec.state == "running" and job_id not in self._running
+            ]
+        stolen: list[dict] = []
+        for job_id in candidates:
+            with self._lock:
+                with self._flock():
+                    disk = self._read_disk(job_id)
+                    if disk is None:
+                        continue
+                    if disk.state != "running":
+                        self._absorb(disk)
+                        continue
+                    lease = self._read_lease(job_id)
+                    if not self._lease_dead(lease, time.time(), job_id):
+                        continue
+                    prev = (lease or {}).get("replica")
+                    # queue-transition: running -> queued (lease steal: the owner died or stopped renewing)
+                    disk.state = "queued"
+                    disk.epoch += 1
+                    disk.owner = None
+                    disk.attempts += 1
+                    disk.error = None
+                    try:
+                        self._persist(disk)
+                    except OSError:
+                        get_logger().exception(
+                            "serve queue: could not persist steal of %s",
+                            job_id)
+                        continue
+                    self._clear_sentinel(job_id)
+                    self._absorb(disk)
+                    self._set_depth_gauge()
+                    stolen.append(dict(
+                        job=job_id, plan=disk.plan_hash,
+                        from_replica=prev, epoch=disk.epoch,
+                        attempts=disk.attempts,
+                    ))
+        for fields in stolen:
+            _LEASE_STEALS.inc()
+            tm.emit("serve_lease_stolen", by=self.replica, **fields)
+        return len(stolen)
 
     # ---------------------------------------------------------- enqueue
 
@@ -262,162 +736,324 @@ class DurableQueue:
         output: str,
     ) -> tuple[JobRecord, str]:
         """Enqueue one unit (or attach to its in-flight twin). Returns
-        (record, outcome) with outcome ∈ new | attached | done:
-        `attached` = a queued/running job with this plan hash already
-        exists and now also answers `request_id`; `done` = the record
-        completed earlier (the caller should serve from the store —
-        and re-enqueue via `rearm=True` if the store lost the bytes)."""
+        (record, outcome) with outcome ∈ new | attached | done |
+        quarantined: `attached` = a queued/running job with this plan
+        hash already exists and now also answers `request_id`; `done` =
+        the record completed earlier (the caller should serve from the
+        store — and re-enqueue via `rearm` if the store lost the
+        bytes); `quarantined` = the plan failed permanently and will
+        not retry until an operator re-arms it (the request is attached
+        for forensics, nothing is scheduled)."""
         with self._lock:
-            existing_id = self._by_plan.get(plan_hash)
-            if existing_id is not None:
-                record = self._jobs[existing_id]
-                if record.state in _ATTACHABLE:
+            with self._flock():
+                existing_id = self._by_plan.get(plan_hash)
+                if existing_id is None and \
+                        time.time() - self._last_refresh > 0.25:
+                    # unknown plan: a PEER may have minted its record
+                    # since our last rescan — refresh (throttled: one
+                    # stat-scan per burst, not per unit) before minting
+                    # a twin. Dedup across replicas stays eventual (a
+                    # miss inside the throttle window makes a duplicate
+                    # record, never a duplicate artifact — the store's
+                    # plan-hash commit is exactly-once regardless).
+                    self._refresh_locked()
+                    existing_id = self._by_plan.get(plan_hash)
+                if existing_id is not None:
+                    # disk is the shared truth: a peer may have moved
+                    # the record since our last poll
+                    record = self._read_disk(existing_id) or \
+                        self._jobs[existing_id]
+                    if record.state in _ATTACHABLE:
+                        if request_id not in record.requests:
+                            record.requests.append(request_id)
+                            self._persist(record)
+                        self._absorb(record)
+                        return record, "attached"
+                    if record.state == "done":
+                        if request_id not in record.requests:
+                            record.requests.append(request_id)
+                            self._persist(record)
+                        self._absorb(record)
+                        return record, "done"
+                    if record.state == "quarantined":
+                        # permanent failures do NOT auto-retry: attach
+                        # for forensics, refuse until an operator rearms
+                        if request_id not in record.requests:
+                            record.requests.append(request_id)
+                            self._persist(record)
+                        self._absorb(record)
+                        return record, "quarantined"
+                    # failed: re-arm the same record for a fresh attempt
+                    # — with a fresh attempt BUDGET (a plan that
+                    # exhausted its retries last week must not inherit
+                    # the spent counter)
+                    self._rearm_locked(record)
                     if request_id not in record.requests:
                         record.requests.append(request_id)
-                        self._persist(record)
-                    return record, "attached"
-                if record.state == "done":
-                    if request_id not in record.requests:
-                        record.requests.append(request_id)
-                        self._persist(record)
-                    return record, "done"
-                # failed: re-arm the same record for a fresh attempt —
-                # with a fresh attempt BUDGET (a plan that exhausted its
-                # retries last week must not inherit the spent counter)
-                # queue-transition: failed -> queued (a fresh request retries the plan)
-                record.state = "queued"
-                record.error = None
-                record.warm = False
-                record.attempts = 0
-                record.enqueued_at = time.time()
-                if request_id not in record.requests:
-                    record.requests.append(request_id)
+                    self._persist(record)
+                    self._absorb(record)
+                    self._set_depth_gauge()
+                    return record, "new"
+                # fresh plan: mint a record under an id no replica has
+                # used (the probe matters — peers allocate from the
+                # same namespace and our view of it may lag a poll)
+                while os.path.exists(
+                        self._record_path(f"j{self._next_id:06d}")):
+                    self._next_id += 1
+                record = JobRecord(
+                    job_id=f"j{self._next_id:06d}",
+                    plan_hash=plan_hash,
+                    plan=plan,
+                    unit=unit,
+                    tenant=tenant,
+                    priority=priority,
+                    output=output,
+                    requests=[request_id],
+                    state="queued",
+                    enqueued_at=time.time(),
+                )
+                self._next_id += 1
                 self._persist(record)
-                self._queued[record.job_id] = record
+                self._absorb(record)
                 self._set_depth_gauge()
                 return record, "new"
-            record = JobRecord(
-                job_id=f"j{self._next_id:06d}",
-                plan_hash=plan_hash,
-                plan=plan,
-                unit=unit,
-                tenant=tenant,
-                priority=priority,
-                output=output,
-                requests=[request_id],
-                state="queued",
-                enqueued_at=time.time(),
-            )
-            self._next_id += 1
-            self._persist(record)
-            self._jobs[record.job_id] = record
-            self._by_plan[plan_hash] = record.job_id
-            self._queued[record.job_id] = record
-            self._set_depth_gauge()
-            return record, "new"
+
+    # holds-lock: _lock
+    def _rearm_locked(self, record: JobRecord) -> None:
+        """Shared re-arm reset: a terminal record back to queued with a
+        FRESH budget and clean forensics (no stale error/errorKind/
+        settledEpoch from the settled life it just left)."""
+        # queue-transition: done|failed|quarantined -> queued (re-arm: evicted artifact / fresh request / operator retry)
+        record.state = "queued"
+        record.error = None
+        record.error_kind = None
+        record.warm = False
+        record.attempts = 0
+        record.not_before = 0.0
+        record.settled_epoch = None
+        record.enqueued_at = time.time()
 
     def rearm(self, job_id: str) -> Optional[JobRecord]:
-        """Force a done-but-evicted record back to queued (the store no
-        longer holds its artifact and a request needs it again)."""
+        """Force a terminal record back to queued: the store evicted a
+        done record's artifact, or an operator cleared a quarantine
+        (docs/SERVE.md "Quarantine workflow"). No-op on queued/running
+        records."""
         with self._lock:
-            record = self._jobs.get(job_id)
-            if record is None or record.state in _ATTACHABLE:
+            with self._flock():
+                record = self._read_disk(job_id) or self._jobs.get(job_id)
+                if record is None or record.state in _ATTACHABLE:
+                    return record
+                self._rearm_locked(record)
+                self._persist(record)
+                self._absorb(record)
+                self._set_depth_gauge()
                 return record
-            # queue-transition: done|failed -> queued (re-arm: store evicted / retry requested)
-            record.state = "queued"
-            record.error = None
-            record.warm = False
-            record.attempts = 0
-            record.enqueued_at = time.time()
-            self._persist(record)
-            self._queued[record.job_id] = record
-            self._set_depth_gauge()
-            return record
 
     # ------------------------------------------------------- scheduling
 
     def queued_snapshot(self) -> list[JobRecord]:
+        """Claim-eligible records: queued, and past their retry backoff
+        (`not_before` — a transient failure's re-eligibility time)."""
+        now = time.time()
         with self._lock:
-            return sorted(self._queued.values(), key=lambda r: r.enqueued_at)
+            return sorted(
+                (r for r in self._queued.values() if r.not_before <= now),
+                key=lambda r: r.enqueued_at,
+            )
 
     def claim(self, job_ids: list[str]) -> list[JobRecord]:
-        """Move jobs queued → running (sentinel down). Jobs another
-        worker claimed first are silently skipped — the returned list is
-        what THIS caller owns. A disk failure (ENOSPC/EIO on the
-        sentinel or the rewrite) mid-way through the list reverts THAT
-        record to queued and stops claiming: the caller still owns
-        everything claimed before it, so no record is ever stranded in
-        'running' with no owner while enqueue attaches newcomers to it."""
+        """Move jobs queued → running (epoch bumped, lease down). Jobs
+        another worker or replica claimed first are silently skipped —
+        the returned list is what THIS caller owns. A disk failure
+        (ENOSPC/EIO on the rewrite or the lease) mid-way through the
+        list reverts THAT record to queued and stops claiming
+        (`serve_claim_reverted`): the caller still owns everything
+        claimed before it, so no record is ever stranded in 'running'
+        with no owner while enqueue attaches newcomers to it."""
         owned: list[JobRecord] = []
+        reverted: list[dict] = []
+        now = time.time()
         with self._lock:
-            for job_id in job_ids:
-                record = self._queued.pop(job_id, None)
-                if record is None:
-                    continue
-                try:
-                    # queue-transition: queued -> running (claim: this worker owns the execution)
-                    record.state = "running"
-                    self._running[job_id] = record
-                    # chainlint: disable=atomic-write (sentinel: only its EXISTENCE signals an unfinished execution — same contract as the engine's .inprogress)
-                    with open(self._sentinel_path(job_id), "w"):
-                        pass
-                    self._persist(record)
-                except OSError:
-                    # queue-transition: running -> queued (claim revert: the disk refused the sentinel/rewrite)
-                    record.state = "queued"
-                    self._running.pop(job_id, None)
-                    self._queued[job_id] = record
+            with self._flock():
+                for job_id in job_ids:
+                    if job_id not in self._queued:
+                        continue
+                    record = self._read_disk(job_id) or self._queued[job_id]
+                    if record.state != "queued" or record.not_before > now:
+                        self._absorb(record)  # peer moved it meanwhile
+                        continue
                     try:
-                        self._clear_sentinel(job_id)
-                    except OSError:  # the disk is already misbehaving
-                        pass         # recovery treats a stray sentinel as requeue
-                    get_logger().exception(
-                        "serve queue: claim of %s failed; reverted to "
-                        "queued", job_id,
-                    )
-                    break
-                owned.append(record)
-            self._set_depth_gauge()
+                        # queue-transition: queued -> running (claim: this worker owns the execution)
+                        record.state = "running"
+                        record.epoch += 1
+                        record.owner = self.replica
+                        self._persist(record)
+                        self._write_lease(record)
+                    except OSError:
+                        # queue-transition: running -> queued (claim revert: the disk refused the rewrite/lease)
+                        record.state = "queued"
+                        record.epoch -= 1
+                        record.owner = None
+                        try:
+                            self._persist(record)
+                        except OSError:
+                            pass  # peers' steal scan reclaims the orphan
+                        try:
+                            self._clear_sentinel(job_id)
+                        except OSError:  # the disk is already misbehaving
+                            pass  # recovery treats a stray lease as dead
+                        self._absorb(record)
+                        reverted.append(dict(job=job_id,
+                                             plan=record.plan_hash))
+                        get_logger().exception(
+                            "serve queue: claim of %s failed; reverted to "
+                            "queued", job_id,
+                        )
+                        break
+                    self._claimed[job_id] = record.epoch
+                    self._absorb(record)
+                    owned.append(record)
+                self._set_depth_gauge()
+        for fields in reverted:
+            _CLAIM_REVERTS.inc()
+            tm.emit("serve_claim_reverted", replica=self.replica, **fields)
         return owned
 
-    def complete(self, job_id: str, warm: bool = False) -> Optional[JobRecord]:
-        with self._lock:
-            record = self._jobs.get(job_id)
-            if record is None:
-                return None
-            self._running.pop(job_id, None)
-            self._queued.pop(job_id, None)
-            # queue-transition: running -> done (execution or warm hit settled)
-            record.state = "done"
-            record.warm = warm
-            record.error = None
-            record.done_at = time.time()
-            self._persist(record)
-            self._clear_sentinel(job_id)
-            self._set_depth_gauge()
-            return record
+    # ----------------------------------------------------------- settle
 
-    def fail(self, job_id: str, error: str,
-             requeue: bool = False) -> Optional[JobRecord]:
-        with self._lock:
-            record = self._jobs.get(job_id)
-            if record is None:
-                return None
+    # holds-lock: _lock
+    def _fence_check(self, job_id: str, op: str) -> tuple:
+        """(base_record, fenced_fields). Every settle starts here: the
+        on-disk record's epoch must match the epoch THIS replica
+        claimed, or the caller lost ownership (steal, recovery by a
+        peer) while it executed — its verdict is refused and the record
+        left exactly as the current owner's protocol put it."""
+        record = self._jobs.get(job_id)
+        if record is None:
+            return None, None
+        disk = self._read_disk(job_id)
+        ours = self._claimed.get(job_id, record.epoch)
+        if disk is not None and disk.epoch != ours:
+            # NOTE: the stale _claimed entry is deliberately KEPT — it
+            # is the memory that we lost this record. Popping it here
+            # would let a SECOND settle attempt fall back to the
+            # absorbed (current) epoch and sail through the fence. It
+            # clears only on a successful settle or a fresh claim.
             self._running.pop(job_id, None)
-            record.error = str(error)[:500]
-            if requeue:
-                # queue-transition: running -> queued (retry: attempts budget not exhausted)
-                record.state = "queued"
-                record.attempts += 1
-                self._queued[job_id] = record
-            else:
-                # queue-transition: running -> failed (attempts budget exhausted)
-                record.state = "failed"
-                record.done_at = time.time()
-            self._persist(record)
-            self._clear_sentinel(job_id)
-            self._set_depth_gauge()
-            return record
+            self._absorb(disk)
+            return None, dict(job=job_id, plan=record.plan_hash, op=op,
+                              held_epoch=ours, current_epoch=disk.epoch)
+        base = disk if disk is not None else record
+        # merge request attachments a peer may have added meanwhile —
+        # our in-memory copy can lag the shared record
+        for req_id in record.requests:
+            if req_id not in base.requests:
+                base.requests.append(req_id)
+        self._claimed.pop(job_id, None)
+        return base, None
+
+    def complete(self, job_id: str, warm: bool = False) -> Optional[JobRecord]:
+        """Settle a claimed job as done. Epoch-fenced: returns None
+        (and emits `serve_settle_fenced`) when ownership moved on —
+        a zombie replica resumed after SIGSTOP cannot settle a record
+        a live peer stole from it."""
+        fenced = None
+        with self._lock:
+            with self._flock():
+                base, fenced = self._fence_check(job_id, "complete")
+                if base is None and fenced is None:
+                    return None
+                if fenced is None:
+                    self._running.pop(job_id, None)
+                    # queue-transition: running -> done (execution or warm hit settled)
+                    base.state = "done"
+                    base.warm = warm
+                    base.error = None
+                    base.error_kind = None
+                    base.done_at = time.time()
+                    base.settled_epoch = base.epoch
+                    self._persist(base)
+                    self._clear_sentinel(job_id)
+                    self._absorb(base)
+                    self._set_depth_gauge()
+        if fenced is not None:
+            _FENCED_SETTLES.inc()
+            tm.emit("serve_settle_fenced", replica=self.replica, **fenced)
+            return None
+        return base
+
+    def fail(self, job_id: str, error: str, requeue: bool = False,
+             backoff_s: float = 0.0,
+             kind: Optional[str] = None) -> Optional[JobRecord]:
+        """Settle a claimed job as failed — or requeue it for a retry,
+        eligible again only after `backoff_s` (exponential backoff with
+        jitter is the SCHEDULER's policy; the queue just persists
+        `not_before` so the whole replica fleet honors it). Epoch-fenced
+        like complete()."""
+        fenced = None
+        with self._lock:
+            with self._flock():
+                base, fenced = self._fence_check(job_id, "fail")
+                if base is None and fenced is None:
+                    return None
+                if fenced is None:
+                    self._running.pop(job_id, None)
+                    base.error = str(error)[:500]
+                    base.error_kind = kind
+                    if requeue:
+                        # queue-transition: running -> queued (retry: attempts budget not exhausted; not_before backoff)
+                        base.state = "queued"
+                        base.attempts += 1
+                        base.owner = None
+                        base.not_before = time.time() + max(0.0, backoff_s)
+                    else:
+                        # queue-transition: running -> failed (attempts budget exhausted)
+                        base.state = "failed"
+                        base.done_at = time.time()
+                        base.settled_epoch = base.epoch
+                    self._persist(base)
+                    self._clear_sentinel(job_id)
+                    self._absorb(base)
+                    self._set_depth_gauge()
+        if fenced is not None:
+            _FENCED_SETTLES.inc()
+            tm.emit("serve_settle_fenced", replica=self.replica, **fenced)
+            return None
+        return base
+
+    def quarantine(self, job_id: str, error: str,
+                   kind: str = "permanent") -> Optional[JobRecord]:
+        """Settle a claimed job as PERMANENTLY failed: no retry will
+        change the outcome (bad params, corrupt SRC), so the plan is
+        parked with its forensics instead of burning the attempts
+        budget. Only `rearm` (the operator workflow) resurrects it.
+        Epoch-fenced like complete()."""
+        fenced = None
+        with self._lock:
+            with self._flock():
+                base, fenced = self._fence_check(job_id, "quarantine")
+                if base is None and fenced is None:
+                    return None
+                if fenced is None:
+                    self._running.pop(job_id, None)
+                    # queue-transition: running -> quarantined (permanent failure: retrying is futile)
+                    base.state = "quarantined"
+                    base.error = str(error)[:500]
+                    base.error_kind = kind
+                    base.done_at = time.time()
+                    base.settled_epoch = base.epoch
+                    self._persist(base)
+                    self._clear_sentinel(job_id)
+                    self._absorb(base)
+                    self._set_depth_gauge()
+        if fenced is not None:
+            _FENCED_SETTLES.inc()
+            tm.emit("serve_settle_fenced", replica=self.replica, **fenced)
+            return None
+        _QUARANTINED.inc()
+        tm.emit("serve_quarantined", job=job_id, plan=base.plan_hash,
+                error=base.error, attempts=base.attempts)
+        return base
 
     # holds-lock: _lock
     def _clear_sentinel(self, job_id: str) -> None:

@@ -26,14 +26,21 @@ Scheduling policy, in order:
      and enqueue-time attachment (queue.py) means overlapping requests
      were already riding the one record.
 
-Execution failures retry up to `max_attempts` (the store decides what
+Execution failures are CLASSIFIED before they are settled
+(docs/SERVE.md "Failure taxonomy"): transient ones (disk pressure,
+device unavailable, OOM) retry up to `max_attempts` with exponential
+backoff + jitter — the record's `not_before` keeps a deterministic
+failure from burning its whole attempts budget in milliseconds —
+while permanent ones (bad params, corrupt SRC) QUARANTINE the plan
+with forensics instead of retrying. The store stays the truth for what
 actually completed: a commit that landed before a crash is a warm hit,
-never a re-execution).
+never a re-execution.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from typing import Callable, Optional
@@ -43,6 +50,7 @@ from ..engine.jobs import Job, JobRunner
 from ..store import runtime as store_runtime
 from ..utils import lockdebug
 from ..utils.log import get_logger
+from ..utils.runner import ChainError
 from .api import PRIORITIES
 from .executors import _unit_of
 from .queue import DurableQueue, JobRecord
@@ -50,6 +58,37 @@ from .queue import DurableQueue, JobRecord
 _INFLIGHT = tm.gauge(
     "chain_serve_inflight", "units currently executing in the serve scheduler"
 )
+
+#: exception types whose retry-worthiness is knowable without a
+#: ChainError kind tag: environmental failures may succeed later;
+#: programming/data errors will not.
+_TRANSIENT_TYPES = (OSError, MemoryError, TimeoutError, ConnectionError)
+_PERMANENT_TYPES = (ValueError, TypeError, KeyError, AssertionError)
+
+
+def classify_failure(exc: BaseException) -> str:
+    """'transient' or 'permanent' for one execution failure. Walks the
+    cause/context chain (the wave barrier and the runner both wrap the
+    executor's exception): an explicit ChainError `kind` anywhere wins;
+    otherwise the first recognizably-environmental or
+    recognizably-deterministic type decides. Unknown shapes default to
+    transient — the attempts budget still bounds them, and retrying an
+    unknown is cheaper than quarantining a recoverable plan."""
+    seen: set = set()
+    cursor: Optional[BaseException] = exc
+    verdict: Optional[str] = None
+    while cursor is not None and id(cursor) not in seen:
+        seen.add(id(cursor))
+        if isinstance(cursor, ChainError) and \
+                getattr(cursor, "kind", None) in ("transient", "permanent"):
+            return cursor.kind
+        if verdict is None:
+            if isinstance(cursor, _TRANSIENT_TYPES):
+                verdict = "transient"
+            elif isinstance(cursor, _PERMANENT_TYPES):
+                verdict = "permanent"
+        cursor = cursor.__cause__ or cursor.__context__
+    return verdict or "transient"
 
 #: stride virtual-time scale (anything ≫ max weight works; power of two
 #: keeps the passes exact in floats far past any realistic uptime)
@@ -163,6 +202,13 @@ class _WaveBarrier:
                 ) from self._error
 
 
+#: worker idle-poll bounds: fast right after a dispatch (work begets
+#: work), decaying when the queue stays empty (an idle fleet must not
+#: hammer the shared queue lock)
+_IDLE_MIN_S = 0.01
+_IDLE_MAX_S = 0.25
+
+
 class Scheduler:
     """Worker threads draining the queue (see module doc for policy)."""
 
@@ -175,6 +221,8 @@ class Scheduler:
         wave_width: int = 4,
         tenant_weights: Optional[dict] = None,
         max_attempts: int = 2,
+        retry_base_s: float = 0.25,
+        retry_cap_s: float = 30.0,
         on_done: Optional[Callable[[JobRecord], None]] = None,
         on_failed: Optional[Callable[[JobRecord], None]] = None,
     ) -> None:
@@ -184,6 +232,8 @@ class Scheduler:
         self.workers = max(1, int(workers))
         self.wave_width = max(1, int(wave_width))
         self.max_attempts = max(1, int(max_attempts))
+        self.retry_base_s = max(0.0, float(retry_base_s))
+        self.retry_cap_s = max(self.retry_base_s, float(retry_cap_s))
         self.on_done = on_done or (lambda record: None)
         self.on_failed = on_failed or (lambda record: None)
         self._picker = StridePicker(tenant_weights)
@@ -220,13 +270,24 @@ class Scheduler:
 
     def _worker(self) -> None:
         log = get_logger()
+        idle_wait = _IDLE_MIN_S
         while not self._stop.is_set():
             try:
                 batch = self._next_batch()
                 if not batch:
-                    self._wake.wait(timeout=0.2)
-                    self._wake.clear()
+                    # idle backoff: stay responsive just after real work
+                    # (a settling wave often unblocks more), decay to
+                    # ~250 ms when the queue stays empty — an idle
+                    # replica fleet must not spin N workers hot against
+                    # the queue lock. A submit's notify() short-circuits
+                    # the wait either way.
+                    if self._wake.wait(timeout=idle_wait):
+                        self._wake.clear()
+                        idle_wait = _IDLE_MIN_S
+                    else:
+                        idle_wait = min(idle_wait * 2.0, _IDLE_MAX_S)
                     continue
+                idle_wait = _IDLE_MIN_S
                 self._dispatch(batch)
             except BaseException:  # noqa: BLE001 - a worker must survive anything
                 # _next_batch is INSIDE the guard: a poisoned queue record
@@ -338,15 +399,38 @@ class Scheduler:
         if done is not None:
             self.on_done(done)
 
+    def _backoff_s(self, attempts: int) -> float:
+        """Exponential retry backoff with ±25% jitter: attempt k waits
+        ~base·2^k (capped). Without it a deterministic transient-looking
+        failure is re-eligible instantly and burns its whole attempts
+        budget in milliseconds; the jitter keeps a replica fleet from
+        retrying a shared record in lockstep."""
+        delay = min(self.retry_cap_s,
+                    self.retry_base_s * (2.0 ** max(0, attempts)))
+        return delay * (0.75 + 0.5 * random.random())
+
     def _settle_failure(self, batch: list[JobRecord], settled: set,
                         exc: Exception) -> None:
         """After a batch failure the STORE is the truth: members whose
-        commit landed are done; the rest retry (attempts budget) or
-        fail. A wave failure is collective, but completion is not.
+        commit landed are done. The rest settle by failure CLASS
+        (classify_failure): permanent failures quarantine the plan with
+        forensics — retrying a determined outcome is waste — while
+        transient ones retry under the attempts budget, re-eligible
+        only after an exponential backoff (the record's not_before). A
+        wave failure is collective, but completion is not — and neither
+        is BLAME: a permanent verdict is applied only when exactly one
+        unsettled member could have caused it, because quarantining a
+        whole wave for one poisoned sibling would park healthy plans
+        behind an operator re-arm. Ambiguous permanent failures retry
+        like transients (jittered backoff desynchronizes the members,
+        so a truly poisoned unit soon fails a wave it owns alone and
+        quarantines then; the attempts budget terminates the rest).
         Per-record settling is itself fenced — one record's persist
         error must not strand its siblings in 'running'."""
         log = get_logger()
         store = store_runtime.active()
+        kind = classify_failure(exc)
+        suspects = sum(1 for r in batch if r.job_id not in settled)
         for record in batch:
             if record.job_id in settled:
                 continue
@@ -360,9 +444,22 @@ class Scheduler:
                 if committed:
                     self._complete(record, settled)
                     continue
+                if kind == "permanent" and suspects == 1:
+                    quarantined = self.queue.quarantine(
+                        record.job_id, error=repr(exc),
+                    )
+                    settled.add(record.job_id)
+                    if quarantined is not None:
+                        log.error("serve: job %s quarantined (permanent "
+                                  "failure): %r", record.job_id, exc)
+                        self.on_failed(quarantined)
+                    continue
                 requeue = record.attempts + 1 < self.max_attempts
                 failed = self.queue.fail(
                     record.job_id, error=repr(exc), requeue=requeue,
+                    backoff_s=self._backoff_s(record.attempts) if requeue
+                    else 0.0,
+                    kind=kind,
                 )
                 settled.add(record.job_id)
                 if failed is not None and not requeue:

@@ -28,7 +28,14 @@ store answers warm in milliseconds; one queued or running attaches; and
 only genuinely novel plans execute, exactly once (docs/SERVE.md).
 
 The engine's global store slot (store/runtime) is configured to the
-serve store at construction: one service per process at a time.
+serve store at construction: one service per process at a time — or
+several REPLICAS of one root in one process (the fleet-shaped tests),
+which share the same store root and so agree on the slot.
+
+Multi-replica: any number of services (in any number of processes) may
+share one root. Queue ownership is lease-fenced (serve/queue.py), and
+the maintenance tick propagates peer executions into this replica's
+request bookkeeping (docs/SERVE.md "Running multiple replicas").
 """
 
 from __future__ import annotations
@@ -36,8 +43,9 @@ from __future__ import annotations
 import json
 import os
 import secrets
+import threading
 import time
-from typing import Optional
+from typing import NamedTuple, Optional
 
 from .. import telemetry as tm
 from ..store import runtime as store_runtime
@@ -49,7 +57,7 @@ from ..utils.log import get_logger
 from . import api
 from .executors import make_executor
 from .pressure import StorePressure
-from .queue import DurableQueue
+from .queue import DurableQueue, owner_process_dead, owner_stamp
 from .scheduler import Scheduler
 
 _REQ_TOTAL = tm.counter(
@@ -80,6 +88,15 @@ class _DoneState:
 _DONE_SENTINEL = _DoneState()
 
 
+class _PlanSettled(NamedTuple):
+    """Record stand-in for cross-replica completion sweeps: all the
+    waiter bookkeeping needs is the plan hash (and, for failures, the
+    error text)."""
+
+    plan_hash: str
+    error: Optional[str] = None
+
+
 class ChainServeService:
     """Composition root of the serve daemon (see module doc)."""
 
@@ -96,6 +113,10 @@ class ChainServeService:
         tenant_weights: Optional[dict] = None,
         max_attempts: int = 2,
         request_retention: int = 10_000,
+        replica: Optional[str] = None,
+        lease_s: float = 15.0,
+        poll_s: float = 1.0,
+        info_path: Optional[str] = None,
     ) -> None:
         self.root = os.path.abspath(root)
         self.artifacts_root = os.path.join(self.root, "artifacts")
@@ -109,7 +130,20 @@ class ChainServeService:
         self.store = store_runtime.configure(
             store_root or os.path.join(self.root, "store")
         )
-        self.queue = DurableQueue(os.path.join(self.root, "queue"))
+        self.queue = DurableQueue(
+            os.path.join(self.root, "queue"),
+            replica=replica, lease_s=lease_s,
+        )
+        self.replica = self.queue.replica
+        self.poll_s = max(0.05, float(poll_s))
+        self.info_path = info_path or os.path.join(
+            self.root, "serve-info.json"
+        )
+        self._poll_stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        #: request-doc stat signatures for the orphan sweep; touched
+        #: only by the maintenance thread
+        self._req_stat: dict[str, tuple] = {}
         self.request_retention = max(1, int(request_retention))
         self._lock = lockdebug.make_lock("serve_service")
         #: request docs; each active one carries a non-persisted
@@ -140,27 +174,142 @@ class ChainServeService:
     def start(self) -> "ChainServeService":
         live.STATUS_PROVIDERS["serve"] = self._status_section
         self.server.start()
+        self.queue.start_heartbeat()
         self.scheduler.start()
-        atomic_write_json(os.path.join(self.root, "serve-info.json"), {
+        self._poll_stop.clear()
+        self._poll_thread = threading.Thread(
+            target=self._maintenance_loop,
+            name="chain-serve-maintenance", daemon=True,
+        )
+        self._poll_thread.start()
+        atomic_write_json(self.info_path, {
             "pid": os.getpid(),
             "port": self.server.port,
             "url": self.server.url,
             "root": self.root,
             "executor": self.executor.kind,
+            "replica": self.replica,
         })
         get_logger().info(
-            "chain-serve: %s (root %s, executor %s, queue: %s)",
-            self.server.url, self.root, self.executor.kind,
+            "chain-serve: %s (root %s, replica %s, executor %s, queue: %s)",
+            self.server.url, self.root, self.replica, self.executor.kind,
             self.queue.recovery,
         )
         return self
 
     def stop(self) -> None:
+        self._poll_stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=10.0)
+            self._poll_thread = None
         self.scheduler.stop()
         self.server.stop()
         live.STATUS_PROVIDERS.pop("serve", None)
+        # releases this replica's leases/liveness so a successor (or a
+        # peer) can reclaim any still-running work immediately
+        self.queue.close()
         if self.store is not None:
             self.store.digests.save()
+
+    # ------------------------------------------------------- maintenance
+
+    def _maintenance_loop(self) -> None:
+        """The replica-fleet tick: merge peer queue changes, steal dead
+        leases (waking our scheduler for the reclaimed work), and settle
+        requests whose plans a PEER replica finished — this replica's
+        scheduler callbacks only fire for its own executions, so
+        cross-replica completions propagate here."""
+        while not self._poll_stop.wait(timeout=self.poll_s):
+            try:
+                result = self.queue.poll()
+                if result.get("stolen") or result.get("changed"):
+                    self.scheduler.notify()
+                self._sweep_remote_settlements()
+                self._adopt_orphan_requests()
+            except Exception:  # noqa: BLE001 - the tick must survive disk hiccups
+                get_logger().exception(
+                    "chain-serve: maintenance tick failed")
+
+    def _sweep_remote_settlements(self) -> None:
+        with self._lock:
+            waited = list(self._plan_waiters)
+        for plan_hash in waited:
+            record = self.queue.by_plan(plan_hash)
+            if record is not None and record.state == "done":
+                self._on_job_done(record)
+            elif record is not None and record.state in (
+                    "failed", "quarantined"):
+                self._on_job_failed(record)
+            elif record is None and self._plan_is_done(plan_hash):
+                # no queue record but the store holds verified bytes: a
+                # peer executed and its record left our view
+                self._on_job_done(_PlanSettled(plan_hash))
+
+    def _adopt_orphan_requests(self) -> None:
+        """An active request whose owning replica died UN-restarted
+        would otherwise wait for some replica's next startup rescan to
+        be adopted; the tick adopts it directly. Terminal docs are
+        stat-cached (they cannot regress), active docs of LIVE owners
+        are re-probed each tick — the probe is one os.kill(pid, 0)."""
+        try:
+            names = os.listdir(self.requests_dir)
+        except OSError:
+            return
+        seen: set = set()
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            req_id = name[:-5]
+            seen.add(req_id)
+            with self._lock:
+                if req_id in self._requests:
+                    continue
+            path = os.path.join(self.requests_dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            sig = (st.st_mtime_ns, st.st_size)
+            if self._req_stat.get(req_id) == sig:
+                continue
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-replace or poisoned: next tick retries
+            if doc.get("state") != "active":
+                self._req_stat[req_id] = sig  # terminal: never re-read
+                continue
+            if not owner_process_dead(doc.get("owner")):
+                continue  # owner lives (or is unknowable): theirs
+            # claim the doc under the fleet fence: re-check and restamp
+            # in one exclusive section so two surviving replicas cannot
+            # both adopt the same orphan off simultaneous ticks
+            claimed = False
+            try:
+                with self.queue.exclusive():
+                    with open(path) as f:
+                        doc = json.load(f)
+                    if doc.get("state") == "active" and \
+                            owner_process_dead(doc.get("owner")):
+                        prev = (doc.get("owner") or {}).get("replica")
+                        doc["owner"] = owner_stamp(self.replica)
+                        atomic_write_json(path, doc, durable=True,
+                                          sort_keys=True)
+                        claimed = True
+            except (OSError, ValueError):
+                continue
+            if not claimed:
+                continue
+            get_logger().warning(
+                "chain-serve: adopting orphaned request %s from dead "
+                "replica %r", req_id, prev)
+            self._adopt_active(doc)
+        # retention pruning deletes docs from disk; their stat entries
+        # must not outlive them (an always-on daemon leaks otherwise)
+        for req_id in list(self._req_stat):
+            if req_id not in seen:
+                self._req_stat.pop(req_id, None)
 
     def __enter__(self) -> "ChainServeService":
         return self.start()
@@ -171,10 +320,12 @@ class ChainServeService:
     # ---------------------------------------------------------- recovery
 
     def _recover_requests(self) -> None:
-        """Reload persisted request records. Active ones re-arm their
-        plan waiters; units whose job record vanished (a crash between
-        request persist and unit enqueue) are re-enqueued; requests
-        whose every unit meanwhile completed are finalized now."""
+        """Reload persisted request records. Finished ones are indexed;
+        every active one is ADOPTED (`_adopt_active`): waiters re-armed,
+        units whose job record vanished (a crash between request
+        persist and unit enqueue) re-enqueued, requests against
+        quarantined plans failed with the forensics, and requests whose
+        every unit meanwhile completed finalized now."""
         try:
             names = sorted(os.listdir(self.requests_dir))
         except OSError:
@@ -193,40 +344,75 @@ class ChainServeService:
                         "serve: unreadable request record %s; skipping", path
                     )
                     continue
-                self._requests[doc["request"]] = doc
                 if doc.get("state") == "active":
-                    recovered_active.append(doc)
-            for doc in recovered_active:
-                req_id = doc["request"]
-                doc["_pending"] = set()
-                for unit_doc in doc["units"].values():
-                    plan_hash = unit_doc["plan"]
-                    if self._plan_is_done(plan_hash):
-                        continue
-                    doc["_pending"].add(plan_hash)
-                    self._plan_waiters.setdefault(plan_hash, set()).add(req_id)
-                    record = self.queue.by_plan(plan_hash)
-                    if record is None:
-                        # enqueue lost to the crash: re-create it from the
-                        # request record (it carries the full unit payload)
-                        self.queue.enqueue(
-                            plan_hash,
-                            unit_doc["planPayload"],
-                            unit_doc["unit"],
-                            doc["tenant"], doc["priority"], req_id,
-                            unit_doc["output"],
-                        )
-                    else:
-                        # the record may be 'failed' (crash before the
-                        # request saw the failure) or 'done' with the
-                        # artifact since evicted (the store check above
-                        # said not-done): re-arm it, mirroring submit —
-                        # otherwise nothing ever runs this plan and the
-                        # recovered request pins it in 'active' forever.
-                        # rearm is a no-op on queued/running records.
-                        self.queue.rearm(record.job_id)
+                    recovered_active.append(doc)  # adopted below
+                else:
+                    self._requests[doc["request"]] = doc
         for doc in recovered_active:
-            self._check_request_done(doc["request"])
+            self._adopt_active(doc)
+
+    def _adopt_active(self, doc: dict) -> None:
+        """Take responsibility for one active request record: re-arm
+        its plan waiters, re-create lost enqueues, fail it against
+        quarantined plans, finalize it if everything already settled.
+        Restamps the ownership so peers stop probing it. Called at
+        recovery (every active doc on disk) and from the maintenance
+        tick (docs whose owning replica process died un-restarted)."""
+        req_id = doc["request"]
+        quarantine_error: Optional[str] = None
+        with self._lock:
+            if req_id in self._requests:
+                return
+            doc["owner"] = owner_stamp(self.replica)
+            doc["_pending"] = set()
+            self._requests[req_id] = doc
+            for unit_doc in doc["units"].values():
+                plan_hash = unit_doc["plan"]
+                if self._plan_is_done(plan_hash):
+                    continue
+                doc["_pending"].add(plan_hash)
+                self._plan_waiters.setdefault(plan_hash, set()).add(req_id)
+                record = self.queue.by_plan(plan_hash)
+                if record is None:
+                    # enqueue lost to the crash: re-create it from the
+                    # request record (it carries the full unit payload)
+                    self.queue.enqueue(
+                        plan_hash,
+                        unit_doc["planPayload"],
+                        unit_doc["unit"],
+                        doc["tenant"], doc["priority"], req_id,
+                        unit_doc["output"],
+                    )
+                elif record.state == "quarantined":
+                    # the plan failed PERMANENTLY while the request
+                    # never saw the verdict: deliver it now instead of
+                    # re-arming work whose outcome is determined
+                    # (docs/SERVE.md "Failure taxonomy")
+                    quarantine_error = (record.error or
+                                       "plan quarantined after permanent "
+                                       "failure")
+                else:
+                    # the record may be 'failed' (crash before the
+                    # request saw the failure) or 'done' with the
+                    # artifact since evicted (the store check above said
+                    # not-done): re-arm it, mirroring submit — otherwise
+                    # nothing ever runs this plan and the adopted
+                    # request pins it in 'active' forever. rearm is a
+                    # no-op on queued/running records.
+                    self.queue.rearm(record.job_id)
+        if quarantine_error is not None:
+            with self._lock:
+                if doc["state"] == "active":
+                    doc["state"] = "failed"
+                    doc["done_at"] = time.time()
+                    doc["error"] = quarantine_error
+            self._persist_request(doc)
+            _REQ_TOTAL.labels(state="failed").inc()
+            tm.emit("serve_request_done", request=req_id,
+                    status="failed", error=quarantine_error)
+            return
+        self._persist_request(doc)  # the new owner stamp, durably
+        self._check_request_done(req_id)
 
     # ------------------------------------------------------- submissions
 
@@ -276,6 +462,9 @@ class ChainServeService:
             "done_at": None,
             "latency_ms": None,
             "warm": False,
+            # liveness stamp: peers adopt this request if our process
+            # dies before finalizing it (maintenance orphan sweep)
+            "owner": owner_stamp(self.replica),
         }
         # the request must be discoverable BEFORE its first unit can
         # complete, or a fast job's on_done would miss the waiter
@@ -285,7 +474,9 @@ class ChainServeService:
             for plan_hash in plans:
                 self._plan_waiters.setdefault(plan_hash, set()).add(req_id)
         self._persist_request(doc)
-        outcomes = {"warm": 0, "enqueued": 0, "attached": 0}
+        outcomes = {"warm": 0, "enqueued": 0, "attached": 0,
+                    "quarantined": 0}
+        quarantine_error: Optional[str] = None
         for plan_hash, unit_doc in plans.items():
             if self._plan_is_done(plan_hash):
                 _UNITS.labels(outcome="warm").inc()
@@ -308,6 +499,15 @@ class ChainServeService:
                 # holds (evicted): re-arm the same record
                 self.queue.rearm(record.job_id)
                 outcome = "new"
+            if outcome == "quarantined":
+                # permanent failure on record: the request fails NOW
+                # instead of waiting on work nothing will run — an
+                # operator re-arms the plan (docs/SERVE.md), a re-POST
+                # then retries it
+                _UNITS.labels(outcome="quarantined").inc()
+                outcomes["quarantined"] += 1
+                quarantine_error = record.error or "plan quarantined"
+                continue
             key = "enqueued" if outcome == "new" else "attached"
             _UNITS.labels(outcome=key).inc()
             outcomes[key] += 1
@@ -317,11 +517,20 @@ class ChainServeService:
         # race that snapshot's iteration (snapshot-under-lock audit)
         with self._lock:
             doc["warm"] = outcomes["warm"] == len(plans)
+            if quarantine_error is not None and doc["state"] == "active":
+                doc["state"] = "failed"
+                doc["done_at"] = time.time()
+                doc["error"] = quarantine_error
         _REQ_TOTAL.labels(state="accepted").inc()
         tm.emit("serve_request", request=req_id,
                 tenant=normalized["tenant"],
                 priority=normalized["priority"], units=len(unit_docs),
                 **outcomes)
+        if quarantine_error is not None:
+            self._persist_request(doc)
+            _REQ_TOTAL.labels(state="failed").inc()
+            tm.emit("serve_request_done", request=req_id, status="failed",
+                    error=quarantine_error)
         self.scheduler.notify()
         self._check_request_done(req_id, submit_t0=t0)
         with self._lock:
@@ -436,6 +645,7 @@ class ChainServeService:
                     self.requests_dir, snapshot["request"] + ".json"
                 ),
                 snapshot,
+                durable=True,  # request docs claim SIGKILL/power-loss proofness
                 sort_keys=True,
             )
 

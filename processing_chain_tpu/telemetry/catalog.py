@@ -70,6 +70,10 @@ METRICS: dict[str, str] = {
     "chain_serve_waves_total": "counter",
     "chain_serve_wave_lanes": "histogram",
     "chain_serve_gc_evicted_bytes_total": "counter",
+    "chain_serve_lease_steals_total": "counter",
+    "chain_serve_fenced_settles_total": "counter",
+    "chain_serve_claim_reverts_total": "counter",
+    "chain_serve_quarantined_total": "counter",
     # telemetry/profiling.py — resource monitor (PR 5)
     "chain_resource_rss_bytes": "gauge",
     "chain_resource_open_fds": "gauge",
@@ -104,6 +108,11 @@ EVENTS: frozenset = frozenset({
     "serve_request_done",  # serve/service.py — request completed/failed
     "serve_requeued",      # serve/queue.py — interrupted job requeued
     "serve_gc",            # serve/pressure.py — budget pass ran
+    "serve_lease_stolen",  # serve/queue.py — dead/expired lease reclaimed
+    "serve_lease_lost",    # serve/queue.py — heartbeat found its lease gone
+    "serve_settle_fenced",     # serve/queue.py — stale-epoch settle refused
+    "serve_claim_reverted",    # serve/queue.py — mid-claim disk error undone
+    "serve_quarantined",   # serve/queue.py — permanent failure parked
 
     "log",             # WARNING+ console records bridged into the log
 })

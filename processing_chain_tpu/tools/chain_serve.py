@@ -65,6 +65,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="fair-share weight for a tenant (default 1)")
     parser.add_argument("--status-file", default=None,
                         help="also rewrite the /status JSON to this file")
+    parser.add_argument("--replica-id", default=None,
+                        help="stable name for this replica in a fleet "
+                             "(default: host-pid-random)")
+    parser.add_argument("--lease-s", type=float, default=15.0,
+                        help="execution-lease duration; a replica that "
+                             "stops renewing for this long loses its "
+                             "claims to peers (docs/SERVE.md)")
+    parser.add_argument("--poll-s", type=float, default=1.0,
+                        help="fleet maintenance tick: peer-record merge, "
+                             "dead-lease stealing, remote completions")
+    parser.add_argument("--info-file", default=None,
+                        help="where to write {pid, port, url, replica} "
+                             "(default ROOT/serve-info.json; give each "
+                             "replica of a fleet its own)")
     args = parser.parse_args(list(argv) if argv is not None else None)
 
     from .store_admin import _parse_bytes
@@ -83,6 +97,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         store_budget_bytes=budget,
         tenant_weights=_parse_tenant_weights(args.tenant_weight),
         max_attempts=args.max_attempts,
+        replica=args.replica_id,
+        lease_s=args.lease_s,
+        poll_s=args.poll_s,
+        info_path=args.info_file,
     )
     stop = threading.Event()
 

@@ -254,6 +254,29 @@ class QueueTransitionChecker(Checker):
         if not self.transitions or not self.queue_visited:
             return findings
         rel_queue = self.queue_rel
+        # declaration sanity: the table every other consumer derives
+        # from must be internally closed — an edge naming an undeclared
+        # state (or an initial state outside STATES) would let writes
+        # pass the per-site check while recovery and the crashcheck
+        # harness have no idea the state exists
+        if self.initial not in self.states:
+            f = Finding(
+                rule=self.rule, path=rel_queue, line=1,
+                message=f"INITIAL {self.initial!r} is not in the declared "
+                        "STATES tuple",
+                symbol="table-unsound")
+            findings.append(f)
+        for a, b in sorted(self.transitions):
+            for endpoint in (a, b):
+                if endpoint not in self.states:
+                    f = Finding(
+                        rule=self.rule, path=rel_queue, line=1,
+                        message=f"declared edge {a} -> {b} names "
+                                f"{endpoint!r}, which is not in STATES — "
+                                "declare the state or fix the edge",
+                        symbol="table-unsound")
+                    f.snippet = f"{a} -> {b}"
+                    findings.append(f)
         for a, b in sorted(self.transitions - self.implemented):
             f = Finding(
                 rule=self.rule, path=rel_queue, line=1,

@@ -40,10 +40,11 @@ import os
 import shutil
 import sys
 import tempfile
+import time
 from typing import Optional, Sequence
 
 from ..serve import queue as queue_mod
-from ..serve.queue import STATES, TRANSITIONS, DurableQueue
+from ..serve.queue import STATES, TERMINAL, TRANSITIONS, DurableQueue
 from ..utils.log import get_logger
 
 
@@ -80,80 +81,124 @@ def _unit(n: int) -> dict:
 def _scenario(q: DurableQueue) -> None:
     """Exercise every declared edge: enqueue/attach, claim, complete,
     retry-requeue, terminal fail, failed re-arm, done re-arm (eviction),
-    and a final drain."""
-    r1, _ = q.enqueue("p1", {"op": "t", "n": 1}, _unit(1), "t0", "normal",
-                      "req-a", "o1.bin")
-    r2, _ = q.enqueue("p2", {"op": "t", "n": 2}, _unit(2), "t0", "normal",
-                      "req-a", "o2.bin")
-    r3, _ = q.enqueue("p3", {"op": "t", "n": 3}, _unit(3), "t1", "high",
-                      "req-b", "o3.bin")
-    q.enqueue("p1", {"op": "t", "n": 1}, _unit(1), "t2", "normal",
-              "req-c", "o1.bin")                        # attach
-    q.claim([r1.job_id, r2.job_id])                     # queued -> running
-    q.complete(r1.job_id)                               # running -> done
-    q.fail(r2.job_id, "boom", requeue=True)             # running -> queued
-    q.claim([r2.job_id])
-    q.fail(r2.job_id, "boom again", requeue=False)      # running -> failed
-    q.enqueue("p2", {"op": "t", "n": 2}, _unit(2), "t0", "normal",
-              "req-d", "o2.bin")                        # failed -> queued
-    q.rearm(r1.job_id)                                  # done -> queued
-    # drain whatever is queued now
-    queued = [r.job_id for r in q.queued_snapshot()]
-    for rec in q.claim(queued):
-        q.complete(rec.job_id)
-    # r3 may still be queued if the drain claimed it already — complete
-    # anything left so the baseline run ends terminal
-    for rec in q.claim([r3.job_id]):
-        q.complete(rec.job_id)
+    permanent-failure quarantine + operator re-arm, an expired-lease
+    steal with the loser's settle fenced, and a final drain. Helper
+    replica handles are CLOSED in the finally (an injected crash kills
+    the whole process — their in-process liveness must die with it)."""
+    peer = DurableQueue(q.root, replica=f"peer-{os.path.basename(q.root)}",
+                        lease_s=0.05)
+    try:
+        r1, _ = q.enqueue("p1", {"op": "t", "n": 1}, _unit(1), "t0",
+                          "normal", "req-a", "o1.bin")
+        r2, _ = q.enqueue("p2", {"op": "t", "n": 2}, _unit(2), "t0",
+                          "normal", "req-a", "o2.bin")
+        r3, _ = q.enqueue("p3", {"op": "t", "n": 3}, _unit(3), "t1",
+                          "high", "req-b", "o3.bin")
+        q.enqueue("p1", {"op": "t", "n": 1}, _unit(1), "t2", "normal",
+                  "req-c", "o1.bin")                        # attach
+        q.claim([r1.job_id, r2.job_id])                     # queued -> running
+        q.complete(r1.job_id)                               # running -> done
+        q.fail(r2.job_id, "boom", requeue=True)             # running -> queued
+        q.claim([r2.job_id])
+        q.fail(r2.job_id, "boom again", requeue=False)      # running -> failed
+        q.enqueue("p2", {"op": "t", "n": 2}, _unit(2), "t0", "normal",
+                  "req-d", "o2.bin")                        # failed -> queued
+        q.rearm(r1.job_id)                                  # done -> queued
+        # permanent-failure taxonomy: quarantine, then operator re-arm
+        r4, _ = q.enqueue("p4", {"op": "t", "n": 4}, _unit(4), "t0",
+                          "normal", "req-e", "o4.bin")
+        q.claim([r4.job_id])
+        q.quarantine(r4.job_id, "bad params")       # running -> quarantined
+        q.rearm(r4.job_id)                          # quarantined -> queued
+        # lease fencing: the peer claims r4, its lease expires (0.05 s,
+        # no heartbeat), q steals it back, and the peer's settle is
+        # REFUSED by the epoch fence
+        peer.poll()
+        assert peer.claim([r4.job_id]), "peer could not claim r4"
+        time.sleep(0.12)                            # outlive the lease
+        stolen = q.poll()["stolen"]                 # running -> queued (steal)
+        assert stolen >= 1, "expired lease was not stolen"
+        fenced = peer.complete(r4.job_id)
+        assert fenced is None, "fenced settle was accepted"
+        # drain whatever is queued now
+        queued = [r.job_id for r in q.queued_snapshot()]
+        for rec in q.claim(queued):
+            q.complete(rec.job_id)
+        # r3 may still be queued if the drain claimed it already —
+        # complete anything left so the baseline run ends terminal
+        for rec in q.claim([r3.job_id]):
+            q.complete(rec.job_id)
+    finally:
+        peer.close()
 
 
 def _seed_interrupted_root(root: str) -> None:
     """A root as a SIGKILLed daemon leaves it: one record persisted as
-    'running' with its sentinel down — recovery must requeue it (the
+    'running' with its lease down — recovery must requeue it (the
     recovery-path atomic writes are fault-injected when DurableQueue
-    opens this root)."""
+    opens this root). close() without settling is the faithful kill:
+    the process's liveness dies, the on-disk record/lease stay."""
     q = DurableQueue(root)
     rec, _ = q.enqueue("pr", {"op": "t", "n": 9}, _unit(9), "t0", "normal",
                        "req-r", "o9.bin")
     q.claim([rec.job_id])
-    # abandon without settling: the record file says running, the
-    # sentinel exists — a faithful mid-execution kill
+    q.close()
 
 
 def _check_recovered(root: str, violations: list, where: str) -> None:
     q = DurableQueue(root)
-    with q._lock:
-        records = dict(q._jobs)
-        queued_idx = set(q._queued)
-    for job_id, rec in records.items():
-        if rec.state not in STATES:
-            violations.append(
-                f"{where}: {job_id} recovered into undeclared state "
-                f"{rec.state!r}")
-        if rec.state == "running":
-            violations.append(
-                f"{where}: {job_id} stranded in 'running' after recovery")
-        if os.path.isfile(q._sentinel_path(job_id)):
-            violations.append(
-                f"{where}: {job_id} sentinel survived recovery")
-        if (rec.state == "queued") != (job_id in queued_idx):
-            violations.append(
-                f"{where}: {job_id} state {rec.state!r} disagrees with "
-                "the queued index")
-    # the recovered queue must still drain to terminal states
-    for _ in range(len(records) + 1):
-        claimable = [r.job_id for r in q.queued_snapshot()]
-        if not claimable:
-            break
-        for rec in q.claim(claimable):
-            q.complete(rec.job_id)
-    with q._lock:
-        stuck = [
-            (job_id, rec.state) for job_id, rec in q._jobs.items()
-            if rec.state not in ("done", "failed")
-        ]
-    if stuck:
-        violations.append(f"{where}: records stuck after drain: {stuck}")
+    try:
+        with q._lock:
+            records = dict(q._jobs)
+            queued_idx = set(q._queued)
+        for job_id, rec in records.items():
+            if rec.state not in STATES:
+                violations.append(
+                    f"{where}: {job_id} recovered into undeclared state "
+                    f"{rec.state!r}")
+            if rec.state == "running":
+                # every owner in these roots is dead (closed), so a
+                # running record after recovery is stranded — a LIVE
+                # peer's lease is the only legitimate keeper
+                violations.append(
+                    f"{where}: {job_id} stranded in 'running' after "
+                    "recovery")
+            if os.path.isfile(q._sentinel_path(job_id)):
+                violations.append(
+                    f"{where}: {job_id} lease survived recovery")
+            if (rec.state == "queued") != (job_id in queued_idx):
+                violations.append(
+                    f"{where}: {job_id} state {rec.state!r} disagrees "
+                    "with the queued index")
+        # the recovered queue must still drain to terminal states
+        for _ in range(len(records) + 1):
+            claimable = [r.job_id for r in q.queued_snapshot()]
+            if not claimable:
+                break
+            for rec in q.claim(claimable):
+                q.complete(rec.job_id)
+        with q._lock:
+            stuck = [
+                (job_id, rec.state) for job_id, rec in q._jobs.items()
+                if rec.state not in TERMINAL
+            ]
+        if stuck:
+            violations.append(f"{where}: records stuck after drain: {stuck}")
+        # settle forensics: a terminal record's settled epoch must be
+        # the epoch the settling owner actually held — an accepted
+        # stale-epoch settle (a fenced zombie slipping through) shows
+        # up as a mismatch here
+        with q._lock:
+            for job_id, rec in q._jobs.items():
+                if rec.state in TERMINAL and \
+                        rec.settled_epoch is not None and \
+                        rec.settled_epoch != rec.epoch:
+                    violations.append(
+                        f"{where}: {job_id} settled under epoch "
+                        f"{rec.settled_epoch} but owns epoch {rec.epoch} "
+                        "— a fenced settle was accepted")
+    finally:
+        q.close()
 
 
 def run_crashcheck(workdir: Optional[str] = None,
@@ -170,14 +215,18 @@ def run_crashcheck(workdir: Optional[str] = None,
         counter = _FaultyWriter(real_writer)
         queue_mod.atomic_write_json = counter
         root = os.path.join(base, "count")
-        _scenario(DurableQueue(root))
+        q0 = DurableQueue(root)
+        try:
+            _scenario(q0)
+        finally:
+            q0.close()
         fault_points["scenario"] = counter.count
 
         rec_root = os.path.join(base, "rcount")
         _seed_interrupted_root(rec_root)
         rec_counter = _FaultyWriter(real_writer)
         queue_mod.atomic_write_json = rec_counter
-        DurableQueue(rec_root)  # recovery pass only
+        DurableQueue(rec_root).close()  # recovery pass only
         fault_points["recovery"] = rec_counter.count
 
         # -------- pass 1: scenario faults -------------------------------
@@ -189,10 +238,15 @@ def run_crashcheck(workdir: Optional[str] = None,
                 queue_mod.atomic_write_json = _FaultyWriter(
                     real_writer, fault_at=k, mode=mode)
                 died = False
+                qf = DurableQueue(root)
                 try:
-                    _scenario(DurableQueue(root))
+                    _scenario(qf)
                 except _InjectedCrash:
                     died = True
+                finally:
+                    # the injected death killed the whole process: its
+                    # in-process liveness dies with it, the disk stays
+                    qf.close()
                 queue_mod.atomic_write_json = real_writer
                 if not died:
                     violations.append(
@@ -211,7 +265,7 @@ def run_crashcheck(workdir: Optional[str] = None,
                 queue_mod.atomic_write_json = _FaultyWriter(
                     real_writer, fault_at=k, mode=mode)
                 try:
-                    DurableQueue(root)
+                    DurableQueue(root).close()
                 except _InjectedCrash:
                     pass
                 queue_mod.atomic_write_json = real_writer

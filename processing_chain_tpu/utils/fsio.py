@@ -8,7 +8,22 @@ import threading
 from typing import Callable, Optional
 
 
-def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
+def _fsync_path(path: str) -> None:
+    """Best-effort fsync of an existing path (file or directory)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, write_fn: Callable[[str], None],
+                 durable: bool = False) -> None:
     """Write `path` via temp-then-os.replace so an interrupted run never
     leaves a truncated file that a later run's exists-check would trust
     (same-directory temp keeps the replace atomic). `write_fn` receives
@@ -17,18 +32,34 @@ def atomic_write(path: str, write_fn: Callable[[str], None]) -> None:
     different threads (the serve daemon persists one request file from
     both the submit thread and scheduler callbacks) must never share a
     temp file, or one thread's os.replace promotes the other's
-    half-written bytes."""
+    half-written bytes.
+
+    `durable=True` additionally fsyncs the temp file BEFORE the replace
+    and (best-effort) the parent directory after it: tmp+rename alone is
+    SIGKILL-proof but not power-loss-proof — os.replace can promote a
+    rename whose data still sits in the page cache, and a crash then
+    serves a durable-looking empty/torn file. Writers whose artifacts
+    claim crash-proofness (durable queue records, serve request docs)
+    opt in; hot-path telemetry writers stay on the fast default."""
     tmp = f"{path}.part.{os.getpid()}.{threading.get_ident()}"
     try:
         write_fn(tmp)
+        if durable:
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
         os.replace(tmp, path)
     except BaseException:
         if os.path.isfile(tmp):
             os.unlink(tmp)
         raise
+    if durable:
+        _fsync_path(os.path.dirname(os.path.abspath(path)))
 
 
-def atomic_write_text(path: str, text: str) -> None:
+def atomic_write_text(path: str, text: str, durable: bool = False) -> None:
     """atomic_write of one pre-rendered string — the shape nearly every
     call site wants. Owns the open/close so no caller can forget the
     flush-before-replace (an unclosed `open(tmp).write(...)` leaves the
@@ -37,10 +68,11 @@ def atomic_write_text(path: str, text: str) -> None:
         with open(tmp, "w") as f:
             f.write(text)
 
-    atomic_write(path, _write)
+    atomic_write(path, _write, durable=durable)
 
 
-def atomic_write_json(path: str, obj, **json_kw) -> None:
+def atomic_write_json(path: str, obj, durable: bool = False,
+                      **json_kw) -> None:
     """atomic_write of one JSON document (indent=1 default to match the
     chain's artifact style)."""
     json_kw.setdefault("indent", 1)
@@ -49,7 +81,7 @@ def atomic_write_json(path: str, obj, **json_kw) -> None:
         with open(tmp, "w") as f:
             json.dump(obj, f, **json_kw)
 
-    atomic_write(path, _write)
+    atomic_write(path, _write, durable=durable)
 
 
 def last_json_line(text: Optional[str]) -> Optional[dict]:

@@ -52,7 +52,20 @@ class Task:
 
 
 class ChainError(RuntimeError):
-    """Raised when any task in a fail-fast batch fails."""
+    """Raised when any task in a fail-fast batch fails.
+
+    `kind` is the failure-taxonomy surface (docs/SERVE.md "Failure
+    taxonomy"): raisers that KNOW whether a failure is worth retrying
+    tag it `"transient"` (disk pressure, device unavailable, OOM — the
+    same inputs may succeed later) or `"permanent"` (bad params,
+    corrupt SRC — retrying burns the attempts budget on a determined
+    outcome). `None` means the raiser made no claim; consumers fall
+    back to exception-type heuristics (serve/scheduler.classify_failure).
+    """
+
+    def __init__(self, *args, kind: Optional[str] = None) -> None:
+        super().__init__(*args)
+        self.kind = kind
 
 
 class ParallelRunner:

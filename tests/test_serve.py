@@ -203,7 +203,10 @@ def test_queue_recovery_requeues_interrupted_jobs(tmp_path):
     assert os.path.isfile(os.path.join(
         root, "jobs", rec_a.job_id + ".json.inprogress"
     ))
-    # daemon dies here; a new queue on the same root recovers
+    # daemon dies here (close() drops the in-process liveness a LIVE
+    # replica's lease would rightly keep); a new queue on the same
+    # root recovers
+    queue.close()
     reloaded = DurableQueue(root)
     assert reloaded.recovery["requeued"] == 1
     rec_a2 = reloaded.record(rec_a.job_id)
@@ -788,13 +791,18 @@ def test_daemon_recovery_requeues_with_attempt_bump(tmp_path):
     root = str(tmp_path / "q")
     queue = DurableQueue(root)
     rec, _ = _enqueue(queue, "k" * 64, "req-1")
-    queue.claim([rec.job_id])
-    # simulate death: drop the in-memory queue, keep the disk state
+    claimed_epoch = queue.claim([rec.job_id])[0].epoch
+    # simulate death: release the liveness claims, keep the disk state
+    queue.close()
     del queue
     reloaded = DurableQueue(root)
     assert reloaded.recovery == {"jobs": 1, "requeued": 1, "done": 0,
-                                 "failed": 0}
-    assert reloaded.record(rec.job_id).state == "queued"
+                                 "failed": 0, "quarantined": 0, "peer": 0}
+    recovered = reloaded.record(rec.job_id)
+    assert recovered.state == "queued"
+    # recovery FENCES the dead owner: its epoch moved on, so a zombie
+    # twin of the old daemon could never settle this record
+    assert recovered.epoch > claimed_epoch
     assert reloaded.queued_snapshot()[0].attempts == 1
 
 
