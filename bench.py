@@ -1033,6 +1033,63 @@ def host_bench() -> dict:
     return out
 
 
+def complexity_bench() -> dict:
+    """Complexity classification: CRF-23 proxy re-encode vs codec priors
+    (`bench.py --complexity-bench`, docs/PRIORS.md). One synthetic x264
+    SRC goes through both paths; the tracked number is the wall-time
+    ratio `priors_vs_proxy` (how much faster proxy-free classification
+    answers), gated by `tools bench-compare` as the
+    `complexity.priors_vs_proxy` band. Also asserts both paths yield a
+    finite complexity value so the gate can't pass on a silent no-op."""
+    import tempfile
+
+    from processing_chain_tpu.io.video import VideoWriter
+    from processing_chain_tpu.tools import complexity as cx
+
+    n, w, h = 96, 640, 360
+    rng = np.random.default_rng(7)
+    base = rng.integers(0, 255, (h, w * 3), np.uint8)
+    base = ((base.astype(np.float32) + np.roll(base, 1, 0)
+             + np.roll(base, 1, 1)) / 3.0 + 40).astype(np.uint8)
+    out: dict = {"metric": "complexity: priors vs CRF-23 proxy",
+                 "frames": n, "geometry": f"{w}x{h}"}
+    with tempfile.TemporaryDirectory(prefix="pc_cx_bench_") as root:
+        src = os.path.join(root, "src.mp4")
+        with VideoWriter(src, "libx264", w, h, "yuv420p", (24, 1),
+                         gop=96, bframes=0, opts="crf=23:preset=fast") as wr:
+            u = np.full((h // 2, w // 2), 128, np.uint8)
+            for i in range(n):
+                y = np.ascontiguousarray(base[:, 3 * i:3 * i + w])
+                wr.write(y, u, u)
+
+        # min of two runs per path: the first priors pass pays the jax
+        # trace/compile of the MV feature kernels (a once-per-process
+        # cost a corpus amortizes away); the proxy path gets the same
+        # steady-state treatment
+        proxy_s, priors_s = [], []
+        for k in (0, 1):
+            t0 = time.perf_counter()
+            proxy = os.path.join(root, f"src_crf23_{k}.avi")
+            cx.proxy_encode(src, proxy)
+            rec_proxy = cx.get_difficulty(proxy, src)
+            proxy_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            rec_priors = cx.get_priors_difficulty(src, force=True)
+            priors_s.append(time.perf_counter() - t0)
+        out["proxy_s"] = round(min(proxy_s), 4)
+        out["priors_s"] = round(min(priors_s), 4)
+
+    out["proxy_complexity"] = round(float(rec_proxy["complexity"]), 4)
+    out["priors_complexity"] = round(float(rec_priors["complexity"]), 4)
+    out["both_finite"] = bool(
+        np.isfinite(rec_proxy["complexity"])
+        and np.isfinite(rec_priors["complexity"])
+    )
+    out["priors_vs_proxy"] = round(out["proxy_s"] / max(out["priors_s"], 1e-9), 2)
+    out["host"] = _host_fingerprint()
+    return out
+
+
 def main() -> None:
     cpu_env = {"JAX_PLATFORMS": "cpu"}
 
@@ -1257,6 +1314,8 @@ if __name__ == "__main__":
         print(json.dumps(_out))
     elif "--host-bench" in sys.argv:
         print(json.dumps(host_bench()))
+    elif "--complexity-bench" in sys.argv:
+        print(json.dumps(complexity_bench()))
     elif "--pin-baseline" in sys.argv:
         print(json.dumps(pin_baseline(), indent=1))
     else:

@@ -247,10 +247,10 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
 def _dispatch_tool(argv: Sequence[str]) -> int:
     """`tools <name> …` subcommands (reference util/ scripts)."""
     tools = (
-        "src-analysis", "complexity", "plots", "metrics", "clean-logs",
-        "run-report", "store", "chain-top", "chain-profile", "bench-compare",
-        "chain-lint", "chain-serve", "serve-soak", "queue-crashcheck",
-        "serve-chaos",
+        "src-analysis", "complexity", "priors", "plots", "metrics",
+        "clean-logs", "run-report", "store", "chain-top", "chain-profile",
+        "bench-compare", "chain-lint", "chain-serve", "serve-soak",
+        "queue-crashcheck", "serve-chaos",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -306,6 +306,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import complexity
 
             return complexity.main(rest)
+        if name == "priors":
+            from .tools import priors_tool
+
+            return priors_tool.main(rest)
         if name == "metrics":
             from .utils.device import ensure_backend
 

@@ -103,12 +103,54 @@ def get_framesize_vp9(filename: str, force: bool = False) -> list[int]:
     return sizes
 
 
+def ffprobe_av1_frame_info(filename: str, timeout: float = 300.0) -> dict:
+    """ffprobe fallback for AV1 frame metadata, routed through the
+    chain's one subprocess door (`utils.runner.shell` — list argv,
+    bounded wall time, ChainError on failure; the subprocess-hygiene
+    rule). ONE `-show_frames` pass yields `{"size": [...],
+    "pict_type": [...]}` so priors consumers get AV1 frame types without
+    a second probe. Raises ChainError when ffprobe is absent/failing."""
+    from ..utils.runner import shell
+
+    proc = shell(
+        [
+            "ffprobe", "-v", "error", "-select_streams", "v:0",
+            "-show_frames", "-show_entries", "frame=pkt_size,pict_type",
+            "-of", "csv=p=0", filename,
+        ],
+        timeout=timeout,
+    )
+    sizes: list[int] = []
+    picts: list[str] = []
+    for line in proc.stdout.splitlines():
+        if not line.strip():
+            continue
+        size, pict = None, "?"
+        for tok in line.strip().split(","):
+            tok = tok.strip()
+            if tok.isdigit():
+                size = int(tok)
+            elif tok:
+                pict = tok
+        # one csv line == one frame: a frame whose pkt_size prints as
+        # N/A must still occupy its slot (size 0), or every consumer
+        # indexing frames by position desyncs past it
+        if size is not None or pict != "?":
+            sizes.append(size if size is not None else 0)
+            picts.append(pict if pict != "N/A" else "?")
+    return {"size": sizes, "pict_type": picts}
+
+
 def get_framesize_av1(filename: str, force: bool = False) -> list[int]:
-    """AV1: packet sizes from the demuxer (reference :266-274 falls back to
-    ffprobe pkt_size). `force` is unused (the demuxer scan is always exact);
-    the default matches the three sibling parsers so a keyword caller sees
-    uniform behavior."""
-    return [int(s) for s in medialib.scan_packets(filename, "video")["size"]]
+    """AV1: packet sizes from the native demuxer (reference :266-274 falls
+    back to ffprobe pkt_size — kept here as the degrade path when the
+    native boundary cannot load, via `ffprobe_av1_frame_info`). `force` is
+    unused (the demuxer scan is always exact); the default matches the
+    three sibling parsers so a keyword caller sees uniform behavior."""
+    try:
+        return [int(s) for s in medialib.scan_packets(filename, "video")["size"]]
+    except medialib.MediaError:
+        return ffprobe_av1_frame_info(filename)["size"]
 
 
 def get_framesizes(filename: str, codec: str, force: bool = False) -> list[int]:

@@ -93,6 +93,42 @@ class MPVideoDesc(ct.Structure):
     ]
 
 
+class MPPriorsFrame(ct.Structure):
+    """Per-frame codec-prior record (native MPPriorsFrame). Field layout is
+    triple-mirrored — C struct, this ctypes Structure, and PRIORS_DTYPE —
+    with mp_priors_record_size as the ABI handshake."""
+
+    _fields_ = [
+        ("pts", ct.c_double),
+        ("pkt_size", ct.c_int64),
+        ("pict_type", ct.c_int32),
+        ("key_frame", ct.c_int32),
+        ("mv_count", ct.c_int32),
+        ("qp_blocks", ct.c_int32),
+        ("qp_mean", ct.c_double),
+        ("qp_var", ct.c_double),
+        ("width", ct.c_int32),
+        ("height", ct.c_int32),
+    ]
+
+
+#: numpy view of MPPriorsFrame, so a batch of records IS a structured array
+#: (no per-record Python unpacking on the hot path)
+PRIORS_DTYPE = np.dtype(
+    {
+        "names": ["pts", "pkt_size", "pict_type", "key_frame", "mv_count",
+                  "qp_blocks", "qp_mean", "qp_var", "width", "height"],
+        "formats": ["<f8", "<i8", "<i4", "<i4", "<i4", "<i4", "<f8", "<f8",
+                    "<i4", "<i4"],
+    },
+    align=True,
+)
+
+#: int32 fields per MV row (native PC_MV_FIELDS):
+#: src_x, src_y, dst_x, dst_y, w, h, source
+MV_FIELDS = 7
+
+
 class MediaError(RuntimeError):
     pass
 
@@ -258,6 +294,33 @@ def ensure_loaded() -> ct.CDLL:
                 f"libpcmedia.so predates mp_decode_audio_s16_ch; rebuild "
                 f"with `make -B -C {_NATIVE_DIR}`"
             ) from exc
+        try:
+            # the codec-prior boundary (docs/PRIORS.md) lands as one unit
+            prec_size = lib.mp_priors_record_size()
+            lib.mp_decoder_open_priors.restype = ct.c_void_p
+            lib.mp_decoder_open_priors.argtypes = [
+                ct.c_char_p, ct.c_int, ct.c_char_p, ct.c_int,
+            ]
+            lib.mp_priors_next_batch.restype = ct.c_long
+            lib.mp_priors_next_batch.argtypes = [
+                ct.c_void_p, ct.POINTER(MPPriorsFrame), ct.c_long,
+                ct.POINTER(ct.c_int32), ct.c_long, ct.c_char_p, ct.c_int,
+            ]
+            lib.mp_priors_close.restype = None
+            lib.mp_priors_close.argtypes = [ct.c_void_p]
+        except AttributeError as exc:
+            raise MediaError(
+                f"libpcmedia.so predates the codec-prior boundary; rebuild "
+                f"with `make -B -C {_NATIVE_DIR}`"
+            ) from exc
+        if prec_size != ct.sizeof(MPPriorsFrame) or \
+                prec_size != PRIORS_DTYPE.itemsize:
+            raise MediaError(
+                f"libpcmedia.so priors-record ABI mismatch (native "
+                f"{prec_size} != ctypes {ct.sizeof(MPPriorsFrame)} / numpy "
+                f"{PRIORS_DTYPE.itemsize}); rebuild with "
+                f"`make -B -C {_NATIVE_DIR}`"
+            )
         lib.mp_encoder_open.restype = ct.c_void_p
         lib.mp_encoder_open.argtypes = [
             ct.c_char_p, ct.c_char_p, ct.c_int, ct.c_int, ct.c_char_p,
@@ -528,6 +591,55 @@ def extract_ivf(path: str, out_path: str) -> None:
     err = _err_buf()
     if lib.mp_extract_ivf(path.encode(), out_path.encode(), err, 512) < 0:
         raise MediaError(f"extract_ivf({path}): {err.value.decode()}")
+
+
+class PriorsBufferTooSmall(MediaError):
+    """A single frame carries more MV rows than the caller's buffer holds;
+    the frame is parked natively — grow the buffer and call again, nothing
+    is lost."""
+
+
+def priors_open(path: str, threads: int = 0) -> int:
+    """Open `path` for codec-prior extraction (MV/QP/frame-type side data;
+    docs/PRIORS.md). Returns an opaque handle for priors_next_batch /
+    priors_close."""
+    lib = ensure_loaded()
+    err = _err_buf()
+    handle = lib.mp_decoder_open_priors(path.encode(), threads, err, 512)
+    if not handle:
+        raise MediaError(f"open_priors({path}): {err.value.decode()}")
+    return handle
+
+
+def priors_next_batch(handle: int, records: np.ndarray,
+                      mv_rows: np.ndarray) -> int:
+    """Fill up to len(records) per-frame prior records (PRIORS_DTYPE) and
+    their MV rows ([cap, MV_FIELDS] int32, frame order — records'
+    `mv_count` delimits per-frame spans) in ONE native call / one GIL
+    release. Returns frames filled; 0 = EOF. Raises PriorsBufferTooSmall
+    when one frame alone overflows `mv_rows` (retry with a bigger block)."""
+    lib = ensure_loaded()
+    assert records.dtype == PRIORS_DTYPE and records.flags["C_CONTIGUOUS"]
+    assert mv_rows.dtype == np.int32 and mv_rows.ndim == 2 \
+        and mv_rows.shape[1] == MV_FIELDS and mv_rows.flags["C_CONTIGUOUS"]
+    err = _err_buf()
+    n = lib.mp_priors_next_batch(
+        handle,
+        records.ctypes.data_as(ct.POINTER(MPPriorsFrame)), records.shape[0],
+        mv_rows.ctypes.data_as(ct.POINTER(ct.c_int32)), mv_rows.shape[0],
+        err, 512,
+    )
+    if n == -2:
+        raise PriorsBufferTooSmall(err.value.decode())
+    if n < 0:
+        raise MediaError(f"priors_next_batch: {err.value.decode()}")
+    return int(n)
+
+
+def priors_close(handle: int) -> None:
+    lib = ensure_loaded()
+    if handle:
+        lib.mp_priors_close(handle)
 
 
 def decode_audio_s16(path: str, start: float = 0.0, duration: float = 0.0,

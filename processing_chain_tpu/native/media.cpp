@@ -27,10 +27,18 @@ extern "C" {
 #include <libavcodec/bsf.h>
 #include <libavformat/avformat.h>
 #include <libavutil/imgutils.h>
+#include <libavutil/motion_vector.h>
 #include <libavutil/opt.h>
 #include <libavutil/pixdesc.h>
 #include <libswresample/swresample.h>
 #include <libswscale/swscale.h>
+// AVVideoEncParams (per-block QP export) landed in FFmpeg 4.3 (lavu 56.45);
+// older 4.x hosts compile the QP aggregation away and report qp_blocks = 0.
+#if LIBAVUTIL_VERSION_MAJOR > 56 || \
+    (LIBAVUTIL_VERSION_MAJOR == 56 && LIBAVUTIL_VERSION_MINOR >= 45)
+#define PC_HAVE_VIDEO_ENC_PARAMS 1
+#include <libavutil/video_enc_params.h>
+#endif
 }
 
 #include <condition_variable>
@@ -679,6 +687,276 @@ EXPORT long mp_decoder_next_batch(MPDecoder* d, uint8_t* p0, uint8_t* p1,
 }
 
 EXPORT void mp_decoder_close(MPDecoder* d) {
+    if (!d) return;
+    av_packet_free(&d->pkt);
+    av_frame_free(&d->frame);
+    avcodec_free_context(&d->dec);
+    avformat_close_input(&d->fmt);
+    delete d;
+}
+
+// ---------------------------------------------------------------------------
+// Codec-prior extraction (docs/PRIORS.md): the decode the chain already pays
+// for also computes motion vectors and per-block QP — this decoder mode
+// exports them as frame side data (AV_CODEC_FLAG2_EXPORT_MVS +
+// AVVideoEncParams) instead of discarding them. No pixel planes cross the
+// boundary: one batch call returns fixed-size per-frame records plus the
+// frames' ragged MV rows, one GIL release per chunk like
+// mp_decoder_next_batch. MV export covers the mpegvideo/h264 decoder
+// families; codecs whose native decoders do not export MVs (hevc, vp9,
+// av1) still yield frame types / packet sizes / QP-when-available.
+// ---------------------------------------------------------------------------
+
+// Per-frame prior record. Mirrored as a ctypes Structure AND a numpy
+// structured dtype in io/medialib.py; mp_priors_record_size is the ABI
+// handshake that keeps the three layouts from drifting.
+struct MPPriorsFrame {
+    double pts;          // seconds (best-effort), NaN when unset
+    int64_t pkt_size;    // compressed bytes of this frame's packet (0 unknown)
+    int32_t pict_type;   // AV_PICTURE_TYPE_*: 1 I, 2 P, 3 B, 0 unknown
+    int32_t key_frame;
+    int32_t mv_count;    // MV rows emitted for this frame
+    int32_t qp_blocks;   // QP samples aggregated (0 = no QP side data)
+    double qp_mean;      // mean per-block QP, -1 when absent
+    double qp_var;       // population variance of per-block QP, -1 when absent
+    int32_t width, height;
+};
+
+//: int32 fields per MV row: src_x, src_y, dst_x, dst_y, w, h, source
+#define PC_MV_FIELDS 7
+
+struct MPPriorsDec {
+    AVFormatContext* fmt = nullptr;
+    AVCodecContext* dec = nullptr;
+    int sidx = -1;
+    AVPacket* pkt = nullptr;
+    AVFrame* frame = nullptr;
+    bool draining = false;
+    // pts/dts -> packet size, so records carry compressed frame sizes
+    // without depending on the deprecated AVFrame.pkt_size (bounded: the
+    // decoder's reorder depth keeps this to a handful of entries)
+    std::map<int64_t, int64_t> pkt_sizes;
+    // a decoded frame whose MV rows did not fit the caller's buffer is
+    // parked here and re-emitted first on the next call — streaming stays
+    // exact under any caller buffer size
+    bool have_pending = false;
+    MPPriorsFrame pending{};
+    std::vector<int32_t> pending_mv;
+};
+
+EXPORT int mp_priors_record_size(void) { return (int)sizeof(MPPriorsFrame); }
+
+EXPORT MPPriorsDec* mp_decoder_open_priors(const char* path, int threads,
+                                           char* err, int errlen) {
+    auto* d = new MPPriorsDec();
+    int ret = avformat_open_input(&d->fmt, path, nullptr, nullptr);
+    if (ret < 0) {
+        set_err(err, errlen, "open_input: " + av_errstr(ret));
+        delete d;
+        return nullptr;
+    }
+    if ((ret = avformat_find_stream_info(d->fmt, nullptr)) < 0) {
+        set_err(err, errlen, "find_stream_info: " + av_errstr(ret));
+        avformat_close_input(&d->fmt);
+        delete d;
+        return nullptr;
+    }
+    const AVCodec* codec = nullptr;
+    d->sidx = pc_find_best_stream(d->fmt, AVMEDIA_TYPE_VIDEO, &codec);
+    if (d->sidx < 0 || !codec) {
+        set_err(err, errlen, "no video stream");
+        avformat_close_input(&d->fmt);
+        delete d;
+        return nullptr;
+    }
+    d->dec = avcodec_alloc_context3(codec);
+    avcodec_parameters_to_context(d->dec, d->fmt->streams[d->sidx]->codecpar);
+    d->dec->thread_count = threads >= 0 ? threads : 0;
+    // the whole point of this mode: ask the decoder to keep what it
+    // already computed
+    d->dec->flags2 |= AV_CODEC_FLAG2_EXPORT_MVS;
+#if defined(PC_HAVE_VIDEO_ENC_PARAMS) && defined(AV_CODEC_EXPORT_DATA_VIDEO_ENC_PARAMS)
+    d->dec->export_side_data |= AV_CODEC_EXPORT_DATA_VIDEO_ENC_PARAMS;
+#endif
+    if ((ret = avcodec_open2(d->dec, codec, nullptr)) < 0) {
+        set_err(err, errlen, "avcodec_open2: " + av_errstr(ret));
+        avcodec_free_context(&d->dec);
+        avformat_close_input(&d->fmt);
+        delete d;
+        return nullptr;
+    }
+    d->pkt = av_packet_alloc();
+    d->frame = av_frame_alloc();
+    return d;
+}
+
+// Decode the next frame and fill (rec, mv). Returns 1 frame, 0 EOF, <0 error.
+static int priors_next_frame(MPPriorsDec* d, MPPriorsFrame* rec,
+                             std::vector<int32_t>& mv, char* err, int errlen) {
+    AVRational tb = d->fmt->streams[d->sidx]->time_base;
+    for (;;) {
+        int ret = avcodec_receive_frame(d->dec, d->frame);
+        if (ret == 0) {
+            memset(rec, 0, sizeof(*rec));
+            int64_t ts = d->frame->best_effort_timestamp != AV_NOPTS_VALUE
+                             ? d->frame->best_effort_timestamp
+                             : d->frame->pts;
+            rec->pts = ts_to_sec(ts, tb);
+            rec->pict_type = (int32_t)d->frame->pict_type;
+#if LIBAVCODEC_VERSION_MAJOR >= 60
+            rec->key_frame = (d->frame->flags & AV_FRAME_FLAG_KEY) ? 1 : 0;
+#else
+            rec->key_frame = d->frame->key_frame ? 1 : 0;
+#endif
+            rec->width = d->frame->width;
+            rec->height = d->frame->height;
+            rec->qp_mean = -1.0;
+            rec->qp_var = -1.0;
+            if (ts != AV_NOPTS_VALUE) {
+                auto it = d->pkt_sizes.find(ts);
+                if (it != d->pkt_sizes.end()) {
+                    rec->pkt_size = it->second;
+                    d->pkt_sizes.erase(it);
+                }
+            }
+            if (const AVFrameSideData* sd = av_frame_get_side_data(
+                    d->frame, AV_FRAME_DATA_MOTION_VECTORS)) {
+                const AVMotionVector* mvs = (const AVMotionVector*)sd->data;
+                size_t n = sd->size / sizeof(*mvs);
+                mv.reserve(mv.size() + n * PC_MV_FIELDS);
+                for (size_t i = 0; i < n; i++) {
+                    mv.push_back((int32_t)mvs[i].src_x);
+                    mv.push_back((int32_t)mvs[i].src_y);
+                    mv.push_back((int32_t)mvs[i].dst_x);
+                    mv.push_back((int32_t)mvs[i].dst_y);
+                    mv.push_back((int32_t)mvs[i].w);
+                    mv.push_back((int32_t)mvs[i].h);
+                    mv.push_back((int32_t)mvs[i].source);
+                }
+                rec->mv_count = (int32_t)n;
+            }
+#ifdef PC_HAVE_VIDEO_ENC_PARAMS
+            if (const AVFrameSideData* sd = av_frame_get_side_data(
+                    d->frame, AV_FRAME_DATA_VIDEO_ENC_PARAMS)) {
+                AVVideoEncParams* par = (AVVideoEncParams*)sd->data;
+                double sum = 0.0, sumsq = 0.0;
+                long nq = 0;
+                if (par->nb_blocks > 0) {
+                    for (unsigned i = 0; i < par->nb_blocks; i++) {
+                        const AVVideoBlockParams* b =
+                            av_video_enc_params_block(par, i);
+                        double q = (double)par->qp + (double)b->delta_qp;
+                        sum += q;
+                        sumsq += q * q;
+                        nq++;
+                    }
+                } else {
+                    sum = (double)par->qp;
+                    sumsq = sum * sum;
+                    nq = 1;
+                }
+                if (nq > 0) {
+                    double mean = sum / nq;
+                    double var = sumsq / nq - mean * mean;
+                    rec->qp_mean = mean;
+                    rec->qp_var = var > 0.0 ? var : 0.0;
+                    rec->qp_blocks = (int32_t)nq;
+                }
+            }
+#endif
+            av_frame_unref(d->frame);
+            return 1;
+        }
+        if (ret == AVERROR_EOF) return 0;
+        if (ret != AVERROR(EAGAIN)) {
+            set_err(err, errlen, "receive_frame: " + av_errstr(ret));
+            return -1;
+        }
+        if (d->draining) return 0;
+        int rret = av_read_frame(d->fmt, d->pkt);
+        if (rret < 0) {
+            d->draining = true;
+            avcodec_send_packet(d->dec, nullptr);
+            continue;
+        }
+        if (d->pkt->stream_index == d->sidx) {
+            int64_t key = d->pkt->pts != AV_NOPTS_VALUE ? d->pkt->pts
+                                                        : d->pkt->dts;
+            // bound the map: a stream whose timestamps never match its
+            // frames (breaking the erase-on-hit) must not grow unbounded
+            if (key != AV_NOPTS_VALUE && d->pkt_sizes.size() < 4096)
+                d->pkt_sizes[key] = d->pkt->size;
+            int sret = avcodec_send_packet(d->dec, d->pkt);
+            if (sret < 0 && sret != AVERROR(EAGAIN)) {
+                av_packet_unref(d->pkt);
+                set_err(err, errlen, "send_packet: " + av_errstr(sret));
+                return -1;
+            }
+        }
+        av_packet_unref(d->pkt);
+    }
+}
+
+// Up to `max_frames` per-frame records in ONE call. MV rows land
+// contiguously in mv_buf ([mv_cap_rows, PC_MV_FIELDS] int32, frame order;
+// frame i's rows start after the rows of frames 0..i-1 of THIS call —
+// recs[i].mv_count delimits them). Returns frames filled (0 = EOF), -1 on
+// decode error, or -2 when a single frame carries more MV rows than
+// mv_cap_rows (the frame is parked; the caller grows its buffer and
+// retries with nothing lost).
+EXPORT long mp_priors_next_batch(MPPriorsDec* d, MPPriorsFrame* recs,
+                                 long max_frames, int32_t* mv_buf,
+                                 long mv_cap_rows, char* err, int errlen) {
+    long n = 0, rows = 0;
+    if (max_frames <= 0) return 0;
+    if (d->have_pending) {
+        long need = d->pending.mv_count;
+        if (need > mv_cap_rows) {
+            set_err(err, errlen,
+                    "mv buffer too small: frame carries " +
+                        std::to_string(need) + " motion vectors");
+            return -2;
+        }
+        recs[n] = d->pending;
+        if (!d->pending_mv.empty())
+            memcpy(mv_buf, d->pending_mv.data(),
+                   d->pending_mv.size() * sizeof(int32_t));
+        rows = need;
+        n = 1;
+        d->have_pending = false;
+        d->pending_mv.clear();
+    }
+    std::vector<int32_t> mv;
+    while (n < max_frames) {
+        MPPriorsFrame rec;
+        mv.clear();
+        int ret = priors_next_frame(d, &rec, mv, err, errlen);
+        if (ret < 0) return ret;
+        if (ret == 0) break;
+        if (rows + rec.mv_count > mv_cap_rows) {
+            d->pending = rec;
+            d->pending_mv = mv;
+            d->have_pending = true;
+            if (n == 0) {
+                set_err(err, errlen,
+                        "mv buffer too small: frame carries " +
+                            std::to_string(rec.mv_count) +
+                            " motion vectors");
+                return -2;
+            }
+            break;
+        }
+        recs[n] = rec;
+        if (!mv.empty())
+            memcpy(mv_buf + (size_t)rows * PC_MV_FIELDS, mv.data(),
+                   mv.size() * sizeof(int32_t));
+        rows += rec.mv_count;
+        n++;
+    }
+    return n;
+}
+
+EXPORT void mp_priors_close(MPPriorsDec* d) {
     if (!d) return;
     av_packet_free(&d->pkt);
     av_frame_free(&d->frame);

@@ -1,0 +1,23 @@
+"""Codec-prior extraction: mine MV/QP/frame-type metadata from the
+bitstreams the chain already decodes (docs/PRIORS.md).
+
+The decode loop the chain pays for anyway also computes motion vectors
+and per-block QP; this package exports them through the native boundary
+(`mp_decoder_open_priors`), persists them as a compact `.priors.npz`
+sidecar committed to the content-addressed store, and feeds them to
+device-side consumers — MV-informed temporal features next to SI/TI
+(`priors.features`) and complexity classification without the CRF-23
+proxy re-encode (`tools complexity --priors`).
+"""
+
+from .model import (  # noqa: F401
+    PRIORS_SCHEMA_VERSION,
+    SIDECAR_SUFFIX,
+    PriorsData,
+    ensure_priors,
+    load_priors,
+    priors_plan,
+    save_priors,
+    sidecar_path,
+)
+from .extract import extract_priors  # noqa: F401

@@ -1,0 +1,132 @@
+"""Streaming reader over the native codec-prior decoder.
+
+One `mp_priors_next_batch` call per chunk (one ctypes crossing, one GIL
+release — the same batch-crossing discipline as `mp_decoder_next_batch`),
+records and MV rows landing in pooled numpy blocks (io/bufpool.py). No
+pixel planes cross the boundary: a priors pass over a clip moves a few
+hundred KB, not gigabytes, which is why complexity classification on top
+of it needs no proxy re-encode.
+
+MV coverage is decoder-dependent: FFmpeg's h264/mpegvideo families
+export motion vectors; the native hevc/vp9/av1 decoders do not (their
+records still carry frame types, packet sizes and QP where available).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .. import telemetry as tm
+from ..io import medialib
+from ..io.bufpool import DEFAULT_POOL, BufferPool
+
+_FRAMES = tm.counter(
+    "chain_priors_frames_total", "frames whose coding metadata was extracted"
+)
+_MVS = tm.counter(
+    "chain_priors_mvs_total", "motion vectors extracted from bitstreams"
+)
+
+#: initial MV block capacity (rows). 1<<16 rows ≈ 1.8 MB and holds ~8
+#: 1080p frames' worth of 16x16-block MVs; a denser frame triggers the
+#: grow-and-retry path (PriorsBufferTooSmall), nothing is lost.
+_MV_CAP0 = 1 << 16
+
+
+def default_chunk_frames() -> int:
+    """Frames per native priors crossing. Chunk granularity never changes
+    the extracted records — only how many ctypes crossings a clip costs —
+    so the knob stays out of the plan (same contract as PC_CHUNK_FRAMES)."""
+    # plan-exempt: (crossing granularity only; the record stream is identical at any chunking — pinned by the chunking-parity test)
+    raw = os.environ.get("PC_PRIORS_CHUNK", "").strip()
+    try:
+        return max(1, int(raw)) if raw else 256
+    except ValueError:
+        return 256
+
+
+def iter_priors_chunks(
+    path: str,
+    chunk_frames: Optional[int] = None,
+    pool: Optional[BufferPool] = None,
+    threads: int = 0,
+) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Yield (records, mv_rows) per chunk: records is a PRIORS_DTYPE
+    structured array of n frames, mv_rows an [m, MV_FIELDS] int32 array
+    holding those frames' MVs in frame order (records["mv_count"]
+    delimits per-frame spans). The yielded arrays are trimmed VIEWS of
+    pooled blocks — consumers copy what they keep; the backing blocks are
+    released when the generator advances."""
+    chunk = chunk_frames or default_chunk_frames()
+    pool = pool or DEFAULT_POOL
+    handle = medialib.priors_open(path, threads=threads)
+    mv_cap = _MV_CAP0
+    try:
+        recs = pool.acquire((chunk,), medialib.PRIORS_DTYPE)
+        mv = pool.acquire((mv_cap, medialib.MV_FIELDS), np.int32)
+        try:
+            while True:
+                try:
+                    n = medialib.priors_next_batch(handle, recs, mv)
+                except medialib.PriorsBufferTooSmall:
+                    # one frame alone overflowed the MV block: double it
+                    # and retry (the frame is parked natively)
+                    pool.release(mv)
+                    mv_cap *= 2
+                    mv = pool.acquire((mv_cap, medialib.MV_FIELDS), np.int32)
+                    continue
+                if n == 0:
+                    break
+                rows = int(recs["mv_count"][:n].sum())
+                if tm.enabled():
+                    _FRAMES.inc(n)
+                    _MVS.inc(rows)
+                yield recs[:n], mv[:rows]
+        finally:
+            pool.release(recs, mv)
+    finally:
+        medialib.priors_close(handle)
+
+
+def extract_priors(path: str, chunk_frames: Optional[int] = None,
+                   pool: Optional[BufferPool] = None, threads: int = 0):
+    """Extract the full per-frame prior stream of `path` into a PriorsData
+    (priors/model.py). One native crossing per chunk; memory stays bounded
+    by the chunk size, not the clip length."""
+    from .model import PriorsData  # late: model imports store, keep cheap
+
+    rec_parts: list[np.ndarray] = []
+    mv_parts: list[np.ndarray] = []
+    for recs, mv in iter_priors_chunks(
+        path, chunk_frames=chunk_frames, pool=pool, threads=threads
+    ):
+        rec_parts.append(recs.copy())
+        mv_parts.append(mv.copy())
+    if rec_parts:
+        records = np.concatenate(rec_parts)
+        mv_rows = (
+            np.concatenate(mv_parts)
+            if mv_parts
+            else np.empty((0, medialib.MV_FIELDS), np.int32)
+        )
+    else:
+        records = np.empty(0, medialib.PRIORS_DTYPE)
+        mv_rows = np.empty((0, medialib.MV_FIELDS), np.int32)
+    offsets = np.zeros(len(records) + 1, np.int64)
+    np.cumsum(records["mv_count"], out=offsets[1:])
+    return PriorsData(
+        width=int(records["width"][0]) if len(records) else 0,
+        height=int(records["height"][0]) if len(records) else 0,
+        pts=records["pts"].astype(np.float64),
+        pict_type=records["pict_type"].astype(np.int8),
+        key_frame=records["key_frame"].astype(np.int8),
+        pkt_size=records["pkt_size"].astype(np.int64),
+        qp_mean=records["qp_mean"].astype(np.float64),
+        qp_var=records["qp_var"].astype(np.float64),
+        qp_blocks=records["qp_blocks"].astype(np.int32),
+        mv_offsets=offsets,
+        mv_rows=mv_rows.astype(np.int32),
+    )

@@ -1,0 +1,284 @@
+"""The priors sidecar: compact on-disk coding-metadata model.
+
+`<src>.priors.npz` holds one clip's per-frame coding metadata — ragged
+MV arrays via an offsets table, per-frame QP mean/variance, frame
+types, compressed packet sizes — as plain npz members readable with
+bare `np.load`. The writer is byte-deterministic (fixed zip metadata,
+no timestamps): the sidecar is committed to the content-addressed
+store as a plan-hashed artifact, and the plan-purity runtime recorder
+(PC_PLAN_DEBUG) fails the suite if one plan hash ever maps to two
+different byte streams — a time-stamped zip would trip it on every
+warm rebuild.
+
+The plan covers everything that determines sidecar bytes: the source
+stream (by content digest via `file_ref`) and the extraction schema
+version. Chunk granularity is deliberately absent — the record stream
+is identical at any chunking (pinned by the chunking-parity test).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import shutil
+import tempfile
+import time
+import zipfile
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import telemetry as tm
+from ..io import medialib
+from ..store import runtime as store_runtime
+from ..store.keys import file_ref
+from ..utils.fsio import atomic_write
+from ..utils.log import get_logger
+
+#: bump when the sidecar member set or record semantics change — part of
+#: the extraction plan, so a bump rebuilds exactly the priors artifacts
+PRIORS_SCHEMA_VERSION = 1
+
+SIDECAR_SUFFIX = ".priors.npz"
+
+#: AV_PICTURE_TYPE_* values surfaced in `pict_type`
+PICT_I, PICT_P, PICT_B = 1, 2, 3
+
+_EXTRACTS = tm.counter(
+    "chain_priors_extract_total", "priors extraction passes executed"
+)
+_CACHE_HITS = tm.counter(
+    "chain_priors_cache_hits_total",
+    "priors requests served from the artifact store (no extraction)",
+)
+_EXTRACT_SECONDS = tm.histogram(
+    "chain_priors_extract_seconds", "wall time of one priors extraction pass"
+)
+
+
+@dataclass
+class PriorsData:
+    """One clip's coding-metadata stream (arrays indexed by frame)."""
+
+    width: int
+    height: int
+    pts: np.ndarray        # float64 [n] seconds
+    pict_type: np.ndarray  # int8 [n] AV_PICTURE_TYPE_* (1 I, 2 P, 3 B)
+    key_frame: np.ndarray  # int8 [n]
+    pkt_size: np.ndarray   # int64 [n] compressed bytes per frame
+    qp_mean: np.ndarray    # float64 [n], -1 when the codec exports no QP
+    qp_var: np.ndarray     # float64 [n], -1 when absent
+    qp_blocks: np.ndarray  # int32 [n] QP samples behind mean/var
+    mv_offsets: np.ndarray  # int64 [n+1] ragged offsets into mv_rows
+    mv_rows: np.ndarray     # int32 [total, MV_FIELDS]
+
+    @property
+    def n_frames(self) -> int:
+        return int(len(self.pts))
+
+    @property
+    def n_mvs(self) -> int:
+        return int(self.mv_rows.shape[0])
+
+    def mv_for(self, i: int) -> np.ndarray:
+        """MV rows of frame `i` (a view): [k, MV_FIELDS] int32 with fields
+        src_x, src_y, dst_x, dst_y, w, h, source."""
+        return self.mv_rows[self.mv_offsets[i]:self.mv_offsets[i + 1]]
+
+    def has_mvs(self) -> bool:
+        return self.n_mvs > 0
+
+    def has_qp(self) -> bool:
+        return bool((self.qp_blocks > 0).any())
+
+    def summary(self) -> dict:
+        """Operator-facing digest (tools priors show / telemetry events)."""
+        qp = self.qp_mean[self.qp_blocks > 0]
+        return {
+            "frames": self.n_frames,
+            "mvs": self.n_mvs,
+            "width": self.width,
+            "height": self.height,
+            "i_frames": int((self.pict_type == PICT_I).sum()),
+            "p_frames": int((self.pict_type == PICT_P).sum()),
+            "b_frames": int((self.pict_type == PICT_B).sum()),
+            "stream_bytes": int(self.pkt_size.sum()),
+            "qp_mean": round(float(qp.mean()), 3) if qp.size else None,
+        }
+
+
+def _members(data: PriorsData) -> dict[str, np.ndarray]:
+    return {
+        "schema": np.array([PRIORS_SCHEMA_VERSION], np.int32),
+        "geometry": np.array([data.width, data.height], np.int32),
+        "pts": np.asarray(data.pts, np.float64),
+        "pict_type": np.asarray(data.pict_type, np.int8),
+        "key_frame": np.asarray(data.key_frame, np.int8),
+        "pkt_size": np.asarray(data.pkt_size, np.int64),
+        "qp_mean": np.asarray(data.qp_mean, np.float64),
+        "qp_var": np.asarray(data.qp_var, np.float64),
+        "qp_blocks": np.asarray(data.qp_blocks, np.int32),
+        "mv_offsets": np.asarray(data.mv_offsets, np.int64),
+        "mv_rows": np.ascontiguousarray(data.mv_rows, np.int32),
+    }
+
+
+def save_priors(path: str, data: PriorsData) -> None:
+    """Write the sidecar atomically with BYTE-DETERMINISTIC zip contents:
+    `np.savez` stamps members with the current time, which would hand the
+    store two different byte streams for one plan hash — the exact
+    corruption class the PC_PLAN_DEBUG recorder exists to catch."""
+    members = _members(data)
+
+    def _write(tmp: str) -> None:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            for name in sorted(members):
+                buf = io.BytesIO()
+                np.lib.format.write_array(buf, members[name],
+                                          allow_pickle=False)
+                info = zipfile.ZipInfo(name + ".npy",
+                                       date_time=(1980, 1, 1, 0, 0, 0))
+                info.compress_type = zipfile.ZIP_DEFLATED
+                info.external_attr = 0o600 << 16
+                zf.writestr(info, buf.getvalue())
+
+    atomic_write(path, _write)
+
+
+def load_priors(path: str) -> PriorsData:
+    with np.load(path, allow_pickle=False) as z:
+        schema = int(z["schema"][0])
+        if schema != PRIORS_SCHEMA_VERSION:
+            raise ValueError(
+                f"{path}: priors schema {schema} != supported "
+                f"{PRIORS_SCHEMA_VERSION}"
+            )
+        geom = z["geometry"]
+        return PriorsData(
+            width=int(geom[0]),
+            height=int(geom[1]),
+            pts=z["pts"],
+            pict_type=z["pict_type"],
+            key_frame=z["key_frame"],
+            pkt_size=z["pkt_size"],
+            qp_mean=z["qp_mean"],
+            qp_var=z["qp_var"],
+            qp_blocks=z["qp_blocks"],
+            mv_offsets=z["mv_offsets"],
+            mv_rows=z["mv_rows"].reshape(-1, medialib.MV_FIELDS),
+        )
+
+
+def sidecar_path(src_path: str) -> str:
+    return src_path + SIDECAR_SUFFIX
+
+
+def priors_plan(src_path: str) -> dict:
+    """The extraction plan: source stream by content digest + schema
+    version. The "op" key is the plan surface's marker (chainlint
+    plan-purity); anything that can change sidecar bytes belongs here."""
+    return {
+        "op": "priors_extract",
+        "schema": PRIORS_SCHEMA_VERSION,
+        "src": file_ref(src_path),
+    }
+
+
+def ensure_priors(
+    src_path: str,
+    store=None,
+    force: bool = False,
+    threads: int = 0,
+) -> tuple[PriorsData, bool]:
+    """The one entry point consumers call: (PriorsData, cache_hit).
+
+    With a store (explicit or the process-wide active one) the sidecar is
+    plan-hash addressed: a warm call plans ZERO extraction work — lookup,
+    verified materialize, load. A miss extracts, writes the sidecar next
+    to the source, and commits it so every later run (and every tenant of
+    chain-serve sharing the store) gets it for free. Without a store the
+    sidecar file next to the source is reused when present."""
+    from .extract import extract_priors  # circular-import guard
+
+    store = store if store is not None else store_runtime.active()
+    side = sidecar_path(src_path)
+    if store is not None and not force:
+        ph = store.plan_hash(priors_plan(src_path))
+        manifest = store.lookup(ph)
+        if manifest is not None:
+            if store.serve_hit(manifest, side):
+                if tm.enabled():
+                    _CACHE_HITS.inc()
+                return load_priors(side), True
+            # serve_hit False is EITHER corruption (manifest dropped —
+            # fall through and re-extract) or a sidecar that cannot be
+            # materialized next to the source (read-only corpus mount).
+            # In the latter case the verified object bytes are still a
+            # perfectly good warm hit: read them where they live.
+            manifest = store.lookup(ph)
+            if manifest is not None:
+                try:
+                    data = load_priors(
+                        store.object_path(manifest.object["sha256"]))
+                except (OSError, ValueError, KeyError):
+                    pass
+                else:
+                    if tm.enabled():
+                        _CACHE_HITS.inc()
+                    return data, True
+    elif store is None and not force and os.path.isfile(side):
+        # make-style freshness, NOT content in the sidecar: embedding the
+        # source's mtime in the artifact would give one plan hash two
+        # byte streams when a source is rewritten with identical content
+        # (the PC_PLAN_DEBUG violation class). A sidecar older than its
+        # source is stale and re-extracted.
+        try:
+            fresh = os.path.getmtime(side) >= os.path.getmtime(src_path)
+        except OSError:
+            fresh = False
+        if fresh:
+            try:
+                return load_priors(side), True
+            except (OSError, ValueError, KeyError):
+                pass  # unreadable or stale-schema sidecar: re-extract
+
+    t0 = time.perf_counter()
+    data = extract_priors(src_path, threads=threads)
+    # the sidecar next to the source is a CONVENIENCE, not a requirement:
+    # classification needs only the in-memory data, and read-only corpus
+    # mounts are normal (proxy mode never needed write access outside its
+    # tmp dir). On OSError the bytes go to a scratch file so the store
+    # still gets its plan-hashed artifact — future runs warm-hit through
+    # the object path above.
+    commit_from = side
+    scratch = None
+    try:
+        save_priors(side, data)
+    except OSError as exc:
+        if store is None:
+            get_logger().warning(
+                "priors: cannot write sidecar %s (%s); continuing without "
+                "a cache", side, exc)
+            commit_from = None
+        else:
+            scratch = tempfile.mkdtemp(prefix="pc-priors-")
+            commit_from = os.path.join(scratch, os.path.basename(side))
+            save_priors(commit_from, data)
+    try:
+        if store is not None and commit_from is not None:
+            ph = store.plan_hash(priors_plan(src_path))
+            store.commit(ph, commit_from, producer="priors",
+                         provenance={"src": os.path.basename(src_path)})
+    finally:
+        if scratch is not None:
+            shutil.rmtree(scratch, ignore_errors=True)
+    if tm.enabled():
+        _EXTRACTS.inc()
+        _EXTRACT_SECONDS.observe(time.perf_counter() - t0)
+        tm.emit(
+            "priors_extract",
+            src=os.path.basename(src_path),
+            seconds=round(time.perf_counter() - t0, 4),
+            **data.summary(),
+        )
+    return data, False

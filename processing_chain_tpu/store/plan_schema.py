@@ -112,6 +112,14 @@ ENV_INPUTS: dict[str, dict] = {
         "reason": "batched host I/O is byte-identical to the per-frame "
                   "fallback (the host-path-smoke CI parity gate)",
     },
+    "PC_PRIORS_CHUNK": {
+        "status": "exempt",
+        "reason": "frames-per-native-crossing granularity of the priors "
+                  "extractor (priors/extract.py); the per-frame record "
+                  "stream — and therefore the deterministic sidecar bytes "
+                  "— is identical at any chunking (pinned by the "
+                  "chunking-parity test in tests/test_priors.py)",
+    },
     "PC_STORE_DIR": {
         "status": "exempt",
         "reason": "names WHERE the store lives, never what any artifact "
@@ -155,6 +163,7 @@ BYTE_SINK_CALLS = (
     "write_batch",     # native batched encode
     "concat_video",    # stream-copy assembly of tmp renders
     "remux",           # container rewrite of an assembled artifact
+    "save_priors",     # the plan-hashed .priors.npz sidecar writer
 )
 
 #: function/method NAMES whose bodies are byte-producing by protocol

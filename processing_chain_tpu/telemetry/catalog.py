@@ -74,6 +74,12 @@ METRICS: dict[str, str] = {
     "chain_serve_fenced_settles_total": "counter",
     "chain_serve_claim_reverts_total": "counter",
     "chain_serve_quarantined_total": "counter",
+    # priors/ — codec-prior extraction (docs/PRIORS.md)
+    "chain_priors_extract_total": "counter",
+    "chain_priors_cache_hits_total": "counter",
+    "chain_priors_frames_total": "counter",
+    "chain_priors_mvs_total": "counter",
+    "chain_priors_extract_seconds": "histogram",
     # telemetry/profiling.py — resource monitor (PR 5)
     "chain_resource_rss_bytes": "gauge",
     "chain_resource_open_fds": "gauge",
@@ -113,6 +119,7 @@ EVENTS: frozenset = frozenset({
     "serve_settle_fenced",     # serve/queue.py — stale-epoch settle refused
     "serve_claim_reverted",    # serve/queue.py — mid-claim disk error undone
     "serve_quarantined",   # serve/queue.py — permanent failure parked
+    "priors_extract",      # priors/model.py — one extraction pass finished
 
     "log",             # WARNING+ console records bridged into the log
 })

@@ -74,6 +74,18 @@ def measure(timeout_s: float = 600.0) -> dict[str, object]:
     for field in _HOST_FIELDS:
         if field in host:
             out[f"host.{field}"] = host[field]
+    # complexity: proxy re-encode vs codec priors (docs/PRIORS.md). The
+    # band is optional in the baseline — a host whose libx264/native
+    # boundary cannot run the bench just skips it — but when the bench
+    # runs, a silent no-op (non-finite complexity) must not pass as a
+    # huge speedup, so the ratio only folds in with both paths finite.
+    proc = shell(
+        [sys.executable, bench, "--complexity-bench"],
+        check=False, timeout=timeout_s, env=env, cwd=_REPO,
+    )
+    cx = last_json_line(proc.stdout)
+    if proc.returncode == 0 and cx is not None and cx.get("both_finite"):
+        out["complexity.priors_vs_proxy"] = cx["priors_vs_proxy"]
     live_path = os.environ.get(
         "PC_BENCH_LIVE_FILE", os.path.join(_REPO, "BENCH_LIVE.json")
     )

@@ -1,4 +1,4 @@
-"""SRC complexity classification via the CRF-23 proxy encode.
+"""SRC complexity classification: CRF-23 proxy encode, or codec priors.
 
 Parity target: reference util/complexity_classification.py:18-251. Every SRC
 is proxy-encoded with x264 CRF 23 (yuv420p, no audio), its normalized
@@ -9,18 +9,35 @@ of their framerate band (≤30 fps vs >30 fps). The resulting
 (config/test_config.py) and drives low/high bitrate-pair selection per
 segment.
 
+`--priors` (docs/PRIORS.md) removes the proxy re-encode from the hot path
+entirely: the classifier reads QP/size statistics of the *existing* encoded
+stream (priors.ensure_priors — MV/QP/frame-type side data the decoder
+already computed) and maps the observed stream rate to a QP-23-equivalent
+rate with the H.264 rate model (bitrate halves per +6 QP), so a stream that
+is small because it was crushed at QP 40 is not mistaken for simple
+content. The quantile-binning layer is UNCHANGED — both modes feed the same
+`classify_dataframe`, and on a corpus encoded at one quality level they
+assign the same classes (pinned by tests/test_priors.py).
+
 Deliberate fix over the reference: the CSV `file` column holds the *SRC*
 basename, not the `<src>_crf23.avi` proxy name the reference tool writes —
 the config layer looks complexity up by SRC filename
 (reference test_config.py:436), and the CSVs shipped with the reference are
 keyed that way too; the raw reference tool output would never match. The
 proxy artifact name is kept in a separate `proxy_file` column.
+
+Second fix (proxy mode): proxies are encoded inside a scratch directory and
+removed after analysis unless `--keep-proxy` — the reference leaves a
+half-written `<src>_crf23.avi` next to its output on every failed run.
 """
 
 from __future__ import annotations
 
 import argparse
+import math
 import os
+import shutil
+import tempfile
 from typing import Optional, Sequence
 
 import pandas as pd
@@ -28,12 +45,21 @@ import pandas as pd
 from ..io import medialib
 from ..io.probe import get_segment_info
 from ..io.video import VideoReader, VideoWriter
-from ..ops.siti import norm_bitrate_complexity
+from ..ops.siti import REFERENCE_BITRATE, norm_bitrate_complexity
+from ..store import runtime as store_runtime
 from ..utils.log import get_logger
 from ..utils.runner import ParallelRunner
 
 #: quantile keys used for the class thresholds
 QUANTILES = (0.25, 0.5, 0.75)
+
+#: the proxy encoder's quality point; --priors normalizes observed stream
+#: rates to this QP so both modes measure the same "rate at CRF/QP 23"
+PRIORS_QP_REF = 23.0
+
+#: complexity units per QP step: H.264 rate halves per +6 QP
+#: (20*log10(2)/6 dB per step, over the reference's 2.75 divisor)
+QP_COMPLEXITY_PER_STEP = 20.0 * math.log10(2.0) / 6.0 / REFERENCE_BITRATE
 
 
 def proxy_encode(input_file: str, output_file: str) -> str:
@@ -88,6 +114,67 @@ def get_difficulty(proxy_file: str, src_file: Optional[str] = None) -> dict:
     }
 
 
+def get_priors_difficulty(src_file: str, force: bool = False) -> dict:
+    """Complexity record for one SRC from its OWN bitstream's coding
+    metadata — no re-encode (docs/PRIORS.md "Complexity without the
+    proxy"). Stream bytes stand in for the proxy's file size; when the
+    codec exports QP, the rate is normalized to PRIORS_QP_REF so streams
+    encoded at different quality points stay comparable. MV statistics
+    ride along as CSV columns for downstream feature users."""
+    from .. import priors
+    from ..priors import features as pf
+
+    data, _ = priors.ensure_priors(src_file, force=force)
+    info = get_segment_info(src_file)
+    duration = float(info["video_duration"])
+    framerate = float(info["video_frame_rate"])
+    width = int(info["video_width"])
+    height = int(info["video_height"])
+    # a frame whose packet could not be matched (timestamp-less or
+    # pathological streams) carries pkt_size 0 — a PARTIAL sum would
+    # silently undercount the stream and misclassify the clip as simple.
+    # Fallback order: the independent VIDEO-stream packet scan (exact,
+    # audio/mux overhead excluded — --priors accepts audio-bearing
+    # containers), then the container size as the last resort.
+    if data.n_frames and (data.pkt_size > 0).all():
+        size = float(data.pkt_size.sum())
+    else:
+        try:
+            size = float(medialib.scan_packets(src_file, "video")["size"].sum())
+        except medialib.MediaError:
+            size = 0.0
+        if size <= 0:
+            size = float(info["file_size"])
+    norm_bitrate, complexity = norm_bitrate_complexity(
+        size, framerate, duration, width, height
+    )
+    qp_sel = data.qp_blocks > 0
+    qp_mean = None
+    if qp_sel.any():
+        weights = data.qp_blocks[qp_sel].astype(float)
+        qp_mean = float((data.qp_mean[qp_sel] * weights).sum() / weights.sum())
+        # observed rate at QP q ≙ rate at QP_REF scaled by 2^((q-REF)/6):
+        # in complexity units that is a linear shift per QP step
+        complexity += (qp_mean - PRIORS_QP_REF) * QP_COMPLEXITY_PER_STEP
+    stats = pf.frame_mv_stats(data)
+    mv_sel = stats["mv_count"] > 0
+    return {
+        "file": os.path.basename(src_file),
+        "norm_bitrate": norm_bitrate,
+        "complexity": complexity,
+        "framerate": framerate,
+        "width": width,
+        "height": height,
+        "size": int(size),
+        "duration": duration,
+        "qp_mean": round(qp_mean, 3) if qp_mean is not None else None,
+        "mv_mean_mag": round(float(stats["mean_mag"][mv_sel].mean()), 4)
+        if mv_sel.any() else None,
+        "mv_p95_mag": round(float(stats["p95_mag"][mv_sel].mean()), 4)
+        if mv_sel.any() else None,
+    }
+
+
 def classify_complexity(complexity: float, framerate: float, quantiles: dict) -> int:
     """Class 0-3 from the framerate band's quantiles (reference
     classify_complexity, util/complexity_classification.py:72-88)."""
@@ -111,28 +198,26 @@ def classify_dataframe(data: pd.DataFrame) -> pd.DataFrame:
     return data
 
 
-def run(
-    inputs: Sequence[str],
-    tmp_dir: str,
-    output_file: str = "complexity_classification.csv",
-    parallelism: int = 1,
-    force: bool = False,
-    dry_run: bool = False,
-) -> Optional[pd.DataFrame]:
-    """Proxy-encode + classify all inputs; writes `<tmp_dir>/<output_file>`
-    and returns the DataFrame (None on dry run)."""
-    log = get_logger()
-    os.makedirs(tmp_dir, exist_ok=True)
-    if not output_file.endswith(".csv"):
-        raise ValueError("output file must be .csv")
+#: CSV column orders per mode (shared tail keeps the config-layer lookup
+#: columns identical across modes)
+_PROXY_COLUMNS = [
+    "file", "proxy_file", "norm_bitrate", "complexity", "framerate",
+    "width", "height", "size", "duration",
+]
+_PRIORS_COLUMNS = [
+    "file", "norm_bitrate", "complexity", "framerate", "width", "height",
+    "size", "duration", "qp_mean", "mv_mean_mag", "mv_p95_mag",
+]
 
+
+def _select_inputs(inputs: Sequence[str], priors: bool) -> list[str]:
+    log = get_logger()
     input_files = []
     for f in inputs:
-        if f.endswith(".avi"):
+        if priors or f.endswith(".avi"):
             input_files.append(f)
         else:
             log.warning("skipping %s: not an .avi file", f)
-
     basenames = [os.path.basename(f) for f in input_files]
     dupes = {b for b in basenames if basenames.count(b) > 1}
     if dupes:
@@ -142,44 +227,103 @@ def run(
         raise ValueError(
             f"duplicate SRC basenames across inputs: {sorted(dupes)}"
         )
+    return input_files
 
+
+def _proxy_records(
+    input_files: Sequence[str],
+    tmp_dir: str,
+    parallelism: int,
+    force: bool,
+    dry_run: bool,
+    keep_proxy: bool,
+) -> Optional[list[dict]]:
+    """Proxy-encode records. Encodes happen inside a scratch directory so
+    a failed run never strands a half-written proxy; finished proxies are
+    promoted into `tmp_dir` only with `keep_proxy` (where later runs may
+    reuse them without `--force`)."""
+    log = get_logger()
     runner = ParallelRunner(max_parallel=parallelism, name="complexity-encode")
-    pairs: list[tuple[str, str]] = []
-    for input_file in input_files:
-        base = os.path.splitext(os.path.basename(input_file))[0]
-        proxy = os.path.join(tmp_dir, base + "_crf23.avi")
-        pairs.append((input_file, proxy))
-        if os.path.isfile(proxy) and not force:
-            log.warning("proxy %s exists, use --force to re-encode", proxy)
-        else:
-            runner.add(proxy_encode, input_file, proxy, label=proxy)
+    scratch = tempfile.mkdtemp(dir=tmp_dir, prefix=".proxy-scratch-")
+    try:
+        pairs: list[tuple[str, str, str]] = []  # (src, kept path, work path)
+        for input_file in input_files:
+            base = os.path.splitext(os.path.basename(input_file))[0]
+            kept = os.path.join(tmp_dir, base + "_crf23.avi")
+            work = os.path.join(scratch, base + "_crf23.avi")
+            if keep_proxy and os.path.isfile(kept) and not force:
+                log.warning("proxy %s exists, use --force to re-encode", kept)
+                pairs.append((input_file, kept, kept))
+            else:
+                pairs.append((input_file, kept, work))
+                runner.add(proxy_encode, input_file, work, label=work)
 
-    if dry_run:
-        for input_file, proxy in pairs:
-            log.info("would encode %s -> %s", input_file, proxy)
-        return None
+        if dry_run:
+            for input_file, _kept, work in pairs:
+                if work.startswith(scratch):
+                    log.info("would encode %s -> %s", input_file,
+                             os.path.basename(work))
+            return None
 
-    if len(runner):
-        log.info("encoding %d proxies, this may take a while …", len(runner))
+        if len(runner):
+            log.info("encoding %d proxies, this may take a while …", len(runner))
+            runner.run()
+
+        records = []
+        for src, kept, work in pairs:
+            records.append(get_difficulty(work, src))
+            if keep_proxy and work != kept:
+                os.replace(work, kept)
+        return records
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
+def run(
+    inputs: Sequence[str],
+    tmp_dir: str,
+    output_file: str = "complexity_classification.csv",
+    parallelism: int = 1,
+    force: bool = False,
+    dry_run: bool = False,
+    priors: bool = False,
+    keep_proxy: bool = False,
+) -> Optional[pd.DataFrame]:
+    """Classify all inputs; writes `<tmp_dir>/<output_file>` and returns
+    the DataFrame (None on dry run). `priors=True` classifies from the
+    existing streams' coding metadata — zero encodes on the hot path."""
+    log = get_logger()
+    os.makedirs(tmp_dir, exist_ok=True)
+    if not output_file.endswith(".csv"):
+        raise ValueError("output file must be .csv")
+
+    input_files = _select_inputs(inputs, priors)
+
+    if priors:
+        if dry_run:
+            for f in input_files:
+                log.info("would extract priors from %s", f)
+            return None
+        # same -p semantics as proxy mode: extractions are independent
+        # single-threaded bitstream passes, so they parallelize cleanly
+        runner = ParallelRunner(max_parallel=parallelism,
+                                name="complexity-priors")
+        for f in input_files:
+            runner.add(get_priors_difficulty, f, force=force, label=f)
         runner.run()
-
-    records = [get_difficulty(proxy, src) for src, proxy in pairs]
+        records = [runner.results[f] for f in input_files]
+        columns = _PRIORS_COLUMNS
+    else:
+        records = _proxy_records(
+            input_files, tmp_dir, parallelism, force, dry_run, keep_proxy
+        )
+        if records is None:
+            return None
+        columns = _PROXY_COLUMNS
     if not records:
         raise ValueError("no inputs analysed")
 
-    data = pd.DataFrame(records)[
-        [
-            "file",
-            "proxy_file",
-            "norm_bitrate",
-            "complexity",
-            "framerate",
-            "width",
-            "height",
-            "size",
-            "duration",
-        ]
-    ].sort_values("file")
+    data = pd.DataFrame(records)[columns].sort_values("file")
     data = classify_dataframe(data)
 
     csv_path = os.path.join(tmp_dir, output_file)
@@ -190,24 +334,40 @@ def run(
 
 def build_parser(parser: Optional[argparse.ArgumentParser] = None) -> argparse.ArgumentParser:
     p = parser or argparse.ArgumentParser(
-        "complexity", description="Classify SRC encoding complexity (CRF-23 proxy)"
+        "complexity",
+        description="Classify SRC encoding complexity (CRF-23 proxy, or "
+        "codec priors with --priors)",
     )
-    p.add_argument("-i", "--input", required=True, nargs="+", help="input SRC files (.avi)")
+    p.add_argument("-i", "--input", required=True, nargs="+",
+                   help="input SRC files (.avi; --priors accepts any container)")
     p.add_argument("-t", "--tmp-dir", default="complexityAnalysis",
-                   help="directory for proxy encodes + the output CSV")
+                   help="directory for the output CSV (and kept proxies)")
     p.add_argument("-p", "--parallelism", type=int, default=1,
                    help="number of parallel proxy encodes")
     p.add_argument("-o", "--output-file", default="complexity_classification.csv",
                    help="CSV output filename")
     p.add_argument("-f", "--force", action="store_true",
-                   help="re-encode existing proxies")
+                   help="re-encode existing proxies / re-extract priors")
     p.add_argument("-n", "--dry-run", action="store_true",
-                   help="show what would be encoded")
+                   help="show what would be encoded/extracted")
+    p.add_argument("--priors", action="store_true",
+                   help="classify from the existing streams' MV/QP/size "
+                   "coding metadata — no proxy re-encode (docs/PRIORS.md)")
+    p.add_argument("--keep-proxy", action="store_true",
+                   help="proxy mode: keep <src>_crf23.avi under --tmp-dir "
+                   "for reuse (default: proxies live in a scratch dir and "
+                   "are removed after analysis)")
+    p.add_argument("--store", default=None, metavar="DIR",
+                   help="artifact store for priors sidecars (default: "
+                   "PC_STORE_DIR when set)")
+    p.add_argument("--no-store", action="store_true",
+                   help="disable the artifact store even if PC_STORE_DIR is set")
     return p
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    store_runtime.configure_from_args(args)
     run(
         args.input,
         tmp_dir=args.tmp_dir,
@@ -215,6 +375,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         parallelism=args.parallelism,
         force=args.force,
         dry_run=args.dry_run,
+        priors=args.priors,
+        keep_proxy=args.keep_proxy,
     )
     return 0
 

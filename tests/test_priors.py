@@ -1,0 +1,673 @@
+"""Tests for the codec-prior subsystem (docs/PRIORS.md).
+
+Golden parity: a synthetic pan with KNOWN per-frame motion is encoded
+with x264 in constant-QP mode, so every extracted quantity has an exact
+expected value — MV count (one per macroblock), MV magnitude (the pan
+speed), per-frame QP (the CQP setting), frame types and packet sizes
+(cross-checked against the independent native packet scan, and against
+`ffprobe -show_frames` when the binary exists).
+
+HEVC/VP9 coverage: FFmpeg's native hevc/vp9 decoders do not export
+motion vectors (only the mpegvideo/h264 families do), so those codecs
+are covered for frame types / packet sizes / graceful-zero MV records,
+and the MV-parity assertions are explicitly H.264-only — that is the
+documented contract, not a gap.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu.io import MediaError, VideoWriter, medialib
+
+pytestmark = []
+
+try:  # the whole module needs the native boundary
+    medialib.ensure_loaded()
+    _NATIVE = True
+except MediaError as exc:  # pragma: no cover - CI always builds it
+    _NATIVE = False
+    pytestmark = [pytest.mark.skip(
+        reason=f"native media boundary unavailable: {exc}")]
+
+if _NATIVE:
+    from processing_chain_tpu import priors
+    from processing_chain_tpu.priors import extract as pext
+    from processing_chain_tpu.priors import features as pf
+    from processing_chain_tpu.priors.model import (
+        PICT_I,
+        PICT_P,
+        PriorsData,
+        load_priors,
+        save_priors,
+    )
+    from processing_chain_tpu.store.store import ArtifactStore
+    from processing_chain_tpu.tools import complexity as cx
+
+
+PAN_DX = 4  # pixels per frame, exact macroblock-predictable motion
+
+
+def write_pan_clip(path, n=24, w=192, h=128, dx=PAN_DX, qp=20,
+                   codec="libx264", opts=None):
+    """Textured pattern panning `dx` px/frame — every inter block's true
+    motion is exactly (-dx, 0) in dst-src convention."""
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 255, (h, w + dx * n), np.uint8)
+    base = (base.astype(np.float32) + np.roll(base, 1, 0)
+            + np.roll(base, 1, 1)).astype(np.uint8)
+    default_opts = f"qp={qp}:preset=fast" if codec == "libx264" else ""
+    with VideoWriter(path, codec, w, h, "yuv420p", (24, 1), gop=250,
+                     bframes=0,
+                     opts=default_opts if opts is None else opts) as wr:
+        u = np.full((h // 2, w // 2), 128, np.uint8)
+        for i in range(n):
+            y = np.ascontiguousarray(base[:, dx * i:dx * i + w])
+            wr.write(y, u, u.copy())
+    return path
+
+
+# ------------------------------------------------------------ golden parity
+
+
+def test_mv_qp_golden_parity_h264(tmp_path):
+    n, w, h, qp = 24, 192, 128, 20
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=n, w=w, h=h, qp=qp)
+
+    data = priors.extract_priors(path)
+    assert data.n_frames == n
+    assert data.width == w and data.height == h
+
+    # frame types: closed single-GOP stream -> one IDR then P frames
+    assert data.pict_type[0] == PICT_I and data.key_frame[0] == 1
+    assert (data.pict_type[1:] == PICT_P).all()
+    assert (data.key_frame[1:] == 0).all()
+
+    # MV counts: h264 exports ~one vector per 16x16 macroblock on a clean
+    # pan (skip blocks included; the encoder may intra-code or
+    # sub-partition a handful of blocks, so the band is ±1/8)
+    mb_count = (w // 16) * (h // 16)
+    assert data.mv_offsets[1] == 0  # I frame: no MVs
+    counts = np.diff(data.mv_offsets)
+    assert (np.abs(counts[1:] - mb_count) <= mb_count // 8).all()
+
+    # MV magnitudes: the known pan, exactly (dst - src == -dx, 0)
+    for i in (1, n // 2, n - 1):
+        rows = data.mv_for(i)
+        dx = rows[:, pf.DST_X] - rows[:, pf.SRC_X]
+        dy = rows[:, pf.DST_Y] - rows[:, pf.SRC_Y]
+        assert np.median(dx) == -PAN_DX
+        assert np.median(dy) == 0
+        # every block is backward-predicted from the previous frame
+        assert (rows[:, pf.MV_SOURCE] < 0).all()
+
+    # QP: CQP mode pins every P-frame macroblock to exactly `qp` (the QP
+    # map covers ALL macroblocks, intra fallbacks included)
+    assert (data.qp_blocks == mb_count).all()
+    p_sel = data.pict_type == PICT_P
+    assert np.allclose(data.qp_mean[p_sel], qp)
+    assert np.allclose(data.qp_var[p_sel], 0.0)
+    # the I frame sits below the P QP (x264 ip_ratio), never above
+    assert qp - 6 <= data.qp_mean[0] <= qp
+
+    # packet sizes: exact cross-check against the independent demuxer
+    # packet scan (no B frames -> packet order == presentation order)
+    scan = medialib.scan_packets(path, "video")
+    assert np.array_equal(data.pkt_size, scan["size"])
+    assert np.array_equal(data.key_frame.astype(np.int8), scan["key"])
+
+    # ffprobe -show_frames truth, when the binary exists on this host
+    if shutil.which("ffprobe"):
+        from processing_chain_tpu.utils.runner import shell
+
+        proc = shell([
+            "ffprobe", "-v", "error", "-select_streams", "v:0",
+            "-show_frames", "-show_entries", "frame=pkt_size,pict_type",
+            "-of", "csv=p=0", path,
+        ], timeout=120.0)
+        types, sizes = [], []
+        for line in proc.stdout.splitlines():
+            for tok in line.strip().split(","):
+                tok = tok.strip()
+                if tok.isdigit():
+                    sizes.append(int(tok))
+                elif tok:
+                    types.append(tok)
+        assert sizes == list(data.pkt_size)
+        want = ["I"] + ["P"] * (n - 1)
+        assert types == want
+
+
+def test_priors_chunking_parity(tmp_path):
+    """The record stream is identical at any chunk granularity — the
+    claim behind PC_PRIORS_CHUNK's plan-exempt entry."""
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=17)
+    a = priors.extract_priors(path, chunk_frames=3)
+    b = priors.extract_priors(path, chunk_frames=64)
+    assert np.array_equal(a.mv_rows, b.mv_rows)
+    assert np.array_equal(a.mv_offsets, b.mv_offsets)
+    assert np.array_equal(a.pkt_size, b.pkt_size)
+    assert np.array_equal(a.qp_mean, b.qp_mean)
+    assert np.array_equal(a.pict_type, b.pict_type)
+
+
+def test_priors_tiny_mv_buffer_grows_without_loss(tmp_path, monkeypatch):
+    """A single dense frame overflowing the MV block triggers the native
+    park + Python grow-and-retry — no rows lost, no rows duplicated."""
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=12)
+    ref = priors.extract_priors(path)
+    monkeypatch.setattr(pext, "_MV_CAP0", 16)  # < 96 MVs per P frame
+    small = priors.extract_priors(path, chunk_frames=5)
+    assert np.array_equal(ref.mv_rows, small.mv_rows)
+    assert np.array_equal(ref.mv_offsets, small.mv_offsets)
+
+
+def test_priors_pool_blocks_released(tmp_path):
+    from processing_chain_tpu.io.bufpool import BufferPool
+
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=8)
+    pool = BufferPool()
+    priors.extract_priors(path, pool=pool, chunk_frames=4)
+    stats = pool.stats()
+    assert stats["outstanding"] == 0  # ownership returned on completion
+
+
+# ------------------------------------------------- unsupported-MV codecs
+
+
+@pytest.mark.parametrize("codec,opts", [
+    ("ffv1", ""),
+    ("libx265", "preset=ultrafast:x265-params=log-level=none"),
+    ("libvpx-vp9", "cpu-used=8:deadline=realtime"),
+])
+def test_priors_codecs_without_mv_export_degrade(tmp_path, codec, opts):
+    """hevc/vp9 (and intra-only ffv1): FFmpeg's native decoders export no
+    motion vectors — records must still carry frame types and packet
+    sizes, with zero MV rows and absent QP, never an error. (This is the
+    documented H.264-only scope of MV parity, not a silent gap.)"""
+    path = str(tmp_path / f"clip_{codec.replace('-', '_')}.mkv")
+    try:
+        write_pan_clip(path, n=8, w=96, h=64, codec=codec, opts=opts)
+    except MediaError as exc:
+        pytest.skip(f"{codec} encoder unavailable: {exc}")
+    data = priors.extract_priors(path)
+    assert data.n_frames == 8
+    assert data.n_mvs == 0
+    assert (np.diff(data.mv_offsets) == 0).all()
+    assert (data.pkt_size > 0).all()
+    assert data.pict_type[0] == PICT_I or data.key_frame[0] == 1
+
+
+# --------------------------------------------------------------- sidecar
+
+
+def _random_priors(seed=0, n=9):
+    rng = np.random.default_rng(seed)
+    counts = rng.integers(0, 40, n)
+    counts[0] = 0
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return PriorsData(
+        width=320, height=180,
+        pts=np.arange(n) / 24.0,
+        pict_type=np.array([1] + [2] * (n - 1), np.int8),
+        key_frame=np.array([1] + [0] * (n - 1), np.int8),
+        pkt_size=rng.integers(100, 9000, n).astype(np.int64),
+        qp_mean=rng.uniform(18, 30, n),
+        qp_var=rng.uniform(0, 4, n),
+        qp_blocks=rng.integers(1, 300, n).astype(np.int32),
+        mv_offsets=offsets,
+        mv_rows=rng.integers(-500, 500,
+                             (int(offsets[-1]), medialib.MV_FIELDS)
+                             ).astype(np.int32),
+    )
+
+
+def test_sidecar_ragged_round_trip(tmp_path):
+    data = _random_priors()
+    side = str(tmp_path / "x.priors.npz")
+    save_priors(side, data)
+    back = load_priors(side)
+    for field in ("pts", "pict_type", "key_frame", "pkt_size", "qp_mean",
+                  "qp_var", "qp_blocks", "mv_offsets", "mv_rows"):
+        assert np.array_equal(getattr(data, field), getattr(back, field)), field
+    assert (back.width, back.height) == (data.width, data.height)
+    # ragged views reconstruct per frame
+    for i in range(data.n_frames):
+        assert np.array_equal(data.mv_for(i), back.mv_for(i))
+    # plain np.load compatibility (no custom reader required)
+    with np.load(side) as z:
+        assert "mv_rows" in z and "qp_mean" in z
+
+
+def test_sidecar_bytes_deterministic(tmp_path):
+    """np.savez stamps zip members with wall time; the sidecar writer
+    must not — one plan hash must always map to one byte stream
+    (PC_PLAN_DEBUG's same-plan/different-bytes gate)."""
+    data = _random_priors()
+    a, b = str(tmp_path / "a.npz"), str(tmp_path / "b.npz")
+    save_priors(a, data)
+    save_priors(b, data)
+    with open(a, "rb") as fa, open(b, "rb") as fb:
+        assert fa.read() == fb.read()
+
+
+def test_sidecar_rejects_future_schema(tmp_path):
+    data = _random_priors()
+    side = str(tmp_path / "x.priors.npz")
+    save_priors(side, data)
+    import io as _io
+    import zipfile
+
+    with zipfile.ZipFile(side) as zf:
+        members = {name: zf.read(name) for name in zf.namelist()}
+    buf = _io.BytesIO()
+    np.lib.format.write_array(buf, np.array([99], np.int32),
+                              allow_pickle=False)
+    members["schema.npy"] = buf.getvalue()
+    with zipfile.ZipFile(side, "w") as zf:
+        for name, blob in members.items():
+            zf.writestr(name, blob)
+    with pytest.raises(ValueError, match="schema"):
+        load_priors(side)
+
+
+# ----------------------------------------------------------------- store
+
+
+def test_store_commit_and_warm_zero_extraction(tmp_path, monkeypatch):
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=10)
+    store = ArtifactStore(str(tmp_path / "store"))
+
+    cold, hit_cold = priors.ensure_priors(path, store=store)
+    assert not hit_cold
+    side = priors.sidecar_path(path)
+    assert os.path.isfile(side)
+
+    # warm: must not extract — a decoder open would be an execution
+    monkeypatch.setattr(
+        medialib, "priors_open",
+        lambda *a, **k: pytest.fail("warm run opened a priors decoder"),
+    )
+    os.unlink(side)  # even with the materialized sidecar gone
+    warm, hit_warm = priors.ensure_priors(path, store=store)
+    assert hit_warm
+    assert np.array_equal(cold.mv_rows, warm.mv_rows)
+    assert np.array_equal(cold.pkt_size, warm.pkt_size)
+
+
+def test_storeless_sidecar_stale_on_src_rewrite(tmp_path):
+    """Without a store, a sidecar OLDER than its source must not be
+    served — the in-place re-encode case (make-style mtime freshness;
+    the store path is content-digest keyed instead)."""
+    from processing_chain_tpu.store import runtime as store_runtime
+
+    store_runtime.configure(None)  # the test IS the store-less path
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=8)
+    a, hit_a = priors.ensure_priors(path)
+    side = priors.sidecar_path(path)
+    assert not hit_a and os.path.isfile(side)
+    # warm store-less call with a fresh sidecar: reused
+    _, hit_b = priors.ensure_priors(path)
+    assert hit_b
+    # rewrite the source in place, newer than the sidecar
+    write_pan_clip(path, n=12)
+    st = os.stat(side)
+    os.utime(path, ns=(st.st_atime_ns + 10**9, st.st_mtime_ns + 10**9))
+    data, hit_c = priors.ensure_priors(path)
+    assert not hit_c
+    assert data.n_frames == 12
+
+
+def test_store_plan_invalidates_on_src_change(tmp_path):
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=8)
+    store = ArtifactStore(str(tmp_path / "store"))
+    _, hit0 = priors.ensure_priors(path, store=store)
+    write_pan_clip(path, n=12)  # different content digest -> new plan
+    data, hit1 = priors.ensure_priors(path, store=store)
+    assert not hit0 and not hit1
+    assert data.n_frames == 12
+
+
+# -------------------------------------------------------------- features
+
+
+def test_features_known_motion():
+    n = 4
+    counts = np.array([0, 6, 6, 6])
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    rows = []
+    for _f in range(3):
+        for k in range(6):
+            sx, sy = 16 * k, 32
+            rows.append([sx, sy, sx + 3, sy + 4, 16, 16, -1])  # |mv| = 5
+    data = PriorsData(
+        width=96, height=64,
+        pts=np.arange(n) / 24.0,
+        pict_type=np.array([1, 2, 2, 2], np.int8),
+        key_frame=np.array([1, 0, 0, 0], np.int8),
+        pkt_size=np.full(n, 100, np.int64),
+        qp_mean=np.full(n, 20.0), qp_var=np.zeros(n),
+        qp_blocks=np.full(n, 24, np.int32),
+        mv_offsets=offsets,
+        mv_rows=np.array(rows, np.int32),
+    )
+    stats = pf.frame_mv_stats(data)
+    assert np.allclose(stats["mean_mag"][1:], 5.0)
+    assert np.allclose(stats["p95_mag"][1:], 5.0)
+    assert stats["mean_mag"][0] == 0.0
+    frac = pf.intra_fraction(data)
+    assert frac[0] == 1.0  # I frame
+    # 6 blocks * 256 px over 96*64 = 1536/6144 covered -> 0.75 intra
+    assert np.allclose(frac[1:], 0.75)
+
+
+def test_features_intra_fraction_bframes_not_double_counted():
+    """Bi-predicted blocks export one MV row per direction (source ±1)
+    over the SAME pixels; coverage must dedup by block anchor or a
+    half-intra B frame reads as fully inter."""
+    n = 2
+    rows = []
+    for k in range(6):  # 6 of 24 blocks inter, each bi-predicted (2 rows)
+        dstx, dsty = 16 * k + 8, 8
+        for source in (-1, 1):
+            rows.append([dstx - 2, dsty, dstx, dsty, 16, 16, source])
+    data = PriorsData(
+        width=96, height=64,
+        pts=np.arange(n) / 24.0,
+        pict_type=np.array([1, 3], np.int8),  # B frame
+        key_frame=np.array([1, 0], np.int8),
+        pkt_size=np.full(n, 10, np.int64),
+        qp_mean=np.full(n, 20.0), qp_var=np.zeros(n),
+        qp_blocks=np.full(n, 24, np.int32),
+        mv_offsets=np.array([0, 0, len(rows)], np.int64),
+        mv_rows=np.array(rows, np.int32),
+    )
+    frac = pf.intra_fraction(data)
+    # 6 unique blocks * 256 px = 1536 of 6144 covered -> 0.75 intra,
+    # NOT 0.5 (the double-counted value)
+    assert np.allclose(frac[1], 0.75)
+
+
+def test_complexity_priors_parallelism(tmp_path):
+    """--priors honors -p like proxy mode (extractions fan out through
+    the ParallelRunner)."""
+    srcs = _complexity_corpus(tmp_path, k=4)
+    df = cx.run(srcs, tmp_dir=str(tmp_path / "par"), priors=True,
+                parallelism=4)
+    assert len(df) == 4 and "complexity_class" in df.columns
+
+
+def test_features_divergence_zoom_vs_pan():
+    """A uniform pan has zero divergence; a radial zoom does not."""
+    def clip_with_field(make_mv):
+        rows = []
+        for by in range(4):
+            for bx in range(6):
+                dstx, dsty = bx * 16 + 8, by * 16 + 8
+                dx, dy = make_mv(dstx - 48, dsty - 32)
+                rows.append([dstx - dx, dsty - dy, dstx, dsty, 16, 16, -1])
+        offsets = np.array([0, 0, len(rows)], np.int64)
+        return PriorsData(
+            width=96, height=64, pts=np.arange(2) / 24.0,
+            pict_type=np.array([1, 2], np.int8),
+            key_frame=np.array([1, 0], np.int8),
+            pkt_size=np.full(2, 10, np.int64),
+            qp_mean=np.full(2, 20.0), qp_var=np.zeros(2),
+            qp_blocks=np.full(2, 24, np.int32),
+            mv_offsets=offsets, mv_rows=np.array(rows, np.int32),
+        )
+
+    pan = clip_with_field(lambda x, y: (4, 0))
+    zoom = clip_with_field(lambda x, y: (int(round(x * 0.25)),
+                                         int(round(y * 0.25))))
+    div_pan = pf.frame_divergence(pan)[1]
+    div_zoom = pf.frame_divergence(zoom)[1]
+    assert div_pan < 0.3
+    assert div_zoom > div_pan + 0.5
+
+
+# ------------------------------------------------- complexity --priors
+
+
+def _complexity_corpus(tmp_path, k=8):
+    """k clips at ONE quality point (crf 23) with increasing texture —
+    the proxy and priors complexity measures must rank them identically,
+    hence bin them identically at the shared quantiles."""
+    paths = []
+    rng = np.random.default_rng(3)
+    for j in range(k):
+        path = str(tmp_path / f"src{j:02d}.avi")
+        w, h, n = 192, 108, 12
+        with VideoWriter(path, "libx264", w, h, "yuv420p", (24, 1),
+                         gop=250, bframes=0, opts="crf=23:preset=fast") as wr:
+            u = np.full((h // 2, w // 2), 128, np.uint8)
+            amp = 2 + 28 * j
+            base = rng.integers(0, amp + 1, (h, w + 4 * n)).astype(np.uint8)
+            for i in range(n):
+                y = np.ascontiguousarray(base[:, 4 * i:4 * i + w] + 60)
+                wr.write(y, u, u.copy())
+        paths.append(path)
+    return paths
+
+
+def test_complexity_priors_matches_proxy_bins(tmp_path, monkeypatch):
+    srcs = _complexity_corpus(tmp_path)
+    proxy_df = cx.run(srcs, tmp_dir=str(tmp_path / "proxy"))
+    # the priors hot path must never encode: make any encode an error
+    monkeypatch.setattr(
+        cx, "proxy_encode",
+        lambda *a, **k: pytest.fail("--priors ran a proxy encode"),
+    )
+    priors_df = cx.run(srcs, tmp_dir=str(tmp_path / "pri"), priors=True)
+
+    assert list(proxy_df["file"]) == list(priors_df["file"])
+    # same classes at the {.25,.5,.75} quantiles on the synthetic corpus
+    assert list(proxy_df["complexity_class"]) == \
+        list(priors_df["complexity_class"])
+    # priors CSV carries the metadata columns, no proxy artifact column
+    assert "qp_mean" in priors_df.columns
+    assert "proxy_file" not in priors_df.columns
+    assert (tmp_path / "pri" / "complexity_classification.csv").is_file()
+    # nothing but sidecars + CSV in the working dir — no encodes happened
+    leftovers = [p.name for p in (tmp_path / "pri").iterdir()
+                 if p.suffix == ".avi"]
+    assert leftovers == []
+
+
+def test_complexity_priors_qp_normalization(tmp_path):
+    """The same content crushed at a higher QP yields a SMALLER stream;
+    the QP rate-model correction must keep its complexity estimate close
+    to the low-QP encode's instead of mistaking it for simple content."""
+    rng = np.random.default_rng(5)
+    w, h, n = 192, 108, 12
+    base = rng.integers(0, 200, (h, w + 4 * n)).astype(np.uint8)
+
+    def encode(path, qp):
+        with VideoWriter(path, "libx264", w, h, "yuv420p", (24, 1),
+                         gop=250, bframes=0,
+                         opts=f"qp={qp}:preset=fast") as wr:
+            u = np.full((h // 2, w // 2), 128, np.uint8)
+            for i in range(n):
+                wr.write(np.ascontiguousarray(base[:, 4 * i:4 * i + w]),
+                         u, u.copy())
+        return path
+
+    lo = cx.get_priors_difficulty(encode(str(tmp_path / "lo.mp4"), 18))
+    hi = cx.get_priors_difficulty(encode(str(tmp_path / "hi.mp4"), 34))
+    assert hi["size"] < lo["size"] * 0.6  # raw bytes differ wildly
+
+    def raw_complexity(rec):
+        return 20.0 * np.log10(rec["norm_bitrate"]) / 2.75
+
+    # the correction is the documented rate model, applied exactly …
+    for rec in (lo, hi):
+        want = raw_complexity(rec) + \
+            (rec["qp_mean"] - cx.PRIORS_QP_REF) * cx.QP_COMPLEXITY_PER_STEP
+        assert np.isclose(rec["complexity"], want)
+    # … and it counteracts the QP-induced size bias in the right
+    # direction: a crushed (high-QP) stream is pushed UP toward its true
+    # complexity, a lavish (low-QP) one down
+    assert hi["complexity"] > raw_complexity(hi) + 2.0
+    assert lo["complexity"] < raw_complexity(lo)
+    # without the correction hi would look SIMPLER than lo; with it the
+    # ordering flips to match the identical underlying content + noise
+    assert raw_complexity(hi) < raw_complexity(lo)
+    assert hi["complexity"] >= lo["complexity"]
+
+
+def test_complexity_priors_partial_pkt_sizes_fall_back(tmp_path, monkeypatch):
+    """One unmatched packet (pkt_size 0) must fall the size measure back
+    to the independent VIDEO-stream packet scan (audio/mux overhead
+    excluded) — a partial sum would misclassify the clip as simple."""
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=8)
+    real = priors.extract_priors(path)
+    want = int(real.pkt_size.sum())  # complete video-stream byte count
+    real.pkt_size[3] = 0  # simulate a timestamp-less packet
+    from processing_chain_tpu import priors as priors_pkg
+
+    monkeypatch.setattr(priors_pkg, "ensure_priors",
+                        lambda *a, **k: (real, False))
+    rec = cx.get_priors_difficulty(path)
+    assert rec["size"] == want
+    assert rec["size"] < os.path.getsize(path)  # container size excluded
+
+
+def test_priors_readonly_source_dir(tmp_path, monkeypatch):
+    """A read-only corpus mount must not break --priors: classification
+    needs only the in-memory data; with a store the artifact commits
+    from scratch space and later runs warm-hit from the object bytes."""
+    from processing_chain_tpu.priors import model as pmodel
+
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=8)
+    real_save = pmodel.save_priors
+
+    def deny_next_to_src(dest, data):
+        if os.path.dirname(os.path.abspath(dest)) == str(tmp_path):
+            raise OSError(30, "Read-only file system", dest)
+        return real_save(dest, data)
+
+    monkeypatch.setattr(pmodel, "save_priors", deny_next_to_src)
+
+    # store-less: works, just uncached
+    from processing_chain_tpu.store import runtime as store_runtime
+
+    store_runtime.configure(None)
+    data, hit = pmodel.ensure_priors(path)
+    assert data.n_frames == 8 and not hit
+    assert not os.path.isfile(pmodel.sidecar_path(path))
+
+    # with a store: cold commit lands via scratch space …
+    store = ArtifactStore(str(tmp_path / "store"))
+    cold, hit_cold = pmodel.ensure_priors(path, store=store)
+    assert cold.n_frames == 8 and not hit_cold
+    # … and the warm path answers from the store's OBJECT bytes when the
+    # sidecar cannot materialize next to the source either
+    real_mat = ArtifactStore._materialize_one
+
+    def deny_materialize(self, digest, dest):
+        if os.path.dirname(os.path.abspath(dest)) == str(tmp_path):
+            raise OSError(30, "Read-only file system", dest)
+        return real_mat(self, digest, dest)
+
+    monkeypatch.setattr(ArtifactStore, "_materialize_one", deny_materialize)
+    monkeypatch.setattr(
+        medialib, "priors_open",
+        lambda *a, **k: pytest.fail("warm run opened a priors decoder"),
+    )
+    warm, hit_warm = pmodel.ensure_priors(path, store=store)
+    assert hit_warm
+    assert not os.path.isfile(pmodel.sidecar_path(path))
+    assert np.array_equal(cold.mv_rows, warm.mv_rows)
+
+
+def test_complexity_priors_works_without_qp(tmp_path):
+    """FFV1 SRCs (no MV/QP export) still classify from stream bytes."""
+    path = str(tmp_path / "src.avi")
+    write_pan_clip(path, n=8, codec="ffv1", opts="")
+    rec = cx.get_priors_difficulty(path)
+    assert np.isfinite(rec["complexity"])
+    assert rec["qp_mean"] is None and rec["mv_mean_mag"] is None
+
+
+# ------------------------------------------------------------- CLI tools
+
+
+def test_priors_tool_extract_and_show(tmp_path, capsys):
+    import json
+
+    from processing_chain_tpu.tools import priors_tool
+
+    path = str(tmp_path / "pan.mp4")
+    write_pan_clip(path, n=8)
+    store = str(tmp_path / "store")
+
+    assert priors_tool.main(["extract", "-i", path, "--store", store,
+                             "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["files"] == 1 and doc["extracted"] == 1
+    assert doc["cache_hits"] == 0 and doc["frames"] == 8
+
+    # warm re-run plans zero extraction jobs
+    assert priors_tool.main(["extract", "-i", path, "--store", store,
+                             "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["extracted"] == 0 and doc["cache_hits"] == 1
+
+    assert priors_tool.main(["show", path]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["frames"] == 8
+    # mean MV magnitude tracks the known pan (a few sub-partitioned or
+    # intra-coded blocks keep it from being exactly PAN_DX)
+    assert abs(shown["features"]["mean_mag"] - PAN_DX) < 0.8
+
+
+# ------------------------------------------- framesizes AV1 satellite
+
+
+def test_av1_ffprobe_fallback_routes_through_shell(monkeypatch, tmp_path):
+    """The AV1 ffprobe fallback goes through the one subprocess door
+    (runner.shell, subprocess-hygiene) and captures pict_type in the
+    same pass so priors get AV1 frame types without a second probe."""
+    from processing_chain_tpu.io import framesizes
+    from processing_chain_tpu.utils import runner
+
+    calls = {}
+
+    class FakeProc:
+        # third line: pkt_size prints as N/A — the frame must keep its
+        # SLOT (size 0), not vanish and desync positional consumers
+        stdout = "1234,P\n98,I\nN/A,B\n77,B\n"
+
+    def fake_shell(cmd, **kw):
+        assert isinstance(cmd, list) and cmd[0] == "ffprobe"
+        assert "-show_frames" in cmd
+        calls["cmd"] = cmd
+        return FakeProc()
+
+    monkeypatch.setattr(runner, "shell", fake_shell)
+    info = framesizes.ffprobe_av1_frame_info("whatever.mp4")
+    assert info["size"] == [1234, 98, 0, 77]
+    assert info["pict_type"] == ["P", "I", "B", "B"]
+    assert calls  # shell was the door
+
+    # get_framesize_av1 degrades onto it when the native scan fails
+    monkeypatch.setattr(
+        medialib, "scan_packets",
+        lambda *a, **k: (_ for _ in ()).throw(MediaError("no native")),
+    )
+    assert framesizes.get_framesize_av1("whatever.mp4") == [1234, 98, 0, 77]

@@ -111,6 +111,7 @@ def test_complexity_end_to_end(tmp_path):
         [hard, easy, str(tmp_path / "skipped.mp4")],
         tmp_dir=str(tmp_path / "ca"),
         parallelism=2,
+        keep_proxy=True,
     )
     assert list(data["file"]) == ["easy.avi", "hard.avi"]
     csv_path = tmp_path / "ca" / "complexity_classification.csv"
@@ -119,8 +120,17 @@ def test_complexity_end_to_end(tmp_path):
     hard_row = data[data["file"] == "hard.avi"].iloc[0]
     assert hard_row["complexity"] > easy_row["complexity"]
     assert hard_row["complexity_class"] >= easy_row["complexity_class"]
-    # proxy artifacts exist and are h264
+    # --keep-proxy: proxy artifacts exist for reuse
     assert (tmp_path / "ca" / "hard_crf23.avi").is_file()
+
+    # default (no keep_proxy): proxies are scratch-only and cleaned up
+    data2 = complexity.run(
+        [hard, easy], tmp_dir=str(tmp_path / "ca2"), parallelism=2,
+    )
+    assert len(data2) == 2
+    leftovers = [p for p in (tmp_path / "ca2").iterdir()
+                 if p.name.endswith("_crf23.avi") or p.name.startswith(".proxy-")]
+    assert leftovers == []
 
 
 def test_complexity_csv_feeds_test_config(tmp_path):

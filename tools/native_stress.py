@@ -92,6 +92,29 @@ def sws_stress() -> None:
     assert out.shape == (T, H // 2, W // 2)
 
 
+def priors_stress(tmp: str) -> None:
+    """The codec-prior decoder (EXPORT_MVS + QP side data,
+    mp_priors_next_batch) under the sanitizers: encode an x264 pan,
+    extract on a threaded decoder with a small chunk (exercises the
+    pending-frame park path), check the golden counts."""
+    from processing_chain_tpu.priors import extract_priors
+
+    path = os.path.join(tmp, "priors.mp4")
+    rng = np.random.default_rng(11)
+    w, h, n = 192, 128, 24
+    base = rng.integers(0, 255, (h, w + 4 * n), np.uint8)
+    with VideoWriter(path, "libx264", w, h, "yuv420p", (24, 1), gop=250,
+                     bframes=0, opts="qp=20:preset=fast") as wr:
+        u = np.full((h // 2, w // 2), 128, np.uint8)
+        for i in range(n):
+            wr.write(np.ascontiguousarray(base[:, 4 * i:4 * i + w]),
+                     u, u.copy())
+    data = extract_priors(path, chunk_frames=7, threads=4)
+    assert data.n_frames == n, f"priors: {data.n_frames} frames != {n}"
+    assert data.n_mvs > 0, "priors: no motion vectors exported"
+    assert int(data.mv_offsets[-1]) == data.n_mvs, "priors: ragged offsets broke"
+
+
 def main() -> int:
     medialib.ensure_loaded()
     print(f"native_stress: {medialib.version()} "
@@ -112,7 +135,8 @@ def main() -> int:
         workers = [
             threading.Thread(target=run, args=(roundtrip, tmp, f"t{i}", 4))
             for i in range(3)
-        ] + [threading.Thread(target=run, args=(sws_stress,))]
+        ] + [threading.Thread(target=run, args=(sws_stress,)),
+             threading.Thread(target=run, args=(priors_stress, tmp))]
         for t in workers:
             t.start()
         for t in workers:
@@ -124,7 +148,7 @@ def main() -> int:
         # serial pass too: fp pool teardown/reopen in one thread
         roundtrip(tmp, "serial", 4)
     print("native_stress: OK (3 concurrent fp roundtrips + batch sws + "
-          "serial pass, parity held)", flush=True)
+          "priors extraction + serial pass, parity held)", flush=True)
     return 0
 
 
