@@ -250,7 +250,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
         "src-analysis", "complexity", "priors", "plots", "metrics",
         "clean-logs", "run-report", "store", "chain-top", "chain-profile",
         "bench-compare", "chain-lint", "chain-serve", "serve-soak",
-        "queue-crashcheck", "serve-chaos",
+        "queue-crashcheck", "serve-chaos", "fleet-top", "trace",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -270,6 +270,14 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import chain_top
 
             return chain_top.main(rest)
+        if name == "fleet-top":
+            from .tools import fleet_top
+
+            return fleet_top.main(rest)
+        if name == "trace":
+            from .tools import trace_tool
+
+            return trace_tool.main(rest)
         if name == "chain-profile":
             from .tools import chain_profile
 

@@ -122,6 +122,11 @@ class Job:
     #: job_* events so an artifact can always be traced back to who
     #: asked for it
     request_ids: tuple = ()
+    #: distributed-trace ids riding with request_ids (serve requests
+    #: mint one per POST; docs/TELEMETRY.md "Fleet observability &
+    #: tracing"): folded into the same provenance/event surfaces so a
+    #: trace can be stitched from job events alone
+    trace_ids: tuple = ()
     #: why should_run returned False
     #: ("output_exists" | "store_hit" | "store_adopted")
     skip_reason: Optional[str] = None
@@ -286,6 +291,8 @@ class Job:
         provenance = dict(self.provenance)
         if self.request_ids:
             provenance["requests"] = list(self.request_ids)
+        if self.trace_ids:
+            provenance["traces"] = list(self.trace_ids)
         try:
             store.commit(
                 self._plan_hash, self.output_path, producer=self.label,
@@ -312,6 +319,8 @@ class Job:
         }
         if self.request_ids:
             record["requests"] = list(self.request_ids)
+        if self.trace_ids:
+            record["traces"] = list(self.trace_ids)
         os.makedirs(os.path.dirname(self.logfile_path), exist_ok=True)
         from ..utils.fsio import atomic_write_text
 
@@ -322,9 +331,13 @@ class Job:
 
     def run(self) -> Any:
         marked = mark_inprogress(self.output_path)
-        req_fields = (
-            {"requests": list(self.request_ids)} if self.request_ids else {}
-        )
+        req_fields: dict = {}
+        if self.request_ids:
+            req_fields["request_ids"] = list(self.request_ids)
+        if self.trace_ids:
+            req_fields["trace_id"] = self.trace_ids[0]
+            if len(self.trace_ids) > 1:
+                req_fields["trace_ids"] = list(self.trace_ids)
         tm.emit("job_start", job=self.label,
                 output=os.path.basename(self.output_path), **req_fields)
         # live view: this job is in flight from here; its completion also
@@ -347,7 +360,7 @@ class Job:
                 tm.emit(
                     "job_end", job=self.label, status="fail",
                     duration_s=round(time.perf_counter() - t0, 4),
-                    error=repr(exc)[:300],
+                    error=repr(exc)[:300], **req_fields,
                 )
                 raise
         dur = time.perf_counter() - t0

@@ -13,6 +13,7 @@ two requests whose grids overlap share the overlapping units' jobs.
 from __future__ import annotations
 
 import re
+import secrets
 from dataclasses import dataclass, field
 
 from ..config import ids
@@ -23,6 +24,19 @@ from ..config import ids
 PRIORITIES: dict[str, int] = {"interactive": 16, "normal": 4, "bulk": 1}
 
 _TENANT_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]{0,63}$")
+
+#: client-supplied trace ids (distributed-tracing context propagation:
+#: a gateway that already minted a trace can thread it through the
+#: chain); server-minted ones are `tr-<hex>` and always match
+_TRACE_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.:-]{3,127}$")
+
+
+def new_trace_id() -> str:
+    """Mint a fleet-unique trace id for one request. Every
+    POST /v1/requests gets one (client-supplied `trace` wins), and it
+    rides the request doc, the durable queue records, the span journal
+    and the job events end to end (docs/TELEMETRY.md)."""
+    return "tr-" + secrets.token_hex(8)
 
 #: one request may expand to at most this many units (a full config-5
 #: database is 1000 PVSes; anything past this is a typo'd range, and a
@@ -107,6 +121,12 @@ def validate_request(payload: object) -> dict:
     params = payload.get("params", {})
     if not isinstance(params, dict):
         raise RequestError("field 'params' must be a JSON object")
+    trace = payload.get("trace")
+    if trace is not None:
+        if not isinstance(trace, str) or not _TRACE_RE.match(trace):
+            raise RequestError(
+                f"trace {trace!r} does not match {_TRACE_RE.pattern}"
+            )
     return {
         "tenant": tenant,
         "priority": priority,
@@ -114,6 +134,7 @@ def validate_request(payload: object) -> dict:
         "srcs": srcs,
         "hrcs": hrcs,
         "params": params,
+        "trace": trace,
     }
 
 

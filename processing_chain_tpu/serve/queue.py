@@ -61,12 +61,31 @@ from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
 from .. import telemetry as tm
+from ..telemetry import catalog
 from ..utils import lockdebug
 from ..utils.fsio import atomic_write_json
 from ..utils.log import get_logger
+from .spans import SpanJournal, safe_replica_name
 
 _QUEUE_DEPTH = tm.gauge(
     "chain_serve_queue_depth", "jobs waiting in the serve queue"
+)
+# SLO phase histograms (docs/TELEMETRY.md "Fleet observability"): the
+# per-(tenant × priority-class) latency truth the fleet view aggregates
+# against catalog.SLO_BANDS. Queue-wait is observed at claim time
+# (enqueue-or-requeue → claim), execution at settle; the request-level
+# end-to-end histogram lives in serve/service.py.
+_QUEUE_WAIT = tm.histogram(
+    "chain_serve_queue_wait_seconds",
+    "time a unit waited in 'queued' before a claim, per tenant/priority",
+    ("tenant", "priority"),
+    buckets=catalog.SLO_LATENCY_BUCKETS,
+)
+_EXEC_SECONDS = tm.histogram(
+    "chain_serve_execution_seconds",
+    "claim-to-settle execution time of a unit, per tenant/priority",
+    ("tenant", "priority"),
+    buckets=catalog.SLO_LATENCY_BUCKETS,
 )
 _LEASE_STEALS = tm.counter(
     "chain_serve_lease_steals_total",
@@ -184,8 +203,19 @@ class JobRecord:
     priority: str
     output: str           # path RELATIVE to the artifacts root
     requests: list = field(default_factory=list)
+    #: trace ids of the requests this record answers, parallel in spirit
+    #: (not index) to `requests` — the durable half of the request-trace
+    #: context, so a record outliving its submitter still knows its
+    #: traces (docs/TELEMETRY.md "Fleet observability & tracing")
+    trace_ids: list = field(default_factory=list)
     state: str = "queued"
     enqueued_at: float = 0.0
+    #: when the record LAST entered 'queued' (enqueue, re-arm, retry,
+    #: steal, recovery) — the queue-wait SLO phase measures from here,
+    #: not from the original enqueue
+    queued_at: float = 0.0
+    #: when the current owner claimed it (None while queued/terminal)
+    claimed_at: Optional[float] = None
     attempts: int = 0
     error: Optional[str] = None
     error_kind: Optional[str] = None  # transient | permanent (taxonomy)
@@ -206,8 +236,11 @@ class JobRecord:
             "priority": self.priority,
             "output": self.output,
             "requests": list(self.requests),
+            "traces": list(self.trace_ids),
             "state": self.state,
             "enqueuedAt": self.enqueued_at,
+            "queuedAt": self.queued_at,
+            "claimedAt": self.claimed_at,
             "attempts": self.attempts,
             "error": self.error,
             "errorKind": self.error_kind,
@@ -230,8 +263,12 @@ class JobRecord:
             priority=data.get("priority", "normal"),
             output=data.get("output", ""),
             requests=list(data.get("requests", [])),
+            trace_ids=list(data.get("traces", [])),
             state=data.get("state", "queued"),
             enqueued_at=float(data.get("enqueuedAt", 0.0)),
+            queued_at=float(data.get("queuedAt", 0.0)
+                            or data.get("enqueuedAt", 0.0)),
+            claimed_at=data.get("claimedAt"),
             attempts=int(data.get("attempts", 0)),
             error=data.get("error"),
             error_kind=data.get("errorKind"),
@@ -289,7 +326,51 @@ class DurableQueue:
                                "failed": 0, "quarantined": 0, "peer": 0}
         with _REPLICAS_LOCK:
             _LIVE_REPLICAS.add(self.replica)
-        self._recover()
+        try:
+            #: incarnation counter for THIS replica id over this root,
+            #: bumped durably on every open: a stable --replica-id that
+            #: restarts shows up in /status and the span journal as the
+            #: same name with a fresh epoch, so fleet views and traces
+            #: can tell generations apart (chaos restarts, bounces)
+            self.replica_epoch = self._bump_replica_epoch()
+            self.spans = SpanJournal(
+                os.path.join(self.root, "spans"), self.replica,
+                replica_epoch=self.replica_epoch,
+            )
+            self._recover()
+        except BaseException:
+            # a constructor that dies (disk failure mid-recovery, or
+            # the crashcheck harness's injected deaths) must not leak
+            # its liveness claims: a name left in _LIVE_REPLICAS would
+            # make this replica's stale leases look alive forever
+            with _REPLICAS_LOCK:
+                _LIVE_REPLICAS.discard(self.replica)
+            fd, self._lockfd = self._lockfd, -1
+            if fd >= 0:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            raise
+
+    def _bump_replica_epoch(self) -> int:
+        path = os.path.join(
+            self.root, "replica-epochs",
+            safe_replica_name(self.replica) + ".json",
+        )
+        with self._lock:
+            with self._flock():
+                try:
+                    with open(path) as f:
+                        epoch = int(json.load(f).get("epoch", 0)) + 1
+                except (OSError, ValueError, TypeError):
+                    epoch = 1
+                try:
+                    os.makedirs(os.path.dirname(path), exist_ok=True)
+                    atomic_write_json(path, {"epoch": epoch})
+                except OSError:
+                    pass  # identity bookkeeping must not block startup
+        return epoch
 
     # ------------------------------------------------------------ lifecycle
 
@@ -303,6 +384,7 @@ class DurableQueue:
         self.stop_heartbeat()
         with _REPLICAS_LOCK:
             _LIVE_REPLICAS.discard(self.replica)
+        self.spans.close()
         fd, self._lockfd = self._lockfd, -1
         if fd >= 0:
             try:
@@ -587,6 +669,16 @@ class DurableQueue:
                         record.owner = None
                         record.attempts += 1
                         record.error = None
+                        record.queued_at = now
+                        record.claimed_at = None
+                        # span BEFORE persist (spans.py ordering rule)
+                        self.spans.append(
+                            "requeue", job=record.job_id,
+                            plan=record.plan_hash, state="queued",
+                            epoch=record.epoch, requests=record.requests,
+                            traces=record.trace_ids, reason="recovery",
+                            attempts=record.attempts,
+                        )
                         self._persist(record)
                         self._clear_sentinel(record.job_id)
                         self.recovery["requeued"] += 1
@@ -703,6 +795,14 @@ class DurableQueue:
                     disk.owner = None
                     disk.attempts += 1
                     disk.error = None
+                    disk.queued_at = time.time()
+                    disk.claimed_at = None
+                    self.spans.append(
+                        "steal", job=job_id, plan=disk.plan_hash,
+                        state="queued", epoch=disk.epoch,
+                        requests=disk.requests, traces=disk.trace_ids,
+                        from_replica=prev, attempts=disk.attempts,
+                    )
                     try:
                         self._persist(disk)
                     except OSError:
@@ -734,6 +834,7 @@ class DurableQueue:
         priority: str,
         request_id: str,
         output: str,
+        trace_id: Optional[str] = None,
     ) -> tuple[JobRecord, str]:
         """Enqueue one unit (or attach to its in-flight twin). Returns
         (record, outcome) with outcome ∈ new | attached | done |
@@ -744,6 +845,17 @@ class DurableQueue:
         bytes); `quarantined` = the plan failed permanently and will
         not retry until an operator re-arms it (the request is attached
         for forensics, nothing is scheduled)."""
+
+        def _attach_ids(record: JobRecord) -> bool:
+            changed = False
+            if request_id not in record.requests:
+                record.requests.append(request_id)
+                changed = True
+            if trace_id and trace_id not in record.trace_ids:
+                record.trace_ids.append(trace_id)
+                changed = True
+            return changed
+
         with self._lock:
             with self._flock():
                 existing_id = self._by_plan.get(plan_hash)
@@ -764,22 +876,26 @@ class DurableQueue:
                     record = self._read_disk(existing_id) or \
                         self._jobs[existing_id]
                     if record.state in _ATTACHABLE:
-                        if request_id not in record.requests:
-                            record.requests.append(request_id)
+                        if _attach_ids(record):
+                            self.spans.append(
+                                "attach", job=record.job_id,
+                                plan=record.plan_hash, state=record.state,
+                                epoch=record.epoch,
+                                requests=[request_id],
+                                traces=[trace_id] if trace_id else [],
+                            )
                             self._persist(record)
                         self._absorb(record)
                         return record, "attached"
                     if record.state == "done":
-                        if request_id not in record.requests:
-                            record.requests.append(request_id)
+                        if _attach_ids(record):
                             self._persist(record)
                         self._absorb(record)
                         return record, "done"
                     if record.state == "quarantined":
                         # permanent failures do NOT auto-retry: attach
                         # for forensics, refuse until an operator rearms
-                        if request_id not in record.requests:
-                            record.requests.append(request_id)
+                        if _attach_ids(record):
                             self._persist(record)
                         self._absorb(record)
                         return record, "quarantined"
@@ -788,8 +904,13 @@ class DurableQueue:
                     # exhausted its retries last week must not inherit
                     # the spent counter)
                     self._rearm_locked(record)
-                    if request_id not in record.requests:
-                        record.requests.append(request_id)
+                    _attach_ids(record)
+                    self.spans.append(
+                        "enqueue", job=record.job_id,
+                        plan=record.plan_hash, state="queued",
+                        epoch=record.epoch, requests=record.requests,
+                        traces=record.trace_ids, rearm=True,
+                    )
                     self._persist(record)
                     self._absorb(record)
                     self._set_depth_gauge()
@@ -800,6 +921,7 @@ class DurableQueue:
                 while os.path.exists(
                         self._record_path(f"j{self._next_id:06d}")):
                     self._next_id += 1
+                now = time.time()
                 record = JobRecord(
                     job_id=f"j{self._next_id:06d}",
                     plan_hash=plan_hash,
@@ -809,10 +931,18 @@ class DurableQueue:
                     priority=priority,
                     output=output,
                     requests=[request_id],
+                    trace_ids=[trace_id] if trace_id else [],
                     state="queued",
-                    enqueued_at=time.time(),
+                    enqueued_at=now,
+                    queued_at=now,
                 )
                 self._next_id += 1
+                self.spans.append(
+                    "enqueue", job=record.job_id, plan=plan_hash,
+                    state="queued", epoch=record.epoch,
+                    requests=record.requests, traces=record.trace_ids,
+                    tenant=tenant, priority=priority,
+                )
                 self._persist(record)
                 self._absorb(record)
                 self._set_depth_gauge()
@@ -832,6 +962,8 @@ class DurableQueue:
         record.not_before = 0.0
         record.settled_epoch = None
         record.enqueued_at = time.time()
+        record.queued_at = record.enqueued_at
+        record.claimed_at = None
 
     def rearm(self, job_id: str) -> Optional[JobRecord]:
         """Force a terminal record back to queued: the store evicted a
@@ -844,6 +976,12 @@ class DurableQueue:
                 if record is None or record.state in _ATTACHABLE:
                     return record
                 self._rearm_locked(record)
+                self.spans.append(
+                    "enqueue", job=record.job_id, plan=record.plan_hash,
+                    state="queued", epoch=record.epoch,
+                    requests=record.requests, traces=record.trace_ids,
+                    rearm=True,
+                )
                 self._persist(record)
                 self._absorb(record)
                 self._set_depth_gauge()
@@ -872,6 +1010,7 @@ class DurableQueue:
         with no owner while enqueue attaches newcomers to it."""
         owned: list[JobRecord] = []
         reverted: list[dict] = []
+        waited: list[tuple] = []
         now = time.time()
         with self._lock:
             with self._flock():
@@ -882,11 +1021,23 @@ class DurableQueue:
                     if record.state != "queued" or record.not_before > now:
                         self._absorb(record)  # peer moved it meanwhile
                         continue
+                    wait_s = max(
+                        0.0, now - (record.queued_at or record.enqueued_at)
+                    )
                     try:
                         # queue-transition: queued -> running (claim: this worker owns the execution)
                         record.state = "running"
                         record.epoch += 1
                         record.owner = self.replica
+                        record.claimed_at = now
+                        self.spans.append(
+                            "claim", job=job_id, plan=record.plan_hash,
+                            state="running", epoch=record.epoch,
+                            requests=record.requests,
+                            traces=record.trace_ids,
+                            queue_wait_s=round(wait_s, 6),
+                            wave=len(job_ids),
+                        )
                         self._persist(record)
                         self._write_lease(record)
                     except OSError:
@@ -894,6 +1045,13 @@ class DurableQueue:
                         record.state = "queued"
                         record.epoch -= 1
                         record.owner = None
+                        record.claimed_at = None
+                        self.spans.append(
+                            "revert", job=job_id, plan=record.plan_hash,
+                            state="queued", epoch=record.epoch,
+                            requests=record.requests,
+                            traces=record.trace_ids,
+                        )
                         try:
                             self._persist(record)
                         except OSError:
@@ -913,7 +1071,11 @@ class DurableQueue:
                     self._claimed[job_id] = record.epoch
                     self._absorb(record)
                     owned.append(record)
+                    waited.append((record.tenant, record.priority, wait_s))
                 self._set_depth_gauge()
+        for tenant, priority, wait_s in waited:
+            _QUEUE_WAIT.labels(tenant=tenant, priority=priority) \
+                .observe(wait_s)
         for fields in reverted:
             _CLAIM_REVERTS.inc()
             tm.emit("serve_claim_reverted", replica=self.replica, **fields)
@@ -958,6 +1120,7 @@ class DurableQueue:
         a zombie replica resumed after SIGSTOP cannot settle a record
         a live peer stole from it."""
         fenced = None
+        exec_obs: Optional[tuple] = None
         with self._lock:
             with self._flock():
                 base, fenced = self._fence_check(job_id, "complete")
@@ -972,15 +1135,43 @@ class DurableQueue:
                     base.error_kind = None
                     base.done_at = time.time()
                     base.settled_epoch = base.epoch
+                    exec_s = None
+                    if base.claimed_at:
+                        exec_s = max(0.0, base.done_at - base.claimed_at)
+                        if not warm:
+                            exec_obs = (base.tenant, base.priority, exec_s)
+                    self.spans.append(
+                        "complete", job=job_id, plan=base.plan_hash,
+                        state="done", epoch=base.epoch,
+                        requests=base.requests, traces=base.trace_ids,
+                        warm=warm,
+                        exec_s=round(exec_s, 6) if exec_s is not None
+                        else None,
+                    )
                     self._persist(base)
                     self._clear_sentinel(job_id)
                     self._absorb(base)
                     self._set_depth_gauge()
+        if exec_obs is not None:
+            _EXEC_SECONDS.labels(tenant=exec_obs[0],
+                                 priority=exec_obs[1]).observe(exec_obs[2])
         if fenced is not None:
+            self._fenced_span(fenced)
             _FENCED_SETTLES.inc()
             tm.emit("serve_settle_fenced", replica=self.replica, **fenced)
             return None
         return base
+
+    def _fenced_span(self, fenced: dict) -> None:
+        """Forensic span for a refused stale-epoch settle: not part of
+        any record's gapless chain (nothing transitioned), but `tools
+        trace show` renders it so a stolen request's timeline shows the
+        zombie's verdict bouncing off the fence."""
+        self.spans.append(
+            "fenced", job=fenced["job"], plan=fenced["plan"],
+            state="", epoch=fenced["current_epoch"],
+            op=fenced["op"], held_epoch=fenced["held_epoch"],
+        )
 
     def fail(self, job_id: str, error: str, requeue: bool = False,
              backoff_s: float = 0.0,
@@ -1006,16 +1197,33 @@ class DurableQueue:
                         base.attempts += 1
                         base.owner = None
                         base.not_before = time.time() + max(0.0, backoff_s)
+                        base.queued_at = time.time()
+                        base.claimed_at = None
+                        self.spans.append(
+                            "requeue", job=job_id, plan=base.plan_hash,
+                            state="queued", epoch=base.epoch,
+                            requests=base.requests, traces=base.trace_ids,
+                            reason="retry", attempts=base.attempts,
+                            backoff_s=round(max(0.0, backoff_s), 3),
+                            error=base.error, kind=kind,
+                        )
                     else:
                         # queue-transition: running -> failed (attempts budget exhausted)
                         base.state = "failed"
                         base.done_at = time.time()
                         base.settled_epoch = base.epoch
+                        self.spans.append(
+                            "fail", job=job_id, plan=base.plan_hash,
+                            state="failed", epoch=base.epoch,
+                            requests=base.requests, traces=base.trace_ids,
+                            error=base.error, kind=kind,
+                        )
                     self._persist(base)
                     self._clear_sentinel(job_id)
                     self._absorb(base)
                     self._set_depth_gauge()
         if fenced is not None:
+            self._fenced_span(fenced)
             _FENCED_SETTLES.inc()
             tm.emit("serve_settle_fenced", replica=self.replica, **fenced)
             return None
@@ -1042,11 +1250,18 @@ class DurableQueue:
                     base.error_kind = kind
                     base.done_at = time.time()
                     base.settled_epoch = base.epoch
+                    self.spans.append(
+                        "quarantine", job=job_id, plan=base.plan_hash,
+                        state="quarantined", epoch=base.epoch,
+                        requests=base.requests, traces=base.trace_ids,
+                        error=base.error, kind=kind,
+                    )
                     self._persist(base)
                     self._clear_sentinel(job_id)
                     self._absorb(base)
                     self._set_depth_gauge()
         if fenced is not None:
+            self._fenced_span(fenced)
             _FENCED_SETTLES.inc()
             tm.emit("serve_settle_fenced", replica=self.replica, **fenced)
             return None

@@ -347,46 +347,64 @@ class Scheduler:
         try:
             os.makedirs(self.artifacts_root, exist_ok=True)
             runner = JobRunner(parallelism=len(batch), name="serve")
-            by_label: dict[str, JobRecord] = {}
+            # records per label — a LIST, not a single slot: the
+            # cross-replica enqueue race can mint twin records for one
+            # plan (docs/SERVE.md "eventual dedup"), and both twins can
+            # be claimed into one wave (same plan ⟹ same bucket key).
+            # They share one execution (JobRunner dedups the identical
+            # job), but EVERY claimed record must settle — a twin left
+            # in 'running' keeps its lease renewed forever and hangs
+            # its requests. The trace-completeness chaos invariant is
+            # what exposed this.
+            by_label: dict[str, list[JobRecord]] = {}
             out_of: dict[str, str] = {}
             for record in batch:
                 label = f"serve:{record.unit['pvs_id']}:{record.plan_hash[:8]}"
-                by_label[label] = record
+                by_label.setdefault(label, []).append(record)
                 out_of[label] = os.path.join(
                     self.artifacts_root, record.output
                 )
+            for label, records in by_label.items():
+                request_ids = list(dict.fromkeys(
+                    r for rec in records for r in rec.requests))
+                trace_ids = list(dict.fromkeys(
+                    t for rec in records for t in rec.trace_ids))
                 runner.add(Job(
                     label=label,
                     output_path=out_of[label],
                     fn=None,  # bound below, once planning has spoken
-                    plan=record.plan,
+                    plan=records[0].plan,
                     provenance={
-                        "tenant": record.tenant,
-                        "priority": record.priority,
+                        "tenant": records[0].tenant,
+                        "priority": records[0].priority,
                         "executor": self.executor.kind,
+                        "replica": self.queue.replica,
                     },
-                    request_ids=tuple(record.requests),
+                    request_ids=tuple(request_ids),
+                    trace_ids=tuple(trace_ids),
                 ))
             planned = {job.label for job in runner.jobs}
             # store warm path: should_run already verified+materialized
             # the artifact for skipped jobs — complete them right now
-            for label, record in by_label.items():
+            for label, records in by_label.items():
                 if label not in planned:
-                    self._complete(record, settled, warm=True)
+                    for record in records:
+                        self._complete(record, settled, warm=True)
             if not planned:
                 return
             # the wave holds exactly the PLANNED members: a warm-skipped
             # unit must neither be recomputed nor waited for
             wave = _WaveBarrier(
                 self.executor,
-                [_unit_of(by_label[j.label].unit) for j in runner.jobs],
+                [_unit_of(by_label[j.label][0].unit) for j in runner.jobs],
                 [out_of[j.label] for j in runner.jobs],
             )
             for job in runner.jobs:
                 job.fn = wave.produce
             runner.run()
             for label in planned:
-                self._complete(by_label[label], settled)
+                for record in by_label[label]:
+                    self._complete(record, settled)
         except Exception as exc:
             self._settle_failure(batch, settled, exc)
         finally:

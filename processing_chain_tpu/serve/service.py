@@ -48,6 +48,7 @@ import time
 from typing import NamedTuple, Optional
 
 from .. import telemetry as tm
+from ..telemetry import catalog as tm_catalog
 from ..store import runtime as store_runtime
 from ..store.store import StoreCorruption
 from ..telemetry import live
@@ -74,6 +75,13 @@ _REQ_SECONDS = tm.histogram(
 _WARM_REQ_SECONDS = tm.histogram(
     "chain_serve_warm_request_seconds",
     "latency of requests answered entirely from the store",
+)
+_E2E_SECONDS = tm.histogram(
+    "chain_serve_e2e_seconds",
+    "request end-to-end latency (submit to done), per tenant/priority "
+    "— the SLO layer's third phase next to queue-wait and execution",
+    ("tenant", "priority"),
+    buckets=tm_catalog.SLO_LATENCY_BUCKETS,
 )
 
 _HASH_LEN = 64  # sha256 hex
@@ -166,6 +174,7 @@ class ChainServeService:
         routes.add("/v1/requests", self._h_requests, methods=("GET", "POST"))
         routes.add_prefix("/v1/requests/", self._h_request)
         routes.add_prefix("/v1/artifacts/", self._h_artifact)
+        routes.add("/fleet", self._h_fleet)
         self.server = live.LiveServer(port, host=host, routes=routes)
         self._recover_requests()
 
@@ -189,6 +198,7 @@ class ChainServeService:
             "root": self.root,
             "executor": self.executor.kind,
             "replica": self.replica,
+            "replica_epoch": self.queue.replica_epoch,
         })
         get_logger().info(
             "chain-serve: %s (root %s, replica %s, executor %s, queue: %s)",
@@ -381,7 +391,7 @@ class ChainServeService:
                         unit_doc["planPayload"],
                         unit_doc["unit"],
                         doc["tenant"], doc["priority"], req_id,
-                        unit_doc["output"],
+                        unit_doc["output"], trace_id=doc.get("trace"),
                     )
                 elif record.state == "quarantined":
                     # the plan failed PERMANENTLY while the request
@@ -409,7 +419,8 @@ class ChainServeService:
             self._persist_request(doc)
             _REQ_TOTAL.labels(state="failed").inc()
             tm.emit("serve_request_done", request=req_id,
-                    status="failed", error=quarantine_error)
+                    trace_id=doc.get("trace"), status="failed",
+                    error=quarantine_error)
             return
         self._persist_request(doc)  # the new owner stamp, durably
         self._check_request_done(req_id)
@@ -435,6 +446,10 @@ class ChainServeService:
             raise api.RequestError(str(exc)) from exc
         units = api.expand_units(normalized)
         req_id = "req-" + secrets.token_hex(5)
+        # every request gets a trace id (client-supplied context wins):
+        # the thread that ties request docs, queue records, span journal
+        # and job events into one cross-replica timeline
+        trace_id = normalized.get("trace") or api.new_trace_id()
         unit_docs: dict[str, dict] = {}
         plans: dict[str, dict] = {}
         for unit in units:
@@ -453,6 +468,7 @@ class ChainServeService:
             plans[plan_hash] = unit_docs[unit.pvs_id]
         doc = {
             "request": req_id,
+            "trace": trace_id,
             "tenant": normalized["tenant"],
             "priority": normalized["priority"],
             "database": normalized["database"],
@@ -492,7 +508,7 @@ class ChainServeService:
             record, outcome = self.queue.enqueue(
                 plan_hash, unit_doc["planPayload"], unit_doc["unit"],
                 normalized["tenant"], normalized["priority"], req_id,
-                unit_doc["output"],
+                unit_doc["output"], trace_id=trace_id,
             )
             if outcome == "done":
                 # the queue remembers a completion the store no longer
@@ -522,14 +538,15 @@ class ChainServeService:
                 doc["done_at"] = time.time()
                 doc["error"] = quarantine_error
         _REQ_TOTAL.labels(state="accepted").inc()
-        tm.emit("serve_request", request=req_id,
+        tm.emit("serve_request", request=req_id, trace_id=trace_id,
                 tenant=normalized["tenant"],
                 priority=normalized["priority"], units=len(unit_docs),
                 **outcomes)
         if quarantine_error is not None:
             self._persist_request(doc)
             _REQ_TOTAL.labels(state="failed").inc()
-            tm.emit("serve_request_done", request=req_id, status="failed",
+            tm.emit("serve_request_done", request=req_id,
+                    trace_id=trace_id, status="failed",
                     error=quarantine_error)
         self.scheduler.notify()
         self._check_request_done(req_id, submit_t0=t0)
@@ -538,6 +555,7 @@ class ChainServeService:
             latency_ms = self._requests[req_id]["latency_ms"]
         return {
             "request": req_id,
+            "trace": trace_id,
             "state": state,
             "units": len(unit_docs),
             "outcomes": outcomes,
@@ -590,7 +608,8 @@ class ChainServeService:
             self._persist_request(doc)
             _REQ_TOTAL.labels(state="failed").inc()
             tm.emit("serve_request_done", request=doc["request"],
-                    status="failed", error=record.error)
+                    trace_id=doc.get("trace"), status="failed",
+                    error=record.error)
 
     def _check_request_done(self, req_id: str,
                             submit_t0: Optional[float] = None) -> None:
@@ -618,13 +637,19 @@ class ChainServeService:
                 )
             warm = doc.get("warm", False)
             latency_s = (doc["done_at"] - doc["created_at"])
+            tenant = doc["tenant"]
+            priority = doc["priority"]
+            trace_id = doc.get("trace")
         self._persist_request(doc)
         self._prune_finished()
         _REQ_TOTAL.labels(state="completed").inc()
         _REQ_SECONDS.observe(max(0.0, latency_s))
+        _E2E_SECONDS.labels(tenant=tenant, priority=priority) \
+            .observe(max(0.0, latency_s))
         if warm:
             _WARM_REQ_SECONDS.observe(max(0.0, latency_s))
-        tm.emit("serve_request_done", request=req_id, status="done",
+        tm.emit("serve_request_done", request=req_id, trace_id=trace_id,
+                status="done",
                 duration_s=round(max(0.0, latency_s), 4), warm=warm)
 
     def _persist_request(self, doc: dict) -> None:
@@ -693,6 +718,7 @@ class ChainServeService:
                 return None
             out = {
                 "request": doc["request"],
+                "trace": doc.get("trace"),
                 "tenant": doc["tenant"],
                 "priority": doc["priority"],
                 "state": doc["state"],
@@ -752,6 +778,11 @@ class ChainServeService:
     def _status_section(self, query: dict) -> dict:
         section = {
             "executor": self.executor.kind,
+            # replica identity: multi-replica runs must be tellable
+            # apart at a glance (/status, chain-top, the fleet view)
+            "replica": self.replica,
+            "replica_epoch": self.queue.replica_epoch,
+            "pid": os.getpid(),
             "queue": self.queue.counts(),
             "requests": {},
         }
@@ -786,6 +817,15 @@ class ChainServeService:
             return self._json(202, self.submit(payload))
         except api.RequestError as exc:
             return self._json(400, {"error": str(exc)})
+
+    def _h_fleet(self, req: live.WebRequest):
+        """The merged fleet view (telemetry/fleet.py): every replica
+        over this root — discovered via their serve-info files — plus
+        the shared queue/request truth from disk and the SLO layer's
+        merged per-(tenant × priority) histograms."""
+        from ..telemetry import fleet
+
+        return self._json(200, fleet.fleet_view(self.root))
 
     def _h_request(self, req: live.WebRequest):
         req_id = req.path[len("/v1/requests/"):]

@@ -74,6 +74,12 @@ METRICS: dict[str, str] = {
     "chain_serve_fenced_settles_total": "counter",
     "chain_serve_claim_reverts_total": "counter",
     "chain_serve_quarantined_total": "counter",
+    # serve/ SLO phase histograms, per (tenant × priority-class) —
+    # merged across replicas by telemetry/fleet.py and graded against
+    # SLO_BANDS below (docs/TELEMETRY.md "Fleet observability")
+    "chain_serve_queue_wait_seconds": "histogram",
+    "chain_serve_execution_seconds": "histogram",
+    "chain_serve_e2e_seconds": "histogram",
     # priors/ — codec-prior extraction (docs/PRIORS.md)
     "chain_priors_extract_total": "counter",
     "chain_priors_cache_hits_total": "counter",
@@ -123,3 +129,37 @@ EVENTS: frozenset = frozenset({
 
     "log",             # WARNING+ console records bridged into the log
 })
+
+# --------------------------------------------------------------- SLOs
+#
+# Declared latency bands for the serve fleet, per SLO phase and
+# priority class (seconds). The phases map onto the three histograms
+# above: queue_wait_s (enqueue/requeue → claim), execution_s (claim →
+# settle), e2e_s (request submit → done). The fleet view
+# (telemetry/fleet.py, /fleet, tools fleet-top) grades every
+# (tenant × priority) flow against these: a flow is "ok" when at least
+# SLO_TARGET_FRACTION of its observations fall inside the band.
+# Declared HERE — next to the metric names — so the bands are one
+# auditable contract, not per-dashboard folklore; tools serve-soak and
+# serve-chaos read the same declaration.
+
+#: phase -> {priority class -> band, seconds}
+SLO_BANDS: dict[str, dict[str, float]] = {
+    "queue_wait_s": {"interactive": 2.5, "normal": 30.0, "bulk": 300.0},
+    "execution_s": {"interactive": 30.0, "normal": 120.0, "bulk": 600.0},
+    "e2e_s": {"interactive": 60.0, "normal": 300.0, "bulk": 1800.0},
+}
+
+#: a flow meets its SLO when this fraction of observations is in-band
+SLO_TARGET_FRACTION = 0.99
+
+#: bucket layout of the three SLO phase histograms: the default latency
+#: buckets extended PAST every band above. Load-bearing: the fleet
+#: view grades bands from cumulative bucket counts, and a band beyond
+#: the largest finite bucket could never report a breach (every
+#: observation would sit "inside" the +Inf bucket). A test pins
+#: max(band) <= max(finite bucket).
+SLO_LATENCY_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0,
+)

@@ -9,6 +9,15 @@ Same enablement contract as the metrics registry: `emit()` starts with
 one attribute check and allocates nothing while telemetry is off, so the
 call can sit on hot-ish paths unguarded (per-chunk, per-job — never
 per-frame).
+
+Records may carry OPTIONAL distributed-tracing fields (docs/TELEMETRY.md
+"Fleet observability & tracing"): `trace_id` (the request's trace
+context — serve request events carry it; job events carry the first of
+their trace ids plus `trace_ids` when one execution answers several)
+and `request_ids` (every request a job event answers). Emit sites add
+them where the context exists; consumers treat absence as "not
+serve-originated", never as an error — batch-chain events predate the
+serve layer and stay valid without them.
 """
 
 from __future__ import annotations

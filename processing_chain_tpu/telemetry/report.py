@@ -287,6 +287,41 @@ def _spans_section(run: RunData, top: int = 10) -> list[str]:
     return _fmt_table(("span", "count", "total_s", "max_s"), rows)
 
 
+def _serve_section(run: RunData, top: int = 15) -> list[str]:
+    """Serve requests with their trace context: `serve_request` joined
+    to `serve_request_done` by request id, trace id included so `tools
+    trace show <trace-id>` picks up exactly where the report leaves
+    off (docs/TELEMETRY.md "Fleet observability & tracing")."""
+    accepted = _events(run, "serve_request")
+    done = {e.get("request"): e
+            for e in _events(run, "serve_request_done")}
+    if not accepted and not done:
+        return []
+    rows = []
+    for e in accepted[-top:]:
+        req = e.get("request", "?")
+        end = done.get(req, {})
+        outcome = end.get("status", "in-flight")
+        if end.get("warm"):
+            outcome += " (warm)"
+        dur = end.get("duration_s")
+        rows.append((
+            req, e.get("trace_id", "-") or "-",
+            f"{e.get('tenant', '?')}/{e.get('priority', '?')}",
+            e.get("units", "?"), outcome,
+            f"{dur:.3f}" if dur is not None else "-",
+        ))
+    lines = _fmt_table(
+        ("request", "trace", "tenant/priority", "units", "outcome", "s"),
+        rows,
+    )
+    unmatched = sorted(set(done) - {e.get("request") for e in accepted})
+    if unmatched:
+        lines.append(f"settled without an accept event in this log "
+                     f"(peer-replica executions): {len(unmatched)}")
+    return lines
+
+
 def _queue_stats(run: RunData) -> dict[str, dict]:
     """{queue: {samples, mean_depth}} from the depth histogram."""
     out = {}
@@ -489,6 +524,10 @@ def render_report(run: RunData) -> str:
         "top spans:\n" + "\n".join(f"  {l}" for l in _spans_section(run)),
         "pipeline:\n" + "\n".join(_stall_section(run)),
     ]
+    serve = _serve_section(run)
+    if serve:
+        parts.append("serve requests:\n" + "\n".join(
+            f"  {l}" for l in serve))
     attribution = _attribution_section(run)
     if attribution:
         parts.append("bottleneck attribution:\n" + "\n".join(attribution))

@@ -135,6 +135,26 @@ def render(status: dict, note: str = "") -> str:
     if len(tasks) > 20:
         lines.append(f"  … and {len(tasks) - 20} more")
 
+    serve = status.get("serve", {})
+    if serve:
+        # replica identity first: in a multi-replica fleet this is how
+        # an operator tells which daemon the frame describes
+        parts = [
+            f"replica {serve.get('replica', '?')}",
+            f"epoch {serve.get('replica_epoch', '?')}",
+            f"pid {serve.get('pid', status.get('pid', '?'))}",
+        ]
+        queue = serve.get("queue", {})
+        if queue:
+            parts.append("queue " + " ".join(
+                f"{k}={v}" for k, v in sorted(queue.items())))
+        reqs = serve.get("requests", {})
+        if reqs:
+            parts.append("requests " + " ".join(
+                f"{k}={v}" for k, v in sorted(reqs.items())))
+        lines.append("")
+        lines.append("serve: " + "  ".join(parts))
+
     counters = status.get("counters", {})
     if counters:
         lines.append("")

@@ -238,15 +238,21 @@ def run_crashcheck(workdir: Optional[str] = None,
                 queue_mod.atomic_write_json = _FaultyWriter(
                     real_writer, fault_at=k, mode=mode)
                 died = False
-                qf = DurableQueue(root)
+                qf = None
                 try:
+                    # construction is INSIDE the fault scope: opening a
+                    # queue performs durable writes of its own (the
+                    # replica-epoch bump), and a death there must be as
+                    # recoverable as one mid-scenario
+                    qf = DurableQueue(root)
                     _scenario(qf)
                 except _InjectedCrash:
                     died = True
                 finally:
                     # the injected death killed the whole process: its
                     # in-process liveness dies with it, the disk stays
-                    qf.close()
+                    if qf is not None:
+                        qf.close()
                 queue_mod.atomic_write_json = real_writer
                 if not died:
                     violations.append(

@@ -264,8 +264,11 @@ def check_invariants(root: str, poisoned: set,
     """The chaos contract, checked from durable state only (no live
     replica required): requests terminal with the right disposition,
     exactly one verified artifact per done plan, every terminal record
-    settled under the epoch its owner held, no surviving leases, and
-    quarantine exactly for the poisoned plans."""
+    settled under the epoch its owner held, no surviving leases,
+    quarantine exactly for the poisoned plans — and TRACE COMPLETENESS:
+    every terminal record's span chain is gapless (serve/spans.py),
+    even for work whose owner was SIGKILLed mid-wave."""
+    from ..serve import spans as serve_spans
     from ..store.store import ArtifactStore, StoreCorruption
 
     violations: list[str] = []
@@ -332,6 +335,10 @@ def check_invariants(root: str, poisoned: set,
     for plan in poisoned - quarantined_plans:
         violations.append(f"poisoned plan {plan[:12]}… was never "
                           "quarantined")
+    # trace completeness: the span journal must fully explain every
+    # terminal record across all the deaths the schedule delivered
+    violations.extend(serve_spans.verify_completeness(root,
+                                                      records=records))
     return violations
 
 
@@ -339,8 +346,9 @@ def check_invariants(root: str, poisoned: set,
 
 
 def _percentile(values: list, frac: float) -> float:
-    ordered = sorted(values)
-    return ordered[min(len(ordered) - 1, int(len(ordered) * frac))]
+    from ..telemetry.fleet import percentile_exact
+
+    return percentile_exact(values, frac)
 
 
 def run_chaos(args, root: str) -> dict:
@@ -512,6 +520,31 @@ def run_chaos(args, root: str) -> dict:
             for unit in docs.get(req_id, {}).get("units", {}).values():
                 poisoned_plans.add(unit["plan"])
 
+        # fleet view captured WHILE survivors are still serving — the
+        # per-(tenant × priority) SLO histograms merged over the fleet
+        # as they stood during/after the churn (FLEET_OBS artifact)
+        try:
+            from ..telemetry import fleet as fleet_mod
+
+            fleet_doc = fleet_mod.fleet_view(root)
+        except Exception as exc:  # noqa: BLE001 - the view must not sink the run
+            fleet_doc = {"error": repr(exc)}
+            failures.append(f"fleet view failed to build: {exc!r}")
+        report["fleet"] = {
+            "alive": fleet_doc.get("alive"),
+            "replicas": len(fleet_doc.get("replicas", [])),
+            "spans": fleet_doc.get("spans"),
+            "slo_flows": sum(
+                len(p) for t in fleet_doc.get("slo", {}).values()
+                for p in t.values()
+            ),
+        }
+        if args.fleet_out:
+            atomic_write_json(args.fleet_out, fleet_doc)
+        if not fleet_doc.get("slo"):
+            failures.append("fleet view carries no SLO histograms — "
+                            "the phase metrics never recorded")
+
         counters = _scrape_metrics(live())
         report["counters"] = counters
         report["kills_done"] = kills_done
@@ -617,6 +650,30 @@ def run_self_test(args, root: str) -> int:
         return 1
     manifest = store.lookup(victim_plan)
     os.unlink(store.object_path(manifest.object["sha256"]))
+    # 4) a trace gap: strip one done job's claim spans from every
+    # journal — its terminal record is then unexplained (an ownership
+    # epoch no span introduced), which the completeness check must flag
+    gap_job = done[-1]["job"]
+    spans_dir = os.path.join(root, "queue", "spans")
+    for name in os.listdir(spans_dir):
+        if not name.endswith(".jsonl"):
+            continue
+        path = os.path.join(spans_dir, name)
+        with open(path) as f:
+            lines = f.readlines()
+        kept = []
+        for line in lines:
+            try:
+                span = json.loads(line)
+            except ValueError:
+                kept.append(line)
+                continue
+            if span.get("job") == gap_job and span.get("phase") == "claim":
+                continue
+            kept.append(line)
+        # chainlint: disable=atomic-write (self-test tamper harness: deliberately corrupting the journal the checker must then flag)
+        with open(path, "w") as f:
+            f.writelines(kept)
     violations = check_invariants(root, set())
     classes = {
         "fenced": any("fenced settle was ACCEPTED" in v
@@ -625,6 +682,8 @@ def run_self_test(args, root: str) -> int:
                       for v in violations),
         "artifact": any(("no store artifact" in v or
                          "corrupt artifact" in v) for v in violations),
+        "trace": any(("chain has a gap" in v or
+                      "no spans at all" in v) for v in violations),
     }
     print(json.dumps({"self_test": True, "violations": violations,
                       "classes": classes}))
@@ -675,6 +734,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--timeout-s", type=float, default=180.0)
     p.add_argument("--out", default=None,
                    help="also write the JSON report here")
+    p.add_argument("--fleet-out", default=None,
+                   help="write the merged fleet view (replicas + SLO "
+                        "histograms captured during churn) here")
     p.add_argument("--root", default=None,
                    help="shared fleet root (default: a fresh temp dir)")
     p.add_argument("--self-test", action="store_true",

@@ -11,6 +11,13 @@ promises (ROADMAP open item #2, docs/SERVE.md):
   * a warm re-run of the same grids answers in milliseconds
     (measured, reported, and gated against --warm-budget-ms).
 
+The report also breaks the cold pass's latency into the SLO phases
+the fleet layer grades (docs/TELEMETRY.md "Fleet observability"):
+p50/p95/p99 of queue-wait (enqueue→claim) and execution (claim→settle)
+from the span journal's exact timestamps, plus request end-to-end —
+so a soak regression says WHICH phase moved, not just that warm p50
+did.
+
 Prints one JSON report line (the `SERVE_SOAK_*.json` artifact committed
 with the PR) and exits nonzero on any violated invariant.
 
@@ -24,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import tempfile
 import threading
 import time
@@ -44,6 +52,40 @@ def _grid(client: int, n_srcs: int, n_hrcs: int, overlap: float) -> dict:
              for i in range(n_srcs - shared)]
     hrcs = [f"HRC{100 + i:03d}" for i in range(n_hrcs)]
     return {"srcs": srcs, "hrcs": hrcs}
+
+
+def _percentiles_ms(values: list) -> Optional[dict]:
+    """{p50, p95, p99} in milliseconds (exact order statistics — the
+    soak has every observation, no bucket estimate needed)."""
+    from ..telemetry.fleet import percentile_exact
+
+    if not values:
+        return None
+    return {"p50": round(percentile_exact(values, 0.50) * 1e3, 3),
+            "p95": round(percentile_exact(values, 0.95) * 1e3, 3),
+            "p99": round(percentile_exact(values, 0.99) * 1e3, 3),
+            "n": len(values)}
+
+
+def phase_latencies(root: str, e2e_s: list) -> dict:
+    """Per-phase latency percentiles from the span journal (queue-wait
+    and execution ride the claim/settle spans) + the caller's request
+    end-to-end samples."""
+    from ..serve import spans as serve_spans
+
+    journal = serve_spans.read_journals(
+        os.path.join(root, "queue", "spans"))
+    queue_wait = [s["queue_wait_s"] for s in journal
+                  if s.get("phase") == "claim"
+                  and s.get("queue_wait_s") is not None]
+    execution = [s["exec_s"] for s in journal
+                 if s.get("phase") == "complete"
+                 and s.get("exec_s") is not None and not s.get("warm")]
+    return {
+        "queue_wait_ms": _percentiles_ms(queue_wait),
+        "execution_ms": _percentiles_ms(execution),
+        "e2e_ms": _percentiles_ms(e2e_s),
+    }
 
 
 def _planned_serve_jobs() -> int:
@@ -138,6 +180,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"duplicate executions: {planned} jobs planned for "
                 f"{len(unique_plans)} unique plans"
             )
+
+        # per-phase latency percentiles (queue-wait vs execution vs
+        # end-to-end), from the span journal's exact timestamps
+        e2e_s = []
+        for rid in req_ids:
+            doc = service.request_status(rid)
+            if doc and doc.get("latency_ms") is not None:
+                e2e_s.append(doc["latency_ms"] / 1e3)
+        report["latency_phases"] = phase_latencies(root, e2e_s)
+        if not report["latency_phases"]["queue_wait_ms"]:
+            failures.append("span journal recorded no claim spans — "
+                            "phase latency accounting is broken")
 
         # warm pass: same grids again — store hits, millisecond latency
         warm_latencies = []

@@ -1,0 +1,497 @@
+"""Fleet observability & request tracing (docs/TELEMETRY.md "Fleet
+observability & tracing"): span-journal determinism, cross-replica
+trace stitching across a steal, SLO histogram bucket math, the trace
+CLI, and the fleet view's dead-replica tolerance.
+
+The replica shape mirrors tests/test_serve_replicas.py: two
+DurableQueues over one root stand in for two daemon processes."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.serve import spans as serve_spans
+from processing_chain_tpu.serve.executors import SyntheticExecutor
+from processing_chain_tpu.serve.queue import DurableQueue
+from processing_chain_tpu.serve.scheduler import Scheduler
+from processing_chain_tpu.serve.service import ChainServeService
+from processing_chain_tpu.store import runtime as store_runtime
+from processing_chain_tpu.telemetry import catalog, fleet
+from processing_chain_tpu.tools import fleet_top, trace_tool
+
+
+def _unit(n=1):
+    return {"database": "P2STR01", "src": f"SRC{100 + n:03d}",
+            "hrc": "HRC100", "params": {},
+            "pvs_id": f"P2STR01_SRC{100 + n:03d}_HRC100"}
+
+
+def _enqueue(queue, plan_hash, request_id, n=1, trace=None):
+    return queue.enqueue(plan_hash, {"op": "t", "k": plan_hash}, _unit(n),
+                         "acme", "normal", request_id,
+                         f"{plan_hash[:8]}.bin", trace_id=trace)
+
+
+@pytest.fixture
+def serve_factory(tmp_path):
+    created = []
+
+    def make(subdir="serve", **kw):
+        svc = ChainServeService(
+            root=str(tmp_path / subdir), port=0, **kw
+        ).start()
+        created.append(svc)
+        return svc
+
+    yield make
+    for svc in created:
+        svc.stop()
+    store_runtime.configure(None)
+    tm.disable()
+
+
+# ------------------------------------------------------ span journal
+
+
+def test_span_journal_append_replay_roundtrip(tmp_path):
+    """Appended spans replay byte-identically (same fields, same
+    order) and merged reads across journals are (ts, replica, seq)
+    ordered — the determinism the trace tool depends on."""
+    root = str(tmp_path / "spans")
+    j = serve_spans.SpanJournal(root, "rep-a", replica_epoch=3)
+    j.append("enqueue", job="j1", plan="p" * 64, state="queued",
+             epoch=0, requests=["r1"], traces=["tr-1"])
+    j.append("claim", job="j1", plan="p" * 64, state="running",
+             epoch=1, requests=["r1"], traces=["tr-1"],
+             queue_wait_s=0.5)
+    j.close()
+    out = serve_spans.read_journal(os.path.join(root, "rep-a.jsonl"))
+    assert [s["phase"] for s in out] == ["enqueue", "claim"]
+    assert [s["seq"] for s in out] == [1, 2]
+    assert out[0]["replica"] == "rep-a"
+    assert out[0]["replica_epoch"] == 3
+    assert out[0]["traces"] == ["tr-1"]
+    assert out[1]["queue_wait_s"] == 0.5
+    # a second journal merges in wall-clock order
+    j2 = serve_spans.SpanJournal(root, "rep-b")
+    j2.append("steal", job="j1", plan="p" * 64, state="queued", epoch=2)
+    j2.close()
+    merged = serve_spans.read_journals(root)
+    assert [s["phase"] for s in merged] == ["enqueue", "claim", "steal"]
+
+
+def test_span_journal_tolerates_torn_tail_and_garbage_names(tmp_path):
+    root = str(tmp_path / "spans")
+    j = serve_spans.SpanJournal(root, "rep/../weird name")
+    j.append("enqueue", job="j1", plan="p", state="queued", epoch=0)
+    j.close()
+    # the journal name is sanitized into the root, no traversal
+    (name,) = os.listdir(root)
+    assert "/" not in name and name.endswith(".jsonl")
+    path = os.path.join(root, name)
+    with open(path, "a") as f:
+        f.write('{"phase": "claim", "job": "j1", "trunc')  # torn tail
+    out = serve_spans.read_journal(path)
+    assert [s["phase"] for s in out] == ["enqueue"]
+
+
+def test_verify_chain_flags_gaps_and_mismatched_terminals():
+    plan = "p" * 64
+
+    def span(phase, epoch, **extra):
+        return {"phase": phase, "job": "j1", "plan": plan,
+                "epoch": epoch, "ts": 0.0, **extra}
+
+    record = {"job": "j1", "state": "done", "epoch": 3,
+              "settledEpoch": 3}
+    good = [span("enqueue", 0), span("claim", 1), span("steal", 2),
+            span("claim", 3), span("complete", 3)]
+    assert serve_spans.verify_chain(good, record) == []
+    # missing the steal that introduced epoch 2: a gap
+    gap = [good[0], good[1], good[3], good[4]]
+    (violation,) = serve_spans.verify_chain(gap, record)
+    assert "gap" in violation and "[2]" in violation
+    # terminal span disagrees with the record's state
+    wrong = good[:-1] + [span("fail", 3)]
+    violations = serve_spans.verify_chain(wrong, record)
+    assert any("'fail'" in v and "'done'" in v for v in violations)
+    # non-terminal records are not judged (their chain is in flight)
+    assert serve_spans.verify_chain(
+        [good[0]], {"job": "j1", "state": "running", "epoch": 1}) == []
+    # a terminal record with no spans at all is the loudest gap
+    assert serve_spans.verify_chain([], record)
+
+
+# ------------------------------------------- cross-replica stitching
+
+
+def test_trace_stitches_across_a_steal(tmp_path):
+    """rep-a claims and dies (close() without settling); rep-b steals,
+    re-claims, completes. The merged journal must yield ONE gapless
+    chain naming both replicas, and verify_completeness must pass."""
+    root = str(tmp_path / "q")
+    qa = DurableQueue(root, replica="rep-a", lease_s=0.2)
+    qb = DurableQueue(root, replica="rep-b", lease_s=0.2)
+    try:
+        plan = "ab" * 32
+        rec, _ = _enqueue(qa, plan, "req-1", trace="tr-steal")
+        assert qa.claim([rec.job_id])
+        qa.close()  # the owner dies un-settled
+        deadline = time.monotonic() + 5.0
+        stolen = 0
+        while time.monotonic() < deadline and not stolen:
+            stolen = qb.poll()["stolen"]
+            time.sleep(0.05)
+        assert stolen == 1
+        assert qb.claim([rec.job_id])
+        assert qb.complete(rec.job_id) is not None
+        spans = serve_spans.read_journals(os.path.join(root, "spans"))
+        chain = serve_spans.spans_for_job(spans, rec.job_id)
+        phases = [(s["phase"], s["replica"]) for s in chain]
+        assert ("enqueue", "rep-a") in phases
+        assert ("claim", "rep-a") in phases
+        assert ("steal", "rep-b") in phases
+        assert phases[-1] == ("complete", "rep-b")
+        # trace ids survived the ownership change
+        assert chain[-1]["traces"] == ["tr-steal"]
+        # the serve-root layout verify_completeness expects: <root>/queue
+        serve_root = str(tmp_path / "sroot")
+        os.makedirs(serve_root)
+        os.symlink(root, os.path.join(serve_root, "queue"))
+        assert serve_spans.verify_completeness(serve_root) == []
+    finally:
+        qb.close()
+
+
+def test_fenced_settle_writes_forensic_span(tmp_path):
+    """A zombie's refused settle lands in the journal as a `fenced`
+    span — visible in timelines, excluded from chain grading."""
+    root = str(tmp_path / "q")
+    qa = DurableQueue(root, replica="rep-a", lease_s=0.2)
+    qb = DurableQueue(root, replica="rep-b", lease_s=0.2)
+    try:
+        rec, _ = _enqueue(qa, "cd" * 32, "req-1")
+        assert qa.claim([rec.job_id])
+        time.sleep(0.3)  # the lease expires; qa plays the zombie
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not qb.poll()["stolen"]:
+            time.sleep(0.05)
+        assert qb.claim([rec.job_id])
+        assert qa.complete(rec.job_id) is None  # fenced
+        assert qb.complete(rec.job_id) is not None
+        spans = serve_spans.read_journals(os.path.join(root, "spans"))
+        fenced = [s for s in spans if s["phase"] == "fenced"]
+        assert len(fenced) == 1
+        assert fenced[0]["replica"] == "rep-a"
+        assert fenced[0]["held_epoch"] == 1
+        assert fenced[0]["epoch"] == 3  # the current owner's epoch
+    finally:
+        qa.close()
+        qb.close()
+
+
+def test_twin_records_for_one_plan_both_settle(tmp_path):
+    """Regression: the cross-replica enqueue race can mint TWO records
+    for one plan, and wave packing claims both into one dispatch (same
+    plan ⟹ same bucket). Both must settle — before the fix the label
+    collision left one twin 'running' forever under a renewed lease
+    (found by the trace-completeness chaos invariant)."""
+    tm.enable()
+    root = str(tmp_path / "q")
+    store_runtime.configure(str(tmp_path / "store"))
+    try:
+        qa = DurableQueue(root, replica="rep-a", lease_s=5.0)
+        qb = DurableQueue(root, replica="rep-b", lease_s=5.0)
+        unit = {"database": "P2STR01", "src": "SRC100", "hrc": "HRC100",
+                "params": {"geometry": [16, 9], "size_bytes": 64},
+                "pvs_id": "P2STR01_SRC100_HRC100"}
+        executor = SyntheticExecutor()
+        plan = executor.plan(
+            type("U", (), {"database": "P2STR01", "src": "SRC100",
+                           "hrc": "HRC100",
+                           "params": unit["params"]})())
+        plan_hash = store_runtime.active().plan_hash(plan)
+        # twins: each replica mints its own record for the same plan
+        # (qb enqueues before any poll, inside the dedup race window)
+        ra, _ = qa.enqueue(plan_hash, plan, unit, "acme", "normal",
+                           "req-a", "twin-a.bin")
+        qb._last_refresh = time.time()  # pin the race: no rescan
+        rb, _ = qb.enqueue(plan_hash, plan, unit, "acme", "normal",
+                           "req-b", "twin-b.bin")
+        assert ra.job_id != rb.job_id
+        qa.poll()  # now qa sees both twins
+        sched = Scheduler(qa, executor, str(tmp_path / "arts"),
+                          workers=1, wave_width=4)
+        batch = qa.claim([ra.job_id, rb.job_id])
+        assert len(batch) == 2
+        sched._dispatch(batch)
+        for job_id in (ra.job_id, rb.job_id):
+            assert qa.record(job_id).state == "done", job_id
+        qa.close()
+        qb.close()
+    finally:
+        store_runtime.configure(None)
+        tm.disable()
+
+
+# ------------------------------------------------- SLO bucket math
+
+
+def test_percentile_and_band_math():
+    # cumulative buckets: 10 obs ≤0.1, 90 ≤1.0, 100 ≤+Inf
+    buckets = {"0.1": 10, "1.0": 90, "+Inf": 100}
+    assert fleet.percentile_from_buckets(buckets, 0.05) == 0.1
+    assert fleet.percentile_from_buckets(buckets, 0.50) == 1.0
+    # the tail lives past the largest finite bound: clamp to it
+    assert fleet.percentile_from_buckets(buckets, 0.99) == 1.0
+    assert fleet.percentile_from_buckets({}, 0.5) is None
+    assert fleet.percentile_from_buckets({"0.1": 0, "+Inf": 0}, 0.5) \
+        is None
+    assert fleet.band_fraction(buckets, 0.1) == pytest.approx(0.1)
+    assert fleet.band_fraction(buckets, 1.0) == pytest.approx(0.9)
+    assert fleet.band_fraction(buckets, 50.0) == pytest.approx(1.0)
+
+
+def test_prometheus_parse_merge_roundtrip():
+    """Two replicas' /metrics renders (the registry's own format) merge
+    bucket-wise; the grades come out against catalog.SLO_BANDS."""
+    from processing_chain_tpu.telemetry.metrics import MetricsRegistry
+
+    def render(values):
+        reg = MetricsRegistry()
+        reg.enabled = True
+        h = reg.histogram("chain_serve_queue_wait_seconds", "t",
+                          ("tenant", "priority"))
+        for v in values:
+            h.labels(tenant="acme", priority="interactive").observe(v)
+        return reg.render_prometheus()
+
+    a = fleet.parse_histograms(render([0.01, 0.2]),
+                               fleet.PHASE_METRICS.values())
+    b = fleet.parse_histograms(render([0.02, 30.0]),
+                               fleet.PHASE_METRICS.values())
+    merged = fleet.merge_histograms([a, b])
+    (key,) = merged
+    assert key[0] == "chain_serve_queue_wait_seconds"
+    assert merged[key]["count"] == 4
+    assert merged[key]["sum"] == pytest.approx(30.23)
+    report = fleet.slo_report(merged)
+    cell = report["acme"]["interactive"]["queue_wait_s"]
+    assert cell["count"] == 4
+    assert cell["band_s"] == catalog.SLO_BANDS["queue_wait_s"]["interactive"]
+    # 3 of 4 observations inside the 2.5 s interactive band: 75% < 99%
+    assert cell["within_band"] == pytest.approx(0.75)
+    assert cell["ok"] is False
+    assert cell["p50"] is not None
+
+
+def test_slo_bands_cover_every_priority_class():
+    from processing_chain_tpu.serve.api import PRIORITIES
+
+    largest_bucket = max(catalog.SLO_LATENCY_BUCKETS)
+    for phase, bands in catalog.SLO_BANDS.items():
+        assert set(bands) == set(PRIORITIES), phase
+        assert all(v > 0 for v in bands.values())
+        # a band past the largest finite bucket could never report a
+        # breach (everything would grade "inside" via the +Inf bucket)
+        assert all(v <= largest_bucket for v in bands.values()), phase
+    assert 0 < catalog.SLO_TARGET_FRACTION <= 1.0
+
+
+def test_journal_stats_tail_sampling(tmp_path):
+    root = str(tmp_path / "spans")
+    j = serve_spans.SpanJournal(root, "rep-a")
+    for i in range(50):
+        j.append("enqueue", job=f"j{i}", plan="p", state="queued",
+                 epoch=0)
+    j.close()
+    exact = serve_spans.journal_stats(root)
+    assert exact["total"] == 50 and not exact["sampled"]
+    assert exact["by_phase"] == {"enqueue": 50}
+    assert exact["files"] == 1 and exact["bytes"] > 0
+    window = serve_spans.journal_stats(root, tail_bytes=400)
+    assert window["sampled"] is True
+    assert 0 < window["total"] < 50  # recent window only, flagged
+
+
+# --------------------------------------------------- trace tool CLI
+
+
+def test_trace_show_cli_end_to_end(serve_factory, tmp_path, capsys):
+    svc = serve_factory(workers=2)
+    acc = svc.submit({
+        "tenant": "acme", "priority": "interactive",
+        "database": "P2STR01", "srcs": ["SRC100"],
+        "hrcs": ["HRC100", "HRC101"],
+        "params": {"size_bytes": 128},
+        "trace": "tr-client-ctx",
+    })
+    assert acc["trace"] == "tr-client-ctx"  # client context wins
+    assert svc.wait_request(acc["request"], timeout=30.0) == "done"
+    chrome_path = str(tmp_path / "trace.json")
+    rc = trace_tool.main(["show", acc["request"], "--root", svc.root,
+                          "--chrome", chrome_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tr-client-ctx" in out
+    assert "trace: COMPLETE" in out
+    assert "enqueue" in out and "claim" in out and "complete" in out
+    with open(chrome_path) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"]
+    assert doc["otherData"]["request"] == acc["request"]
+    # lookup by trace id resolves to the same request
+    rc = trace_tool.main(["show", "tr-client-ctx", "--root", svc.root])
+    assert rc == 0
+    capsys.readouterr()
+    # a gateway trace shared by a SECOND request renders BOTH timelines
+    # (an arbitrary pick would claim COMPLETE while hiding a request)
+    acc2 = svc.submit({
+        "tenant": "acme", "priority": "interactive",
+        "database": "P2STR01", "srcs": ["SRC101"], "hrcs": ["HRC100"],
+        "params": {"size_bytes": 128}, "trace": "tr-client-ctx",
+    })
+    assert svc.wait_request(acc2["request"], timeout=30.0) == "done"
+    rc = trace_tool.main(["show", "tr-client-ctx", "--root", svc.root])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert acc["request"] in out and acc2["request"] in out
+    # ls lists it
+    assert trace_tool.main(["ls", "--root", svc.root]) == 0
+    out = capsys.readouterr().out
+    assert acc["request"] in out and "tr-client-ctx" in out
+    # unknown ref: exit 1
+    assert trace_tool.main(["show", "req-nope", "--root", svc.root]) == 1
+
+
+def test_request_docs_and_records_carry_trace_ids(serve_factory):
+    svc = serve_factory(workers=1)
+    acc = svc.submit({
+        "tenant": "acme", "database": "P2STR01", "srcs": ["SRC100"],
+        "hrcs": ["HRC100"], "params": {"size_bytes": 128},
+    })
+    assert acc["trace"].startswith("tr-")
+    assert svc.wait_request(acc["request"], timeout=30.0) == "done"
+    status = svc.request_status(acc["request"])
+    assert status["trace"] == acc["trace"]
+    with open(os.path.join(svc.requests_dir,
+                           acc["request"] + ".json")) as f:
+        doc = json.load(f)
+    assert doc["trace"] == acc["trace"]
+    (record,) = [svc.queue.record(j) for j in [
+        json.load(open(os.path.join(svc.root, "queue", "jobs", n)))["job"]
+        for n in os.listdir(os.path.join(svc.root, "queue", "jobs"))
+        if n.endswith(".json")
+    ]]
+    assert acc["trace"] in record.trace_ids
+
+
+# ------------------------------------------------------- fleet view
+
+
+def test_fleet_view_with_one_dead_replica_renders(serve_factory):
+    """Two info files — one live service, one stale claim pointing at
+    a dead port. The view must mark the dead one and still merge the
+    live one's SLO data; fleet-top must render it without crashing."""
+    svc = serve_factory(workers=2)
+    acc = svc.submit({
+        "tenant": "acme", "priority": "bulk", "database": "P2STR01",
+        "srcs": ["SRC100", "SRC101"], "hrcs": ["HRC100"],
+        "params": {"size_bytes": 128},
+    })
+    assert svc.wait_request(acc["request"], timeout=30.0) == "done"
+    # a dead peer: its info file survives, its port answers nothing
+    with open(os.path.join(svc.root, "replica-dead.json"), "w") as f:
+        json.dump({"url": "http://127.0.0.1:9", "replica": "ghost",
+                   "pid": 999999, "replica_epoch": 7}, f)
+    view = fleet.fleet_view(svc.root, timeout_s=1.0)
+    by_name = {r["replica"]: r for r in view["replicas"]}
+    assert set(by_name) == {svc.replica, "ghost"}
+    assert by_name["ghost"]["alive"] is False
+    assert by_name["ghost"]["error"] == "unreachable"
+    assert by_name[svc.replica]["alive"] is True
+    assert by_name[svc.replica]["replica_epoch"] == \
+        svc.queue.replica_epoch
+    assert view["alive"] == 1
+    assert view["queue"].get("done", 0) >= 2
+    assert view["requests"].get("done", 0) >= 1
+    cell = view["slo"]["acme"]["bulk"]["e2e_s"]
+    assert cell["count"] >= 1 and cell["ok"] in (True, False)
+    assert view["spans"]["total"] >= 6
+    frame = fleet_top.render(view)
+    assert "ghost" in frame and "DEAD" in frame
+    assert svc.replica in frame
+    assert "acme/bulk" in frame
+    # the /fleet endpoint serves the same document
+    import urllib.request
+
+    with urllib.request.urlopen(svc.server.url + "/fleet",
+                                timeout=10) as resp:
+        served = json.load(resp)
+    assert {r["replica"] for r in served["replicas"]} == set(by_name)
+
+
+def test_status_and_chain_top_show_replica_identity(serve_factory):
+    from processing_chain_tpu.telemetry import live
+    from processing_chain_tpu.tools import chain_top
+
+    svc = serve_factory(workers=1)
+    status = live.build_status({})
+    serve = status["serve"]
+    assert serve["replica"] == svc.replica
+    assert serve["replica_epoch"] == svc.queue.replica_epoch
+    assert serve["pid"] == os.getpid()
+    frame = chain_top.render(status)
+    assert f"replica {svc.replica}" in frame
+    assert f"epoch {svc.queue.replica_epoch}" in frame
+
+
+def test_soak_phase_percentiles(tmp_path):
+    from processing_chain_tpu.tools.serve_soak import (
+        _percentiles_ms, phase_latencies,
+    )
+
+    assert _percentiles_ms([]) is None
+    p = _percentiles_ms([0.1, 0.2, 0.3, 0.4])
+    assert p["n"] == 4 and p["p50"] == 300.0 and p["p99"] == 400.0
+    # a tiny journal: one claim + one complete span
+    root = str(tmp_path)
+    j = serve_spans.SpanJournal(os.path.join(root, "queue", "spans"),
+                                "rep-a")
+    j.append("claim", job="j1", plan="p", state="running", epoch=1,
+             queue_wait_s=0.25)
+    j.append("complete", job="j1", plan="p", state="done", epoch=1,
+             exec_s=0.5, warm=False)
+    j.append("complete", job="j2", plan="q", state="done", epoch=1,
+             exec_s=9.0, warm=True)  # warm settles are excluded
+    j.close()
+    phases = phase_latencies(root, [1.5])
+    assert phases["queue_wait_ms"]["p50"] == 250.0
+    assert phases["execution_ms"] == {"p50": 500.0, "p95": 500.0,
+                                      "p99": 500.0, "n": 1}
+    assert phases["e2e_ms"]["p50"] == 1500.0
+
+
+def test_events_carry_trace_fields(serve_factory):
+    tm.enable()
+    tm.EVENTS.clear()
+    svc = serve_factory(workers=1)
+    acc = svc.submit({
+        "tenant": "acme", "database": "P2STR01", "srcs": ["SRC100"],
+        "hrcs": ["HRC100"], "params": {"size_bytes": 128},
+    })
+    assert svc.wait_request(acc["request"], timeout=30.0) == "done"
+    records = tm.EVENTS.records()
+    accepted = [r for r in records if r["event"] == "serve_request"]
+    assert accepted and accepted[-1]["trace_id"] == acc["trace"]
+    done = [r for r in records if r["event"] == "serve_request_done"]
+    assert done and done[-1]["trace_id"] == acc["trace"]
+    job_starts = [r for r in records if r["event"] == "job_start"
+                  and r.get("trace_id")]
+    assert job_starts and job_starts[-1]["trace_id"] == acc["trace"]
+    assert acc["request"] in job_starts[-1]["request_ids"]
