@@ -1,0 +1,449 @@
+"""The production executor: real databases through chain-serve.
+
+`ChainExecutor` closes ROADMAP open item 2: a POSTed request whose
+``params.config`` names a database YAML (the P.NATS Phase 2 grammar the
+whole chain parses — config/test_config.py) expands into per-PVS units,
+and each wave drives the REAL p01–p04 stages through the engine
+JobRunner — segment encodes, metadata sidecars, the AVPVS render +
+stalling pass, and every PostProcessing's CPVS. Every stage artifact is
+committed to the content-addressed store under its own plan hash by the
+engine jobs themselves (exactly as a batch `p00` run would), so
+``/v1/artifacts/<hash>`` serves all four artifact families; the serve
+unit's own artifact is a small deterministic **manifest** naming each
+family's store hash, which is what a client walks to fetch them.
+
+Identity: the unit plan folds ``file_ref(config)`` + ``file_ref(src)``
++ the byte-affecting knob values (effective AVPVS codec, FFV1 slices,
+resize method — exactly the ``plan``-status inputs of
+store/plan_schema.py). Folding the knobs is what keeps the manifest
+byte-deterministic per plan hash (the PC_PLAN_DEBUG gate): the inner
+artifact hashes the manifest lists are pure functions of (config bytes,
+SRC bytes, knobs). A config edit re-runs the serve unit, but the inner
+jobs are plan-hashed individually — everything untouched is a store
+warm hit, so the re-run rebuilds only what the edit actually changed.
+
+Execution discipline: chain waves SERIALIZE through a process-wide lock.
+Two concurrent waves could otherwise both plan an encode of a segment
+shared by sibling HRCs (one JobRunner dedups writers; two independent
+ones cannot), and the device stages share one backend anyway. Across
+replica processes the same overlap is benign-by-determinism (identical
+plans produce identical bytes and the store commit is idempotent), but
+deployments that hammer one database from many replicas should shard
+databases per replica (docs/SERVE.md "Real database execution").
+
+Online services (YouTube/Bitmovin segments) are refused as PERMANENT
+failures: an always-on daemon must not reach for the network because a
+config asked it to.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..store import keys
+from ..store import runtime as store_runtime
+from ..utils import lockdebug
+from ..utils.fsio import atomic_write_text
+from ..utils.runner import ChainError
+from .api import RequestError, Unit
+from .executors import record_waves
+
+#: one chain wave at a time per process (module docstring)
+_EXEC_LOCK = lockdebug.make_lock("serve_chain_exec")
+
+#: JobRunner pool widths per phase (p01/p02 are host-pool work like the
+#: batch stages; p03/p04 pipeline internally — engine/jobs caps apply)
+_HOST_POOL = 4
+_DEVICE_POOL = 2
+
+
+class ChainExecutor:
+    """Real SRC×HRC units through the full chain. Params:
+
+        config    REQUIRED — server-side path of the database YAML;
+                  the SRC files live next to it in the standard layout
+                  (the operator mounts the corpus on the serving host)
+    """
+
+    kind = "chain"
+
+    def __init__(self) -> None:
+        #: parsed configs keyed by (abspath, mtime_ns, size) — reparsing
+        #: per unit would probe every SRC per POST; touched only under
+        #: _cache_lock (plan() runs on the HTTP thread, cost_features on
+        #: scheduler workers)
+        self._cache_lock = lockdebug.make_lock("serve_chain_cfgcache")
+        self._configs: dict = {}       # guarded-by: _cache_lock
+        self._complexity: dict = {}    # guarded-by: _cache_lock
+
+    # ------------------------------------------------------------ config
+
+    @staticmethod
+    def _config_path(params: dict) -> str:
+        return os.path.abspath(str(params.get("config", "")))
+
+    def _config(self, path: str):
+        """The parsed TestConfig for one database YAML, cached by stat
+        signature (an edited config reparses, an unchanged one never
+        re-probes its SRCs)."""
+        from ..config import TestConfig
+
+        st = os.stat(path)
+        sig = (path, st.st_mtime_ns, st.st_size)
+        with self._cache_lock:
+            cached = self._configs.get(path)
+            if cached is not None and cached[0] == sig:
+                return cached[1]
+        cfg = TestConfig(path)
+        with self._cache_lock:
+            self._configs[path] = (sig, cfg)
+        return cfg
+
+    def _pvs_of(self, unit: Unit):
+        """The Pvs behind one unit, via the cached config. Raises
+        RequestError (→ HTTP 400) when the grid names cells the
+        database does not define — the front door's job, not a
+        quarantine's."""
+        path = self._config_path(unit.params)
+        try:
+            cfg = self._config(path)
+        except OSError as exc:
+            raise RequestError(
+                f"params.config {path!r} is not readable: {exc}"
+            ) from exc
+        except Exception as exc:  # ConfigError ⊂ ValueError
+            raise RequestError(
+                f"params.config {path!r} failed to parse: {exc}"
+            ) from exc
+        if cfg.data.get("databaseId") != unit.database:
+            raise RequestError(
+                f"request database {unit.database!r} does not match "
+                f"config databaseId {cfg.data.get('databaseId')!r}"
+            )
+        pvs = cfg.pvses.get(unit.pvs_id)
+        if pvs is None:
+            raise RequestError(
+                f"PVS {unit.pvs_id!r} is not in the database's pvsList "
+                "(check the srcs/hrcs grid against the config)"
+            )
+        return pvs
+
+    # ----------------------------------------------------------- protocol
+
+    def _knobs(self, pvs) -> dict:
+        """The byte-affecting knob values (store/plan_schema.py 'plan'
+        inputs), folded into the unit plan so the manifest's inner
+        hashes are a pure function of the plan (module docstring)."""
+        from ..models import avpvs as av
+        from ..ops.resize import plan_resize_method
+
+        codec = av.effective_avpvs_codec(pvs.get_pix_fmt_for_avpvs())
+        return {
+            "avpvs_codec": codec,
+            "ffv1_slices": (
+                av.ffv1_slices(av.ffv1_coding_threads())
+                if codec == "ffv1" else None
+            ),
+            "resize": plan_resize_method(),
+            "cpvs": {"rawvideo": False, "crf": 17},
+        }
+
+    def plan(self, unit: Unit) -> dict:
+        pvs = self._pvs_of(unit)
+        return {
+            "op": "serve.chain",
+            "schema": 1,
+            "database": unit.database,
+            "src": unit.src,
+            "hrc": unit.hrc,
+            "config": keys.file_ref(self._config_path(unit.params)),
+            "src_file": keys.file_ref(pvs.src.file_path),
+            "knobs": self._knobs(pvs),
+        }
+
+    def output_name(self, unit: Unit, plan_hash: str) -> str:
+        return f"{unit.pvs_id}_{plan_hash[:12]}.manifest.json"
+
+    def validate_params(self, params: dict) -> None:
+        config = params.get("config")
+        if not isinstance(config, str) or not config:
+            raise ValueError(
+                "params.config must name the database YAML on the "
+                "serving host"
+            )
+        if not os.path.isfile(config):
+            raise ValueError(
+                f"params.config {config!r} does not exist on the serving "
+                "host"
+            )
+
+    def bucket_key(self, record_unit: dict) -> Optional[tuple]:
+        try:
+            params = record_unit.get("params", {})
+            config = params.get("config")
+            if not config:
+                return None
+            return ("chain", os.path.abspath(str(config)),
+                    record_unit["database"])
+        except (AttributeError, TypeError, ValueError, KeyError):
+            return None  # pre-validation garbage record: unbatchable
+
+    # -------------------------------------------------------- cost model
+
+    def _src_complexity(self, src_path: str) -> Optional[float]:
+        """Priors complexity of one SRC (QP-normalized rate — docs/
+        PRIORS.md), memoized per path. The first request against a new
+        SRC pays one extraction; the sidecar is store-committed, so
+        every later request (and every replica sharing the store) is
+        warm. None on any failure — the cost model stays total."""
+        with self._cache_lock:
+            if src_path in self._complexity:
+                return self._complexity[src_path]
+        try:
+            from ..tools.complexity import get_priors_difficulty
+
+            value = float(get_priors_difficulty(src_path)["complexity"])
+        except Exception:  # noqa: BLE001 - priors are an estimate, not a gate
+            value = None
+        with self._cache_lock:
+            self._complexity[src_path] = value
+        return value
+
+    def cost_features(self, record_unit: dict) -> Optional[dict]:
+        """Predicted-cost features for serve/cost.py: encode/device/
+        CPVS frame-megapixels from the config's own quality ladder,
+        target codec + bitrate, priors complexity of the SRC. None (→
+        the model's default cost) when the unit cannot be parsed —
+        this runs inside the scheduler's packing pass and must not
+        raise."""
+        try:
+            pvs = self._pvs_of(self._unit_from_record(record_unit))
+        except Exception:  # noqa: BLE001 - totality like bucket_key
+            return None
+        try:
+            from ..models import avpvs as av
+
+            enc_fmpix = 0.0
+            out_bytes = 0.0
+            duration = 0.0
+            codec = None
+            for seg in pvs.segments:
+                ql = seg.quality_level
+                frames = float(seg.duration) * float(ql.fps)
+                enc_fmpix += frames * ql.width * ql.height / 1e6
+                duration += float(seg.duration)
+                if codec is None:
+                    codec = ql.video_codec
+                if ql.video_bitrate:
+                    out_bytes += float(ql.video_bitrate) * 1000.0 / 8.0 \
+                        * float(seg.duration)
+            w, h = av.avpvs_dimensions(pvs)
+            canvas_frames = duration * av.canvas_fps(pvs)
+            dev_fmpix = canvas_frames * w * h / 1e6
+            cpvs_fmpix = 0.0
+            for pp in pvs.test_config.post_processings:
+                pp_frames = duration * float(
+                    getattr(pp, "display_frame_rate", None) or
+                    av.canvas_fps(pvs)
+                )
+                cpvs_fmpix += pp_frames * pp.display_width \
+                    * pp.display_height / 1e6
+            return {
+                # four stage passes' worth of per-unit setup (probes,
+                # JobRunner plumbing, store commits) before any pixel
+                # moves — dominant for tiny units, noise for real ones
+                "fixed_s": 1.0,
+                "enc_fmpix": enc_fmpix,
+                "dev_fmpix": dev_fmpix,
+                "cpvs_fmpix": cpvs_fmpix,
+                "out_bytes": out_bytes,
+                "codec": codec,
+                "complexity": self._src_complexity(pvs.src.file_path),
+            }
+        except Exception:  # noqa: BLE001 - totality like bucket_key
+            return None
+
+    @staticmethod
+    def _unit_from_record(record_unit: dict) -> Unit:
+        return Unit(
+            database=record_unit["database"], src=record_unit["src"],
+            hrc=record_unit["hrc"],
+            params=dict(record_unit.get("params", {})),
+        )
+
+    # -------------------------------------------------------- execution
+
+    def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
+        record_waves(len(units))
+        store = store_runtime.active()
+        if store is None:
+            raise ChainError(
+                "the chain executor requires an artifact store (it is "
+                "what serves the stage artifacts)", kind="permanent",
+            )
+        # waves pack same-config units (bucket_key), but a solo wave of
+        # a foreign record must still work: group defensively
+        by_config: dict[str, list[int]] = {}
+        for i, unit in enumerate(units):
+            by_config.setdefault(
+                self._config_path(unit.params), []
+            ).append(i)
+        with _EXEC_LOCK:
+            for config_path, indices in by_config.items():
+                self._run_config_group(
+                    store, config_path,
+                    [units[i] for i in indices],
+                    [outputs[i] for i in indices],
+                )
+
+    # holds-lock: _EXEC_LOCK
+    def _run_config_group(self, store, config_path: str,
+                          units: list[Unit], outputs: list[str]) -> None:
+        """p01–p04 for one database's units, through the engine
+        JobRunner — store commits, sentinels, provenance and telemetry
+        ride along exactly as in a batch run."""
+        from ..config import TestConfig
+        from ..engine.jobs import JobRunner
+        from ..models import avpvs as av
+        from ..models import cpvs as cp
+        from ..models import metadata as md
+        from ..models import segments as seg_model
+        from ..utils.parse_args import _DEFAULT_SPINNER
+
+        # a FRESH filtered parse (the cached one is unfiltered): the
+        # chain's own planning decides from exactly these PVSes
+        cfg = TestConfig(
+            config_path,
+            filter_pvses="|".join(u.pvs_id for u in units),
+        )
+        pvses = []
+        for unit in units:
+            pvs = cfg.pvses.get(unit.pvs_id)
+            if pvs is None:
+                raise ChainError(
+                    f"PVS {unit.pvs_id!r} vanished from {config_path!r} "
+                    "(config edited since submit?)", kind="permanent",
+                )
+            if pvs.is_online():
+                raise ChainError(
+                    f"PVS {unit.pvs_id!r} needs online services "
+                    "(YouTube/Bitmovin), which chain-serve does not "
+                    "execute", kind="permanent",
+                )
+            pvses.append(pvs)
+
+        pool = min(_HOST_POOL, max(1, len(pvses)))
+        av.set_default_fp_workers(min(_DEVICE_POOL, pool))
+
+        # p01 — segment encodes (deduped across sibling HRCs by the
+        # runner's writer table; store-warm ones skip)
+        seg_model.reset_run_state()
+        p01 = JobRunner(parallelism=pool, name="serve-p01")
+        seg_jobs: dict = {}
+        for segment in sorted(cfg.get_required_segments()):
+            job = seg_model.encode_segment(segment)
+            if job is not None:
+                seg_jobs[segment.filename] = job
+                p01.add(job)
+        p01.run()
+
+        # p02 — per-PVS metadata tables, through the pool (the jobs are
+        # independent: one PVS's tables never read another's)
+        p02 = JobRunner(parallelism=pool, name="serve-p02")
+        md_jobs = {}
+        for pvs in pvses:
+            md_jobs[pvs.pvs_id] = md.metadata_job(pvs)
+            p02.add(md_jobs[pvs.pvs_id])
+        p02.run()
+
+        # p03 — AVPVS render, then the stalling pass (planned only after
+        # the renders exist: its plan hashes the wo_buffer bytes)
+        p03 = JobRunner(parallelism=min(_DEVICE_POOL, pool),
+                        name="serve-p03")
+        av_jobs = {}
+        for pvs in pvses:
+            av_jobs[pvs.pvs_id] = av.create_avpvs_wo_buffer(pvs)
+            p03.add(av_jobs[pvs.pvs_id])
+        p03.run()
+        p03_stall = JobRunner(parallelism=min(_DEVICE_POOL, pool),
+                              name="serve-p03-stall")
+        stall_jobs = {}
+        for pvs in pvses:
+            job = av.apply_stalling(pvs, spinner_path=_DEFAULT_SPINNER)
+            if job is not None:
+                stall_jobs[pvs.pvs_id] = job
+                p03_stall.add(job)
+        p03_stall.run()
+
+        # p04 — every PostProcessing context
+        p04 = JobRunner(parallelism=min(_DEVICE_POOL, pool),
+                        name="serve-p04")
+        cpvs_jobs: dict = {}
+        for pvs in pvses:
+            cpvs_jobs[pvs.pvs_id] = []
+            for pp in cfg.post_processings:
+                job = cp.create_cpvs(pvs, pp)
+                if job is not None:
+                    cpvs_jobs[pvs.pvs_id].append(job)
+                    p04.add(job)
+        p04.run()
+
+        # the unit manifests: every family artifact by store plan hash
+        # (re-resolved NOW — the inputs exist with their final bytes)
+        for unit, pvs, output in zip(units, pvses, outputs):
+            manifest = self._manifest(
+                store, unit, pvs,
+                segment_jobs=[seg_jobs[s.filename] for s in pvs.segments
+                              if s.filename in seg_jobs],
+                metadata_job=md_jobs[pvs.pvs_id],
+                avpvs_job=stall_jobs.get(pvs.pvs_id) or
+                av_jobs[pvs.pvs_id],
+                cpvs_jobs=cpvs_jobs[pvs.pvs_id],
+            )
+            atomic_write_text(
+                output, json.dumps(manifest, sort_keys=True) + "\n"
+            )
+
+    @staticmethod
+    def _artifact_entry(store, job) -> dict:
+        entry = {
+            "name": os.path.basename(job.output_path),
+            "plan": store.plan_hash(job.plan),
+            "size": os.path.getsize(job.output_path),
+        }
+        if job.extra_outputs:
+            entry["extras"] = sorted(
+                os.path.basename(p) for p in job.extra_outputs
+            )
+        return entry
+
+    def _manifest(self, store, unit: Unit, pvs, segment_jobs,
+                  metadata_job, avpvs_job, cpvs_jobs) -> dict:
+        """One unit's deterministic artifact index: family → store plan
+        hash(es). Byte-stable for a given unit plan (sort_keys +
+        content-derived fields only) — the store commits it under the
+        unit's plan hash, and PC_PLAN_DEBUG holds it to the same
+        same-plan/same-bytes contract as every other artifact."""
+        if any(job.plan is None for job in
+               [*segment_jobs, metadata_job, avpvs_job, *cpvs_jobs]):
+            raise ChainError(
+                f"chain unit {unit.pvs_id}: a stage job carries no plan "
+                "— its artifact cannot be store-addressed",
+                kind="permanent",
+            )
+        return {
+            "schema": 1,
+            "op": "serve.chain",
+            "pvs": unit.pvs_id,
+            "database": unit.database,
+            "artifacts": {
+                "segments": [self._artifact_entry(store, j)
+                             for j in segment_jobs],
+                "metadata": self._artifact_entry(store, metadata_job),
+                "avpvs": self._artifact_entry(store, avpvs_job),
+                "cpvs": [self._artifact_entry(store, j)
+                         for j in cpvs_jobs],
+            },
+        }

@@ -19,10 +19,10 @@ Built-ins:
     (deterministic synthetic YUV), drives the whole bucket through
     `run_bucket` on the process mesh, writes the scaled luma. Proof
     that cross-request work actually lands in one compiled step.
-
-The production database executor (units backed by real SRC files and
-HRC event lists through the p01–p04 stages) plugs in through the same
-protocol — see docs/SERVE.md "Executors".
+  * `chain` — the production executor (serve/chain_executor.py,
+    loaded lazily): units backed by real SRC files and HRC event lists
+    through the full p01–p04 stages, serving every artifact family
+    from the store — see docs/SERVE.md "Real database execution".
 """
 
 from __future__ import annotations
@@ -71,6 +71,16 @@ class Executor(Protocol):
         """Reject executor params this executor cannot execute
         (raise ValueError). Called at the HTTP front door so a bad
         request 400s instead of becoming a durable queue record."""
+        ...
+
+    def cost_features(self, record_unit: dict) -> Optional[dict]:
+        """Feature dict for the predicted-cost model (serve/cost.py:
+        work_s / out_bytes / enc_fmpix / dev_fmpix / cpvs_fmpix /
+        codec / complexity). Same totality contract as bucket_key —
+        it runs at the POST front door and in the scheduler's packing
+        pass, so return None for an unparseable unit, never raise
+        (cost.predict_unit_cost guards anyway and falls back to
+        DEFAULT_COST_S)."""
         ...
 
     def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
@@ -158,6 +168,18 @@ class SyntheticExecutor:
         except (AttributeError, TypeError, ValueError):
             # a pre-validation durable record with garbage params (null,
             # non-dict, unparseable geometry): unbatchable, never a raise
+            return None
+
+    def cost_features(self, record_unit: dict) -> Optional[dict]:
+        """Synthetic units declare their cost outright: work_ms of
+        simulated compute + the artifact bytes they write."""
+        try:
+            params = record_unit.get("params", {}) or {}
+            return {
+                "work_s": float(params.get("work_ms", 0) or 0) / 1e3,
+                "out_bytes": float(params.get("size_bytes", 4096) or 4096),
+            }
+        except (AttributeError, TypeError, ValueError):
             return None
 
     @staticmethod
@@ -267,6 +289,17 @@ class DeviceWaveExecutor(SyntheticExecutor):
             return None  # pre-validation garbage record: unbatchable
         return ("wave",) + tuple(geo[k] for k in self._GEO)
 
+    def cost_features(self, record_unit: dict) -> Optional[dict]:
+        """Wave units are device resizes: frames × destination pixels."""
+        try:
+            geo = self._geometry(record_unit.get("params", {}))
+        except (AttributeError, TypeError, ValueError):
+            return None
+        return {
+            "dev_fmpix": geo["frames"] * geo["dst_h"] * geo["dst_w"] / 1e6,
+            "out_bytes": geo["frames"] * geo["dst_h"] * geo["dst_w"] * 1.5,
+        }
+
     def _mesh(self):
         from ..parallel.mesh import make_mesh
 
@@ -324,11 +357,21 @@ EXECUTORS = {
     DeviceWaveExecutor.kind: DeviceWaveExecutor,
 }
 
+#: kinds resolved by deferred import — the chain executor pulls in the
+#: config/model layers, which must not load just to run a synthetic
+#: soak (and importing it here would be a serve-package import cycle)
+_LAZY_EXECUTORS = ("chain",)
+
 
 def make_executor(kind: str):
+    if kind == "chain":
+        from .chain_executor import ChainExecutor
+
+        return ChainExecutor()
     try:
         return EXECUTORS[kind]()
     except KeyError:
         raise ValueError(
-            f"unknown serve executor {kind!r}; known: {sorted(EXECUTORS)}"
+            f"unknown serve executor {kind!r}; known: "
+            f"{sorted([*EXECUTORS, *_LAZY_EXECUTORS])}"
         ) from None

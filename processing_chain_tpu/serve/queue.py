@@ -225,6 +225,10 @@ class JobRecord:
     owner: Optional[str] = None       # replica id of the current claimant
     not_before: float = 0.0           # retry backoff: claim-eligibility time
     settled_epoch: Optional[int] = None  # epoch the terminal write carried
+    #: predicted execution seconds (serve/cost.py, stamped at enqueue):
+    #: the scheduler packs waves by it, admission sums it per tenant,
+    #: and the settle-time feedback loop grades it against reality
+    cost_s: float = 0.0
 
     def to_json(self) -> dict:
         return {
@@ -250,6 +254,7 @@ class JobRecord:
             "owner": self.owner,
             "notBefore": self.not_before,
             "settledEpoch": self.settled_epoch,
+            "costS": self.cost_s,
         }
 
     @classmethod
@@ -278,6 +283,7 @@ class JobRecord:
             owner=data.get("owner"),
             not_before=float(data.get("notBefore", 0.0)),
             settled_epoch=data.get("settledEpoch"),
+            cost_s=float(data.get("costS", 0.0) or 0.0),
         )
 
 
@@ -835,6 +841,7 @@ class DurableQueue:
         request_id: str,
         output: str,
         trace_id: Optional[str] = None,
+        cost_s: float = 0.0,
     ) -> tuple[JobRecord, str]:
         """Enqueue one unit (or attach to its in-flight twin). Returns
         (record, outcome) with outcome ∈ new | attached | done |
@@ -853,6 +860,13 @@ class DurableQueue:
                 changed = True
             if trace_id and trace_id not in record.trace_ids:
                 record.trace_ids.append(trace_id)
+                changed = True
+            if cost_s > record.cost_s:
+                # a pre-cost-model record (or a fresher estimate) picks
+                # up the caller's prediction on EVERY attach path, not
+                # just re-arm — wave packing and outstanding_cost must
+                # not treat a known-heavy in-flight unit as free
+                record.cost_s = float(cost_s)
                 changed = True
             return changed
 
@@ -904,7 +918,7 @@ class DurableQueue:
                     # exhausted its retries last week must not inherit
                     # the spent counter)
                     self._rearm_locked(record)
-                    _attach_ids(record)
+                    _attach_ids(record)  # also re-stamps cost_s
                     self.spans.append(
                         "enqueue", job=record.job_id,
                         plan=record.plan_hash, state="queued",
@@ -935,6 +949,7 @@ class DurableQueue:
                     state="queued",
                     enqueued_at=now,
                     queued_at=now,
+                    cost_s=max(0.0, float(cost_s)),
                 )
                 self._next_id += 1
                 self.spans.append(
@@ -1294,3 +1309,18 @@ class DurableQueue:
             for record in self._jobs.values():
                 states[record.state] = states.get(record.state, 0) + 1
             return states
+
+    def outstanding_cost(self, tenant: Optional[str] = None) -> float:
+        """Predicted seconds of unfinished (queued + running) work, for
+        one tenant or the whole queue — the admission gate's view of a
+        tenant's backlog (serve/cost.py). Reads this replica's merged
+        view of the shared records: cross-replica freshness is bounded
+        by the poll interval, which is the admission contract
+        (docs/SERVE.md 'Cost-aware scheduling & admission')."""
+        with self._lock:
+            return sum(
+                record.cost_s
+                for source in (self._queued, self._running)
+                for record in source.values()
+                if tenant is None or record.tenant == tenant
+            )

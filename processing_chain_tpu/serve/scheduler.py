@@ -208,6 +208,11 @@ class _WaveBarrier:
 _IDLE_MIN_S = 0.01
 _IDLE_MAX_S = 0.25
 
+#: how deep the same-bucket fill looks into the queued snapshot; with
+#: cost budgets a skip no longer ends the scan, so the window must be
+#: bounded — the packing pass runs under the scheduler lock
+_FILL_SCAN_CAP = 256
+
 
 class Scheduler:
     """Worker threads draining the queue (see module doc for policy)."""
@@ -223,6 +228,7 @@ class Scheduler:
         max_attempts: int = 2,
         retry_base_s: float = 0.25,
         retry_cap_s: float = 30.0,
+        wave_budget_s: Optional[float] = None,
         on_done: Optional[Callable[[JobRecord], None]] = None,
         on_failed: Optional[Callable[[JobRecord], None]] = None,
     ) -> None:
@@ -231,6 +237,12 @@ class Scheduler:
         self.artifacts_root = artifacts_root
         self.workers = max(1, int(workers))
         self.wave_width = max(1, int(wave_width))
+        #: cost-aware packing (serve/cost.py): fill waves until the
+        #: members' PREDICTED seconds reach this budget instead of
+        #: stopping at a unit count — None keeps count-based packing
+        self.wave_budget_s = (
+            float(wave_budget_s) if wave_budget_s else None
+        )
         self.max_attempts = max(1, int(max_attempts))
         self.retry_base_s = max(0.0, float(retry_base_s))
         self.retry_cap_s = max(self.retry_base_s, float(retry_cap_s))
@@ -302,10 +314,15 @@ class Scheduler:
         the claimed batch is the seed plus up to `wave_width - 1` other
         queued records sharing its bucket key (p03_batch geometry
         semantics — same key ⟺ same compiled device step), in enqueue
-        order. The fill scans only until the wave is full, instead of
-        packing the entire snapshot into waves to keep one — a deep
-        queue must not cost O(queue) key calls under the scheduler lock
-        per dispatch."""
+        order. With `wave_budget_s` set, the fill also balances
+        PREDICTED seconds (the records' `cost_s`, serve/cost.py): a
+        member that would push the wave past the budget is skipped in
+        favor of later, lighter same-bucket units — waves stop being
+        "4 units" and start being "~budget seconds", which is what
+        keeps one all-heavy wave from defining the e2e tail. The fill
+        scans only a bounded window instead of packing the entire
+        snapshot into waves to keep one — a deep queue must not cost
+        O(queue) key calls under the scheduler lock per dispatch."""
 
         def safe_key(record: JobRecord):
             # totality guaranteed HERE, not re-audited per executor: one
@@ -323,14 +340,22 @@ class Scheduler:
                 return []
             seed = self._picker.pick(queued)
             wave = [seed]
+            wave_cost = seed.cost_s
             seed_key = safe_key(seed)
             if seed_key is not None:  # None = unbatchable: solo wave
-                for record in queued:
+                for record in queued[:_FILL_SCAN_CAP]:
                     if len(wave) >= self.wave_width:
                         break
-                    if (record.job_id != seed.job_id
-                            and safe_key(record) == seed_key):
-                        wave.append(record)
+                    if (record.job_id == seed.job_id
+                            or safe_key(record) != seed_key):
+                        continue
+                    if (self.wave_budget_s is not None
+                            and wave_cost + record.cost_s
+                            > self.wave_budget_s):
+                        continue  # too heavy for THIS wave; a lighter
+                        # same-bucket unit further on may still fit
+                    wave.append(record)
+                    wave_cost += record.cost_s
             return self.queue.claim([r.job_id for r in wave])
 
     # --------------------------------------------------------- execution
@@ -344,6 +369,11 @@ class Scheduler:
         and soak up attaching newcomers."""
         settled: set[str] = set()
         _INFLIGHT.inc(len(batch))
+        # the wave's predicted mass — what cost-aware packing balances;
+        # the pack bench (tools serve-soak --pack-bench) grades packing
+        # policies from exactly these records
+        tm.emit("serve_wave", units=len(batch),
+                predicted_s=round(sum(r.cost_s for r in batch), 4))
         try:
             os.makedirs(self.artifacts_root, exist_ok=True)
             runner = JobRunner(parallelism=len(batch), name="serve")

@@ -55,7 +55,7 @@ from ..telemetry import live
 from ..utils import lockdebug
 from ..utils.fsio import atomic_write_json
 from ..utils.log import get_logger
-from . import api
+from . import api, cost
 from .executors import make_executor
 from .pressure import StorePressure
 from .queue import DurableQueue, owner_process_dead, owner_stamp
@@ -125,6 +125,9 @@ class ChainServeService:
         lease_s: float = 15.0,
         poll_s: float = 1.0,
         info_path: Optional[str] = None,
+        wave_budget_s: Optional[float] = None,
+        admission_budget_s: Optional[float] = None,
+        tenant_budget_s: Optional[float] = None,
     ) -> None:
         self.root = os.path.abspath(root)
         self.artifacts_root = os.path.join(self.root, "artifacts")
@@ -164,10 +167,20 @@ class ChainServeService:
         self.pressure = StorePressure(
             self.store, store_budget_bytes, self.active_plans
         )
+        #: cost-aware serving knobs (docs/SERVE.md "Cost-aware
+        #: scheduling & admission"); budgets of None disable each gate
+        self.admission_budget_s = (
+            float(admission_budget_s) if admission_budget_s else None
+        )
+        self.tenant_budget_s = (
+            float(tenant_budget_s) if tenant_budget_s else None
+        )
+        self.cost_ledger = cost.CostLedger()
         self.scheduler = Scheduler(
             self.queue, self.executor, self.artifacts_root,
             workers=workers, wave_width=wave_width,
             tenant_weights=tenant_weights, max_attempts=max_attempts,
+            wave_budget_s=wave_budget_s,
             on_done=self._on_job_done, on_failed=self._on_job_failed,
         )
         routes = live.default_routes()
@@ -392,6 +405,7 @@ class ChainServeService:
                         unit_doc["unit"],
                         doc["tenant"], doc["priority"], req_id,
                         unit_doc["output"], trace_id=doc.get("trace"),
+                        cost_s=float(unit_doc.get("cost_s", 0.0) or 0.0),
                     )
                 elif record.state == "quarantined":
                     # the plan failed PERMANENTLY while the request
@@ -452,20 +466,65 @@ class ChainServeService:
         trace_id = normalized.get("trace") or api.new_trace_id()
         unit_docs: dict[str, dict] = {}
         plans: dict[str, dict] = {}
-        for unit in units:
-            plan = self.executor.plan(unit)
-            plan_hash = self.store.plan_hash(plan)
-            unit_docs[unit.pvs_id] = {
-                "plan": plan_hash,
-                "planPayload": plan,
-                "output": self.executor.output_name(unit, plan_hash),
-                "unit": {
-                    "database": unit.database, "src": unit.src,
-                    "hrc": unit.hrc, "params": unit.params,
-                    "pvs_id": unit.pvs_id,
-                },
-            }
-            plans[plan_hash] = unit_docs[unit.pvs_id]
+        try:
+            for unit in units:
+                # plan construction is part of the front door: the chain
+                # executor resolves the grid against the database config
+                # here, so a cell the database does not define is a 400,
+                # never a durable record
+                plan = self.executor.plan(unit)
+                plan_hash = self.store.plan_hash(plan)
+                unit_docs[unit.pvs_id] = {
+                    "plan": plan_hash,
+                    "planPayload": plan,
+                    "output": self.executor.output_name(unit, plan_hash),
+                    "cost_s": round(cost.predict_unit_cost(
+                        self.executor, {
+                            "database": unit.database, "src": unit.src,
+                            "hrc": unit.hrc, "params": unit.params,
+                            "pvs_id": unit.pvs_id,
+                        }), 4),
+                    "unit": {
+                        "database": unit.database, "src": unit.src,
+                        "hrc": unit.hrc, "params": unit.params,
+                        "pvs_id": unit.pvs_id,
+                    },
+                }
+                plans[plan_hash] = unit_docs[unit.pvs_id]
+        except api.RequestError:
+            _REQ_TOTAL.labels(state="rejected").inc()
+            raise
+        # admission control (docs/SERVE.md "Cost-aware scheduling &
+        # admission"): COLD units' predicted seconds against the
+        # per-request and per-tenant budgets, refused at POST time with
+        # a 429 forensic body — before any durable state exists. The
+        # warm set is computed once and reused by the enqueue loop.
+        # Units whose plan is already queued/running cost nothing
+        # either: they ATTACH to the in-flight record (singleflight),
+        # whose prediction is already in the tenant's outstanding sum —
+        # charging them again would refuse exactly the overlapping-grid
+        # workload the serve layer exists to dedup, and double-count
+        # the predicted ledger.
+        warm_plans = {ph for ph in plans if self._plan_is_done(ph)}
+
+        def _in_flight(plan_hash: str) -> bool:
+            record = self.queue.by_plan(plan_hash)
+            return record is not None and record.state in (
+                "queued", "running")
+
+        try:
+            predicted_s = cost.check_admission(
+                normalized["tenant"],
+                [(ud["unit"]["pvs_id"], ud["cost_s"])
+                 for ph, ud in plans.items()
+                 if ph not in warm_plans and not _in_flight(ph)],
+                self.admission_budget_s,
+                self.tenant_budget_s,
+                self.queue.outstanding_cost(normalized["tenant"]),
+            )
+        except cost.AdmissionError:
+            _REQ_TOTAL.labels(state="rejected").inc()
+            raise
         doc = {
             "request": req_id,
             "trace": trace_id,
@@ -478,6 +537,8 @@ class ChainServeService:
             "done_at": None,
             "latency_ms": None,
             "warm": False,
+            #: the admission decision's evidence, kept on the record
+            "predicted_cost_s": round(predicted_s, 3),
             # liveness stamp: peers adopt this request if our process
             # dies before finalizing it (maintenance orphan sweep)
             "owner": owner_stamp(self.replica),
@@ -490,13 +551,15 @@ class ChainServeService:
             for plan_hash in plans:
                 self._plan_waiters.setdefault(plan_hash, set()).add(req_id)
         self._persist_request(doc)
+        self.cost_ledger.admitted(normalized["tenant"], predicted_s)
         outcomes = {"warm": 0, "enqueued": 0, "attached": 0,
                     "quarantined": 0}
         quarantine_error: Optional[str] = None
         for plan_hash, unit_doc in plans.items():
-            if self._plan_is_done(plan_hash):
+            if plan_hash in warm_plans:
                 _UNITS.labels(outcome="warm").inc()
                 outcomes["warm"] += 1
+                self.cost_ledger.warm(normalized["tenant"])
                 with self._lock:
                     doc["_pending"].discard(plan_hash)
                     waiters = self._plan_waiters.get(plan_hash)
@@ -509,6 +572,7 @@ class ChainServeService:
                 plan_hash, unit_doc["planPayload"], unit_doc["unit"],
                 normalized["tenant"], normalized["priority"], req_id,
                 unit_doc["output"], trace_id=trace_id,
+                cost_s=unit_doc["cost_s"],
             )
             if outcome == "done":
                 # the queue remembers a completion the store no longer
@@ -582,6 +646,7 @@ class ChainServeService:
         return True
 
     def _on_job_done(self, record) -> None:
+        self._settle_cost(record)
         with self._lock:
             waiters = self._plan_waiters.pop(record.plan_hash, set())
             for req_id in waiters:
@@ -591,6 +656,27 @@ class ChainServeService:
         for req_id in sorted(waiters):
             self._check_request_done(req_id)
         self.pressure.maybe_collect()
+
+    def _settle_cost(self, record) -> None:
+        """The cost model's feedback loop (docs/SERVE.md): grade the
+        record's predicted seconds against what execution really took.
+        Only for executions THIS replica owned — a peer's completion
+        already landed in its own ledger/metrics, and the fleet view
+        merges the replicas' counters (double-observing here would
+        double-count fleet-wide)."""
+        if getattr(record, "owner", None) != self.replica:
+            return
+        tenant = getattr(record, "tenant", "") or ""
+        if getattr(record, "warm", False):
+            self.cost_ledger.warm(tenant)
+            return
+        claimed_at = getattr(record, "claimed_at", None)
+        done_at = getattr(record, "done_at", None)
+        if claimed_at and done_at:
+            self.cost_ledger.observed(
+                tenant, getattr(record, "cost_s", 0.0),
+                max(0.0, done_at - claimed_at),
+            )
 
     def _on_job_failed(self, record) -> None:
         with self._lock:
@@ -603,13 +689,15 @@ class ChainServeService:
                 doc["state"] = "failed"
                 doc["done_at"] = time.time()
                 doc["error"] = record.error
+                # same visibility contract as _check_request_done: the
+                # terminal event is published before the lock drops
+                _REQ_TOTAL.labels(state="failed").inc()
+                tm.emit("serve_request_done", request=doc["request"],
+                        trace_id=doc.get("trace"), status="failed",
+                        error=record.error)
                 docs.append(doc)
         for doc in docs:
             self._persist_request(doc)
-            _REQ_TOTAL.labels(state="failed").inc()
-            tm.emit("serve_request_done", request=doc["request"],
-                    trace_id=doc.get("trace"), status="failed",
-                    error=record.error)
 
     def _check_request_done(self, req_id: str,
                             submit_t0: Optional[float] = None) -> None:
@@ -637,20 +725,23 @@ class ChainServeService:
                 )
             warm = doc.get("warm", False)
             latency_s = (doc["done_at"] - doc["created_at"])
-            tenant = doc["tenant"]
-            priority = doc["priority"]
-            trace_id = doc.get("trace")
+            # counters + the terminal event fire INSIDE the lock that
+            # makes the state flip visible: a waiter that observes
+            # 'done' must also find serve_request_done in the event log
+            # — emitting after the (fsynced) persist below left a
+            # window a loaded suite actually hit
+            _REQ_TOTAL.labels(state="completed").inc()
+            _REQ_SECONDS.observe(max(0.0, latency_s))
+            _E2E_SECONDS.labels(tenant=doc["tenant"],
+                                priority=doc["priority"]) \
+                .observe(max(0.0, latency_s))
+            if warm:
+                _WARM_REQ_SECONDS.observe(max(0.0, latency_s))
+            tm.emit("serve_request_done", request=req_id,
+                    trace_id=doc.get("trace"), status="done",
+                    duration_s=round(max(0.0, latency_s), 4), warm=warm)
         self._persist_request(doc)
         self._prune_finished()
-        _REQ_TOTAL.labels(state="completed").inc()
-        _REQ_SECONDS.observe(max(0.0, latency_s))
-        _E2E_SECONDS.labels(tenant=tenant, priority=priority) \
-            .observe(max(0.0, latency_s))
-        if warm:
-            _WARM_REQ_SECONDS.observe(max(0.0, latency_s))
-        tm.emit("serve_request_done", request=req_id, trace_id=trace_id,
-                status="done",
-                duration_s=round(max(0.0, latency_s), 4), warm=warm)
 
     def _persist_request(self, doc: dict) -> None:
         # snapshot AND write under the lock (the queue's own discipline:
@@ -726,6 +817,7 @@ class ChainServeService:
                 "done_at": doc["done_at"],
                 "latency_ms": doc["latency_ms"],
                 "warm": doc.get("warm", False),
+                "predicted_cost_s": doc.get("predicted_cost_s"),
                 "units": {},
             }
             if "error" in doc:
@@ -785,6 +877,12 @@ class ChainServeService:
             "pid": os.getpid(),
             "queue": self.queue.counts(),
             "requests": {},
+            # per-tenant predicted/observed accounting + model error
+            # (docs/SERVE.md "Cost-aware scheduling & admission")
+            "cost": {
+                **self.cost_ledger.report(),
+                "outstanding_s": round(self.queue.outstanding_cost(), 3),
+            },
         }
         with self._lock:
             for doc in self._requests.values():
@@ -815,6 +913,11 @@ class ChainServeService:
             return self._json(400, {"error": "body is not valid JSON"})
         try:
             return self._json(202, self.submit(payload))
+        except cost.AdmissionError as exc:
+            # 429 with the full forensic body: what was predicted,
+            # against which budget, and which units are the heaviest —
+            # the client can split the grid or retry as work settles
+            return self._json(429, exc.doc)
         except api.RequestError as exc:
             return self._json(400, {"error": str(exc)})
 

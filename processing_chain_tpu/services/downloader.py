@@ -189,21 +189,43 @@ class YoutubeClient(Protocol):
         ...
 
 
+#: the one answer to "which yt-dlp module does this environment have?"
+#: — shared by YtdlClient's constructor and the plan-time capability
+#: probe, so the two can never disagree about what an import would find
+_YTDL_MODULES = ("yt_dlp", "youtube_dl")
+
+
+def find_ytdl_module() -> Optional[str]:
+    """Name of the importable yt-dlp flavor, or None. The SINGLE
+    definition both the runtime import (YtdlClient) and the plan-time
+    feasibility probe (`Downloader._youtube_available`) key on: the two
+    used to encode the preference order independently, which is exactly
+    how a plan-says-infeasible / download-would-have-worked split (or
+    the reverse) creeps in."""
+    import importlib.util
+
+    for name in _YTDL_MODULES:
+        try:
+            if importlib.util.find_spec(name) is not None:
+                return name
+        except (ImportError, ValueError):
+            continue
+    return None
+
+
 class YtdlClient:
     """Real client over yt-dlp / youtube-dl, whichever is importable."""
 
     def __init__(self) -> None:
-        try:
-            import yt_dlp as ytdl  # type: ignore
-        except ImportError:
-            try:
-                import youtube_dl as ytdl  # type: ignore
-            except ImportError as exc:
-                raise RuntimeError(
-                    "neither yt-dlp nor youtube-dl is installed; "
-                    "online YouTube encodes are unavailable"
-                ) from exc
-        self._ytdl = ytdl
+        import importlib
+
+        name = find_ytdl_module()
+        if name is None:
+            raise RuntimeError(
+                "neither yt-dlp nor youtube-dl is installed; "
+                "online YouTube encodes are unavailable"
+            )
+        self._ytdl = importlib.import_module(name)
 
     def extract_info(self, url: str) -> dict:
         with self._ytdl.YoutubeDL({"quiet": True}) as ydl:
@@ -559,18 +581,12 @@ class Downloader:
         `download_video` constructs YtdlClient lazily, so keying the plan
         decision on `self.youtube is None` would declare a perfectly
         feasible run infeasible (constructed without a client but with
-        yt-dlp importable) — probe actual importability instead."""
+        yt-dlp importable) — probe actual importability, through the
+        SAME module-resolution definition the client constructor uses
+        (`find_ytdl_module`), so plan and download can never disagree."""
         if self.youtube is not None:
             return True
-        import importlib.util
-
-        try:
-            return (
-                importlib.util.find_spec("yt_dlp") is not None
-                or importlib.util.find_spec("youtube_dl") is not None
-            )
-        except (ImportError, ValueError):
-            return False
+        return find_ytdl_module() is not None
 
     def plan_capability(self, seg, force: bool = False) -> Optional[str]:
         """Plan-time feasibility of producing this online segment in THIS

@@ -28,11 +28,14 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     # the artifact store: plan = segment digests + stall schedule, the
     # four tables commit/materialize together. Without a store, Job's
     # skip-existing on the qchanges table plus the model's per-file
-    # _maybe_write guards reproduce the legacy behavior. Serial: the
-    # native demux + numpy scans are already parallel inside.
+    # _maybe_write guards reproduce the legacy behavior. The jobs run
+    # `-p`-wide through the pool (ROADMAP item 3): one PVS's tables
+    # never read another's, so per-PVS metadata is free throughput —
+    # the native demux releases the GIL and the numpy scans are
+    # per-file, exactly the p01 encode-pool shape.
     runner = JobRunner(
         force=cli_args.force, dry_run=cli_args.dry_run,
-        parallelism=1, name="p02",
+        parallelism=cli_args.parallelism, name="p02",
     )
     n_items = 0
     for _pvs_id, pvs in local_shard(test_config.pvses):
@@ -42,5 +45,5 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
         runner.add(md.metadata_job(pvs, force=cli_args.force))
         n_items += 1
     tm.stage_items("p02", n_items)
-    runner.run_serial()
+    runner.run()
     return test_config

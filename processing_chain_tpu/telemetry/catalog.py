@@ -80,6 +80,13 @@ METRICS: dict[str, str] = {
     "chain_serve_queue_wait_seconds": "histogram",
     "chain_serve_execution_seconds": "histogram",
     "chain_serve_e2e_seconds": "histogram",
+    # serve/cost.py — predicted-cost model: per-tenant accounting,
+    # admission refusals, and the observed-vs-predicted audit trail
+    # (docs/SERVE.md "Cost-aware scheduling & admission")
+    "chain_serve_cost_predicted_seconds_total": "counter",
+    "chain_serve_cost_observed_seconds_total": "counter",
+    "chain_serve_cost_error_ratio": "histogram",
+    "chain_serve_cost_rejected_total": "counter",
     # priors/ — codec-prior extraction (docs/PRIORS.md)
     "chain_priors_extract_total": "counter",
     "chain_priors_cache_hits_total": "counter",
@@ -125,6 +132,8 @@ EVENTS: frozenset = frozenset({
     "serve_settle_fenced",     # serve/queue.py — stale-epoch settle refused
     "serve_claim_reverted",    # serve/queue.py — mid-claim disk error undone
     "serve_quarantined",   # serve/queue.py — permanent failure parked
+    "serve_admission_rejected",  # serve/cost.py — over-budget POST refused
+    "serve_wave",          # serve/scheduler.py — one wave dispatched
     "priors_extract",      # priors/model.py — one extraction pass finished
 
     "log",             # WARNING+ console records bridged into the log

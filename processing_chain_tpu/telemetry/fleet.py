@@ -53,6 +53,17 @@ PHASE_METRICS = {
     "e2e_s": "chain_serve_e2e_seconds",
 }
 
+#: per-tenant cost-accounting counters merged into the /fleet "cost"
+#: section (serve/cost.py; docs/SERVE.md "Cost-aware scheduling &
+#: admission")
+COST_COUNTERS = (
+    "chain_serve_cost_predicted_seconds_total",
+    "chain_serve_cost_observed_seconds_total",
+    "chain_serve_cost_rejected_total",
+)
+#: the observed/predicted audit histogram (same section)
+COST_ERROR_METRIC = "chain_serve_cost_error_ratio"
+
 #: percentiles the SLO report estimates from the merged buckets
 PERCENTILES = (0.50, 0.95, 0.99)
 
@@ -112,6 +123,26 @@ _PROM_LINE = re.compile(
 _LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 
 
+def _prom_samples(text: str) -> Iterable[tuple]:
+    """(name, labels, value) per sample line of one /metrics render —
+    the ONE place the line grammar, label unescaping and value parsing
+    live; parse_histograms and parse_counters both consume it (an
+    escaping fix must not have to land twice)."""
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line.strip())
+        if m is None:
+            continue
+        labels = {k: v.replace('\\"', '"').replace("\\\\", "\\")
+                  for k, v in _LABEL.findall(m.group("labels") or "")}
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        yield m.group("name"), labels, value
+
+
 def parse_histograms(text: str, names: Iterable[str]) -> dict:
     """The named histograms out of one /metrics render. Returns
     {(name, labelitems): {"labels", "buckets" (cumulative, by le
@@ -126,21 +157,9 @@ def parse_histograms(text: str, names: Iterable[str]) -> dict:
             "labels": labels, "buckets": {}, "sum": 0.0, "count": 0,
         })
 
-    for line in text.splitlines():
-        if not line or line.startswith("#"):
-            continue
-        m = _PROM_LINE.match(line.strip())
-        if m is None:
-            continue
-        name = m.group("name")
+    for name, labels, value in _prom_samples(text):
         base, _, suffix = name.rpartition("_")
         if base not in wanted or suffix not in ("bucket", "sum", "count"):
-            continue
-        labels = {k: v.replace('\\"', '"').replace("\\\\", "\\")
-                  for k, v in _LABEL.findall(m.group("labels") or "")}
-        try:
-            value = float(m.group("value"))
-        except ValueError:
             continue
         if suffix == "bucket":
             le = labels.pop("le", "+Inf")
@@ -150,6 +169,70 @@ def parse_histograms(text: str, names: Iterable[str]) -> dict:
         else:
             entry(base, labels)["count"] += int(value)
     return out
+
+
+def parse_counters(text: str, names: Iterable[str]) -> dict:
+    """The named counters (or gauges) out of one /metrics render:
+    {(name, labelitems): {"labels", "value"}} — the counter sibling of
+    `parse_histograms`, for the cost-accounting merge."""
+    wanted = set(names)
+    out: dict = {}
+    for name, labels, value in _prom_samples(text):
+        if name not in wanted:
+            continue
+        key = (name, tuple(sorted(labels.items())))
+        entry = out.setdefault(key, {"labels": labels, "value": 0.0})
+        entry["value"] += value
+    return out
+
+
+def merge_counters(parsed: Iterable[dict]) -> dict:
+    """Sum per-replica counter parses (cumulative counts sum exactly,
+    like the histogram merge)."""
+    merged: dict = {}
+    for one in parsed:
+        for key, series in one.items():
+            into = merged.setdefault(key, {
+                "labels": dict(series["labels"]), "value": 0.0,
+            })
+            into["value"] += series["value"]
+    return merged
+
+
+def cost_report(counters: dict, error_hist: dict) -> dict:
+    """The /fleet "cost" section from merged counters + the merged
+    observed/predicted ratio histogram: per-tenant predicted/observed
+    seconds, admission refusals by reason, and the model-error
+    estimate. Empty sub-dicts when the fleet has no cost traffic."""
+    tenants: dict = {}
+    rejected: dict = {}
+    for (name, _), series in sorted(counters.items()):
+        if name == "chain_serve_cost_rejected_total":
+            reason = series["labels"].get("reason", "?")
+            rejected[reason] = rejected.get(reason, 0) \
+                + int(series["value"])
+            continue
+        tenant = series["labels"].get("tenant", "")
+        entry = tenants.setdefault(
+            tenant, {"predicted_s": 0.0, "observed_s": 0.0}
+        )
+        if name == "chain_serve_cost_predicted_seconds_total":
+            entry["predicted_s"] = round(
+                entry["predicted_s"] + series["value"], 3)
+        elif name == "chain_serve_cost_observed_seconds_total":
+            entry["observed_s"] = round(
+                entry["observed_s"] + series["value"], 3)
+    error: Optional[dict] = None
+    for (name, _), series in error_hist.items():
+        if name != COST_ERROR_METRIC or not series["count"]:
+            continue
+        error = {
+            "n": series["count"],
+            "ratio_p50": percentile_from_buckets(series["buckets"], 0.50),
+            "ratio_p95": percentile_from_buckets(series["buckets"], 0.95),
+        }
+    return {"tenants": tenants, "rejected": rejected,
+            "model_error": error}
 
 
 def merge_histograms(parsed: Iterable[dict]) -> dict:
@@ -304,6 +387,7 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
     root = os.path.abspath(root)
     replicas: list[dict] = []
     parsed: list[dict] = []
+    parsed_counters: list[dict] = []
     for info in discover_replicas(root):
         entry = {
             "replica": info.get("replica"),
@@ -335,12 +419,18 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
         if entry["alive"]:
             text = _fetch(info["url"].rstrip("/") + "/metrics", timeout_s)
             if text is not None:
+                rendered = text.decode(errors="replace")
                 parsed.append(parse_histograms(
-                    text.decode(errors="replace"), PHASE_METRICS.values()
+                    rendered,
+                    [*PHASE_METRICS.values(), COST_ERROR_METRIC],
                 ))
+                parsed_counters.append(
+                    parse_counters(rendered, COST_COUNTERS)
+                )
         else:
             entry["error"] = "unreachable"
         replicas.append(entry)
+    merged_hists = merge_histograms(parsed)
     return {
         "schema": 1,
         "generated_at": round(time.time(), 3),
@@ -349,8 +439,12 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
         "alive": sum(1 for r in replicas if r["alive"]),
         "queue": queue_counts(root),
         "requests": request_counts(root),
-        "slo": slo_report(merge_histograms(parsed)),
+        "slo": slo_report(merged_hists),
         "slo_bands": catalog.SLO_BANDS,
+        # per-tenant predicted/observed seconds + admission refusals,
+        # merged across replicas (serve/cost.py)
+        "cost": cost_report(merge_counters(parsed_counters),
+                            merged_hists),
         # tail-sampled on purpose: the journals are unbounded
         # append-only history and /fleet refreshes every few seconds
         "spans": serve_spans.journal_stats(

@@ -48,7 +48,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--host", default=None,
                         help="bind host (default 127.0.0.1 / PC_LIVE_HOST)")
     parser.add_argument("--executor", default="synthetic",
-                        help="unit executor: synthetic | wave")
+                        help="unit executor: synthetic | wave | chain "
+                             "(chain = real databases through p01-p04; "
+                             "requests carry params.config)")
     parser.add_argument("--workers", type=int, default=2,
                         help="scheduler worker threads")
     parser.add_argument("--wave-width", type=int, default=4,
@@ -79,6 +81,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="where to write {pid, port, url, replica} "
                              "(default ROOT/serve-info.json; give each "
                              "replica of a fleet its own)")
+    parser.add_argument("--wave-budget-s", type=float, default=None,
+                        help="cost-aware wave packing: fill waves to "
+                             "this many PREDICTED seconds (serve/cost.py)"
+                             " instead of stopping at --wave-width")
+    parser.add_argument("--admission-budget-s", type=float, default=None,
+                        help="refuse (429) any request whose cold units "
+                             "predict more than this many seconds")
+    parser.add_argument("--tenant-budget-s", type=float, default=None,
+                        help="refuse (429, retryable) work that would "
+                             "push a tenant's outstanding predicted "
+                             "seconds past this budget")
     args = parser.parse_args(list(argv) if argv is not None else None)
 
     from .store_admin import _parse_bytes
@@ -101,6 +114,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         lease_s=args.lease_s,
         poll_s=args.poll_s,
         info_path=args.info_file,
+        wave_budget_s=args.wave_budget_s,
+        admission_budget_s=args.admission_budget_s,
+        tenant_budget_s=args.tenant_budget_s,
     )
     stop = threading.Event()
 

@@ -108,6 +108,30 @@ def render(view: dict, note: str = "") -> str:
             f"spans: {span_stats['total']}{tail_note} "
             + " ".join(f"{k}={v}" for k, v in sorted(by_phase.items()))
         )
+    fleet_cost = view.get("cost", {})
+    if fleet_cost.get("tenants") or fleet_cost.get("rejected"):
+        lines.append("")
+        lines.append("cost (predicted vs observed seconds, "
+                     "serve/cost.py):")
+        for tenant, entry in sorted(fleet_cost.get("tenants",
+                                                   {}).items()):
+            lines.append(
+                f"  {tenant or '(any)':<20} "
+                f"predicted {entry.get('predicted_s', 0.0):9.1f}s  "
+                f"observed {entry.get('observed_s', 0.0):9.1f}s"
+            )
+        err = fleet_cost.get("model_error")
+        if err:
+            lines.append(
+                f"  model error: n={err['n']} "
+                f"obs/pred p50≤{err['ratio_p50']} p95≤{err['ratio_p95']}"
+            )
+        rejected = fleet_cost.get("rejected", {})
+        if rejected:
+            lines.append(
+                "  admission rejected: "
+                + " ".join(f"{k}={v}" for k, v in sorted(rejected.items()))
+            )
     slo = view.get("slo", {})
     lines.append("")
     lines.append("SLO (merged over live replicas; bands from "

@@ -11,6 +11,18 @@ promises (ROADMAP open item #2, docs/SERVE.md):
   * a warm re-run of the same grids answers in milliseconds
     (measured, reported, and gated against --warm-budget-ms).
 
+`--executor chain` runs the soak over a REAL synthetic corpus: the
+harness renders SRC videos of deliberately mixed complexity, writes a
+database YAML around them, and the overlapping clients drive the full
+p01–p04 stages through the production executor — every artifact family
+lands in the store, still with zero duplicate executions.
+
+`--pack-bench` instead benches the scheduler's packing POLICY:
+cost-aware wave packing (balance predicted seconds, serve/cost.py) vs
+count-based packing on an adversarially-ordered mixed-complexity queue,
+reporting per-wave predicted-seconds spread and per-unit e2e tail for
+both (the committed `COST_PACK_*.json` band).
+
 The report also breaks the cold pass's latency into the SLO phases
 the fleet layer grades (docs/TELEMETRY.md "Fleet observability"):
 p50/p95/p99 of queue-wait (enqueue→claim) and execution (claim→settle)
@@ -23,8 +35,9 @@ with the PR) and exits nonzero on any violated invariant.
 
     python -m processing_chain_tpu tools serve-soak
         [--clients 8] [--srcs 6] [--hrcs 4] [--overlap 0.5]
-        [--executor synthetic] [--workers 4] [--wave-width 4]
+        [--executor synthetic|wave|chain] [--workers 4] [--wave-width 4]
         [--warm-budget-ms 1000] [--out FILE] [--root DIR]
+        [--pack-bench] [--wave-budget-s S]
 """
 
 from __future__ import annotations
@@ -98,6 +111,254 @@ def _planned_serve_jobs() -> int:
     ))
 
 
+# ------------------------------------------------------ chain corpus
+
+
+def make_chain_corpus(root: str, n_srcs: int, n_hrcs: int) -> dict:
+    """A REAL synthetic corpus for the production executor: `n_srcs`
+    tiny SRC videos of deliberately MIXED complexity (spatial detail ×
+    motion speed × noise all vary per SRC, so the priors cost model has
+    something to rank) and an `n_hrcs`-rung bitrate ladder around them.
+    Returns {"config", "srcs", "hrcs"}."""
+    import numpy as np
+
+    from ..io import VideoWriter
+
+    db_id = "P2SXM77"
+    db_dir = os.path.join(root, "corpus", db_id)
+    os.makedirs(os.path.join(db_dir, "srcVid"), exist_ok=True)
+    w, h, n, fps = 160, 90, 48, 24
+    rng = np.random.default_rng(7)
+    srcs = [f"SRC{i:03d}" for i in range(n_srcs)]
+    for i, src in enumerate(srcs):
+        path = os.path.join(db_dir, "srcVid", src + ".avi")
+        detail = 5 + 18 * i          # spatial frequency ramps per SRC
+        speed = 1 + 3 * i            # motion ramps per SRC
+        noise = 3.0 * i              # coding complexity ramps per SRC
+        with VideoWriter(path, "ffv1", w, h, "yuv420p", (fps, 1)) as wr:
+            xx, yy = np.meshgrid(np.arange(w), np.arange(h))
+            for f in range(n):
+                y = (np.sin((xx + speed * f) / max(1, 30 - detail))
+                     + np.cos((yy + f) / 17)) * 50 + 120
+                if noise:
+                    y = y + rng.normal(0.0, noise, y.shape)
+                y = np.clip(y, 0, 255).astype(np.uint8)
+                u = np.full((h // 2, w // 2), 128, np.uint8)
+                v = np.full((h // 2, w // 2), 118, np.uint8)
+                wr.write(y, u, v)
+    hrcs = [f"HRC{i:03d}" for i in range(n_hrcs)]
+    qls = "\n".join(
+        f"  Q{i}: {{index: {i}, videoCodec: h264, "
+        f"videoBitrate: {150 * (i + 1)}, width: {w}, height: {h}, "
+        f"fps: {fps}}}"
+        for i in range(n_hrcs)
+    )
+    hrc_rows = "\n".join(
+        f"  {hrc}: {{videoCodingId: VC01, eventList: [[Q{i}, 2]]}}"
+        for i, hrc in enumerate(hrcs)
+    )
+    pvs_rows = "\n".join(
+        f"  - {db_id}_{src}_{hrc}" for src in srcs for hrc in hrcs
+    )
+    config = os.path.join(db_dir, db_id + ".yaml")
+    atomic_write_text(config, (
+        f"databaseId: {db_id}\n"
+        "syntaxVersion: 6\n"
+        "type: short\n"
+        f"qualityLevelList:\n{qls}\n"
+        "codingList:\n"
+        "  VC01: {type: video, encoder: libx264, passes: 1, "
+        "iFrameInterval: 1, preset: ultrafast}\n"
+        "srcList:\n"
+        + "\n".join(f"  {s}: {s}.avi" for s in srcs) + "\n"
+        f"hrcList:\n{hrc_rows}\n"
+        f"pvsList:\n{pvs_rows}\n"
+        "postProcessingList:\n"
+        f"  - {{type: pc, displayWidth: {w}, displayHeight: {h}, "
+        f"codingWidth: {w}, codingHeight: {h}, displayFrameRate: {fps}}}\n"
+    ))
+    return {"config": config, "database": db_id, "srcs": srcs,
+            "hrcs": hrcs}
+
+
+def _corpus_grid(client: int, corpus: dict, overlap: float) -> dict:
+    """Overlapping per-client subsets of the REAL corpus grid (the
+    chain-mode sibling of `_grid`): a shared core plus a rotating
+    tail."""
+    srcs, hrcs = corpus["srcs"], corpus["hrcs"]
+    shared = max(1, int(len(srcs) * overlap))
+    picked = list(srcs[:shared])
+    for k in range(len(srcs) - shared):
+        picked.append(srcs[(shared + client + k) % len(srcs)])
+    return {"srcs": sorted(set(picked)), "hrcs": list(hrcs)}
+
+
+# ------------------------------------------------------- pack bench
+
+
+def pack_bench(args) -> int:
+    """Cost-aware vs count-based wave packing on an adversarially
+    ordered mixed-complexity queue: a burst of light units followed by
+    a burst of heavy ones (the order a bursty tenant actually
+    produces). Count-based packing groups the heavies into a few
+    monolithic all-heavy waves whose coarse granularity straggles the
+    end of the drain; cost-aware packing splits them into ~budget-sized
+    waves that spread across workers. Reports, per policy: per-wave
+    predicted-seconds spread (CV + max) and per-unit e2e latency
+    percentiles. Exit 1 unless cost-aware improves BOTH — the committed
+    `COST_PACK_*.json` band."""
+    from ..serve import cost as serve_cost
+    from ..serve.api import Unit
+    from ..serve.executors import SyntheticExecutor
+    from ..serve.queue import DurableQueue
+    from ..serve.scheduler import Scheduler
+    from ..store import keys
+
+    log = get_logger()
+    tm.enable()
+    root = args.root or tempfile.mkdtemp(prefix="chain-pack-bench-")
+    heavy_ms, light_ms = 200, 10
+    n_heavy, n_light = 12, 36
+    executor = SyntheticExecutor()
+
+    def predict(work_ms: int) -> float:
+        return serve_cost.predict_unit_cost(executor, {
+            "params": {"work_ms": work_ms, "size_bytes": 1024},
+        })
+
+    budget = args.wave_budget_s or (
+        predict(heavy_ms) + 3 * predict(light_ms) + 0.005
+    )
+    report: dict = {
+        "bench": "pack",
+        "heavy_ms": heavy_ms, "light_ms": light_ms,
+        "n_heavy": n_heavy, "n_light": n_light,
+        "workers": args.workers, "wave_width": args.wave_width,
+        "wave_budget_s": round(budget, 4),
+        "modes": {},
+    }
+    failures: list[str] = []
+    work = [light_ms] * n_light + [heavy_ms] * n_heavy
+    for mode in ("count", "cost"):
+        mroot = os.path.join(root, mode)
+        queue = DurableQueue(os.path.join(mroot, "queue"))
+        try:
+            for i, work_ms in enumerate(work):
+                unit = Unit(database="P2STR01", src=f"SRC{100 + i:03d}",
+                            hrc="HRC100",
+                            params={"geometry": [64, 36],
+                                    "work_ms": work_ms,
+                                    "size_bytes": 1024})
+                plan = executor.plan(unit)
+                record_unit = {
+                    "database": unit.database, "src": unit.src,
+                    "hrc": unit.hrc, "params": unit.params,
+                    "pvs_id": unit.pvs_id,
+                }
+                queue.enqueue(
+                    keys.plan_hash(plan), plan, record_unit, "acme",
+                    "normal", f"req-{i}", f"u{i}.bin",
+                    cost_s=serve_cost.predict_unit_cost(
+                        executor, record_unit),
+                )
+            events_before = len(tm.EVENTS.records())
+            sched = Scheduler(
+                queue, executor, os.path.join(mroot, "artifacts"),
+                workers=args.workers, wave_width=args.wave_width,
+                wave_budget_s=budget if mode == "cost" else None,
+            )
+            t0 = time.perf_counter()
+            sched.start()
+            drained = sched.wait_idle(timeout=180.0)
+            wall_s = time.perf_counter() - t0
+            sched.stop()
+            if not drained:
+                failures.append(f"{mode}: queue never drained")
+                continue
+            wave_pred = [
+                e.get("predicted_s", 0.0)
+                for e in tm.EVENTS.records()[events_before:]
+                if e.get("event") == "serve_wave"
+            ]
+            records = [queue.record(j) for j in _record_ids(queue)]
+            e2e = [
+                max(0.0, r.done_at - r.enqueued_at)
+                for r in records
+                if r is not None and r.state == "done" and r.done_at
+            ]
+            mean = sum(wave_pred) / max(1, len(wave_pred))
+            var = sum((x - mean) ** 2 for x in wave_pred) \
+                / max(1, len(wave_pred))
+            from ..telemetry.fleet import percentile_exact
+
+            results = {
+                "waves": len(wave_pred),
+                "wave_pred_mean_s": round(mean, 4),
+                "wave_pred_max_s": round(max(wave_pred), 4)
+                if wave_pred else None,
+                "wave_pred_cv": round((var ** 0.5) / mean, 4)
+                if mean else None,
+                "units_done": len(e2e),
+                "e2e_p50_s": round(percentile_exact(e2e, 0.50), 4)
+                if e2e else None,
+                "e2e_p95_s": round(percentile_exact(e2e, 0.95), 4)
+                if e2e else None,
+                "wall_s": round(wall_s, 3),
+            }
+            report["modes"][mode] = results
+            if len(e2e) != len(work):
+                failures.append(
+                    f"{mode}: {len(e2e)}/{len(work)} units completed")
+        finally:
+            queue.close()
+    count_m, cost_m = report["modes"].get("count"), \
+        report["modes"].get("cost")
+    if count_m and cost_m and None not in (
+            count_m["e2e_p95_s"], cost_m["e2e_p95_s"],
+            count_m["wave_pred_cv"], cost_m["wave_pred_cv"]):
+        report["improvement"] = {
+            "wave_pred_cv": round(
+                count_m["wave_pred_cv"] / max(1e-9, cost_m["wave_pred_cv"]),
+                3) if cost_m["wave_pred_cv"] else None,
+            "e2e_p95": round(
+                count_m["e2e_p95_s"] / max(1e-9, cost_m["e2e_p95_s"]), 3),
+        }
+        if cost_m["wave_pred_cv"] >= count_m["wave_pred_cv"]:
+            failures.append(
+                "cost-aware packing did not reduce per-wave "
+                f"predicted-seconds spread (cv {cost_m['wave_pred_cv']} "
+                f"vs {count_m['wave_pred_cv']})")
+        if cost_m["e2e_p95_s"] >= count_m["e2e_p95_s"]:
+            failures.append(
+                "cost-aware packing did not improve the e2e tail "
+                f"(p95 {cost_m['e2e_p95_s']}s vs "
+                f"{count_m['e2e_p95_s']}s)")
+    else:
+        # a mode that completed nothing already appended its failure;
+        # the comparison is meaningless without both sides' numbers
+        failures.append("pack comparison skipped: a mode has no "
+                        "completed units")
+    report["failures"] = failures
+    report["ok"] = not failures
+    line = json.dumps(report, sort_keys=True)
+    print(line)
+    if args.out:
+        atomic_write_text(args.out, line + "\n")
+    if failures:
+        for f in failures:
+            log.error("pack-bench: %s", f)
+        return 1
+    log.info("pack-bench: OK — wave-spread cv %s -> %s, e2e p95 %ss -> %ss",
+             count_m["wave_pred_cv"], cost_m["wave_pred_cv"],
+             count_m["e2e_p95_s"], cost_m["e2e_p95_s"])
+    return 0
+
+
+def _record_ids(queue) -> list[str]:
+    with queue._lock:
+        return list(queue._jobs)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="tools serve-soak")
     parser.add_argument("--clients", type=int, default=8)
@@ -114,15 +375,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="also write the JSON report here")
     parser.add_argument("--root", default=None,
                         help="serve root (default: a fresh temp dir)")
+    parser.add_argument("--wave-budget-s", type=float, default=None,
+                        help="cost-aware packing budget (predicted "
+                             "seconds per wave; serve/cost.py)")
+    parser.add_argument("--pack-bench", action="store_true",
+                        help="bench cost-aware vs count-based wave "
+                             "packing instead of running the soak")
     args = parser.parse_args(list(argv) if argv is not None else None)
+
+    if args.pack_bench:
+        return pack_bench(args)
 
     from ..serve.service import ChainServeService
 
     log = get_logger()
     root = args.root or tempfile.mkdtemp(prefix="chain-serve-soak-")
+    corpus: Optional[dict] = None
+    if args.executor == "chain":
+        # a real synthetic corpus: mixed-complexity SRCs + a bitrate
+        # ladder, driven through the full p01-p04 stages
+        corpus = make_chain_corpus(root, args.srcs, args.hrcs)
     service = ChainServeService(
         root=root, port=0, executor=args.executor,
         workers=args.workers, wave_width=args.wave_width,
+        wave_budget_s=args.wave_budget_s,
     ).start()
     report: dict = {"clients": args.clients, "srcs": args.srcs,
                     "hrcs": args.hrcs, "overlap": args.overlap,
@@ -135,15 +411,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         results: list[Optional[dict]] = [None] * args.clients
         geometry = [64, 36]
 
-        def _client(i: int) -> None:
-            body = {
+        def _body(i: int, priority: str) -> dict:
+            if corpus is not None:
+                return {
+                    "tenant": tenants[i],
+                    "priority": priority,
+                    "database": corpus["database"],
+                    **_corpus_grid(i, corpus, args.overlap),
+                    "params": {"config": corpus["config"]},
+                }
+            return {
                 "tenant": tenants[i],
-                "priority": ("interactive", "normal", "bulk")[i % 3],
+                "priority": priority,
                 "database": "P2STR01",
                 **_grid(i, args.srcs, args.hrcs, args.overlap),
                 "params": {"geometry": geometry, "size_bytes": 2048},
             }
-            results[i] = service.submit(body)
+
+        def _client(i: int) -> None:
+            results[i] = service.submit(
+                _body(i, ("interactive", "normal", "bulk")[i % 3])
+            )
 
         t0 = time.perf_counter()
         threads = [threading.Thread(target=_client, args=(i,))
@@ -153,7 +441,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for t in threads:
             t.join()
         req_ids = [r["request"] for r in results if r]
-        states = {rid: service.wait_request(rid, timeout=120.0)
+        wait_s = 600.0 if corpus is not None else 120.0
+        states = {rid: service.wait_request(rid, timeout=wait_s)
                   for rid in req_ids}
         cold_wall_s = time.perf_counter() - t0
         incomplete = sorted(r for r, s in states.items() if s != "done")
@@ -181,6 +470,46 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"{len(unique_plans)} unique plans"
             )
 
+        if corpus is not None and req_ids:
+            # all four stages really ran: every unit's manifest names a
+            # verified store object per artifact family (every request
+            # walked — the grids overlap, but each must resolve)
+            families_missing: set = {"segments", "metadata", "avpvs",
+                                     "cpvs"}
+            units_to_verify: dict = {}
+            for rid in req_ids:
+                doc = service.request_status(rid)
+                for unit in (doc or {}).get("units", {}).values():
+                    units_to_verify[unit["plan"]] = unit
+            for unit in units_to_verify.values():
+                manifest = service.store.lookup(unit["plan"])
+                if manifest is None:
+                    failures.append(
+                        f"unit manifest {unit['plan']} not in the store")
+                    continue
+                with open(service.store.object_path(
+                        manifest.object["sha256"])) as f:
+                    artifacts = json.load(f)["artifacts"]
+                for family, entry in artifacts.items():
+                    entries = entry if isinstance(entry, list) else [entry]
+                    for one in entries:
+                        m = service.store.lookup(one["plan"])
+                        if m is None:
+                            failures.append(
+                                f"{family} artifact {one['name']} not "
+                                "in the store")
+                            continue
+                        service.store.verify_object(m.object)
+                        families_missing.discard(family)
+            if families_missing:
+                failures.append(
+                    f"artifact families never produced: "
+                    f"{sorted(families_missing)}")
+            report["artifact_families"] = sorted(
+                {"segments", "metadata", "avpvs", "cpvs"}
+                - families_missing)
+            report["cost"] = service.cost_ledger.report()
+
         # per-phase latency percentiles (queue-wait vs execution vs
         # end-to-end), from the span journal's exact timestamps
         e2e_s = []
@@ -196,12 +525,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # warm pass: same grids again — store hits, millisecond latency
         warm_latencies = []
         for i in range(args.clients):
-            body = {
-                "tenant": tenants[i], "priority": "interactive",
-                "database": "P2STR01",
-                **_grid(i, args.srcs, args.hrcs, args.overlap),
-                "params": {"geometry": geometry, "size_bytes": 2048},
-            }
+            body = _body(i, "interactive")
             t1 = time.perf_counter()
             accepted = service.submit(body)
             state = service.wait_request(accepted["request"], timeout=30.0)

@@ -980,3 +980,41 @@ def test_p01_online_sos_skips_and_existing_file_passes(tmp_path):
         with open(seg.file_path, "wb") as fh:
             fh.write(b"\x00" * 32)
     p01.run(_p01_args(), test_config=tc2)
+
+
+def test_find_ytdl_module_is_the_one_shared_definition(monkeypatch):
+    """The client constructor and the plan-time capability probe must
+    resolve yt-dlp availability through ONE definition
+    (dl.find_ytdl_module) — two private copies of the preference walk
+    is exactly how plan_capability and download_video drift apart."""
+    import importlib.machinery
+    import sys
+    import types
+
+    # neither flavor importable: probe says None, client refuses
+    monkeypatch.setattr(
+        dl, "find_ytdl_module", dl.find_ytdl_module)  # the real one
+    monkeypatch.setitem(sys.modules, "yt_dlp", None)
+    monkeypatch.setitem(sys.modules, "youtube_dl", None)
+    monkeypatch.setattr(dl, "_YTDL_MODULES", ("no_such_ytdl_a",
+                                              "no_such_ytdl_b"))
+    assert dl.find_ytdl_module() is None
+    with pytest.raises(RuntimeError, match="neither yt-dlp"):
+        dl.YtdlClient()
+
+    # a fake flavor importable: BOTH consumers see it — the probe
+    # returns its name and the constructor imports that exact module
+    fake = types.ModuleType("fake_ytdl")
+    fake.__spec__ = importlib.machinery.ModuleSpec("fake_ytdl",
+                                                   loader=None)
+    monkeypatch.setitem(sys.modules, "fake_ytdl", fake)
+    monkeypatch.setattr(dl, "_YTDL_MODULES", ("fake_ytdl",))
+    assert dl.find_ytdl_module() == "fake_ytdl"
+    client = dl.YtdlClient()
+    assert client._ytdl is fake
+    # and the Downloader-level feasibility probe keys on the same walk
+    d = dl.Downloader(".")
+    d.youtube = None
+    assert d._youtube_available() is True
+    monkeypatch.setattr(dl, "_YTDL_MODULES", ("no_such_ytdl_a",))
+    assert d._youtube_available() is False

@@ -1,0 +1,271 @@
+"""The production chain executor (serve/chain_executor.py): a POSTed
+real-database request drives p01–p04 through chain-serve and every
+artifact family — segments, metadata tables, AVPVS, CPVS — is served
+verified from the content-addressed store, with plan-hash singleflight
+intact across re-POSTs (ROADMAP item 2, docs/SERVE.md "Real database
+execution")."""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+import urllib.request
+
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.io import medialib
+from processing_chain_tpu.serve import api
+from processing_chain_tpu.serve.service import ChainServeService
+from processing_chain_tpu.store import runtime as store_runtime
+
+
+def _native_available() -> bool:
+    try:
+        medialib.ensure_loaded()
+        return True
+    except Exception:  # pragma: no cover - env-dependent
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(),
+    reason="native media boundary unavailable",
+)
+
+DB_ID = "P2SXM72"
+
+DB_YAML = textwrap.dedent(f"""\
+    databaseId: {DB_ID}
+    syntaxVersion: 6
+    type: short
+    qualityLevelList:
+      Q0: {{index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24}}
+      Q1: {{index: 1, videoCodec: h264, videoBitrate: 500, width: 160, height: 90, fps: 24}}
+    codingList:
+      VC01: {{type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}}
+    srcList:
+      SRC000: SRC000.avi
+    hrcList:
+      HRC000: {{videoCodingId: VC01, eventList: [[Q0, 2]]}}
+      HRC001: {{videoCodingId: VC01, eventList: [[Q1, 2], [stall, 0.5]]}}
+    pvsList:
+      - {DB_ID}_SRC000_HRC000
+      - {DB_ID}_SRC000_HRC001
+    postProcessingList:
+      - {{type: pc, displayWidth: 160, displayHeight: 90, codingWidth: 160, codingHeight: 90, displayFrameRate: 24}}
+""")
+
+
+@pytest.fixture(scope="module")
+def chain_db(tmp_path_factory):
+    from tests.test_pipeline_e2e import write_db
+
+    tmp = tmp_path_factory.mktemp("chaindb")
+    return write_db(tmp, DB_ID, DB_YAML, {"SRC000.avi": dict(n=48)})
+
+
+@pytest.fixture
+def serve_factory(tmp_path):
+    created = []
+
+    def make(subdir="serve", **kw):
+        svc = ChainServeService(
+            root=str(tmp_path / subdir), port=0, executor="chain", **kw
+        ).start()
+        created.append(svc)
+        return svc
+
+    yield make
+    for svc in created:
+        svc.stop()
+    store_runtime.configure(None)
+    tm.disable()
+
+
+def _planned_serve_jobs() -> int:
+    """Every job the serve stack planned: the outer serve waves plus
+    the inner serve-p01..p04 stage runners."""
+    metric = tm.REGISTRY.snapshot().get("chain_jobs_planned_total")
+    if not metric:
+        return 0
+    return int(sum(
+        s.get("value", 0) for s in metric["series"]
+        if str(s.get("labels", {}).get("runner", "")).startswith("serve")
+    ))
+
+
+def _fetch(url: str) -> bytes:
+    with urllib.request.urlopen(url) as resp:
+        assert resp.status == 200
+        return resp.read()
+
+
+def test_real_database_serves_every_artifact_family(serve_factory,
+                                                    chain_db):
+    """The acceptance path: POST a real database grid, and a verified
+    object from EACH of the four artifact families comes back through
+    /v1/artifacts — plus warm re-POST executes zero new jobs."""
+    svc = serve_factory(workers=2)
+    planned_before = _planned_serve_jobs()
+    body = {
+        "tenant": "studio", "database": DB_ID,
+        "srcs": ["SRC000"], "hrcs": ["HRC000", "HRC001"],
+        "params": {"config": chain_db},
+    }
+    accepted = svc.submit(body)
+    assert accepted["state"] in ("active", "done")
+    assert svc.wait_request(accepted["request"], timeout=300.0) == "done"
+    doc = svc.request_status(accepted["request"])
+    assert doc["predicted_cost_s"] > 0
+    assert set(doc["units"]) == {f"{DB_ID}_SRC000_HRC000",
+                                 f"{DB_ID}_SRC000_HRC001"}
+
+    for pvs_id, unit in doc["units"].items():
+        manifest = json.loads(_fetch(svc.server.url + unit["artifact"]))
+        assert manifest["pvs"] == pvs_id
+        families = manifest["artifacts"]
+        assert set(families) == {"segments", "metadata", "avpvs", "cpvs"}
+        assert families["segments"] and families["cpvs"]
+        # metadata carries the sidecar tables as extras (.buff only
+        # for the stalling HRC — metadata_paths semantics)
+        exts = {name.rsplit(".", 1)[-1]
+                for name in families["metadata"]["extras"]}
+        assert {"vfi", "afi"} <= exts
+        if pvs_id.endswith("HRC001"):
+            assert "buff" in exts
+        # one verified object per family, served over the wire with the
+        # exact committed byte count
+        for family, entry in families.items():
+            entries = entry if isinstance(entry, list) else [entry]
+            for one in entries:
+                m = svc.store.lookup(one["plan"])
+                assert m is not None, (family, one)
+                svc.store.verify_object(m.object)
+                data = _fetch(
+                    svc.server.url + "/v1/artifacts/" + one["plan"])
+                assert len(data) == one["size"], (family, one["name"])
+
+    # the stalled HRC's AVPVS is the post-stalling render (longer than
+    # the 2 s event list: the stall adds canvas frames)
+    stalled = json.loads(_fetch(
+        svc.server.url
+        + doc["units"][f"{DB_ID}_SRC000_HRC001"]["artifact"]))
+    plain = json.loads(_fetch(
+        svc.server.url
+        + doc["units"][f"{DB_ID}_SRC000_HRC000"]["artifact"]))
+    assert stalled["artifacts"]["avpvs"]["name"].endswith(
+        f"{DB_ID}_SRC000_HRC001.avi")
+    assert stalled["artifacts"]["avpvs"]["size"] > \
+        plain["artifacts"]["avpvs"]["size"] * 0.5
+
+    cold_planned = _planned_serve_jobs() - planned_before
+    assert cold_planned > 0
+
+    # warm singleflight through the REAL executor: a re-POST of the
+    # same grid answers from the store at submit time, zero new jobs
+    accepted2 = svc.submit(body)
+    assert svc.wait_request(accepted2["request"], timeout=60.0) == "done"
+    assert _planned_serve_jobs() - planned_before == cold_planned
+    doc2 = svc.request_status(accepted2["request"])
+    assert doc2["warm"] is True
+    assert doc2["latency_ms"] is not None
+
+
+def test_chain_grid_validates_at_the_front_door(serve_factory, chain_db):
+    """Grid cells the database does not define are a 400 at POST time
+    — never a durable record, never a quarantine."""
+    svc = serve_factory()
+    with pytest.raises(api.RequestError, match="not in the database"):
+        svc.submit({
+            "tenant": "studio", "database": DB_ID,
+            "srcs": ["SRC000"], "hrcs": ["HRC777"],
+            "params": {"config": chain_db},
+        })
+    with pytest.raises(api.RequestError, match="does not match"):
+        svc.submit({
+            "tenant": "studio", "database": "P2SXM73",
+            "srcs": ["SRC000"], "hrcs": ["HRC000"],
+            "params": {"config": chain_db},
+        })
+    with pytest.raises(api.RequestError, match="params.config"):
+        svc.submit({
+            "tenant": "studio", "database": DB_ID,
+            "srcs": ["SRC000"], "hrcs": ["HRC000"],
+            "params": {"config": chain_db + ".missing"},
+        })
+    with pytest.raises(api.RequestError, match="config"):
+        svc.submit({
+            "tenant": "studio", "database": DB_ID,
+            "srcs": ["SRC000"], "hrcs": ["HRC000"],
+            "params": {},
+        })
+    assert svc.queue.counts() == {}
+
+
+def test_chain_cost_features_are_real(serve_factory, chain_db):
+    """The cost model sees the config's own facts: encode
+    frame-megapixels, the target codec, output bytes from the bitrate
+    ladder — and degrades to None (default cost) on garbage units."""
+    svc = serve_factory()
+    features = svc.executor.cost_features({
+        "database": DB_ID, "src": "SRC000", "hrc": "HRC001",
+        "params": {"config": chain_db},
+        "pvs_id": f"{DB_ID}_SRC000_HRC001",
+    })
+    assert features is not None
+    # 2 s × 24 fps × 160×90 ≈ 0.69 encode frame-megapixels
+    assert features["enc_fmpix"] == pytest.approx(0.691, rel=0.05)
+    assert features["codec"] == "h264"
+    assert features["out_bytes"] == pytest.approx(
+        500e3 / 8 * 2, rel=0.01)
+    assert features["dev_fmpix"] > features["enc_fmpix"]  # 60 fps canvas
+    assert features["cpvs_fmpix"] > 0
+    assert svc.executor.cost_features({"params": None}) is None
+    # bucket key groups by (config, database) and stays total
+    key = svc.executor.bucket_key({
+        "database": DB_ID, "src": "SRC000", "hrc": "HRC000",
+        "params": {"config": chain_db},
+    })
+    assert key == ("chain", os.path.abspath(chain_db), DB_ID)
+    assert svc.executor.bucket_key({"params": {}}) is None
+
+
+def test_p02_metadata_routes_through_the_pool(tmp_path, monkeypatch):
+    """ROADMAP item 3 satellite: per-PVS metadata jobs are independent,
+    so p02 must hand N PVSes to the JobRunner pool at the requested
+    `-p`, not run them serial — pinned here via a recording runner on a
+    dry-run plan (no media touched)."""
+    from types import SimpleNamespace
+
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.stages import p02_generate_metadata as p02
+    from tests.fixtures import write_short_db
+
+    yaml_path, prober = write_short_db(tmp_path)  # 2 PVSes
+    cfg = TestConfig(yaml_path, prober=prober)
+    captured = {}
+
+    class Recorder(p02.JobRunner):
+        def __init__(self, *args, **kw):
+            super().__init__(*args, **kw)
+            captured["parallelism"] = self.parallelism
+
+        def run(self):
+            captured["jobs"] = len(self.jobs)
+            captured["mode"] = "pool"
+            return super().run()
+
+        def run_serial(self):
+            captured["mode"] = "serial"
+            return super().run_serial()
+
+    monkeypatch.setattr(p02, "JobRunner", Recorder)
+    args = SimpleNamespace(
+        force=False, dry_run=True, parallelism=3,
+        skip_online_services=False, test_config=yaml_path,
+        filter_src=None, filter_hrc=None, filter_pvs=None,
+    )
+    p02.run(args, test_config=cfg)
+    assert captured == {"parallelism": 3, "jobs": 2, "mode": "pool"}

@@ -79,6 +79,11 @@ def test_concurrent_claim_yields_exactly_one_owner(two_queues):
     for key, q in (("a", qa), ("b", qb)):
         for job_id in wins[key]:
             assert q.complete(job_id) is not None
+    # cross-replica visibility is via poll() (the maintenance tick's
+    # sync), not magic: without it qa's view of qb's completions is
+    # whatever it absorbed during the claim race — a latent flake
+    # whenever qb actually won a job
+    qa.poll()
     assert qa.counts().get("done", 0) == len(job_ids)
 
 
