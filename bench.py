@@ -1090,6 +1090,100 @@ def complexity_bench() -> dict:
     return out
 
 
+def fused_bench() -> dict:
+    """Fused vs staged p03+p04 wall time (`bench.py --fused-bench`,
+    docs/PERF.md "single-decode chain"). One synthetic short database
+    (one PVS, pc + mobile contexts) runs p03+p04 twice — staged
+    (PC_FUSE_P04 off: stalling + every CPVS re-decode the AVPVS) and
+    fused (on: everything renders from the in-memory stream) — with
+    cold outputs each time. The tracked number is the wall-time ratio
+    `fused_vs_unfused` (>1 = fused is faster), gated by
+    `tools bench-compare` as the `e2e.fused_vs_unfused` band with a
+    floor ≈ 1: the fused path must never regress below the staged one."""
+    import shutil
+    import tempfile
+    import textwrap
+
+    from processing_chain_tpu.cli import main as cli_main
+    from processing_chain_tpu.io.video import VideoWriter
+
+    n, w, h, fps = 96, 320, 180, 24
+    out: dict = {"metric": "e2e: fused vs staged p03+p04",
+                 "frames": n, "geometry": f"{w}x{h}"}
+    with tempfile.TemporaryDirectory(prefix="pc_fused_bench_") as root:
+        db = os.path.join(root, "P2SXM91")
+        os.makedirs(os.path.join(db, "srcVid"))
+        from processing_chain_tpu.utils.fsio import atomic_write_text
+
+        yaml_path = os.path.join(db, "P2SXM91.yaml")
+        atomic_write_text(yaml_path, textwrap.dedent(f"""\
+                databaseId: P2SXM91
+                syntaxVersion: 6
+                type: short
+                qualityLevelList:
+                  Q0: {{index: 0, videoCodec: h264, videoBitrate: 400, width: {w}, height: {h}, fps: {fps}}}
+                codingList:
+                  VC01: {{type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}}
+                srcList:
+                  SRC000: SRC000.avi
+                hrcList:
+                  HRC000: {{videoCodingId: VC01, eventList: [[Q0, {n // fps}]]}}
+                pvsList:
+                  - P2SXM91_SRC000_HRC000
+                postProcessingList:
+                  - {{type: pc, displayWidth: {w * 2}, displayHeight: {h * 2}, codingWidth: {w * 2}, codingHeight: {h * 2}, displayFrameRate: {fps}}}
+                  - {{type: mobile, displayWidth: {w * 2}, displayHeight: {h * 2}, codingWidth: {w * 2}, codingHeight: {h * 2}, displayFrameRate: {fps}}}
+            """))
+        rng = np.random.default_rng(11)
+        base = rng.integers(0, 255, (h, w * 3), np.uint8)
+        base = ((base.astype(np.float32) + np.roll(base, 1, 0)
+                 + np.roll(base, 1, 1)) / 3.0 + 40).astype(np.uint8)
+        with VideoWriter(os.path.join(db, "srcVid", "SRC000.avi"),
+                         "ffv1", w, h, "yuv420p", (fps, 1)) as wr:
+            u = np.full((h // 2, w // 2), 128, np.uint8)
+            for i in range(n):
+                y = np.ascontiguousarray(base[:, 2 * i:2 * i + w])
+                wr.write(y, u, u)
+        rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
+        if rc != 0:
+            out["error"] = "p01 failed"
+            return out
+
+        def one(mode: str) -> float:
+            for d in ("avpvs", "cpvs"):
+                shutil.rmtree(os.path.join(db, d), ignore_errors=True)
+            env_before = os.environ.get("PC_FUSE_P04")
+            os.environ["PC_FUSE_P04"] = "1" if mode == "fused" else "0"
+            try:
+                t0 = time.perf_counter()
+                rc3 = cli_main(
+                    ["p03", "-c", yaml_path, "--skip-requirements"])
+                rc4 = cli_main(
+                    ["p04", "-c", yaml_path, "--skip-requirements"])
+                if rc3 != 0 or rc4 != 0:
+                    raise RuntimeError(f"{mode} p03/p04 failed")
+                return time.perf_counter() - t0
+            finally:
+                if env_before is None:
+                    os.environ.pop("PC_FUSE_P04", None)
+                else:
+                    os.environ["PC_FUSE_P04"] = env_before
+
+        # min of two runs per mode: the first pays jax trace/compile of
+        # whichever transform kernels the session has not seen yet
+        staged_s, fused_s = [], []
+        for _ in (0, 1):
+            staged_s.append(one("staged"))
+            fused_s.append(one("fused"))
+    out["staged_s"] = round(min(staged_s), 4)
+    out["fused_s"] = round(min(fused_s), 4)
+    out["fused_vs_unfused"] = round(
+        out["staged_s"] / max(out["fused_s"], 1e-9), 3
+    )
+    out["host"] = _host_fingerprint()
+    return out
+
+
 def main() -> None:
     cpu_env = {"JAX_PLATFORMS": "cpu"}
 
@@ -1316,6 +1410,8 @@ if __name__ == "__main__":
         print(json.dumps(host_bench()))
     elif "--complexity-bench" in sys.argv:
         print(json.dumps(complexity_bench()))
+    elif "--fused-bench" in sys.argv:
+        print(json.dumps(fused_bench()))
     elif "--pin-baseline" in sys.argv:
         print(json.dumps(pin_baseline(), indent=1))
     else:

@@ -308,6 +308,20 @@ class Job:
                 self.output_path, exc,
             )
 
+    def complete_externally(self) -> None:
+        """Finalize an output whose bytes were produced OUTSIDE run() —
+        the p03 batch waves and the fused p03+p04 driver (models/fused)
+        render many member artifacts in one pass, then bind each to its
+        own existing plan hash through this: provenance, the store
+        commit (plan hash re-resolved against the final input bytes,
+        exactly as run()'s tail does), and only then the crash-sentinel
+        clear — a crash inside the commit leaves the sentinel, so the
+        next run redoes the artifact instead of trusting bytes the
+        store never vouched for."""
+        self.write_provenance()
+        self.commit_to_store()
+        clear_inprogress(self.output_path)
+
     def write_provenance(self) -> None:
         if not self.logfile_path:
             return

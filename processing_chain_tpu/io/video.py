@@ -24,6 +24,13 @@ _IO_BATCH = tm.counter(
 )
 _IO_BATCH_DECODE = _IO_BATCH.labels(op="decode")
 _IO_BATCH_ENCODE = _IO_BATCH.labels(op="encode")
+_DECODER_OPENS = tm.counter(
+    "chain_io_decoder_opens_total",
+    "VideoReader decoder opens — each is one full decode pass over a "
+    "container, so the fused chain's 'one decode per SRC' claim "
+    "(PC_FUSE_P04, models/fused) is a measurable invariant, not a "
+    "code-review assertion",
+)
 
 
 @dataclass
@@ -176,6 +183,8 @@ class VideoReader:
                 f"{desc.bytes_per_sample} bytes/sample unsupported (packed "
                 f"deinterleave is 8-bit only)"
             )
+        if tm.enabled():
+            _DECODER_OPENS.inc()
 
     def _deinterleave(self, raw: np.ndarray) -> tuple[np.ndarray, ...]:
         """Packed 422 row bytes [h, 2w] → planar (y, u, v) copies,

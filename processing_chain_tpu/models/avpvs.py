@@ -501,84 +501,135 @@ def _wo_buffer_provenance(pvs: Pvs, w: int, h: int, pix_fmt: str) -> dict:
     }
 
 
+def _pump_ready(ready, writer: pf.AsyncWriter, feat: SiTiAccumulator,
+                h: int, w: int, pix_fmt: str, tap=None) -> None:
+    """Already-prefetched host chunks → device resize (+ on-device
+    SI/TI features) → async encode. Transfers are double-buffered
+    (pipeline.iter_device_ahead): chunk k+1's device_put is issued
+    while chunk k's compute is in flight, and the pooled decode
+    blocks ride to the AsyncWriter, which recycles them once the
+    encoded outputs prove the compute consumed them.
+
+    With `tap` set (the fused p04 fan-out, models/fused), the quantized
+    chunk is fetched to host ON THIS LOOP — proving the compute that
+    read the pooled decode blocks finished before they recycle — and
+    handed to both the AVPVS writer and the tap."""
+    import jax
+
+    from ..parallel.pipeline import iter_device_ahead
+
+    sub = fr.chroma_subsampling(pix_fmt)
+    ten_bit = "10" in pix_fmt
+    for chunk, dev in iter_device_ahead(
+        ready, lambda c: [jax.device_put(p) for p in c]
+    ):
+        scaled = fr.scale_yuv_frames(dev, h, w, "bicubic", sub)
+        quant = fr.quantize_device(scaled, ten_bit)
+        feat.update(quant[0])
+        if tap is None:
+            writer.put(quant, recycle=chunk)
+        else:
+            host = [np.asarray(q) for q in quant]
+            writer.put(host, recycle=chunk)
+            tap(host)
+
+
+def _render_wo_buffer(
+    pvs: Pvs, out_path: str, w: int, h: int, pix_fmt: str,
+    avpvs_src_fps: bool, force_60_fps: bool, feat: SiTiAccumulator,
+    fanout=None,
+) -> None:
+    """The decode → device rescale → FFV1(+audio) render body shared by
+    the per-PVS job and the fused driver. With `fanout` set
+    (models/fused.FusedFanout), `fanout.start(...)` is called once rate
+    and audio are known and every quantized chunk is fed to the fan-out
+    after the AVPVS writer — ONE SRC decode feeding the AVPVS, the
+    staged stalling pass, and every CPVS/preview render."""
+    tc = pvs.test_config
+
+    def _pump(chunks, writer, tap):
+        with pf.Prefetcher(chunks, depth=2) as pre:
+            _pump_ready(pre, writer, feat, h, w, pix_fmt, tap)
+
+    if tc.is_short():
+        # single segment, native segment frame rate unless -z/-f60
+        seg = pvs.segments[0]
+        audio, srate = _short_segment_audio(seg)
+        with VideoReader(seg.file_path) as reader:
+            rate, chunks = _short_rate_chunks(
+                pvs, reader, avpvs_src_fps, force_60_fps
+            )
+            tap = (
+                fanout.start(rate, audio, srate, w, h, pix_fmt)
+                if fanout is not None else None
+            )
+            with pf.AsyncWriter(
+                _ffv1_writer(
+                    out_path, w, h, pix_fmt, rate,
+                    with_audio=audio is not None, sample_rate=srate,
+                    audio_codec="flac",
+                )
+            ) as writer:
+                if audio is not None:
+                    writer.write_audio(audio)
+                _pump(chunks, writer, tap)
+    else:
+        rate = canvas_fps(pvs, avpvs_src_fps)
+        total = float(sum(s.get_segment_duration() for s in pvs.segments))
+        samples, srate = _decode_stereo(pvs.src.file_path, 0.0, total)
+        tap = (
+            fanout.start(rate, samples, srate, w, h, pix_fmt)
+            if fanout is not None else None
+        )
+        with pf.AsyncWriter(
+            _ffv1_writer(
+                out_path, w, h, pix_fmt, rate, with_audio=True,
+                sample_rate=srate,
+            )
+        ) as writer:
+            writer.write_audio(samples)
+            factories = [
+                (lambda s=seg: _segment_canvas_chunks(s, rate))
+                for seg in pvs.segments
+            ]
+            with pf.MultiSegmentPrefetcher(
+                factories, workers=_decode_workers(), depth=2
+            ) as pre:
+                _pump_ready(pre, writer, feat, h, w, pix_fmt, tap)
+
+
 def create_avpvs_wo_buffer(
     pvs: Pvs,
     avpvs_src_fps: bool = False,
     force_60_fps: bool = False,
+    fanout=None,
 ) -> Optional[Job]:
     """The decode+rescale(+concat+audio) stage producing the pre-stalling
-    AVPVS (or the final one when the HRC has no buffering)."""
-    tc = pvs.test_config
+    AVPVS (or the final one when the HRC has no buffering). `fanout`
+    (models/fused.FusedFanout) rides the same decode to render the
+    stalling pass + every CPVS context in the same job — PC_FUSE_P04."""
     out_path = _wo_buffer_out_path(pvs)
     w, h = avpvs_dimensions(pvs)
     pix_fmt = pvs.get_pix_fmt_for_avpvs()
 
-    def _pump_ready(ready, writer: pf.AsyncWriter, feat: SiTiAccumulator) -> None:
-        """Already-prefetched host chunks → device resize (+ on-device
-        SI/TI features) → async encode. Transfers are double-buffered
-        (pipeline.iter_device_ahead): chunk k+1's device_put is issued
-        while chunk k's compute is in flight, and the pooled decode
-        blocks ride to the AsyncWriter, which recycles them once the
-        encoded outputs prove the compute consumed them."""
-        import jax
-
-        from ..parallel.pipeline import iter_device_ahead
-
-        sub = fr.chroma_subsampling(pix_fmt)
-        ten_bit = "10" in pix_fmt
-        for chunk, dev in iter_device_ahead(
-            ready, lambda c: [jax.device_put(p) for p in c]
-        ):
-            scaled = fr.scale_yuv_frames(dev, h, w, "bicubic", sub)
-            quant = fr.quantize_device(scaled, ten_bit)
-            feat.update(quant[0])
-            writer.put(quant, recycle=chunk)
-
-    def _pump(chunks, writer: pf.AsyncWriter, feat: SiTiAccumulator) -> None:
-        with pf.Prefetcher(chunks, depth=2) as pre:
-            _pump_ready(pre, writer, feat)
-
     def run() -> str:
         SiTiAccumulator.discard(out_path)  # never leave a stale sidecar
         feat = SiTiAccumulator()
-        if tc.is_short():
-            # single segment, native segment frame rate unless -z/-f60
-            seg = pvs.segments[0]
-            audio, srate = _short_segment_audio(seg)
-            with VideoReader(seg.file_path) as reader:
-                rate, chunks = _short_rate_chunks(
-                    pvs, reader, avpvs_src_fps, force_60_fps
-                )
-                with pf.AsyncWriter(
-                    _ffv1_writer(
-                        out_path, w, h, pix_fmt, rate,
-                        with_audio=audio is not None, sample_rate=srate,
-                        audio_codec="flac",
-                    )
-                ) as writer:
-                    if audio is not None:
-                        writer.write_audio(audio)
-                    _pump(chunks, writer, feat)
-        else:
-            rate = canvas_fps(pvs, avpvs_src_fps)
-            total = float(sum(s.get_segment_duration() for s in pvs.segments))
-            samples, srate = _decode_stereo(pvs.src.file_path, 0.0, total)
-            with pf.AsyncWriter(
-                _ffv1_writer(
-                    out_path, w, h, pix_fmt, rate, with_audio=True,
-                    sample_rate=srate,
-                )
-            ) as writer:
-                writer.write_audio(samples)
-                factories = [
-                    (lambda s=seg: _segment_canvas_chunks(s, rate))
-                    for seg in pvs.segments
-                ]
-                with pf.MultiSegmentPrefetcher(
-                    factories, workers=_decode_workers(), depth=2
-                ) as pre:
-                    _pump_ready(pre, writer, feat)
-        feat.write(out_path)
+        try:
+            _render_wo_buffer(
+                pvs, out_path, w, h, pix_fmt, avpvs_src_fps, force_60_fps,
+                feat, fanout,
+            )
+            feat.write(out_path)
+        except BaseException:
+            if fanout is not None:
+                fanout.abort()
+            raise
+        if fanout is not None:
+            # flush + finalize the fan-out artifacts (stalled AVPVS,
+            # CPVS contexts, preview): commits ride each member job's
+            # existing plan hash (models/fused)
+            fanout.close()
         return out_path
 
     return Job(
@@ -624,6 +675,7 @@ def create_avpvs_wo_buffer_batch(
     pvses: list,
     avpvs_src_fps: bool = False,
     force_60_fps: bool = False,
+    fanouts: Optional[dict] = None,
 ) -> Optional[Job]:
     """Multi-device p03: ONE job running the PVS batch through the
     (pvs × time) device mesh (parallel/p03_batch), instead of one device
@@ -644,7 +696,15 @@ def create_avpvs_wo_buffer_batch(
     from the captured boundary frames, matching the single path's carry.
 
     Skip-existing/--force filtering happens in the stage (per-PVS), so
-    every pvs passed here is due for (re)generation."""
+    every pvs passed here is due for (re)generation.
+
+    `fanouts` maps SHORT pvses to their fused-p04 fan-outs
+    (models/fused.FusedFanout, PC_FUSE_P04): each short lane's emit also
+    feeds the fan-out, the wave driver's Lane.on_done flushes it the
+    moment the lane exhausts, and its member artifacts commit right
+    after the lane's wave drains. Long tests keep the legacy staged
+    passes here — their per-segment lanes cross waves out of stream
+    order, which a streaming fan-out cannot consume."""
     if not pvses:
         return None
     from contextlib import ExitStack
@@ -744,6 +804,8 @@ def create_avpvs_wo_buffer_batch(
                         for spec in wave:
                             pvs, out_path = spec["pvs"], spec["out"]
                             w, h = spec["w"], spec["h"]
+                            tap = None
+                            fan = None
                             if spec["kind"] == "short":
                                 audio, srate = _short_segment_audio(spec["seg"])
                                 reader = stack.enter_context(
@@ -752,6 +814,17 @@ def create_avpvs_wo_buffer_batch(
                                 rate, chunks = _short_rate_chunks(
                                     pvs, reader, avpvs_src_fps, force_60_fps
                                 )
+                                fan = (fanouts or {}).get(pvs)
+                                if fan is not None:
+                                    # the fused p04 fan-out rides this
+                                    # lane's emits (PC_FUSE_P04);
+                                    # registered before start() so the
+                                    # wave's failure sweep aborts a
+                                    # fan-out that died mid-open
+                                    spec["fanout"] = fan
+                                    tap = fan.start(
+                                        rate, audio, srate, w, h, pix_fmt
+                                    )
                                 writer = stack.enter_context(
                                     pf.AsyncWriter(_ffv1_writer(
                                         out_path, w, h, pix_fmt, rate,
@@ -776,13 +849,23 @@ def create_avpvs_wo_buffer_batch(
                             feat = SiTiAccumulator()
                             spec["feat"] = feat
                             spec["sink"] = sink
+                            if tap is None:
+                                emit = sink.emit
+                            else:
+                                def emit(planes, _sink=sink, _tap=tap):
+                                    _sink.emit(planes)
+                                    _tap(planes)
                             lanes.append(p03_batch.Lane(
                                 chunks=chunks,
-                                emit=sink.emit,
+                                emit=emit,
                                 n_frames_hint=int(
                                     round(spec["seg"].duration * rate)
                                 ),
                                 emit_features=feat.extend,
+                                on_done=(
+                                    fan.finish_streams
+                                    if fan is not None else None
+                                ),
                             ))
                         p03_batch.run_bucket(
                             lanes, mesh, dh, dw, "bicubic",
@@ -795,6 +878,9 @@ def create_avpvs_wo_buffer_batch(
                     # partial artifact must never survive to satisfy a
                     # later run's skip-existing check
                     for spec in wave:
+                        fan = spec.get("fanout")
+                        if fan is not None:
+                            fan.abort()
                         for p in (spec["out"], spec["final"]):
                             if os.path.isfile(p):
                                 os.unlink(p)
@@ -814,8 +900,13 @@ def create_avpvs_wo_buffer_batch(
                                 spec["pvs"], spec["w"], spec["h"],
                                 spec["pix_fmt"],
                             ),
-                        ).write_provenance()
-                        clear_inprogress(spec["out"])
+                        ).complete_externally()
+                        fan = spec.get("fanout")
+                        if fan is not None:
+                            # fan-out members (stalled AVPVS, CPVS,
+                            # preview) commit under their own plan
+                            # hashes now that the lane's wave drained
+                            fan.close()
 
         # long-test assembly: native stream-copy concat of the tmp
         # renders + SRC audio remux + stitched feature sidecar
@@ -868,8 +959,7 @@ def create_avpvs_wo_buffer_batch(
                         pvs, pvs_specs[0]["w"], pvs_specs[0]["h"],
                         pvs_specs[0]["pix_fmt"],
                     ),
-                ).write_provenance()
-                clear_inprogress(out_path)
+                ).complete_externally()
             except BaseException:
                 if os.path.isfile(out_path):
                     os.unlink(out_path)
@@ -915,6 +1005,123 @@ def load_spinner(path: str) -> np.ndarray:
     return np.asarray(img, dtype=np.uint8)
 
 
+def insert_stall_silence(audio: np.ndarray, srate: int, events) -> np.ndarray:
+    """Insert stall-length silence at the wallclock event positions —
+    the audio half of the bufferer pass, shared by `apply_stalling` and
+    the fused driver (models/fused) so the two cannot drift."""
+    pieces = []
+    cursor = 0
+    for t, d in sorted((float(e[0]), float(e[1])) for e in events):
+        cut = int(round(t * srate))
+        pieces.append(audio[cursor:cut])
+        pieces.append(np.zeros((int(round(d * srate)), audio.shape[1]), np.int16))
+        cursor = cut
+    pieces.append(audio[cursor:])
+    return np.concatenate([p for p in pieces if len(p)])
+
+
+def make_stall_compositor(pix_fmt: str, spinner_path: Optional[str],
+                          skipping: bool, n_rotations: int):
+    """`fn(gathered_planes, stall, black, phase) -> quantized planes` —
+    the per-chunk stall composite of `apply_stalling` (spinner bank
+    prep + the sharded-vs-single-device routing), extracted so the
+    fused driver (models/fused) runs the SAME math on the SAME code
+    path. Inputs are the gathered source planes of one output chunk and
+    its per-frame plan slices; the return value goes straight to the
+    writer."""
+    import jax
+
+    ten_bit = "10" in pix_fmt
+    depth_scale = 4.0 if ten_bit else 1.0
+    sub_h, sub_w = fr.chroma_subsampling(pix_fmt)
+    sp_y = sp_u = sp_v = sa = sa_c = None
+    if not skipping and spinner_path:
+        bank_yuv, bank_a = ov.prepare_spinner(
+            load_spinner(spinner_path), n_rotations
+        )
+        # spinner bank is on the 8-bit scale; lift for 10-bit AVPVS
+        sp_y = bank_yuv[:, 0] * depth_scale
+        # chroma bank on the AVPVS chroma grid (420: half both dims,
+        # 422: half width only)
+        sp_u = bank_yuv[:, 1][:, ::sub_h, ::sub_w] * depth_scale
+        sp_v = bank_yuv[:, 2][:, ::sub_h, ::sub_w] * depth_scale
+        sa = bank_a
+        if (sub_h, sub_w) == (2, 2):
+            sa_c = ov.downsample_alpha(bank_a)
+        else:
+            sa_c = bank_a[:, ::sub_h, ::sub_w]
+
+    black_values = (
+        16.0 * depth_scale, 128.0 * depth_scale, 128.0 * depth_scale
+    )
+    devs = jax.devices()
+    sharded = None
+    grain = 1
+    if len(devs) > 1:
+        # the composite is frame-local: shard each chunk's frames
+        # across every visible device (ops/overlay sharded path)
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(devs)
+        sharded = ov.make_sharded_stall_renderer(
+            mesh,
+            (None,) * 5 if skipping or sp_y is None
+            else (jnp.asarray(sp_y), jnp.asarray(sa),
+                  jnp.asarray(sp_u), jnp.asarray(sp_v),
+                  jnp.asarray(sa_c)),
+            black_values, ten_bit, (sub_h, sub_w),
+        )
+        grain = mesh.shape["pvs"]
+
+    def composite(gathered, stall, black, phase):
+        sel_len = gathered[0].shape[0]
+        if sharded is not None:
+            pad = (-sel_len) % grain
+
+            def padded(a, pad=pad):
+                a = np.asarray(a)
+                if pad:
+                    a = np.concatenate(
+                        [a, np.repeat(a[-1:], pad, axis=0)]
+                    )
+                return a
+
+            outs = sharded(
+                jnp.asarray(padded(gathered[0]), jnp.float32),
+                jnp.asarray(padded(gathered[1]), jnp.float32),
+                jnp.asarray(padded(gathered[2]), jnp.float32),
+                jnp.asarray(padded(stall), jnp.float32),
+                jnp.asarray(padded(black), jnp.float32),
+                jnp.asarray(padded(phase), jnp.int32),
+            )
+            return [o[:sel_len] for o in outs]
+        # single device: host-planned composite
+        sub = ov.StallPlan(
+            src_idx=np.arange(sel_len, dtype=np.int32),
+            stall_mask=np.asarray(stall),
+            black_mask=np.asarray(black),
+            phase=np.asarray(phase),
+        )
+        y = jnp.asarray(gathered[0], jnp.float32)
+        u = jnp.asarray(gathered[1], jnp.float32)
+        v = jnp.asarray(gathered[2], jnp.float32)
+        oy = ov.render_stalled_plane(
+            y, sub, sp_y, sa, black_value=black_values[0],
+            crop_align=(sub_h, sub_w),
+        )
+        ou = ov.render_stalled_plane(
+            u, sub, sp_u, sa_c, black_value=black_values[1],
+            crop_align=(sub_h, sub_w), grid_scale=(sub_h, sub_w),
+        )
+        ovv = ov.render_stalled_plane(
+            v, sub, sp_v, sa_c, black_value=black_values[2],
+            crop_align=(sub_h, sub_w), grid_scale=(sub_h, sub_w),
+        )
+        return fr.quantize_device([oy, ou, ovv], ten_bit)
+
+    return composite
+
+
 def apply_stalling(
     pvs: Pvs,
     spinner_path: Optional[str] = None,
@@ -944,29 +1151,13 @@ def apply_stalling(
         n = int(vstreams[0].get("nb_frames") or 0) if vstreams else 0
         if n <= 0:
             n = len(medialib.scan_packets(in_path, "video")["size"])
-        ten_bit = "10" in pix_fmt
         plan = ov.plan_stalling(
             n, rate, events, skipping=skipping, black_frame=True,
             n_rotations=n_rotations,
         )
-        depth_scale = 4.0 if ten_bit else 1.0
-        sub_h, sub_w = fr.chroma_subsampling(pix_fmt)
-        sp_y = sp_u = sp_v = sa = sa_c = None
-        if not skipping and spinner_path:
-            bank_yuv, bank_a = ov.prepare_spinner(
-                load_spinner(spinner_path), n_rotations
-            )
-            # spinner bank is on the 8-bit scale; lift for 10-bit AVPVS
-            sp_y = bank_yuv[:, 0] * depth_scale
-            # chroma bank on the AVPVS chroma grid (420: half both dims,
-            # 422: half width only)
-            sp_u = bank_yuv[:, 1][:, ::sub_h, ::sub_w] * depth_scale
-            sp_v = bank_yuv[:, 2][:, ::sub_h, ::sub_w] * depth_scale
-            sa = bank_a
-            if (sub_h, sub_w) == (2, 2):
-                sa_c = ov.downsample_alpha(bank_a)
-            else:
-                sa_c = bank_a[:, ::sub_h, ::sub_w]
+        composite = make_stall_compositor(
+            pix_fmt, spinner_path, skipping, n_rotations
+        )
 
         # audio: decode, insert stall silence at wallclock positions
         audio = None
@@ -978,15 +1169,7 @@ def apply_stalling(
         except medialib.MediaError:
             audio = None
         if audio is not None and audio.size and not skipping:
-            pieces = []
-            cursor = 0
-            for t, d in sorted((float(e[0]), float(e[1])) for e in events):
-                cut = int(round(t * srate))
-                pieces.append(audio[cursor:cut])
-                pieces.append(np.zeros((int(round(d * srate)), audio.shape[1]), np.int16))
-                cursor = cut
-            pieces.append(audio[cursor:])
-            audio = np.concatenate([p for p in pieces if len(p)])
+            audio = insert_stall_silence(audio, srate, events)
 
         # stream the output timeline: the plan's source indices are
         # monotonic nondecreasing (play/freeze/repeat), so one decode pass
@@ -1006,79 +1189,16 @@ def apply_stalling(
             chunks = pf.stream_monotonic_gather(
                 reader, lambda k: int(plan.src_idx[k]), plan.n_out, chunk
             )
-            import jax
-
-            black_values = (
-                16.0 * depth_scale, 128.0 * depth_scale, 128.0 * depth_scale
-            )
-            devs = jax.devices()
-            sharded = None
-            if len(devs) > 1:
-                # the composite is frame-local: shard each chunk's frames
-                # across every visible device (ops/overlay sharded path)
-                from ..parallel.mesh import make_mesh
-
-                mesh = make_mesh(devs)
-                sharded = ov.make_sharded_stall_renderer(
-                    mesh,
-                    (None,) * 5 if skipping or sp_y is None
-                    else (jnp.asarray(sp_y), jnp.asarray(sa),
-                          jnp.asarray(sp_u), jnp.asarray(sp_v),
-                          jnp.asarray(sa_c)),
-                    black_values, ten_bit, (sub_h, sub_w),
-                )
-                grain = mesh.shape["pvs"]
             with pf.Prefetcher(chunks, depth=2) as pre:
                 for chunk_no, gathered in enumerate(pre):
                     start = chunk_no * chunk
                     sel_len = gathered[0].shape[0]
-                    stall = plan.stall_mask[start: start + sel_len]
-                    black = plan.black_mask[start: start + sel_len]
-                    phase = plan.phase[start: start + sel_len]
-                    if sharded is not None:
-                        pad = (-sel_len) % grain
-
-                        def padded(a, pad=pad):
-                            a = np.asarray(a)
-                            if pad:
-                                a = np.concatenate(
-                                    [a, np.repeat(a[-1:], pad, axis=0)]
-                                )
-                            return a
-
-                        outs = sharded(
-                            jnp.asarray(padded(gathered[0]), jnp.float32),
-                            jnp.asarray(padded(gathered[1]), jnp.float32),
-                            jnp.asarray(padded(gathered[2]), jnp.float32),
-                            jnp.asarray(padded(stall), jnp.float32),
-                            jnp.asarray(padded(black), jnp.float32),
-                            jnp.asarray(padded(phase), jnp.int32),
-                        )
-                        writer.put([o[:sel_len] for o in outs])
-                        continue
-                    # single device: host-planned composite
-                    sub = ov.StallPlan(
-                        src_idx=np.arange(sel_len, dtype=np.int32),
-                        stall_mask=stall,
-                        black_mask=black,
-                        phase=phase,
-                    )
-                    y = jnp.asarray(gathered[0], jnp.float32)
-                    u = jnp.asarray(gathered[1], jnp.float32)
-                    v = jnp.asarray(gathered[2], jnp.float32)
-                    oy = ov.render_stalled_plane(
-                        y, sub, sp_y, sa, black_value=black_values[0],
-                        crop_align=(sub_h, sub_w),
-                    )
-                    ou = ov.render_stalled_plane(
-                        u, sub, sp_u, sa_c, black_value=black_values[1],
-                        crop_align=(sub_h, sub_w), grid_scale=(sub_h, sub_w),
-                    )
-                    ovv = ov.render_stalled_plane(
-                        v, sub, sp_v, sa_c, black_value=black_values[2],
-                        crop_align=(sub_h, sub_w), grid_scale=(sub_h, sub_w),
-                    )
-                    writer.put(fr.quantize_device([oy, ou, ovv], ten_bit))
+                    writer.put(composite(
+                        gathered,
+                        plan.stall_mask[start: start + sel_len],
+                        plan.black_mask[start: start + sel_len],
+                        plan.phase[start: start + sel_len],
+                    ))
         return out_path
 
     lf = pvs.get_logfile_path()

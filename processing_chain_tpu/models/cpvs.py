@@ -82,16 +82,25 @@ def _limit_frames(chunks, n_max: int):
             return
 
 
+def trim_normalize_long_audio(
+    samples: np.ndarray, rate: int, pvs: Pvs, normalize: bool
+) -> np.ndarray:
+    """The long-test audio treatment (reference `-t` trim + the
+    ffmpeg-normalize step): shared by the decode-driven path and the
+    fused driver so the two cannot drift."""
+    total = pvs.hrc.get_long_hrc_duration()
+    samples = samples[: int(round(total * rate))]
+    if normalize:
+        samples = normalize_rms(samples)
+    return samples
+
+
 def _audio_for_long(pvs: Pvs, normalize: bool):
     try:
         samples, rate = medialib.decode_audio_s16(pvs.get_avpvs_file_path())
     except medialib.MediaError:
         return None, 48000
-    total = pvs.hrc.get_long_hrc_duration()
-    samples = samples[: int(round(total * rate))]
-    if normalize:
-        samples = normalize_rms(samples)
-    return samples, rate
+    return trim_normalize_long_audio(samples, rate, pvs, normalize), rate
 
 
 def cpvs_plan(
@@ -177,6 +186,115 @@ def t_cap_frames(t: float, rate: Fraction) -> int:
     return math.ceil(Fraction(t_us, 1_000_000) * rate)
 
 
+def make_cpvs_transform(plan: dict, post_processing: PostProcessing,
+                        pix_fmt: str, rawvideo: bool):
+    """The per-chunk device transform one CPVS render applies, built
+    from the pure decision record (`cpvs_plan`). ONE definition serves
+    the decode-driven path (`create_cpvs`) and the fused in-memory path
+    (models/fused) — fused-vs-unfused parity is by construction, not by
+    parallel maintenance."""
+    pp = post_processing
+    ten_bit = "10" in pix_fmt
+    dw, dh = pp.display_width, pp.display_height
+    need_pad = plan["pad"] is not None
+
+    if plan["context"] == "pc":
+        def pc_chunk(chunk):
+            y, u, v = (jnp.asarray(p) for p in chunk[:3])
+            if "420" in pix_fmt and not rawvideo:
+                # packed/uyvy and v210 outputs are 422-based: lift
+                # chroma; rawvideo passes through the AVPVS layout
+                u, v = pf.chroma_420_to_422(u, v)
+            if need_pad:
+                # chroma pads on its own grid: full height for 422
+                # layouts, half height for raw 420 passthrough
+                c_h = dh // 2 if (rawvideo and "420" in pix_fmt) else dh
+                y = pad_ops.pad_center(y, dh, dw, 16.0 if not ten_bit else 64.0)
+                u = pad_ops.pad_center(u, c_h, dw // 2, 128.0 if not ten_bit else 512.0)
+                v = pad_ops.pad_center(v, c_h, dw // 2, 128.0 if not ten_bit else 512.0)
+            if rawvideo:
+                # raw passthrough in the AVPVS pix_fmt
+                return fr.to_uint8([y, u, v], ten_bit)
+            if not ten_bit:
+                # packed UYVY422 via the rawvideo encoder
+                yq, uq, vq = fr.to_uint8([y, u, v], False)
+                return [pf.pack_uyvy422(
+                    jnp.asarray(yq), jnp.asarray(uq), jnp.asarray(vq)
+                )]
+            # v210 encoder takes planar yuv422p10le input
+            return fr.to_uint8([y, u, v], True)
+
+        return pc_chunk
+
+    def mobile_chunk(chunk):
+        # mobile / tablet: output is always 8-bit yuv420p, so 10-bit
+        # AVPVS chunks are depth-converted first
+        chunk = list(chunk[:3])
+        if ten_bit:
+            chunk = [pf.depth_10_to_8(jnp.asarray(p)) for p in chunk]
+        if need_pad:
+            # pad-only at native AVPVS size (letterbox), the
+            # reference's padding branch applies no scale
+            # (lib/ffmpeg.py:1207-1210)
+            y, u, v = pad_ops.pad_yuv(
+                tuple(jnp.asarray(p) for p in chunk), dh, dw, "yuv420p"
+            )
+        else:
+            y, u, v = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
+        return fr.to_uint8([y, u, v], False)
+
+    return mobile_chunk
+
+
+def open_cpvs_writer(out_path: str, plan: dict,
+                     post_processing: PostProcessing, w: int, h: int,
+                     out_rate: Fraction, audio, srate: int):
+    """(VideoWriter, has_audio) for one CPVS render, plan-directed —
+    the other half of the shared execution surface (see
+    `make_cpvs_transform`)."""
+    pp = post_processing
+    dw, dh = pp.display_width, pp.display_height
+    need_pad = plan["pad"] is not None
+    if plan["context"] == "pc":
+        aud = (
+            dict(audio_codec=plan["audio"]["codec"], sample_rate=srate,
+                 channels=plan["audio"]["channels"])
+            if (plan["audio"] and audio is not None and audio.size)
+            else {}
+        )
+        writer = VideoWriter(
+            out_path, plan["vcodec"], dw if need_pad else w,
+            dh if need_pad else h, plan["pix_fmt"],
+            (out_rate.numerator, out_rate.denominator), **aud,
+        )
+        return writer, bool(aud)
+    aud = (
+        dict(audio_codec=plan["audio"]["codec"], sample_rate=srate,
+             channels=plan["audio"]["channels"],
+             audio_bitrate_kbps=plan["audio"]["bitrate_kbps"])
+        if (plan["audio"] and audio is not None and audio.size)
+        else {}
+    )
+    opts = (
+        f"crf={plan['crf']}:preset={plan['preset']}:"
+        f"profile={plan['profile']}:movflags=+faststart"
+    )
+    writer = VideoWriter(
+        out_path, "libx264", dw, dh, "yuv420p",
+        (out_rate.numerator, out_rate.denominator), opts=opts, **aud,
+    )
+    return writer, bool(aud)
+
+
+def cpvs_out_rate(plan: dict, avpvs_fps: float) -> Fraction:
+    """Output frame rate of one CPVS render: the plan's display rate
+    (pc branch) or the AVPVS rate (mobile), rationalized exactly as the
+    writer consumes it."""
+    return Fraction(
+        plan["fps"] if plan["fps"] is not None else avpvs_fps
+    ).limit_denominator(1001)
+
+
 def create_cpvs(
     pvs: Pvs,
     post_processing: PostProcessing,
@@ -201,107 +319,26 @@ def create_cpvs(
             # fps=displayFrameRate filter; pc branch only — mobile keeps
             # the AVPVS rate, see cpvs_plan)
             chunks = _avpvs_chunks(reader, plan["fps"])
-            out_rate = Fraction(
-                plan["fps"] if plan["fps"] is not None else reader.fps
-            ).limit_denominator(1001)
+            out_rate = cpvs_out_rate(plan, reader.fps)
             if plan["t"] is not None:
                 # the reference's long-test `-t total_duration` cap
                 chunks = _limit_frames(chunks, t_cap_frames(plan["t"], out_rate))
-            ten_bit = "10" in pix_fmt
 
             audio = None
             srate = 48000
             if tc.is_long():
                 audio, srate = _audio_for_long(pvs, normalize=plan["normalize"])
 
-            if plan["context"] == "pc":
-                vcodec, target_pix_fmt = plan["vcodec"], plan["pix_fmt"]
-                need_pad = plan["pad"] is not None
-                dw, dh = pp.display_width, pp.display_height
-                aud = (
-                    dict(audio_codec=plan["audio"]["codec"], sample_rate=srate,
-                         channels=plan["audio"]["channels"])
-                    if (plan["audio"] and audio is not None and audio.size)
-                    else {}
-                )
-
-                def pc_chunk(chunk):
-                    y, u, v = (jnp.asarray(p) for p in chunk[:3])
-                    if "420" in pix_fmt and not rawvideo:
-                        # packed/uyvy and v210 outputs are 422-based: lift
-                        # chroma; rawvideo passes through the AVPVS layout
-                        u, v = pf.chroma_420_to_422(u, v)
-                    if need_pad:
-                        # chroma pads on its own grid: full height for 422
-                        # layouts, half height for raw 420 passthrough
-                        c_h = dh // 2 if (rawvideo and "420" in pix_fmt) else dh
-                        y = pad_ops.pad_center(y, dh, dw, 16.0 if not ten_bit else 64.0)
-                        u = pad_ops.pad_center(u, c_h, dw // 2, 128.0 if not ten_bit else 512.0)
-                        v = pad_ops.pad_center(v, c_h, dw // 2, 128.0 if not ten_bit else 512.0)
-                    if rawvideo:
-                        # raw passthrough in the AVPVS pix_fmt
-                        return fr.to_uint8([y, u, v], ten_bit)
-                    if not ten_bit:
-                        # packed UYVY422 via the rawvideo encoder
-                        yq, uq, vq = fr.to_uint8([y, u, v], False)
-                        return [pf.pack_uyvy422(
-                            jnp.asarray(yq), jnp.asarray(uq), jnp.asarray(vq)
-                        )]
-                    # v210 encoder takes planar yuv422p10le input
-                    return fr.to_uint8([y, u, v], True)
-
-                with pfe.AsyncWriter(VideoWriter(
-                    out_path, vcodec, dw if need_pad else w, dh if need_pad else h,
-                    target_pix_fmt, (out_rate.numerator, out_rate.denominator),
-                    **aud,
-                )) as writer:
-                    if aud:
-                        writer.write_audio(audio)
-                    with pfe.Prefetcher(chunks, depth=2) as pre:
-                        for chunk in pre:
-                            writer.put(pc_chunk(chunk))
-            else:
-                # mobile / tablet: x264 CRF mp4, scale (+pad) to display
-                # dims; output is always 8-bit yuv420p, so 10-bit AVPVS
-                # chunks are depth-converted first
-                dw, dh = pp.display_width, pp.display_height
-                aud = (
-                    dict(audio_codec=plan["audio"]["codec"], sample_rate=srate,
-                         channels=plan["audio"]["channels"],
-                         audio_bitrate_kbps=plan["audio"]["bitrate_kbps"])
-                    if (plan["audio"] and audio is not None and audio.size)
-                    else {}
-                )
-                opts = (
-                    f"crf={plan['crf']}:preset={plan['preset']}:"
-                    f"profile={plan['profile']}:movflags=+faststart"
-                )
-                need_pad = plan["pad"] is not None
-
-                def mobile_chunk(chunk):
-                    chunk = list(chunk[:3])
-                    if ten_bit:
-                        chunk = [pf.depth_10_to_8(jnp.asarray(p)) for p in chunk]
-                    if need_pad:
-                        # pad-only at native AVPVS size (letterbox), the
-                        # reference's padding branch applies no scale
-                        # (lib/ffmpeg.py:1207-1210)
-                        y, u, v = pad_ops.pad_yuv(
-                            tuple(jnp.asarray(p) for p in chunk), dh, dw, "yuv420p"
-                        )
-                    else:
-                        y, u, v = fr.scale_yuv_frames(chunk, dh, dw, "bicubic", (2, 2))
-                    return fr.to_uint8([y, u, v], False)
-
-                with pfe.AsyncWriter(VideoWriter(
-                    out_path, "libx264", dw, dh, "yuv420p",
-                    (out_rate.numerator, out_rate.denominator), opts=opts, **aud,
-                )) as writer:
-                    if aud:
-                        writer.write_audio(audio)
-                    with pfe.Prefetcher(chunks, depth=2) as pre:
-                        for chunk in pre:
-                            writer.put(mobile_chunk(chunk))
+            transform = make_cpvs_transform(plan, pp, pix_fmt, rawvideo)
+            vw, has_audio = open_cpvs_writer(
+                out_path, plan, pp, w, h, out_rate, audio, srate
+            )
+            with pfe.AsyncWriter(vw) as writer:
+                if has_audio:
+                    writer.write_audio(audio)
+                with pfe.Prefetcher(chunks, depth=2) as pre:
+                    for chunk in pre:
+                        writer.put(transform(chunk))
         return out_path
 
     # plan: the AVPVS digest covers every upstream knob transitively;
@@ -341,15 +378,56 @@ def create_cpvs(
     )
 
 
-def create_preview(pvs: Pvs) -> Optional[Job]:
-    """ProRes + AAC preview (reference create_preview :1250-1259)."""
-    out_path = pvs.get_preview_file_path()
-
+def make_preview_transform(pix_fmt: str):
+    """The per-chunk ProRes-preview transform; shared by the
+    decode-driven path and the fused driver (see make_cpvs_transform)."""
     def fr_round(*planes):
         return tuple(
             jnp.clip(jnp.floor(p.astype(jnp.float32) + 0.5), 0, 255).astype(jnp.uint8)
             for p in planes
         )
+
+    def preview_chunk(chunk):
+        y, u, v = (jnp.asarray(p) for p in chunk[:3])
+        if "420" in pix_fmt:
+            u, v = pf.chroma_420_to_422(u, v)
+        if "10" not in pix_fmt:
+            y, u, v = (
+                pf.depth_8_to_10(q.astype(jnp.uint8))
+                for q in fr_round(y, u, v)
+            )
+        return [y, u, v]
+
+    return preview_chunk
+
+
+def open_preview_writer(out_path: str, w: int, h: int, fps: float,
+                        audio, srate: int):
+    """(VideoWriter, has_audio) for the ProRes preview. ProRes is
+    all-intra: the same frame-parallel pool as the FFV1 writeback
+    applies (PC_FFV1_WORKERS names the host intra-writeback pool, not
+    one codec)."""
+    from .avpvs import ffv1_workers
+
+    aud = (
+        dict(audio_codec="aac", sample_rate=srate, channels=2)
+        if audio is not None and audio.size
+        else {}
+    )
+    frac = Fraction(fps).limit_denominator(1001)
+    workers = ffv1_workers()
+    writer = VideoWriter(
+        out_path, "prores_ks", w, h,
+        "yuv422p10le", (frac.numerator, frac.denominator),
+        opts=f"pc_fp_workers={workers}" if workers > 0 else "",
+        **aud,
+    )
+    return writer, bool(aud)
+
+
+def create_preview(pvs: Pvs) -> Optional[Job]:
+    """ProRes + AAC preview (reference create_preview :1250-1259)."""
+    out_path = pvs.get_preview_file_path()
 
     def run() -> str:
         audio = None
@@ -358,46 +436,21 @@ def create_preview(pvs: Pvs) -> Optional[Job]:
             audio, srate = medialib.decode_audio_s16(pvs.get_avpvs_file_path())
         except medialib.MediaError:
             audio = None
-        aud = (
-            dict(audio_codec="aac", sample_rate=srate, channels=2)
-            if audio is not None and audio.size
-            else {}
-        )
         with VideoReader(pvs.get_avpvs_file_path()) as reader:
-            pix_fmt = reader.pix_fmt
-            frac = Fraction(reader.fps).limit_denominator(1001)
-
-            def preview_chunk(chunk):
-                y, u, v = (jnp.asarray(p) for p in chunk[:3])
-                if "420" in pix_fmt:
-                    u, v = pf.chroma_420_to_422(u, v)
-                if "10" not in pix_fmt:
-                    y, u, v = (
-                        pf.depth_8_to_10(q.astype(jnp.uint8))
-                        for q in fr_round(y, u, v)
-                    )
-                return [y, u, v]
-
-            # ProRes is all-intra: the same frame-parallel pool as the
-            # FFV1 writeback applies (PC_FFV1_WORKERS — the knob names
-            # the host intra-writeback pool, not one codec)
-            from .avpvs import ffv1_workers
-
-            workers = ffv1_workers()
-            with pfe.AsyncWriter(VideoWriter(
-                out_path, "prores_ks", reader.width, reader.height,
-                "yuv422p10le", (frac.numerator, frac.denominator),
-                opts=f"pc_fp_workers={workers}" if workers > 0 else "",
-                **aud,
-            )) as writer:
-                if aud:
+            transform = make_preview_transform(reader.pix_fmt)
+            vw, has_audio = open_preview_writer(
+                out_path, reader.width, reader.height, reader.fps,
+                audio, srate,
+            )
+            with pfe.AsyncWriter(vw) as writer:
+                if has_audio:
                     writer.write_audio(audio)
                 with pfe.Prefetcher(
                     pfe.iter_plane_chunks(reader, avpvs.chunk_frames()),
                     depth=2
                 ) as pre:
                     for chunk in pre:
-                        writer.put(preview_chunk(chunk))
+                        writer.put(transform(chunk))
         return out_path
 
     return Job(

@@ -1,0 +1,610 @@
+"""Fused p03+p04 driver (``PC_FUSE_P04``): single-decode chain.
+
+The shipping chain decoded the committed AVPVS once per downstream
+consumer — the stalling pass re-decoded the wo_buffer render, and every
+PostProcessing CPVS (plus the preview) re-decoded the final AVPVS.
+FAST's doctrine (arXiv:1603.08968, PAPERS.md) is to exploit structure
+already computed upstream instead of re-doing it per stage: the AVPVS
+frames the p03 device pass just quantized ARE the frames every one of
+those decodes would produce (FFV1/rawvideo are lossless), so this
+module renders everything downstream from the in-memory stream —
+
+    SRC decode ─▶ device resize ─▶ quantized AVPVS chunks
+                                       ├─▶ AVPVS writer        (as today)
+                                       ├─▶ StallStream ─▶ composite
+                                       │        ├─▶ stalled-AVPVS writer
+                                       │        └─▶ (final stream)
+                                       └─▶ per-PostProcessing CPVS
+                                           pipelines + preview
+
+ONE SRC decode feeds the AVPVS, the staged stalling pass and all CPVS
+renders (`chain_io_decoder_opens_total` makes the invariant measurable).
+
+Parity discipline — the whole feature is gated on the fused path
+producing decoded-identical artifacts (the plan hashes are unchanged,
+so the store serves fused and unfused runs interchangeably):
+
+  * the CPVS/preview transforms and writer construction are the SAME
+    functions the decode-driven path runs (models/cpvs
+    make_cpvs_transform / open_cpvs_writer / make_preview_transform);
+  * the stall composite is the SAME function apply_stalling runs
+    (models/avpvs.make_stall_compositor), fed by `StallStream` — an
+    incremental replay of ov.plan_stalling + the monotonic gather that
+    needs no a-priori frame count (`streamed_stall_plan` pins record
+    parity against plan_stalling over an (n × events) matrix);
+  * audio rides from memory through the same helpers
+    (insert_stall_silence, trim_normalize_long_audio) the file-decoding
+    paths use — the intermediates are lossless, so the samples are the
+    bytes a decode of the artifact would return.
+
+Memoization contract: the fused fan-out only engages when the AVPVS
+itself is due for (re)generation — a warm AVPVS with a stale CPVS keeps
+today's exact partial-invalidation behavior (legacy p04 rebuilds just
+that context from the materialized artifact). Every member artifact is
+committed under its own existing plan hash via Job.complete_externally,
+with the same crash-sentinel discipline as the batch waves.
+"""
+
+from __future__ import annotations
+
+import os
+from fractions import Fraction
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..engine import prefetch as pfe
+from ..engine.jobs import clear_inprogress, mark_inprogress
+from ..ops import overlay as ov
+from ..utils.runner import ChainError
+from . import avpvs as av
+from . import cpvs as cp
+
+
+def fused_p04_enabled() -> bool:
+    """The PC_FUSE_P04 gate. Routing only: the fused path renders
+    decoded-identical artifacts under unchanged plan hashes, so the
+    flag never reaches a plan payload."""
+    # plan-exempt: (fused-vs-unfused CPVS/AVPVS bytes are decoded-identical and plan hashes unchanged; pinned by tests/test_fused.py parity suite + the fused-smoke CI job)
+    return os.environ.get("PC_FUSE_P04", "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+# --------------------------------------------------------- stall replay
+
+
+class _StallSchedule:
+    """plan_stalling's spinner/black insertion mode, replayed
+    incrementally: events fire as the source position reaches them,
+    with trailing (past-stream-end) events flushed by finish() — the
+    min(n, event_frame) clamp of the batch formulation, without
+    knowing n up front. emit(src_idx, stall, black, phase)."""
+
+    def __init__(self, fps: float, events, emit: Callable,
+                 black_frame: bool = True, spinner_rps: float = 1.0,
+                 n_rotations: int = 64) -> None:
+        self._fps = float(fps)
+        self._events = sorted((float(e[0]), float(e[1])) for e in events)
+        self._emit = emit
+        self._black = 1 if black_frame else 0
+        self._rps = spinner_rps
+        self._n_rot = n_rotations
+        self._ei = 0
+        self._spin = 0
+        self._next_src = 0
+        #: stall backgrounds are always the previous played frame; no
+        #: long-range retention needed (StallStream contract)
+        self.anchors: frozenset = frozenset()
+
+    def _emit_stalls(self, ei: int) -> None:
+        n_stall = int(round(self._events[ei][1] * self._fps))
+        bg = max(0, self._next_src - 1)
+        for _ in range(n_stall):
+            phase = int(
+                self._spin * self._rps * self._n_rot / self._fps
+            ) % self._n_rot
+            self._emit(bg, 1, self._black, phase)
+            self._spin += 1
+
+    def on_source(self, k: int) -> None:
+        while self._ei < len(self._events) and int(round(
+            self._events[self._ei][0] * self._fps
+        )) <= self._next_src:
+            self._emit_stalls(self._ei)
+            self._ei += 1
+        self._emit(self._next_src, 0, 0, 0)
+        self._next_src += 1
+
+    def finish(self) -> None:
+        while self._ei < len(self._events):
+            self._emit_stalls(self._ei)
+            self._ei += 1
+
+
+class _SkipSchedule:
+    """plan_stalling's frame-freeze (skipping) mode, replayed
+    incrementally. The batch form mutates src_idx sequentially
+    (`src_idx[start:end] = src_idx[start]` per event, in the given
+    order); `anchors[i]` is the value that assignment reads — the
+    array state after events < i — so per-position resolution needs no
+    array. Length-preserving: one record per source frame."""
+
+    def __init__(self, fps: float, events, emit: Callable) -> None:
+        fps = float(fps)
+        norm = []
+        t_cursor = 0.0
+        for ev in events:
+            # bare durations freeze back-to-back from t=0 (the .buff
+            # freeze format carries no positions) — plan_stalling parity
+            if isinstance(ev, (list, tuple)):
+                norm.append((float(ev[0]), float(ev[1])))
+            else:
+                norm.append((t_cursor, float(ev)))
+                t_cursor += float(ev)
+        self._ranges = [
+            (int(round(t * fps)), int(round((t + d) * fps))) for t, d in norm
+        ]
+        self._emit = emit
+        anchors: list[int] = []
+        for i, (s, _e) in enumerate(self._ranges):
+            v = s
+            for j in range(i):
+                sj, ej = self._ranges[j]
+                if sj <= s < ej:
+                    v = anchors[j]
+            anchors.append(v)
+        self._anchors = anchors
+        self.anchors = frozenset(anchors)
+
+    def on_source(self, k: int) -> None:
+        v = k
+        stall = 0
+        for i, (s, e) in enumerate(self._ranges):
+            if s <= k < e:
+                v = self._anchors[i]
+                stall = 1
+        self._emit(v, stall, 0, 0)
+
+    def finish(self) -> None:
+        pass
+
+
+def streamed_stall_plan(
+    n_frames: int,
+    fps: float,
+    buff_events: list,
+    skipping: bool = False,
+    black_frame: bool = True,
+    spinner_rps: float = 1.0,
+    n_rotations: int = 64,
+) -> ov.StallPlan:
+    """Run the incremental schedule over `n_frames` sources and return
+    the records as a StallPlan — the parity surface tests diff against
+    ov.plan_stalling(n_frames, ...) field by field."""
+    recs: list[tuple] = []
+    emit = lambda *r: recs.append(r)  # noqa: E731 - record capture
+    sched = (
+        _SkipSchedule(fps, buff_events, emit) if skipping
+        else _StallSchedule(fps, buff_events, emit, black_frame=black_frame,
+                            spinner_rps=spinner_rps, n_rotations=n_rotations)
+    )
+    for k in range(n_frames):
+        sched.on_source(k)
+    sched.finish()
+    return ov.StallPlan(
+        src_idx=np.array([r[0] for r in recs], np.int32),
+        stall_mask=np.array([r[1] for r in recs], np.int8),
+        black_mask=np.array([r[2] for r in recs], np.int8),
+        phase=np.array([r[3] for r in recs], np.int32),
+    )
+
+
+class StallStream:
+    """Bind the incremental schedule to pushed frames: feed() source
+    frames in order, receive output records via
+    emit(frame_planes, stall, black, phase). Bounded retention: the
+    previous frame (stall backgrounds) plus the freeze anchors the
+    schedule precomputed — never the whole stream."""
+
+    def __init__(self, fps: float, events, skipping: bool, emit: Callable,
+                 n_rotations: int = 64) -> None:
+        self._emit = emit
+        self._sched = (
+            _SkipSchedule(fps, events, self._on_record) if skipping
+            else _StallSchedule(fps, events, self._on_record,
+                                n_rotations=n_rotations)
+        )
+        self._retain = self._sched.anchors
+        self._k = -1
+        self._cur = None
+        self._prev = None
+        self._retained: dict[int, list] = {}
+
+    def feed(self, planes: list) -> None:
+        self._k += 1
+        self._cur = planes
+        if self._k in self._retain:
+            self._retained[self._k] = planes
+        self._sched.on_source(self._k)
+        self._prev = planes
+
+    def finish(self) -> None:
+        # an empty source emits nothing, trailing events included —
+        # stream_monotonic_gather parity (no frames, no gather output)
+        if self._k >= 0:
+            self._sched.finish()
+
+    def _on_record(self, src: int, stall: int, black: int, phase: int) -> None:
+        if src == self._k:
+            planes = self._cur
+        elif src == self._k - 1:
+            planes = self._prev
+        else:
+            planes = self._retained.get(src)
+        if planes is None:
+            raise ChainError(
+                f"fused stalling: source frame {src} not retained at "
+                f"position {self._k} (schedule/retention bug)"
+            )
+        self._emit(planes, stall, black, phase)
+
+
+# ------------------------------------------------------ fan-out pipelines
+
+
+class _ContextPipeline:
+    """One CPVS render fed from the in-memory final-AVPVS stream:
+    optional display-rate resample (push-based stream_fps_resample, the
+    same index math), the `-t` output cap, the SHARED per-chunk
+    transform, and an AsyncWriter encoder."""
+
+    def __init__(self, out_path: str, plan: dict, pp, w: int, h: int,
+                 pix_fmt: str, avpvs_fps: float, audio, srate: int,
+                 rawvideo: bool, chunk: int) -> None:
+        self._transform = cp.make_cpvs_transform(plan, pp, pix_fmt, rawvideo)
+        out_rate = cp.cpvs_out_rate(plan, avpvs_fps)
+        vw, has_audio = cp.open_cpvs_writer(
+            out_path, plan, pp, w, h, out_rate, audio, srate
+        )
+        self._writer = pfe.AsyncWriter(vw)
+        if has_audio:
+            self._writer.write_audio(audio)
+        dst = plan["fps"]
+        self._resample = dst is not None and dst != avpvs_fps
+        self._src_fps = avpvs_fps
+        self._dst_fps = dst
+        self._cap = (
+            cp.t_cap_frames(plan["t"], out_rate)
+            if plan["t"] is not None else None
+        )
+        self._chunk = chunk
+        self._out_n = 0       # output frames emitted (cap accounting)
+        self._buf: list = []  # pending frames on the resample path
+        self._gather_k = 0    # next output index (resample)
+        self._cur = -1        # last source frame index seen
+        self._last = None
+        self._finished = False
+
+    # -- chunk fast path (no rate change: frames map 1:1)
+
+    def _put_chunk(self, planes: list) -> None:
+        if self._cap is not None:
+            left = self._cap - self._out_n
+            if left <= 0:
+                return
+            if planes[0].shape[0] > left:
+                planes = [p[:left] for p in planes]
+        if planes[0].shape[0] == 0:
+            return
+        self._out_n += planes[0].shape[0]
+        self._writer.put(self._transform(planes))
+
+    # -- frame path (display-rate resample)
+
+    def _out_index(self, k: int) -> int:
+        # stream_fps_resample's ffmpeg `fps=` index math, verbatim
+        return int(np.floor(k / self._dst_fps * self._src_fps + 0.5))
+
+    def _emit_frame(self, planes: list) -> None:
+        if self._cap is not None and self._out_n >= self._cap:
+            return
+        self._out_n += 1
+        self._buf.append(planes)
+        if len(self._buf) >= self._chunk:
+            self._flush_buf()
+
+    def _flush_buf(self) -> None:
+        if not self._buf:
+            return
+        stacked = [
+            np.stack([f[p] for f in self._buf]) for p in range(3)
+        ]
+        self._buf = []
+        self._writer.put(self._transform(stacked))
+
+    def feed(self, planes: list) -> None:
+        if not self._resample:
+            self._put_chunk(planes)
+            return
+        t = planes[0].shape[0]
+        for i in range(t):
+            frame = [p[i] for p in planes]
+            self._cur += 1
+            self._last = frame
+            while self._out_index(self._gather_k) <= self._cur:
+                self._emit_frame(frame)
+                self._gather_k += 1
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        if self._resample and self._last is not None:
+            # fps= output length: round(n_src / src_fps * dst_fps);
+            # past-the-end outputs repeat the last frame (clamp)
+            n_out = int(round(
+                (self._cur + 1) / self._src_fps * self._dst_fps
+            ))
+            while self._gather_k < n_out:
+                self._emit_frame(self._last)
+                self._gather_k += 1
+        self._flush_buf()
+        self._writer.close()
+
+    def abort(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:  # noqa: BLE001 - teardown on the failure path
+            pass
+
+
+class _PreviewPipeline:
+    """The ProRes preview fed from the in-memory final stream (no
+    resample, no cap — preview parity with create_preview)."""
+
+    def __init__(self, out_path: str, w: int, h: int, pix_fmt: str,
+                 avpvs_fps: float, audio, srate: int) -> None:
+        self._transform = cp.make_preview_transform(pix_fmt)
+        vw, has_audio = cp.open_preview_writer(
+            out_path, w, h, avpvs_fps, audio, srate
+        )
+        self._writer = pfe.AsyncWriter(vw)
+        if has_audio:
+            self._writer.write_audio(audio)
+        self._finished = False
+
+    def feed(self, planes: list) -> None:
+        self._writer.put(self._transform(planes))
+
+    def finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            self._writer.close()
+
+    def abort(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:  # noqa: BLE001 - teardown on the failure path
+            pass
+
+
+class FusedFanout:
+    """Per-PVS fused p04 fan-out. Built by the stage/executor with the
+    run's knobs; `start()` is called by the render body once rate/audio
+    are known (returns the chunk tap), `feed()` receives every
+    quantized AVPVS chunk, `finish_streams()` flushes and closes the
+    downstream encoders (the wave driver calls it via Lane.on_done as
+    lanes exhaust, bounding open codec contexts), and `close()` commits
+    every member artifact under its existing plan hash. `abort()`
+    removes partial outputs and clears their crash sentinels."""
+
+    def __init__(self, pvs, *, spinner_path: Optional[str] = None,
+                 n_rotations: int = 64, rawvideo: bool = False,
+                 nonraw_crf: int = 17, mobile_vprofile: str = "high",
+                 mobile_preset: str = "fast", preview: bool = False) -> None:
+        self.pvs = pvs
+        self._spinner = spinner_path
+        self._n_rot = n_rotations
+        self._rawvideo = rawvideo
+        self._crf = nonraw_crf
+        self._vprofile = mobile_vprofile
+        self._preset = mobile_preset
+        self._buffering = pvs.has_buffering()
+        self._skipping = pvs.has_framefreeze() if self._buffering else False
+        self._events = (
+            pvs.get_buff_events_media_time() if self._buffering else []
+        )
+        self.engaged = False
+        self._finished = False
+        self._closed = False
+        self._pipelines: list = []
+        self._marked: list[str] = []
+        self._stall_writer = None
+        self._stall_stream = None
+        self._compositor = None
+        self._srec: list = []
+        self._schunk = 0
+        # member jobs: the EXISTING per-artifact jobs — never run; they
+        # carry the plan identity, provenance and commit surface, so
+        # warm hits and partial invalidation behave exactly as today
+        self.stall_job = av.apply_stalling(
+            pvs, spinner_path=spinner_path, n_rotations=n_rotations
+        )
+        self.cpvs_jobs = [
+            cp.create_cpvs(pvs, pp, rawvideo, nonraw_crf,
+                           mobile_vprofile, mobile_preset)
+            for pp in pvs.test_config.post_processings
+        ]
+        self.preview_job = cp.create_preview(pvs) if preview else None
+        outs = [j.output_path for j in self.member_jobs()]
+        dups = sorted({o for o in outs if outs.count(o) > 1})
+        if dups:
+            raise ChainError(
+                f"fused p04 fan-out for {pvs.pvs_id}: multiple contexts "
+                f"write {dups} — write-write race"
+            )
+
+    def member_jobs(self) -> list:
+        jobs = []
+        if self.stall_job is not None:
+            jobs.append(self.stall_job)
+        jobs.extend(self.cpvs_jobs)
+        if self.preview_job is not None:
+            jobs.append(self.preview_job)
+        return jobs
+
+    # ------------------------------------------------------------ start
+
+    def start(self, rate: float, audio, srate: int, w: int, h: int,
+              pix_fmt: str) -> Callable:
+        """Open every downstream writer (audio first, exactly like the
+        decode-driven paths) and return the chunk tap. `rate` is the
+        AVPVS canvas rate; it is rationalized the way the writer muxes
+        it so the resample decisions match what a reader of the
+        artifact would see."""
+        frac = Fraction(rate).limit_denominator(1001)
+        avpvs_fps = frac.numerator / frac.denominator
+        tc = self.pvs.test_config
+        self.engaged = True
+        chunk = av.chunk_frames()
+        self._schunk = chunk
+
+        final_audio = audio
+        if self._buffering:
+            if audio is not None and audio.size and not self._skipping:
+                final_audio = av.insert_stall_silence(
+                    audio, srate, self._events
+                )
+            stall_out = self.stall_job.output_path
+            mark_inprogress(stall_out)
+            self._marked.append(stall_out)
+            has_audio = final_audio is not None and final_audio.size > 0
+            self._stall_writer = pfe.AsyncWriter(av._ffv1_writer(
+                stall_out, w, h, pix_fmt, avpvs_fps,
+                with_audio=has_audio, sample_rate=srate,
+            ))
+            if has_audio:
+                self._stall_writer.write_audio(final_audio)
+            self._compositor = av.make_stall_compositor(
+                pix_fmt, self._spinner, self._skipping, self._n_rot
+            )
+            self._stall_stream = StallStream(
+                avpvs_fps, self._events, self._skipping,
+                emit=self._on_stall_record, n_rotations=self._n_rot,
+            )
+
+        for job, pp in zip(self.cpvs_jobs, tc.post_processings):
+            plan = cp.cpvs_plan(
+                self.pvs, pp, h, self._rawvideo, self._crf,
+                self._vprofile, self._preset,
+            )
+            ctx_audio = None
+            if tc.is_long() and final_audio is not None and final_audio.size:
+                ctx_audio = cp.trim_normalize_long_audio(
+                    final_audio, srate, self.pvs, plan["normalize"]
+                )
+            mark_inprogress(job.output_path)
+            self._marked.append(job.output_path)
+            self._pipelines.append(_ContextPipeline(
+                job.output_path, plan, pp, w, h, pix_fmt, avpvs_fps,
+                ctx_audio, srate, self._rawvideo, chunk,
+            ))
+        if self.preview_job is not None:
+            mark_inprogress(self.preview_job.output_path)
+            self._marked.append(self.preview_job.output_path)
+            self._pipelines.append(_PreviewPipeline(
+                self.preview_job.output_path, w, h, pix_fmt, avpvs_fps,
+                final_audio, srate,
+            ))
+        return self.feed
+
+    # ------------------------------------------------------------- flow
+
+    def feed(self, planes: list) -> None:
+        """One quantized AVPVS chunk ([T, H, W] host stacks)."""
+        if self._stall_stream is not None:
+            t = planes[0].shape[0]
+            for i in range(t):
+                self._stall_stream.feed([p[i] for p in planes])
+        else:
+            self._feed_final(planes)
+
+    def _feed_final(self, planes: list) -> None:
+        for pipe in self._pipelines:
+            pipe.feed(planes)
+
+    def _on_stall_record(self, frame_planes, stall, black, phase) -> None:
+        self._srec.append((frame_planes, stall, black, phase))
+        if len(self._srec) >= self._schunk:
+            self._flush_stall_chunk()
+
+    def _flush_stall_chunk(self) -> None:
+        if not self._srec:
+            return
+        recs, self._srec = self._srec, []
+        gathered = [
+            np.stack([np.asarray(r[0][p]) for r in recs]) for p in range(3)
+        ]
+        stall = np.array([r[1] for r in recs], np.int8)
+        black = np.array([r[2] for r in recs], np.int8)
+        phase = np.array([r[3] for r in recs], np.int32)
+        outs = self._compositor(gathered, stall, black, phase)
+        # fetched ONCE: the stalled writer takes host arrays and the
+        # same arrays fan out to every context pipeline — what a decoder
+        # of the stalled artifact would produce (lossless writeback)
+        host = [np.asarray(o) for o in outs]
+        self._stall_writer.put(host)
+        self._feed_final(host)
+
+    # -------------------------------------------------------- lifecycle
+
+    def finish_streams(self) -> None:
+        """Flush tails and close every downstream encoder (idempotent).
+        Commits stay in close(): the wave driver calls this from
+        Lane.on_done the moment a lane exhausts, so encoder contexts
+        free up while other lanes still stream."""
+        if self._finished or not self.engaged:
+            return
+        self._finished = True
+        if self._stall_stream is not None:
+            self._stall_stream.finish()
+            self._flush_stall_chunk()
+            self._stall_writer.close()
+        for pipe in self._pipelines:
+            pipe.finish()
+
+    def close(self) -> None:
+        """Finalize: flush + commit every member artifact under its own
+        plan hash (provenance, store commit, sentinel clear — the same
+        tail a standalone job run has)."""
+        if self._closed:
+            return
+        try:
+            self.finish_streams()
+        except BaseException:
+            self.abort()
+            raise
+        self._closed = True
+        if not self.engaged:
+            return
+        for job in self.member_jobs():
+            job.complete_externally()
+
+    def abort(self) -> None:
+        """Failure path: no partial artifact may survive to satisfy a
+        later run's skip-existing check."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._stall_writer is not None:
+            try:
+                self._stall_writer.close()
+            except Exception:  # noqa: BLE001 - teardown on the failure path
+                pass
+        for pipe in self._pipelines:
+            pipe.abort()
+        for out in self._marked:
+            if os.path.isfile(out):
+                os.unlink(out)
+            clear_inprogress(out)

@@ -72,6 +72,12 @@ class Lane:
     emit: Callable[[list], None]
     n_frames_hint: int = 0  # for wave grouping only; 0 = unknown
     emit_features: Optional[Callable[[np.ndarray, np.ndarray], None]] = None
+    #: called once, after the lane's LAST real frames have been emitted
+    #: (an exhausted lane rides the wave as discarded padding until the
+    #: longest lane finishes): the fused p04 fan-out flushes and closes
+    #: its downstream encoders here, so open codec contexts are bounded
+    #: by the live lanes, not the wave width
+    on_done: Optional[Callable[[], None]] = None
 
 
 def _rechunk(
@@ -279,6 +285,17 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
     pool = pool or bufpool.DEFAULT_POOL
     prev_sharding = NamedSharding(mesh, P("pvs", None, None))
     done = [False] * len(wave)
+    notified = [False] * len(wave)
+
+    def notify_done() -> None:
+        # a lane's done flag flips while fetching the NEXT block, so by
+        # the time the current block's emits ran, every real frame of a
+        # done lane is out — safe to fire its on_done now
+        for i, ln in enumerate(wave):
+            if done[i] and not notified[i]:
+                notified[i] = True
+                if ln.on_done is not None:
+                    ln.on_done()
     # cross-block TI carry stays at container depth (the quantized luma a
     # decoder of the artifact would see; u8/u16 device_put, not f32)
     prev = np.zeros((n_pvs, dst_h, dst_w),
@@ -367,6 +384,12 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
         # in host memory across the next iteration
         prev = host[0][:, -1].copy()
         first = False
+        notify_done()
+    # every lane is exhausted once the loop ends (covers lanes that
+    # were empty from the first gather)
+    for i in range(len(done)):
+        done[i] = True
+    notify_done()
     # clean exit only: on an exception a device_put/step may still be
     # reading a wave buffer (its outputs never fetched), so the buffers
     # are deliberately DROPPED, not released — same rule as AsyncWriter's

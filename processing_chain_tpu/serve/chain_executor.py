@@ -359,18 +359,39 @@ class ChainExecutor:
         p02.run()
 
         # p03 — AVPVS render, then the stalling pass (planned only after
-        # the renders exist: its plan hashes the wo_buffer bytes)
+        # the renders exist: its plan hashes the wo_buffer bytes). Under
+        # PC_FUSE_P04 (models/fused) each due AVPVS renders the stalling
+        # pass + every CPVS context from the same decode — a chain wave
+        # stops paying the per-stage re-decodes; warm/partial PVSes keep
+        # the staged path exactly as before.
+        from ..models import fused as fused_mod
+
+        fuse = fused_mod.fused_p04_enabled()
+        fanouts: dict = {}
         p03 = JobRunner(parallelism=min(_DEVICE_POOL, pool),
                         name="serve-p03")
         av_jobs = {}
         for pvs in pvses:
-            av_jobs[pvs.pvs_id] = av.create_avpvs_wo_buffer(pvs)
+            fo = None
+            if fuse:
+                fo = fused_mod.FusedFanout(
+                    pvs, spinner_path=_DEFAULT_SPINNER
+                )
+                fanouts[pvs.pvs_id] = fo
+            av_jobs[pvs.pvs_id] = av.create_avpvs_wo_buffer(pvs, fanout=fo)
             p03.add(av_jobs[pvs.pvs_id])
         p03.run()
         p03_stall = JobRunner(parallelism=min(_DEVICE_POOL, pool),
                               name="serve-p03-stall")
         stall_jobs = {}
         for pvs in pvses:
+            fo = fanouts.get(pvs.pvs_id)
+            if fo is not None and fo.engaged:
+                # fused render produced + committed the stalled AVPVS;
+                # its job still carries the manifest's plan identity
+                if fo.stall_job is not None:
+                    stall_jobs[pvs.pvs_id] = fo.stall_job
+                continue
             job = av.apply_stalling(pvs, spinner_path=_DEFAULT_SPINNER)
             if job is not None:
                 stall_jobs[pvs.pvs_id] = job

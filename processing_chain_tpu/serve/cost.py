@@ -73,6 +73,12 @@ _REJECTED = tm.counter(
     "requests refused by cost admission control, per reason",
     ("reason",),
 )
+_CAL_SCALE = tm.gauge(
+    "chain_serve_cost_calibration_scale",
+    "current per-host calibration multiplier applied to every cost "
+    "prediction (1.0 = the documented base coefficients; refit from "
+    "the CostLedger's observed/predicted ratio ring)",
+)
 
 # ------------------------------------------------------- model constants
 #
@@ -116,6 +122,77 @@ COMPLEXITY_MULT_RANGE = (0.5, 4.0)
 #: predicted cost for a unit whose features are unknowable (foreign
 #: record, raising feature hook): keeps packing/accounting total
 DEFAULT_COST_S = 1.0
+
+# ----------------------------------------------------- host calibration
+#
+# The base coefficients above were measured on ONE reference container;
+# a deployment's hosts run the same formula at a different absolute
+# speed (and the fused p04 path shifts execution seconds again). The
+# calibration layer refits a single per-host SCALE from the ledger's
+# observed/predicted ratio ring — one auditable number (reported in
+# /status and /fleet, and as `chain_serve_cost_calibration_scale`)
+# instead of silently re-deriving every coefficient. A scale is all the
+# scheduler needs: wave packing and admission compare RELATIVE costs,
+# and the absolute budget error is exactly the median ratio the refit
+# removes.
+
+#: refuse to fit from fewer settled observations than this
+CALIBRATION_MIN_SAMPLES = 32
+#: fitted-scale clamp: one pathological soak must not 100x the gate
+CALIBRATION_SCALE_RANGE = (0.1, 10.0)
+
+_CAL_LOCK = lockdebug.make_lock("serve_cost_cal")
+_CALIBRATION: dict = {"scale": 1.0, "n": 0}   # guarded-by: _CAL_LOCK
+
+
+def calibration() -> dict:
+    """The calibration in force: {"scale", "n" (samples behind it)}."""
+    with _CAL_LOCK:
+        return dict(_CALIBRATION)
+
+
+def calibration_scale() -> float:
+    with _CAL_LOCK:
+        return float(_CALIBRATION["scale"])
+
+
+def set_calibration(scale: float, n: int = 0) -> dict:
+    """Install a per-host prediction multiplier (clamped). Applied by
+    `predict_unit_cost` to every later prediction."""
+    lo, hi = CALIBRATION_SCALE_RANGE
+    scale = float(min(hi, max(lo, scale)))
+    with _CAL_LOCK:
+        _CALIBRATION.update(scale=scale, n=int(n))
+        doc = dict(_CALIBRATION)
+    _CAL_SCALE.set(scale)
+    return doc
+
+
+def reset_calibration() -> None:
+    with _CAL_LOCK:
+        _CALIBRATION.update(scale=1.0, n=0)
+    _CAL_SCALE.set(1.0)
+
+
+def fit_scale(ratios: list, min_samples: int = CALIBRATION_MIN_SAMPLES
+              ) -> Optional[dict]:
+    """Fit a correction factor from observed/predicted ratios: the
+    MEDIAN ratio (robust against the heavy tail warm-adjacent waves put
+    on the mean), clamped. None when there are too few finite samples
+    to trust a refit."""
+    clean = sorted(
+        r for r in ratios
+        if isinstance(r, (int, float)) and math.isfinite(r) and r > 0
+    )
+    if len(clean) < max(1, min_samples):
+        return None
+    mid = len(clean) // 2
+    median = (
+        clean[mid] if len(clean) % 2
+        else 0.5 * (clean[mid - 1] + clean[mid])
+    )
+    lo, hi = CALIBRATION_SCALE_RANGE
+    return {"scale": round(min(hi, max(lo, median)), 4), "n": len(clean)}
 
 
 def complexity_multiplier(complexity: Optional[float]) -> float:
@@ -175,7 +252,9 @@ def predict_unit_cost(executor, record_unit: dict) -> float:
             features = hook(record_unit)
         except Exception:  # noqa: BLE001 - any feature failure = default cost
             features = None
-    return cost_from_features(features)
+    # the per-host calibration multiplies the WHOLE prediction: the
+    # observed/predicted ratio it was fitted from is a whole-cost ratio
+    return cost_from_features(features) * calibration_scale()
 
 
 # ----------------------------------------------------------- admission
@@ -319,6 +398,35 @@ class CostLedger:
         """A unit settled from the store without executing."""
         with self._lock:
             self._tenant(tenant)["warm_units"] += 1
+
+    def ratios(self) -> list:
+        """Snapshot of the observed/predicted ratio ring."""
+        with self._lock:
+            return list(self._ratios)
+
+    def calibrate(self, min_samples: int = CALIBRATION_MIN_SAMPLES
+                  ) -> Optional[dict]:
+        """Refit the per-host scale from the ratio ring and install it.
+        The ring's ratios were observed against predictions carrying
+        the scale in force at THEIR time, so the fit COMPOSES with the
+        current scale (iterative refinement: a perfectly-calibrated
+        host fits median ≈ 1 and the scale is a fixed point). A
+        successful refit DRAINS the ring: its ratios are now stale
+        (they argue against a scale no longer in force), and a
+        periodic tick (--cost-calibrate) re-fitting them would
+        compound the same correction exponentially. The next refit
+        waits for `min_samples` fresh post-refit observations. Returns
+        the installed calibration, or None when the ring is too thin."""
+        fitted = fit_scale(self.ratios(), min_samples)
+        if fitted is None:
+            return None
+        cal = set_calibration(
+            calibration_scale() * fitted["scale"], fitted["n"]
+        )
+        with self._lock:
+            self._ratios.clear()
+            self._ratio_i = 0
+        return cal
 
     def report(self) -> dict:
         """The auditable summary: per-tenant sums + model error. Error

@@ -128,6 +128,7 @@ class ChainServeService:
         wave_budget_s: Optional[float] = None,
         admission_budget_s: Optional[float] = None,
         tenant_budget_s: Optional[float] = None,
+        cost_calibrate: bool = False,
     ) -> None:
         self.root = os.path.abspath(root)
         self.artifacts_root = os.path.join(self.root, "artifacts")
@@ -176,6 +177,10 @@ class ChainServeService:
             float(tenant_budget_s) if tenant_budget_s else None
         )
         self.cost_ledger = cost.CostLedger()
+        #: periodic per-host refit of the cost-prediction scale from
+        #: the ledger's observed/predicted ratio ring (maintenance
+        #: tick; docs/SERVE.md "Cost-aware scheduling & admission")
+        self.cost_calibrate = bool(cost_calibrate)
         self.scheduler = Scheduler(
             self.queue, self.executor, self.artifacts_root,
             workers=workers, wave_width=wave_width,
@@ -249,6 +254,10 @@ class ChainServeService:
                     self.scheduler.notify()
                 self._sweep_remote_settlements()
                 self._adopt_orphan_requests()
+                if self.cost_calibrate:
+                    # cheap (a sorted copy of a bounded ring); a thin
+                    # ring returns None and the scale stays put
+                    self.cost_ledger.calibrate()
             except Exception:  # noqa: BLE001 - the tick must survive disk hiccups
                 get_logger().exception(
                     "chain-serve: maintenance tick failed")
@@ -882,6 +891,12 @@ class ChainServeService:
             "cost": {
                 **self.cost_ledger.report(),
                 "outstanding_s": round(self.queue.outstanding_cost(), 3),
+                # the per-host prediction multiplier in force (1.0 =
+                # base coefficients); refit when --cost-calibrate is on
+                "calibration": {
+                    **cost.calibration(),
+                    "enabled": self.cost_calibrate,
+                },
             },
         }
         with self._lock:

@@ -56,6 +56,27 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     avpvs_codec = getattr(cli_args, "avpvs_codec", None)
     if avpvs_codec:
         os.environ["PC_AVPVS_CODEC"] = avpvs_codec
+    # fused p04 fan-out (PC_FUSE_P04, models/fused): PVSes whose AVPVS
+    # is due render the stalling pass + every CPVS context from the
+    # same decode. Dry runs must plan exactly like the legacy path, so
+    # planning-only runs never engage it. The p04 knobs ride getattr
+    # defaults, matching what the p04 stage would use in the same
+    # orchestrated run (its namespace carries the same defaults).
+    from ..models import fused as fused_mod
+
+    fuse = fused_mod.fused_p04_enabled() and not cli_args.dry_run
+    fanouts: dict = {}
+
+    def _fanout(pvs):
+        fo = fused_mod.FusedFanout(
+            pvs, spinner_path=spinner,
+            rawvideo=bool(getattr(cli_args, "rawvideo", False)),
+            nonraw_crf=int(getattr(cli_args, "nonraw_crf", 17)),
+            preview=bool(getattr(cli_args, "lightweight_preview", False)),
+        )
+        fanouts[pvs] = fo
+        return fo
+
     shard = local_shard(test_config.pvses)
     eligible = []
     for _pvs_id, pvs in shard:
@@ -95,9 +116,18 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
                 pvs for pvs, job in per_pvs.items()
                 if job.should_run(cli_args.force, runner="p03")
             ]
+            if fuse:
+                # short lanes fan out in the wave driver; long tests
+                # keep the staged passes (their per-segment lanes cross
+                # waves out of stream order)
+                for pvs in todo:
+                    if pvs.test_config.is_short():
+                        _fanout(pvs)
             runner.add(
                 av.create_avpvs_wo_buffer_batch(
-                    todo, avpvs_src_fps=avpvs_src_fps, force_60_fps=force_60_fps
+                    todo, avpvs_src_fps=avpvs_src_fps,
+                    force_60_fps=force_60_fps,
+                    fanouts=fanouts or None,
                 )
             )
             batch = (todo, per_pvs)
@@ -108,6 +138,7 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
                         pvs,
                         avpvs_src_fps=avpvs_src_fps,
                         force_60_fps=force_60_fps,
+                        fanout=_fanout(pvs) if fuse else None,
                     )
                 )
         # two phases: stalling reads the wo_buffer outputs of phase one
@@ -121,6 +152,11 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
         # render) must exist with its final bytes for the store's
         # hit/miss decision to be about THIS run's input, not a stale one
         for pvs in eligible:
+            fo = fanouts.get(pvs)
+            if fo is not None and fo.engaged:
+                # the fused render already produced AND committed the
+                # stalled AVPVS from the in-memory stream
+                continue
             stall_runner.add(av.apply_stalling(pvs, spinner_path=spinner))
         stall_runner.run()
 

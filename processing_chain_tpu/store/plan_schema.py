@@ -81,6 +81,17 @@ ENV_INPUTS: dict[str, dict] = {
                   "than this env read",
     },
     # ------------------------------------------------ never alters bytes
+    "PC_FUSE_P04": {
+        "status": "exempt",
+        "reason": "routing only: the fused p03+p04 fan-out (models/"
+                  "fused) renders the stalling pass and every CPVS from "
+                  "the in-memory quantized frames a decode of the "
+                  "artifact would return (lossless intermediates), "
+                  "through the SAME transform/compositor/writer code as "
+                  "the staged path — decoded-identical bytes under "
+                  "unchanged plan hashes, pinned by tests/test_fused.py "
+                  "and the fused-smoke CI parity gate",
+    },
     "PC_FFV1_THREADS": {
         "status": "exempt",
         "reason": "slice-threading width parallelizes the encode of the "

@@ -516,9 +516,26 @@ class ArtifactStore:
 
     def _materialize_one(self, digest: dict, dest: str) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+        obj = self.object_path(digest["sha256"])
+        try:
+            if os.path.samefile(obj, dest):
+                # dest already IS the object (hardlink). Linking through
+                # tmp would strand it: POSIX rename of two links of one
+                # inode is a silent NO-OP, leaving tmp behind to fail
+                # the NEXT materialize with EEXIST — which converted a
+                # perfectly warm hit into a spurious rebuild whenever
+                # two destinations share one plan hash (sibling HRCs
+                # with identical wo_buffer plans).
+                return
+        except OSError:
+            pass  # dest missing (or stat raced): materialize normally
         tmp = f"{dest}.store.{os.getpid()}.part"
         try:
-            _link_or_copy(self.object_path(digest["sha256"]), tmp)
+            if os.path.isfile(tmp):
+                # stale strand from a pre-fix run or a crashed
+                # materialize: heal it instead of failing EEXIST
+                os.unlink(tmp)
+            _link_or_copy(obj, tmp)
             os.replace(tmp, dest)
         except BaseException:
             if os.path.isfile(tmp):

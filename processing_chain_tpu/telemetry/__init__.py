@@ -134,6 +134,14 @@ def stage_span(stage: str, **fields) -> Iterator[None]:
     before_comp = (
         _profiling.components_from_live()[0] if REGISTRY.enabled else None
     )
+    # decoder opens diffed per stage: the attribution engine refuses a
+    # decode_bound verdict for a stage that opened ZERO decoders (its
+    # consumer-blocked seconds are in-memory plumbing — the fused p04
+    # fan-out — not decode; telemetry/profiling.attribute_run)
+    before_opens = (
+        REGISTRY.sum_series("chain_io_decoder_opens_total", None)
+        if REGISTRY.enabled else None
+    )
     emit("stage_start", stage=stage, **fields)
     HEARTBEATS.stage_begin(stage)
     t0 = time.perf_counter()
@@ -158,6 +166,13 @@ def stage_span(stage: str, **fields) -> Iterator[None]:
                 comp: round(total - before_comp.get(comp, 0.0), 4)
                 for comp, total in after_comp.items()
             }
+            after_opens = REGISTRY.sum_series(
+                "chain_io_decoder_opens_total", None
+            )
+            if after_opens is not None:
+                extra["decoder_opens"] = int(
+                    after_opens - (before_opens or 0.0)
+                )
         emit(
             "stage_end",
             stage=stage,

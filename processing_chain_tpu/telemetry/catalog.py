@@ -42,6 +42,8 @@ METRICS: dict[str, str] = {
     "chain_pipeline_wait_seconds_total": "counter",
     # io — batched host frame path (PR 4)
     "chain_io_batch_calls_total": "counter",
+    # io — decoder opens: the fused chain's one-decode-per-SRC invariant
+    "chain_io_decoder_opens_total": "counter",
     "chain_bufpool_hits_total": "counter",
     "chain_bufpool_misses_total": "counter",
     "chain_bufpool_recycled_bytes_total": "counter",
@@ -87,6 +89,7 @@ METRICS: dict[str, str] = {
     "chain_serve_cost_observed_seconds_total": "counter",
     "chain_serve_cost_error_ratio": "histogram",
     "chain_serve_cost_rejected_total": "counter",
+    "chain_serve_cost_calibration_scale": "gauge",
     # priors/ — codec-prior extraction (docs/PRIORS.md)
     "chain_priors_extract_total": "counter",
     "chain_priors_cache_hits_total": "counter",

@@ -413,6 +413,9 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
                 entry["requests"] = serve.get("requests", {})
                 entry["executor"] = serve.get("executor")
                 entry["uptime_s"] = status.get("uptime_s")
+                entry["cost_calibration"] = (
+                    serve.get("cost") or {}
+                ).get("calibration")
                 rss = (status.get("resources") or {}).get("rss_bytes")
                 if rss:
                     entry["rss_bytes"] = rss
@@ -443,8 +446,16 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
         "slo_bands": catalog.SLO_BANDS,
         # per-tenant predicted/observed seconds + admission refusals,
         # merged across replicas (serve/cost.py)
-        "cost": cost_report(merge_counters(parsed_counters),
-                            merged_hists),
+        "cost": {
+            **cost_report(merge_counters(parsed_counters), merged_hists),
+            # per-replica prediction-scale calibration (serve/cost.py
+            # --cost-calibrate): per host, never merged — each replica
+            # runs its own hardware
+            "calibration": {
+                r["replica"]: r["cost_calibration"]
+                for r in replicas if r.get("cost_calibration")
+            },
+        },
         # tail-sampled on purpose: the journals are unbounded
         # append-only history and /fleet refreshes every few seconds
         "spans": serve_spans.journal_stats(

@@ -666,9 +666,22 @@ def attribute_run(metrics: dict, events: Sequence[dict]) -> dict[str, dict]:
         stage = rec.get("stage", "?")
         # components absent from the event were unmeasured for the whole
         # stage (no series existed) — report them as such, not as zeros
+        reattributed = False
+        if rec.get("decoder_opens") == 0 and comps.get("decode"):
+            # consumer-blocked seconds in a stage that opened ZERO
+            # decoders cannot be decode time: the stage consumed
+            # in-memory streams (the fused p04 fan-out renders CPVS
+            # from device-resident frames) and the waits are pipeline
+            # plumbing. Without this gate a fused run's p03/p04 stages
+            # could report decode_bound on a decode that never happened
+            # — the exact verdict the fusion exists to retire.
+            comps = dict(comps, decode=0.0)
+            reattributed = True
         result = classify_components(
             comps, missing=set(COMPONENT_METRICS) - set(comps)
         )
+        if reattributed:
+            result["decode_reattributed"] = True
         result["wall_s"] = rec.get("duration_s")
         verdicts[stage] = result
     if not verdicts and metrics:

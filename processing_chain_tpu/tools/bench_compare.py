@@ -86,6 +86,15 @@ def measure(timeout_s: float = 600.0) -> dict[str, object]:
     cx = last_json_line(proc.stdout)
     if proc.returncode == 0 and cx is not None and cx.get("both_finite"):
         out["complexity.priors_vs_proxy"] = cx["priors_vs_proxy"]
+    # fused vs staged p03+p04 (docs/PERF.md "single-decode chain"):
+    # floor ≈ 1 — the fused path must not regress below the staged one
+    proc = shell(
+        [sys.executable, bench, "--fused-bench"],
+        check=False, timeout=timeout_s, env=env, cwd=_REPO,
+    )
+    fb = last_json_line(proc.stdout)
+    if proc.returncode == 0 and fb is not None and "fused_vs_unfused" in fb:
+        out["e2e.fused_vs_unfused"] = fb["fused_vs_unfused"]
     live_path = os.environ.get(
         "PC_BENCH_LIVE_FILE", os.path.join(_REPO, "BENCH_LIVE.json")
     )

@@ -92,6 +92,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="refuse (429, retryable) work that would "
                              "push a tenant's outstanding predicted "
                              "seconds past this budget")
+    parser.add_argument("--cost-calibrate", action="store_true",
+                        help="periodically refit the per-host cost-"
+                             "prediction scale from the observed/"
+                             "predicted ratio ring (serve/cost.py; "
+                             "reported in /status and /fleet)")
     args = parser.parse_args(list(argv) if argv is not None else None)
 
     from .store_admin import _parse_bytes
@@ -117,6 +122,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         wave_budget_s=args.wave_budget_s,
         admission_budget_s=args.admission_budget_s,
         tenant_budget_s=args.tenant_budget_s,
+        cost_calibrate=args.cost_calibrate,
     )
     stop = threading.Event()
 

@@ -378,6 +378,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--wave-budget-s", type=float, default=None,
                         help="cost-aware packing budget (predicted "
                              "seconds per wave; serve/cost.py)")
+    parser.add_argument("--calibrate-out", default=None, metavar="FILE",
+                        help="after the soak, fit the per-host cost-"
+                             "prediction scale from the ledger's "
+                             "observed/predicted ratios and write the "
+                             "fitted coefficients JSON to FILE "
+                             "(serve/cost.py calibration)")
     parser.add_argument("--pack-bench", action="store_true",
                         help="bench cost-aware vs count-based wave "
                              "packing instead of running the soak")
@@ -560,6 +566,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 f"warm request latency {warm_sorted[-1]:.1f} ms over the "
                 f"{args.warm_budget_ms:.0f} ms budget"
             )
+        if args.calibrate_out:
+            # fit from whatever the soak observed (a soak is a
+            # deliberate sample, so no minimum-ring gate) and report
+            # the full auditable document next to the model error
+            from ..serve import cost as serve_cost
+
+            fitted = serve_cost.fit_scale(
+                service.cost_ledger.ratios(), min_samples=1
+            )
+            cal_doc = {
+                "fitted": fitted,
+                "applied_base_scale": serve_cost.calibration_scale(),
+                "model_error": service.cost_ledger.report()["model_error"],
+                "host_cpu_count": os.cpu_count(),
+            }
+            atomic_write_text(
+                args.calibrate_out,
+                json.dumps(cal_doc, sort_keys=True) + "\n",
+            )
+            report["calibration"] = cal_doc
+            if fitted is None:
+                failures.append(
+                    "--calibrate-out: no observed/predicted ratios to "
+                    "fit from (did any unit execute?)"
+                )
     finally:
         service.stop()
     report["failures"] = failures

@@ -1,0 +1,502 @@
+"""Fused p03+p04 (PC_FUSE_P04, models/fused): the single-decode chain.
+
+Parity discipline: the fused fan-out must produce DECODED-IDENTICAL
+artifacts under unchanged plan hashes — these tests pin it at three
+altitudes: the incremental stall schedule against ov.plan_stalling, the
+full CLI chain fused-vs-staged (stalled AVPVS + every CPVS context),
+and the model-layer long path (audio, display-rate resample, preview).
+The store tests pin the memoization contract: a warm fused run plans
+zero jobs, and a single-context invalidation rebuilds exactly that
+CPVS. The attribution tests pin the decode-verdict gate (a stage with
+zero decoder opens can no longer report decode_bound).
+"""
+
+import glob
+import os
+import shutil
+import textwrap
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.cli import main as cli_main
+from processing_chain_tpu.io import medialib
+from processing_chain_tpu.io.video import VideoReader
+from processing_chain_tpu.models import fused as fused_mod
+from processing_chain_tpu.ops import overlay as ov
+from processing_chain_tpu.store import runtime as store_runtime
+
+from test_pipeline_e2e import write_db
+
+
+@pytest.fixture(autouse=True)
+def _clean_runtime(monkeypatch):
+    """No test leaks the fuse flag, an active store or telemetry state."""
+    monkeypatch.delenv("PC_FUSE_P04", raising=False)
+    tm.reset()
+    yield
+    store_runtime.configure(None)
+    tm.disable()
+    tm.reset()
+
+
+# ------------------------------------------------- stall-schedule parity
+
+
+STALL_CASES = [
+    (n, fps, events)
+    for n in (0, 1, 5, 48, 150)
+    for fps in (24.0, 60.0)
+    for events in ([], [[0.0, 0.5]], [[2.0, 0.5]],
+                   [[1.0, 0.25], [1.5, 0.5]], [[100.0, 1.0]],
+                   [[0.5, 0.0]], [[1.0, 0.2], [1.0, 0.3]])
+]
+
+SKIP_CASES = [
+    (n, 24.0, events)
+    for n in (0, 1, 48, 150)
+    for events in ([0.5, 0.25], [[1.0, 0.5]],
+                   [[0.5, 1.0], [1.0, 0.5]],      # overlapping chain
+                   [[2.0, 1.0], [2.5, 2.0]],
+                   [[3.0, 1.0], [1.0, 2.0]],      # out-of-order ranges
+                   [0.1, 0.1, 0.1])
+]
+
+
+def _plans_equal(a: ov.StallPlan, b: ov.StallPlan) -> bool:
+    return all(
+        np.array_equal(np.asarray(getattr(a, f)), np.asarray(getattr(b, f)))
+        for f in ("src_idx", "stall_mask", "black_mask", "phase")
+    )
+
+
+def test_streamed_stall_plan_matches_plan_stalling_spinner_mode():
+    for n, fps, events in STALL_CASES:
+        ref = ov.plan_stalling(n, fps, events, skipping=False,
+                               black_frame=True, n_rotations=64)
+        got = fused_mod.streamed_stall_plan(n, fps, events, skipping=False,
+                                            black_frame=True, n_rotations=64)
+        assert _plans_equal(ref, got), (n, fps, events)
+
+
+def test_streamed_stall_plan_matches_plan_stalling_skipping_mode():
+    for n, fps, events in SKIP_CASES:
+        ref = ov.plan_stalling(n, fps, events, skipping=True)
+        got = fused_mod.streamed_stall_plan(n, fps, events, skipping=True)
+        assert _plans_equal(ref, got), (n, fps, events)
+
+
+def test_streamed_stall_plan_randomized_matrix():
+    rng = np.random.default_rng(7)
+    for _ in range(120):
+        n = int(rng.integers(0, 80))
+        fps = float(rng.choice([23.976, 24.0, 30.0, 60.0]))
+        skipping = bool(rng.integers(0, 2))
+        events = [
+            [float(rng.uniform(0, n / fps * 1.3 + 0.5)),
+             float(rng.uniform(0, 1.0))]
+            for _ in range(int(rng.integers(0, 4)))
+        ]
+        ref = ov.plan_stalling(n, fps, events, skipping=skipping)
+        got = fused_mod.streamed_stall_plan(n, fps, events,
+                                            skipping=skipping)
+        assert _plans_equal(ref, got), (n, fps, events, skipping)
+
+
+def test_stall_stream_binds_frames_and_bounds_retention():
+    """The frame binder reproduces the gather of the batch plan (frames
+    indexed by src_idx) while retaining only anchors + the previous
+    frame."""
+    fps, events = 24.0, [[0.5, 1.0], [1.0, 0.5]]
+    n = 60
+    out = []
+    stream = fused_mod.StallStream(
+        fps, events, True,
+        emit=lambda planes, *rec: out.append((planes[0][0, 0], rec)),
+    )
+    frames = [[np.full((2, 2), k, np.uint8)] * 3 for k in range(n)]
+    for f in frames:
+        stream.feed(f)
+    stream.finish()
+    plan = ov.plan_stalling(n, fps, events, skipping=True)
+    assert len(out) == len(plan.src_idx)
+    for (val, _rec), src in zip(out, plan.src_idx):
+        assert val == src
+    # retention is the anchor set, not the stream
+    assert len(stream._retained) <= 2
+
+
+# ------------------------------------------------------ e2e CLI parity
+
+
+SHORT_YAML = textwrap.dedent("""\
+    databaseId: P2SXM92
+    syntaxVersion: 6
+    type: short
+    qualityLevelList:
+      Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24}
+    codingList:
+      VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+    srcList:
+      SRC000: SRC000.avi
+    hrcList:
+      HRC000: {videoCodingId: VC01, eventList: [[Q0, 2]]}
+      HRC002: {videoCodingId: VC01, eventList: [[Q0, 2], [stall, 0.5]]}
+    pvsList:
+      - P2SXM92_SRC000_HRC000
+      - P2SXM92_SRC000_HRC002
+    postProcessingList:
+      - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 24}
+      - {type: mobile, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 30}
+    """)
+
+#: artifacts whose codecs are deterministic (lossless FFV1 / rawvideo):
+#: fused must decode BIT-IDENTICAL to staged
+PARITY_EXACT = (
+    "avpvs/P2SXM92_SRC000_HRC000.avi",
+    "avpvs/P2SXM92_SRC000_HRC002.avi",          # stalled
+    "cpvs/P2SXM92_SRC000_HRC000_PC.avi",
+    "cpvs/P2SXM92_SRC000_HRC002_PC.avi",
+)
+
+#: x264 artifacts: libx264 at FIXED settings is measurably
+#: nondeterministic on this host class (staged-vs-staged fresh-process
+#: runs produce occasionally-different streams from byte-identical
+#: encoder input — hypervisor-dependent SIMD capability detection), so
+#: the pinned invariant is (a) the encoder INPUT bytes are hash-equal
+#: fused-vs-staged (test below) and (b) the decodes agree to
+#: near-lossless PSNR
+PARITY_LOSSY = (
+    "cpvs/P2SXM92_SRC000_HRC000_MO.mp4",
+    "cpvs/P2SXM92_SRC000_HRC002_MO.mp4",
+)
+
+PARITY_ARTIFACTS = PARITY_EXACT + PARITY_LOSSY
+
+
+@pytest.fixture(scope="module")
+def fused_vs_staged(tmp_path_factory):
+    """One short database through the chain twice — staged then fused —
+    with the staged artifacts stashed and the decoder-open counts of
+    the p03+p04 phase recorded for each mode."""
+    tmp = tmp_path_factory.mktemp("fuseddb")
+    yaml_path = write_db(tmp, "P2SXM92", SHORT_YAML,
+                         {"SRC000.avi": dict(n=48)})
+    db = os.path.dirname(yaml_path)
+    rc = cli_main(["p01", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+    rc = cli_main(["p02", "-c", yaml_path, "--skip-requirements"])
+    assert rc == 0
+
+    import hashlib
+
+    from processing_chain_tpu.io import video as vid
+
+    def p03_p04(fused: bool) -> tuple:
+        """Run p03+p04 in one mode; returns (decoder opens, per-mp4
+        encoder-input sha1) — the hash is taken at write time on the
+        writer thread, i.e. the exact bytes libx264 consumed."""
+        for d in ("avpvs", "cpvs"):
+            shutil.rmtree(os.path.join(db, d), ignore_errors=True)
+        os.environ["PC_FUSE_P04"] = "1" if fused else "0"
+        tm.enable()
+        before = tm.REGISTRY.sum_series(
+            "chain_io_decoder_opens_total", None) or 0.0
+        enc_hashes: dict = {}
+        orig_wb = vid.VideoWriter.write_batch
+
+        def hashing_wb(self, *planes):
+            if self.path.endswith(".mp4"):
+                h = enc_hashes.setdefault(
+                    os.path.basename(self.path), hashlib.sha1()
+                )
+                for p in planes:
+                    h.update(np.ascontiguousarray(np.asarray(p)).tobytes())
+            return orig_wb(self, *planes)
+
+        vid.VideoWriter.write_batch = hashing_wb
+        try:
+            assert cli_main(
+                ["p03", "-c", yaml_path, "--skip-requirements"]) == 0
+            assert cli_main(
+                ["p04", "-c", yaml_path, "--skip-requirements"]) == 0
+        finally:
+            vid.VideoWriter.write_batch = orig_wb
+            os.environ.pop("PC_FUSE_P04", None)
+        after = tm.REGISTRY.sum_series(
+            "chain_io_decoder_opens_total", None) or 0.0
+        tm.disable()
+        return (int(after - before),
+                {k: h.hexdigest() for k, h in enc_hashes.items()})
+
+    staged_opens, staged_hashes = p03_p04(fused=False)
+    ref_dir = os.path.join(db, "staged_ref")
+    os.makedirs(ref_dir, exist_ok=True)
+    for rel in PARITY_ARTIFACTS:
+        shutil.copy(os.path.join(db, rel),
+                    os.path.join(ref_dir, rel.replace("/", "_")))
+    fused_opens, fused_hashes = p03_p04(fused=True)
+    return {"db": db, "yaml": yaml_path, "ref_dir": ref_dir,
+            "staged_opens": staged_opens, "fused_opens": fused_opens,
+            "staged_hashes": staged_hashes, "fused_hashes": fused_hashes}
+
+
+def _decoded(path):
+    with VideoReader(path) as r:
+        return r.read_all()[0]
+
+
+def _psnr(a: np.ndarray, b: np.ndarray) -> float:
+    mse = np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2)
+    return 10 * np.log10(255.0 ** 2 / max(mse, 1e-12))
+
+
+def test_fused_artifacts_decode_identical_to_staged(fused_vs_staged):
+    db, ref_dir = fused_vs_staged["db"], fused_vs_staged["ref_dir"]
+    for rel in PARITY_EXACT:
+        got = _decoded(os.path.join(db, rel))
+        ref = _decoded(os.path.join(ref_dir, rel.replace("/", "_")))
+        assert len(got) == len(ref), rel
+        for g, f in zip(got, ref):
+            np.testing.assert_array_equal(g, f, err_msg=rel)
+
+
+def test_fused_feeds_identical_bytes_to_the_lossy_encoders(fused_vs_staged):
+    """The real parity invariant for the x264 family: the fused
+    pipeline hands libx264 the EXACT bytes the staged re-decode path
+    does (write-thread sha1 per output). The encoded streams are then
+    compared at near-lossless PSNR because libx264 itself is
+    nondeterministic at fixed settings on this host class — measured on
+    the STAGED path alone (fresh-process staged runs occasionally emit
+    different streams from byte-identical input), so stream equality
+    cannot be the contract for either path."""
+    staged, fused = (fused_vs_staged["staged_hashes"],
+                     fused_vs_staged["fused_hashes"])
+    assert staged and set(staged) == set(fused)
+    assert staged == fused
+    db, ref_dir = fused_vs_staged["db"], fused_vs_staged["ref_dir"]
+    for rel in PARITY_LOSSY:
+        got = _decoded(os.path.join(db, rel))
+        ref = _decoded(os.path.join(ref_dir, rel.replace("/", "_")))
+        assert len(got) == len(ref), rel
+        for g, f in zip(got, ref):
+            assert g.shape == f.shape, rel
+            assert _psnr(g, f) >= 45.0, rel
+
+
+def test_fused_run_eliminates_the_redecodes(fused_vs_staged):
+    """The measurable invariant: staged p03+p04 re-decodes the AVPVS
+    once for the stalling pass and once per CPVS context; the fused run
+    opens decoders only for the SRC-side segment decodes."""
+    staged, fused = (fused_vs_staged["staged_opens"],
+                     fused_vs_staged["fused_opens"])
+    # staged: 2 segment decodes + apply_stalling (probe + gather = 2)
+    #         + 4 CPVS decodes = 8; fused: 2 segment decodes
+    assert fused < staged
+    assert fused == 2, (staged, fused)
+
+
+def test_fused_long_single_device_parity_with_audio_resample_preview(
+        tmp_path):
+    """The per-PVS (single-device) fused path on a LONG test: stalled
+    audio with silence insertion, the pc display-rate resample (30 vs
+    the 60 fps canvas), and the ProRes preview — all decoded-identical
+    to the staged render."""
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2LTR01
+        syntaxVersion: 6
+        type: long
+        segmentDuration: 1
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24, audioCodec: aac, audioBitrate: 96}
+          Q1: {index: 1, videoCodec: h264, videoBitrate: 500, width: 320, height: 180, fps: 24, audioCodec: aac, audioBitrate: 96}
+        codingList:
+          VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+          AC01: {type: audio, encoder: aac}
+        srcList:
+          SRC001: SRC001.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            audioCodingId: AC01
+            eventList: [[Q0, 1], [stall, 0.5], [Q1, 1]]
+        pvsList:
+          - P2LTR01_SRC001_HRC000
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 30}
+        """)
+    yaml_path = write_db(tmp_path, "P2LTR01", yaml_text,
+                         {"SRC001.avi": dict(n=48, audio=True)})
+    db = os.path.dirname(yaml_path)
+    assert cli_main(
+        ["p00", "-c", yaml_path, "-str", "1234", "--skip-requirements"]
+    ) == 0
+    assert cli_main(["p04", "-c", yaml_path, "-e",
+                     "--skip-requirements"]) == 0
+
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.models import avpvs as av
+    from processing_chain_tpu.utils.parse_args import _DEFAULT_SPINNER
+
+    tc = TestConfig(yaml_path)
+    pvs = next(iter(tc.pvses.values()))
+    artifacts = {
+        "stalled": pvs.get_avpvs_file_path(),
+        "cpvs": pvs.get_cpvs_file_path(context="pc"),
+        "preview": pvs.get_preview_file_path(),
+    }
+    staged = {}
+    for key, path in artifacts.items():
+        with VideoReader(path) as r:
+            video, _ = r.read_all()
+        staged[key] = (video, medialib.decode_audio_s16(path))
+    for d in ("avpvs", "cpvs"):
+        for f in glob.glob(os.path.join(db, d, "*")):
+            os.unlink(f)
+
+    fanout = fused_mod.FusedFanout(
+        pvs, spinner_path=_DEFAULT_SPINNER, preview=True
+    )
+    av.create_avpvs_wo_buffer(pvs, fanout=fanout).run()
+    assert fanout.engaged
+
+    for key, path in artifacts.items():
+        with VideoReader(path) as r:
+            video, _ = r.read_all()
+        audio = medialib.decode_audio_s16(path)
+        ref_video, ref_audio = staged[key]
+        assert len(video) == len(ref_video), key
+        for g, f in zip(video, ref_video):
+            if key == "preview":
+                # ProRes is lossy: hold the near-lossless bound rather
+                # than stream equality (the x264 doctrine — encoder
+                # nondeterminism at fixed settings exists on this host
+                # class independent of fusion)
+                assert g.shape == f.shape and _psnr(g, f) >= 55.0, key
+            else:
+                np.testing.assert_array_equal(g, f, err_msg=key)
+        assert audio[0].shape == ref_audio[0].shape, key
+        assert audio[1] == ref_audio[1]
+        if key == "preview":  # AAC: same near-lossless stance
+            diff = np.abs(audio[0].astype(np.int32)
+                          - ref_audio[0].astype(np.int32))
+            assert float(diff.mean()) < 50.0, key
+        else:  # pcm_s16le: exact
+            np.testing.assert_array_equal(
+                audio[0], ref_audio[0], err_msg=key)
+
+
+# ------------------------------------------------------- store contract
+
+
+def _planned_jobs() -> float:
+    return tm.REGISTRY.sum_series("chain_jobs_planned_total", None) or 0.0
+
+
+def test_fused_warm_store_plans_zero_and_partial_rebuilds_exactly_one(
+        tmp_path, monkeypatch):
+    """Memoization contract of the fused run: every member artifact
+    commits under its existing plan hash, so a warm re-run plans ZERO
+    jobs, and invalidating one CPVS context rebuilds exactly that CPVS
+    through the legacy partial path."""
+    yaml_path = write_db(tmp_path, "P2SXM92", SHORT_YAML,
+                         {"SRC000.avi": dict(n=48)})
+    monkeypatch.setenv("PC_STORE_DIR", str(tmp_path / "store"))
+    monkeypatch.setenv("PC_FUSE_P04", "1")
+    tm.enable()
+    assert cli_main(
+        ["p00", "-c", yaml_path, "-str", "1234", "--skip-requirements"]
+    ) == 0
+
+    # warm: the fused run committed AVPVS + stalled + every CPVS, so a
+    # full p03+p04 re-run plans nothing
+    before = _planned_jobs()
+    assert cli_main(["p03", "-c", yaml_path, "--skip-requirements"]) == 0
+    assert cli_main(["p04", "-c", yaml_path, "--skip-requirements"]) == 0
+    assert _planned_jobs() - before == 0
+
+    # single-context invalidation: corrupt ONE pc-context CPVS's store
+    # object — that plan converts to a miss and rebuilds; everything
+    # else stays warm. (The pc context is rawvideo: its rebuild is
+    # byte-identical, so the PC_PLAN_DEBUG same-plan/same-bytes gate
+    # stays clean — an x264 context would trip the suite-wide recorder
+    # on the encoder's own fixed-settings nondeterminism.)
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.models import cpvs as cp
+
+    store = store_runtime.active()
+    assert store is not None
+    tc = TestConfig(yaml_path)
+    pvs = tc.pvses["P2SXM92_SRC000_HRC000"]
+    target_pp = next(
+        pp for pp in tc.post_processings if pp.processing_type == "pc"
+    )
+    job = cp.create_cpvs(pvs, target_pp)
+    manifest = store.lookup(store.plan_hash(job.plan))
+    assert manifest is not None
+    obj = store.object_path(manifest.object["sha256"])
+    os.chmod(obj, 0o644)
+    with open(obj, "r+b") as f:
+        f.write(b"\x00" * 16)
+
+    before = _planned_jobs()
+    assert cli_main(["p03", "-c", yaml_path, "--skip-requirements"]) == 0
+    assert cli_main(["p04", "-c", yaml_path, "--skip-requirements"]) == 0
+    assert _planned_jobs() - before == 1
+
+
+# -------------------------------------------------- attribution verdict
+
+
+def test_attribution_refuses_decode_bound_without_decoder_opens():
+    """A stage whose decoder-opens delta is ZERO must not classify its
+    consumer-blocked seconds as decode (the fused fan-out feeds
+    in-memory streams); with opens recorded the verdict stands."""
+    from processing_chain_tpu.telemetry.profiling import attribute_run
+
+    def stage_end(stage, opens):
+        return {
+            "event": "stage_end", "stage": stage, "duration_s": 20.0,
+            "decoder_opens": opens,
+            "components": {"decode": 10.0, "encode": 1.0,
+                           "transfer": 0.5, "compute": 0.5},
+        }
+
+    verdicts = attribute_run({}, [stage_end("p04", 0)])
+    assert verdicts["p04"]["verdict"] != "decode_bound"
+    assert verdicts["p04"]["decode_reattributed"] is True
+
+    verdicts = attribute_run({}, [stage_end("p04", 5)])
+    assert verdicts["p04"]["verdict"] == "decode_bound"
+    assert "decode_reattributed" not in verdicts["p04"]
+
+    # pre-PR events without the field keep their verdicts untouched
+    rec = stage_end("p04", 0)
+    del rec["decoder_opens"]
+    verdicts = attribute_run({}, [rec])
+    assert verdicts["p04"]["verdict"] == "decode_bound"
+
+
+def test_fused_fanout_abort_removes_partial_outputs(tmp_path):
+    """A fused render that dies mid-stream must leave no partial CPVS
+    behind (the batch-path sweep calls abort)."""
+    yaml_path = write_db(tmp_path, "P2SXM92", SHORT_YAML,
+                         {"SRC000.avi": dict(n=48)})
+    assert cli_main(["p01", "-c", yaml_path, "--skip-requirements"]) == 0
+    from processing_chain_tpu.config import TestConfig
+    from processing_chain_tpu.models import avpvs as av
+
+    tc = TestConfig(yaml_path)
+    pvs = tc.pvses["P2SXM92_SRC000_HRC002"]
+    fanout = fused_mod.FusedFanout(pvs, spinner_path=None)
+    boom = RuntimeError("mid-stream failure")
+    fanout.feed = lambda planes: (_ for _ in ()).throw(boom)
+    job = av.create_avpvs_wo_buffer(pvs, fanout=fanout)
+    with pytest.raises(RuntimeError):
+        job.run()
+    outs = [j.output_path for j in fanout.member_jobs()]
+    assert outs
+    for out in outs:
+        assert not os.path.isfile(out), out
+        assert not os.path.isfile(out + ".inprogress"), out

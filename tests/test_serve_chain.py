@@ -173,6 +173,58 @@ def test_real_database_serves_every_artifact_family(serve_factory,
     assert doc2["latency_ms"] is not None
 
 
+def _planned_for_runner(runner: str) -> int:
+    metric = tm.REGISTRY.snapshot().get("chain_jobs_planned_total")
+    if not metric:
+        return 0
+    return int(sum(
+        s.get("value", 0) for s in metric["series"]
+        if s.get("labels", {}).get("runner") == runner
+    ))
+
+
+def test_fused_executor_renders_cpvs_in_the_p03_pass(serve_factory,
+                                                     tmp_path,
+                                                     monkeypatch):
+    """PC_FUSE_P04 through the production executor: the p03 pass
+    renders the stalling pass + every CPVS from the in-memory stream
+    (models/fused), so serve-p04 plans ZERO jobs while the manifest
+    still names every family — a chain wave stops paying the
+    re-decode."""
+    from tests.test_pipeline_e2e import write_db
+
+    monkeypatch.setenv("PC_FUSE_P04", "1")
+    svc = serve_factory(subdir="serve-fused", workers=2)
+    # a PRISTINE database copy: the module db already holds artifacts
+    # from earlier tests, which a fresh store would adopt instead of
+    # rendering — adoption would mask the fused path entirely
+    db_path = write_db(tmp_path / "fuseddb", DB_ID, DB_YAML,
+                       {"SRC000.avi": dict(n=48)})
+    p04_before = _planned_for_runner("serve-p04")
+    p03_before = _planned_for_runner("serve-p03")
+    accepted = svc.submit({
+        "tenant": "fusedco", "database": DB_ID,
+        "srcs": ["SRC000"], "hrcs": ["HRC000", "HRC001"],
+        "params": {"config": db_path},
+    })
+    assert svc.wait_request(accepted["request"], timeout=300.0) == "done"
+    doc = svc.request_status(accepted["request"])
+    for pvs_id, unit in doc["units"].items():
+        manifest = json.loads(_fetch(svc.server.url + unit["artifact"]))
+        families = manifest["artifacts"]
+        assert set(families) == {"segments", "metadata", "avpvs", "cpvs"}
+        assert families["cpvs"], pvs_id
+        for entry in families["cpvs"]:
+            m = svc.store.lookup(entry["plan"])
+            assert m is not None, (pvs_id, entry)
+            svc.store.verify_object(m.object)
+        # the stalled HRC's avpvs rode the fused render too
+        m = svc.store.lookup(families["avpvs"]["plan"])
+        assert m is not None and svc.store.lookup(families["avpvs"]["plan"])
+    assert _planned_for_runner("serve-p03") > p03_before
+    assert _planned_for_runner("serve-p04") == p04_before
+
+
 def test_chain_grid_validates_at_the_front_door(serve_factory, chain_db):
     """Grid cells the database does not define are a 400 at POST time
     — never a durable record, never a quarantine."""

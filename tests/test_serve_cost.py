@@ -414,3 +414,94 @@ def test_attach_stamps_cost_on_prefix_era_records(tmp_path):
         assert reread.cost_s == pytest.approx(5.0)
     finally:
         queue.close()
+
+
+# ------------------------------------------------- per-host calibration
+
+
+@pytest.fixture(autouse=True)
+def _reset_calibration():
+    cost.reset_calibration()
+    yield
+    cost.reset_calibration()
+
+
+def test_fit_scale_median_clamp_and_min_samples():
+    # median, robust against the warm-adjacent tail
+    fitted = cost.fit_scale([0.5, 0.5, 0.5, 40.0], min_samples=4)
+    assert fitted == {"scale": 0.5, "n": 4}
+    # too thin a ring: refuse to fit
+    assert cost.fit_scale([1.0] * 3, min_samples=4) is None
+    # non-finite / non-positive samples are discarded before the gate
+    assert cost.fit_scale([float("nan"), -1.0, 0.0, 2.0],
+                          min_samples=2) is None
+    # clamp: one pathological soak cannot 100x the admission gate
+    assert cost.fit_scale([1000.0] * 8, min_samples=8)["scale"] == 10.0
+    assert cost.fit_scale([1e-6] * 8, min_samples=8)["scale"] == 0.1
+
+
+def test_calibration_scales_every_prediction():
+    ex = SyntheticExecutor()
+    unit = {"params": {"work_ms": 1000}}
+    base = cost.predict_unit_cost(ex, unit)
+    cost.set_calibration(2.0, n=64)
+    assert cost.predict_unit_cost(ex, unit) == pytest.approx(2.0 * base)
+    assert cost.calibration() == {"scale": 2.0, "n": 64}
+    cost.reset_calibration()
+    assert cost.predict_unit_cost(ex, unit) == pytest.approx(base)
+
+
+def test_ledger_calibrate_composes_with_the_scale_in_force():
+    """The ring's ratios were observed against predictions that already
+    carried the current scale, so a refit composes multiplicatively —
+    a perfectly calibrated host (median ratio 1) is a fixed point."""
+    ledger = cost.CostLedger()
+    for _ in range(40):
+        ledger.observed("t", predicted_s=1.0, exec_s=2.0)
+    doc = ledger.calibrate(min_samples=32)
+    assert doc["scale"] == pytest.approx(2.0)
+    # second round: the hardware did not change, ratios now ~1
+    ledger2 = cost.CostLedger()
+    for _ in range(40):
+        ledger2.observed("t", predicted_s=2.0, exec_s=2.0)
+    doc = ledger2.calibrate(min_samples=32)
+    assert doc["scale"] == pytest.approx(2.0)  # fixed point
+    # a thin ring refuses and keeps the scale put
+    assert cost.CostLedger().calibrate() is None
+    assert cost.calibration()["scale"] == pytest.approx(2.0)
+
+
+def test_ledger_calibrate_drains_the_ring_no_compounding():
+    """A successful refit consumes its ratios: they were observed
+    against the PREVIOUS scale, and the periodic --cost-calibrate tick
+    re-fitting the same ring would compound the same correction every
+    second (2.0 -> 4.0 -> 8.0 -> clamp) until fresh samples trickled
+    in. After a refit the next tick must be a no-op until min_samples
+    new observations arrive."""
+    ledger = cost.CostLedger()
+    for _ in range(40):
+        ledger.observed("t", predicted_s=1.0, exec_s=2.0)
+    doc = ledger.calibrate(min_samples=32)
+    assert doc["scale"] == pytest.approx(2.0)
+    # the tick fires again before any new unit settles: no compounding
+    assert ledger.calibrate(min_samples=32) is None
+    assert cost.calibration()["scale"] == pytest.approx(2.0)
+    assert ledger.ratios() == []
+    # fresh post-refit observations re-arm the refit and compose
+    for _ in range(40):
+        ledger.observed("t", predicted_s=2.0, exec_s=3.0)
+    doc = ledger.calibrate(min_samples=32)
+    assert doc["scale"] == pytest.approx(3.0)
+
+
+def test_service_reports_calibration_and_tick_refits(serve_factory):
+    svc = serve_factory(subdir="serve-cal", cost_calibrate=True)
+    for _ in range(cost.CALIBRATION_MIN_SAMPLES):
+        svc.cost_ledger.observed("t", predicted_s=1.0, exec_s=3.0)
+    doc = svc.cost_ledger.calibrate()
+    assert doc is not None and doc["scale"] == pytest.approx(3.0)
+    body = json.loads(urllib.request.urlopen(
+        svc.server.url + "/status").read().decode())
+    cal = body["serve"]["cost"]["calibration"]
+    assert cal["enabled"] is True
+    assert cal["scale"] == pytest.approx(3.0)

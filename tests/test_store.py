@@ -396,6 +396,51 @@ def test_warm_run_skips_everything_and_param_flip_rebuilds_downstream(tmp_path):
     assert executed == []
 
 
+def test_two_destinations_one_plan_hash_stay_warm(tmp_path):
+    """Two jobs with IDENTICAL plans but different output paths (the
+    real chain's shape: sibling HRCs whose wo_buffer renders share one
+    plan) must stay warm forever. Regression: `_materialize_one`'s
+    tmp-link + os.replace was a POSIX NO-OP when dest already WAS the
+    object's inode, stranding the `.store.<pid>.part` link; the next
+    materialize of that dest then failed EEXIST and converted the warm
+    hit into a spurious rebuild."""
+    import glob
+
+    store_runtime.configure(str(tmp_path / "store"))
+    out_dir = str(tmp_path / "db")
+    os.makedirs(out_dir)
+
+    def render_job(name, executed):
+        # identical plan AND identical bytes, two destinations — the
+        # sibling-HRC wo_buffer shape (one plan hash, two outputs)
+        out = os.path.join(out_dir, name + ".txt")
+
+        def fn():
+            executed.append(name)
+            write(out, "avpvs-bytes:1")
+            return out
+
+        return Job(label=name, output_path=out, fn=fn,
+                   plan={"op": "render", "param": 1})
+
+    def run_pair(executed):
+        r = JobRunner(parallelism=1, name="mini")
+        r.add(render_job("hrc000", executed))
+        r.add(render_job("hrc002_wo_buffer", executed))
+        r.run_serial()
+
+    executed: list = []
+    run_pair(executed)
+    assert executed  # cold pass really built something
+    # the warm flip-flop needed TWO warm passes to misfire: pass one
+    # strands the tmp link, pass two hits EEXIST and rebuilds
+    for _ in range(3):
+        executed = []
+        run_pair(executed)
+        assert executed == []
+        assert glob.glob(os.path.join(out_dir, "*.part")) == []
+
+
 def test_warm_run_restores_deleted_outputs_without_executing(tmp_path):
     store_runtime.configure(str(tmp_path / "store"))
     out_dir = str(tmp_path / "db")
