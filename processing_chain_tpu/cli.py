@@ -178,7 +178,10 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
                 "p04": p04_generate_cpvs,
             }[stage]
             test_config = mod.run(args)
-    except (ConfigError, ChainError) as exc:
+    except (ConfigError, ChainError, MediaError) as exc:
+        # MediaError is a CLASSIFIED native-boundary failure (corrupt
+        # input, injected fault — it names path + stream frame, docs/
+        # ROBUSTNESS.md): a user-grade error exit, not a traceback
         status = "fail"
         log_mod.get_logger().error("%s", exc)
         return 1
@@ -250,7 +253,8 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
         "src-analysis", "complexity", "priors", "plots", "metrics",
         "clean-logs", "run-report", "store", "chain-top", "chain-profile",
         "bench-compare", "chain-lint", "chain-serve", "serve-soak",
-        "queue-crashcheck", "serve-chaos", "fleet-top", "trace",
+        "queue-crashcheck", "serve-chaos", "media-crashcheck",
+        "serve-admin", "fleet-top", "trace",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -306,6 +310,14 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import serve_chaos
 
             return serve_chaos.main(rest)
+        if name == "media-crashcheck":
+            from .tools import media_crashcheck
+
+            return media_crashcheck.main(rest)
+        if name == "serve-admin":
+            from .tools import serve_admin
+
+            return serve_admin.main(rest)
         if name == "src-analysis":
             from .tools import src_analysis
 

@@ -231,7 +231,16 @@ class Src:
         self.pvses: set[Pvs] = set()
         self.segments: set[Segment] = set()
         self.duration: Optional[float] = None
-        self.stream_info: Optional[dict] = None
+        self._stream_info: Optional[dict] = None
+        #: deferred probe failure (docs/ROBUSTNESS.md): a SRC whose
+        #: bytes the decoder rejects must not fail the WHOLE config
+        #: parse — it fails the units that touch it, when they touch it
+        self.probe_error: Optional[BaseException] = None
+        #: stat signature (size, mtime_ns) of the bytes the deferred
+        #: verdict was issued against: a REPLACED upload (the re-arm
+        #: workflow) must earn a fresh probe on a long-lived parse, not
+        #: inherit the old bytes' conviction
+        self._probe_stat: Optional[tuple] = None
 
         if isinstance(data, str):
             self.filename = data
@@ -280,11 +289,87 @@ class Src:
             get_logger().debug("SRC %s found in local srcVid folder", self.filename)
             self.file_path = local
 
+    def _stat_sig(self) -> Optional[tuple]:
+        try:
+            st = os.stat(self.file_path)
+            return (st.st_size, st.st_mtime_ns)
+        except OSError:
+            return None
+
     def locate_and_get_info(self) -> None:
+        from ..io.medialib import MediaError
+
+        if self._stream_info is not None:
+            return  # one probe per Src, even across its PVSes
+        if self.probe_error is not None and \
+                self._stat_sig() == self._probe_stat:
+            return  # same bytes, same deferred verdict
+        healing = self.probe_error is not None
         self.locate_src_file()
-        self.stream_info = self.test_config.prober.src_info(
-            self.file_path, self.info_path
-        )
+        try:
+            self._stream_info = self.test_config.prober.src_info(
+                self.file_path, self.info_path
+            )
+            self.probe_error = None
+            if healing:
+                # the repaired bytes may disagree with the yuv420p
+                # stand-in the parse minted for the unprobeable SRC
+                # (Segment._set_pix_fmt): re-derive from the live probe
+                # so plans minted after the heal carry honest knobs
+                for seg in self.segments:
+                    seg._set_pix_fmt()
+        except MediaError as exc:
+            # DEFERRED: a hostile/corrupt SRC must poison only the
+            # units that reference it, not 400 every request against
+            # the database (serve) or kill a whole batch run at parse.
+            # Consumers hit the classified re-raise in `stream_info`.
+            self.probe_error = exc
+            self._probe_stat = self._stat_sig()
+            get_logger().warning(
+                "SRC %s is unprobeable (%s) — deferring the failure to "
+                "the units that touch it", self.filename,
+                str(exc)[:200],
+            )
+
+    @property
+    def stream_info(self) -> dict:
+        """The probed video-stream info. For an unprobeable SRC this
+        raises the deferred verdict — classified `poison` (the decoder
+        rejected the BYTES; retrying them is futile, serve quarantines
+        the content digest) with the path forensics every media error
+        carries (docs/ROBUSTNESS.md)."""
+        if self._stream_info is None and self.probe_error is not None:
+            from ..io.medialib import MediaError
+
+            if self._stat_sig() != self._probe_stat:
+                # the bytes changed since the verdict (repaired upload
+                # on a long-lived cached parse): re-probe before
+                # re-raising a conviction about bytes that are gone. A
+                # re-probe that fails in a NEW way (file deleted, …)
+                # falls through to the deferred-verdict raise below.
+                try:
+                    self.locate_and_get_info()
+                except Exception:  # noqa: BLE001 - heal is best-effort
+                    pass
+            if self._stream_info is not None:
+                return self._stream_info
+            raise MediaError(
+                f"SRC {self.file_path} is unprobeable: "
+                f"{str(self.probe_error)[:500]}",
+                kind="poison",
+            ) from self.probe_error
+        if self._stream_info is None:
+            from ..io.medialib import MediaError
+
+            raise MediaError(
+                f"SRC {self.file_path} was never probed "
+                "(locate_and_get_info not called)"
+            )
+        return self._stream_info
+
+    @stream_info.setter
+    def stream_info(self, value: Optional[dict]) -> None:
+        self._stream_info = value
 
     def uses_10_bit(self) -> bool:
         pix_fmt = self.stream_info["pix_fmt"]
@@ -292,6 +377,8 @@ class Src:
 
     def get_duration(self) -> float:
         if self.duration is None:
+            if self.probe_error is not None:
+                self.stream_info  # raises the deferred classified verdict
             self.duration = float(
                 self.test_config.prober.duration(self.file_path, self.info_path)
             )
@@ -488,6 +575,14 @@ class Segment:
         if self.src.is_youtube:
             self.target_pix_fmt = "yuv420p"
             return
+        if self.src.probe_error is not None:
+            # unprobeable SRC (deferred poison, Src.stream_info): a
+            # deterministic stand-in — this segment never encodes; its
+            # units fail classified the moment a stage touches the
+            # bytes, and the plan needs SOME total pixel format so the
+            # serve front door can enqueue them (docs/ROBUSTNESS.md)
+            self.target_pix_fmt = "yuv420p"
+            return
         src_pix_fmt = self.src.stream_info["pix_fmt"]
         if "444" in src_pix_fmt or "422" in src_pix_fmt or "rgb" in src_pix_fmt:
             self.target_pix_fmt = "yuv422p"
@@ -667,7 +762,11 @@ class Pvs:
         self.hrc = hrc
         self.segments: list[Segment] = []
 
-        if not src.is_youtube:
+        # the upscale gate needs probed geometry; an unprobeable SRC
+        # (deferred poison, see Src.stream_info) skips it — the units
+        # fail classified when a stage touches the bytes instead of
+        # failing the whole parse here
+        if not src.is_youtube and src.probe_error is None:
             max_width, _ = hrc.get_max_res()
             src_width = src.stream_info["width"]
             if src_width < max_width:

@@ -403,7 +403,12 @@ class TestConfig:
         for pvs in self.pvses.values():
             src_length: Optional[float] = None
             if not pvs.src.is_youtube:
-                if pvs.hrc.event_list[0].duration != "src_duration":
+                # an unprobeable SRC (deferred poison, config/domain.py
+                # Src.stream_info) skips the advisory duration check —
+                # its units fail classified at execution instead of
+                # failing the whole parse here
+                if pvs.hrc.event_list[0].duration != "src_duration" \
+                        and pvs.src.probe_error is None:
                     src_length = float(pvs.src.get_duration())
                     total = sum(
                         e.duration

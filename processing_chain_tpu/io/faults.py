@@ -1,0 +1,467 @@
+"""Deterministic media-fault injection + decode/encode deadlines.
+
+The native boundary (io/medialib, io/video) is where hostile bytes
+meet the chain: a truncated SRC surfaces as a mid-stream decode error,
+a decompression bomb as a hang, a full disk as a failed encode write.
+Those paths are exactly the ones ordinary tests never exercise —
+real corrupt files are fiddly to author and hangs are untestable
+without a clock. This module makes every one of those failures a
+DETERMINISTIC, scriptable event, the same way PC_LOCK_DEBUG makes lock
+inversions observable and PC_PLAN_DEBUG makes cache poisoning
+observable (docs/ROBUSTNESS.md):
+
+  * ``PC_MEDIA_FAULTS`` — a fault spec consulted when a decoder or
+    encoder OPENS (never per frame): zero cost when unset, one dict
+    lookup per open when set. Tests, CI (`media-fault-smoke`) and the
+    chaos harnesses drive it; production never sets it.
+  * ``PC_MEDIA_DEADLINE_S`` — a wall-clock budget for every native
+    decode/encode crossing. Python cannot interrupt a hung native
+    call, so the guarded call runs on a daemon thread and an expiry
+    ABANDONS it (handle deliberately leaked — closing a handle another
+    thread is still inside would be a use-after-free), records
+    watchdog-grade forensics (all-thread stack dump, the PR 3
+    `dump_all_stacks`), and raises ``MediaDeadlineExpired``
+    (kind="transient") — the worker dies, the replica keeps serving.
+
+Fault spec grammar (semicolon-separated clauses)::
+
+    PC_MEDIA_FAULTS="kind[@param=value[,param=value...]][;kind@...]"
+
+    decode-error   @ frame=N [,match=SUBSTR] [,times=K]
+        the decode crossing that would produce frame N raises a
+        MediaError instead (the truncated-mid-GOP shape)
+    short-read     @ frame=N [,match=SUBSTR] [,times=K]
+        the decoder reports EOF at frame N with NO error — the silent
+        truncation shape (container promised more; decoder just ends)
+    hang           @ seconds=S [,op=decode|encode] [,frame=N]
+                     [,match=SUBSTR] [,times=K]
+        the native crossing sleeps S seconds (uninterruptible from the
+        caller's thread, exactly like a real wedged decoder) — the
+        deadline self-test's trigger
+    geometry-flip  @ frame=N [,match=SUBSTR] [,times=K]
+        raises the native boundary's own mid-stream geometry-change
+        rejection shape (media.cpp fails loudly on w/h/format flips)
+    enospc         @ [frame=N] [,match=SUBSTR] [,times=K]
+        the encode write raises OSError(ENOSPC) — the full-disk shape
+        the store-commit and fused-fan-out degrade paths must survive
+
+``match`` filters by path substring (absent = every path); ``times``
+caps how often the clause fires process-wide (default 1 — a fault that
+fired once lets the retry succeed, which is what the staged-fallback
+and transient-retry tests need; 0 = unlimited). Every fault surfaces
+as an exception or an early EOF — never a silently altered committed
+artifact — which is what keeps the knob plan-exempt
+(store/plan_schema.py): an aborted execution commits nothing.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .. import telemetry as tm
+from ..utils import lockdebug
+from .medialib import MediaError
+
+_FAULTS_INJECTED = tm.counter(
+    "chain_media_faults_injected_total",
+    "PC_MEDIA_FAULTS clauses fired, by fault kind",
+    ("kind",),
+)
+_DEADLINE_EXPIRED = tm.counter(
+    "chain_media_deadline_expired_total",
+    "native decode/encode crossings abandoned past PC_MEDIA_DEADLINE_S",
+)
+
+_KINDS = ("decode-error", "short-read", "hang", "geometry-flip", "enospc")
+
+#: per-clause fire counts, process-wide (keyed by (spec, clause index))
+#: so `times=1` semantics survive re-parsing the same spec at every
+#: decoder open
+_FIRED_LOCK = lockdebug.make_lock("media_faults")
+_FIRED: dict[tuple, int] = {}  # guarded-by: _FIRED_LOCK
+
+
+class FaultSpecError(ValueError):
+    """A malformed PC_MEDIA_FAULTS value. Raised at the first decoder/
+    encoder open so a typo'd chaos run fails loudly instead of running
+    faultless and 'proving' robustness it never tested."""
+
+
+@dataclass(frozen=True)
+class FaultClause:
+    kind: str
+    frame: Optional[int] = None
+    seconds: float = 0.0
+    op: str = "any"            # decode | encode | any (hang only)
+    match: str = ""
+    times: int = 1             # 0 = unlimited
+    index: int = 0             # position in the spec (fire-count key)
+    spec: str = field(default="", compare=False)
+
+    def matches_path(self, path: str) -> bool:
+        return self.match in path if self.match else True
+
+    def fire(self) -> bool:
+        """Consume one firing; False when the times budget is spent."""
+        key = (self.spec, self.index)
+        with _FIRED_LOCK:
+            fired = _FIRED.get(key, 0)
+            if self.times and fired >= self.times:
+                return False
+            _FIRED[key] = fired + 1
+        _FAULTS_INJECTED.labels(kind=self.kind).inc()
+        return True
+
+
+def reset_fire_counts() -> None:
+    """Test hook: forget which clauses already fired."""
+    with _FIRED_LOCK:
+        _FIRED.clear()
+
+
+def _parse_clause(text: str, index: int, spec: str) -> FaultClause:
+    kind, _, params_text = text.partition("@")
+    kind = kind.strip()
+    if kind not in _KINDS:
+        raise FaultSpecError(
+            f"PC_MEDIA_FAULTS: unknown fault kind {kind!r} "
+            f"(known: {', '.join(_KINDS)})"
+        )
+    params: dict = {}
+    for part in filter(None, (p.strip() for p in params_text.split(","))):
+        key, eq, value = part.partition("=")
+        if not eq:
+            raise FaultSpecError(
+                f"PC_MEDIA_FAULTS: clause {text!r}: parameter {part!r} "
+                "is not key=value"
+            )
+        params[key.strip()] = value.strip()
+    try:
+        frame = int(params.pop("frame")) if "frame" in params else None
+        seconds = float(params.pop("seconds", 0.0))
+        times = int(params.pop("times", 1))
+    except ValueError as exc:
+        raise FaultSpecError(
+            f"PC_MEDIA_FAULTS: clause {text!r}: {exc}"
+        ) from exc
+    op = params.pop("op", "any")
+    match = params.pop("match", "")
+    if params:
+        raise FaultSpecError(
+            f"PC_MEDIA_FAULTS: clause {text!r}: unknown parameter(s) "
+            f"{sorted(params)}"
+        )
+    if kind == "hang" and seconds <= 0:
+        raise FaultSpecError(
+            f"PC_MEDIA_FAULTS: clause {text!r}: hang needs seconds=S > 0"
+        )
+    if op not in ("decode", "encode", "any"):
+        raise FaultSpecError(
+            f"PC_MEDIA_FAULTS: clause {text!r}: op must be decode|encode"
+        )
+    if kind in ("decode-error", "short-read", "geometry-flip") \
+            and frame is None:
+        frame = 0
+    return FaultClause(kind=kind, frame=frame, seconds=seconds, op=op,
+                       match=match, times=times, index=index, spec=spec)
+
+
+_PARSE_LOCK = lockdebug.make_lock("media_faults_parse")
+_PARSED: dict[str, tuple] = {}  # guarded-by: _PARSE_LOCK
+
+
+def parse_spec(spec: str) -> tuple[FaultClause, ...]:
+    with _PARSE_LOCK:
+        cached = _PARSED.get(spec)
+    if cached is not None:
+        return cached
+    clauses = tuple(
+        _parse_clause(part, i, spec)
+        for i, part in enumerate(
+            filter(None, (p.strip() for p in spec.split(";")))
+        )
+    )
+    with _PARSE_LOCK:
+        _PARSED[spec] = clauses
+    return clauses
+
+
+def _active_spec() -> tuple[FaultClause, ...]:
+    # plan-exempt: (test/CI/chaos fault injection — every clause aborts the consuming execution (exception or EOF-kill) before any artifact commits; production never sets it. docs/ROBUSTNESS.md)
+    spec = os.environ.get("PC_MEDIA_FAULTS", "").strip()
+    if not spec:
+        return ()
+    return parse_spec(spec)
+
+
+def _emit_injected(clause: FaultClause, path: str,
+                   frame: Optional[int]) -> None:
+    tm.emit("media_fault_injected", kind=clause.kind,
+            path=os.path.basename(path), frame=frame)
+
+
+class _PathFaults:
+    """Clauses matching one open path, with a stream frame cursor."""
+
+    def __init__(self, path: str, clauses: tuple) -> None:
+        self.path = path
+        self.clauses = clauses
+        self.pos = 0  # frames already delivered/consumed
+
+    def hang(self, op: str) -> None:
+        """Injected native hang. Call this INSIDE the deadline-guarded
+        crossing (io/video wraps it with the native call): a real
+        wedged native call does not poll cancellation flags, so neither
+        does this one — only the deadline (or the isolation
+        subprocess's kill) gets past it."""
+        for clause in self.clauses:
+            if clause.kind != "hang" or clause.op not in (op, "any"):
+                continue
+            if clause.frame is not None and self.pos < clause.frame:
+                continue
+            if clause.fire():
+                _emit_injected(clause, self.path, self.pos)
+                time.sleep(clause.seconds)
+
+
+class DecoderFaults(_PathFaults):
+    """Decode-side injection. `check` runs before the native crossing:
+    a decode-error/geometry-flip whose frame falls inside the requested
+    window raises THERE (a real mid-stream error also eats the frames
+    the codec had buffered past the damage); a short-read reports EOF
+    once its frame is reached — the silent truncation shape — with the
+    window capped so exactly `frame` frames are ever delivered."""
+
+    def cap_frames(self, want: int) -> int:
+        for clause in self.clauses:
+            if clause.kind == "short-read" and \
+                    self.pos < clause.frame < self.pos + want:
+                want = clause.frame - self.pos
+        return want
+
+    def check(self, want: int) -> Optional[int]:
+        """Raise/EOF per the spec; returns 0 to short-circuit the
+        native call with an injected EOF, or None to proceed (then
+        call `advance(n)` with the real decoded count)."""
+        for clause in self.clauses:
+            if clause.kind in ("decode-error", "geometry-flip") and \
+                    clause.frame < self.pos + want:
+                if clause.fire():
+                    _emit_injected(clause, self.path, clause.frame)
+                    if clause.kind == "decode-error":
+                        raise MediaError(
+                            f"decode {self.path} @frame {clause.frame}: "
+                            "injected decode error (PC_MEDIA_FAULTS) — "
+                            "Invalid data found when processing input"
+                        )
+                    # the exact rejection shape media.cpp raises when a
+                    # hostile stream flips geometry mid-stream
+                    raise MediaError(
+                        f"decode {self.path} @frame {clause.frame}: "
+                        "injected mid-stream geometry change "
+                        "(PC_MEDIA_FAULTS): frame geometry/format "
+                        "changed mid-stream"
+                    )
+            elif clause.kind == "short-read" and self.pos >= clause.frame:
+                if clause.fire():
+                    _emit_injected(clause, self.path, clause.frame)
+                    return 0  # silent early EOF: the nasty shape
+        return None
+
+    def advance(self, n: int) -> None:
+        self.pos += n
+
+
+class EncoderFaults(_PathFaults):
+    def check(self, frames: int) -> None:
+        for clause in self.clauses:
+            if clause.kind != "enospc":
+                continue
+            if clause.frame is not None and \
+                    not (self.pos <= clause.frame < self.pos + max(1, frames)):
+                continue
+            if clause.fire():
+                _emit_injected(clause, self.path, self.pos)
+                raise OSError(
+                    errno.ENOSPC,
+                    "No space left on device (injected: PC_MEDIA_FAULTS)",
+                    self.path,
+                )
+        self.pos += frames
+
+
+def decoder_faults(path: str) -> Optional[DecoderFaults]:
+    """The decode-side fault plan for one open, or None (the common
+    case — one env lookup per OPEN, nothing per frame)."""
+    clauses = tuple(
+        c for c in _active_spec()
+        if c.matches_path(path)
+        and (c.kind != "enospc")
+        and (c.kind != "hang" or c.op in ("decode", "any"))
+    )
+    return DecoderFaults(path, clauses) if clauses else None
+
+
+def encoder_faults(path: str) -> Optional[EncoderFaults]:
+    clauses = tuple(
+        c for c in _active_spec()
+        if c.matches_path(path)
+        and c.kind in ("enospc", "hang")
+        and (c.kind != "hang" or c.op in ("encode", "any"))
+    )
+    return EncoderFaults(path, clauses) if clauses else None
+
+
+# ------------------------------------------------------------ deadlines
+
+
+class MediaDeadlineExpired(MediaError):
+    """A native decode/encode crossing exceeded PC_MEDIA_DEADLINE_S and
+    was abandoned. kind="transient" by construction: the input MAY be a
+    decompression bomb, but a loaded host produces the same symptom —
+    the serve taxonomy retries under the attempts budget, and the
+    PC_ISOLATE_DECODE first-contact gate is what upgrades repeat
+    offenders to poison (docs/ROBUSTNESS.md)."""
+
+    def __init__(self, *args) -> None:
+        super().__init__(*args, kind="transient")
+
+
+def media_deadline_s() -> Optional[float]:
+    """The per-crossing wall-clock budget, read at decoder/encoder OPEN
+    (None = unlimited, the default — zero added cost). A malformed
+    value fails LOUDLY (same philosophy as FaultSpecError): silently
+    running with no deadline while the operator believes hang
+    protection is on is the exact failure the knob exists to prevent."""
+    # plan-exempt: (wall-clock budget only: an expiry aborts the crossing with MediaDeadlineExpired before any artifact commits; the frames delivered by surviving crossings are identical at any budget)
+    raw = os.environ.get("PC_MEDIA_DEADLINE_S", "").strip()
+    if not raw:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        raise FaultSpecError(
+            f"PC_MEDIA_DEADLINE_S: {raw!r} is not a number of seconds"
+        ) from None
+    return value if value > 0 else None
+
+
+class GuardWorker:
+    """One persistent daemon worker for a reader/writer's guarded
+    crossings. `write()` crosses per FRAME — spawning a thread per
+    crossing would tax exactly the hot path the deadline protects, so
+    the owner keeps ONE worker for its lifetime. A deadline expiry
+    abandons the worker mid-call (the owner poisons itself and never
+    submits again — same leak semantics as the abandoned handle); a
+    clean close() stops it. Deliberately NOT a ThreadPoolExecutor: its
+    atexit hook JOINS workers, so a wedged native call would block
+    interpreter exit — the one thing the deadline exists to prevent."""
+
+    def __init__(self, name: str) -> None:
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, name=name[:60], daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, result, error, done = item
+            try:
+                result.append(fn())
+            except BaseException as exc:  # noqa: BLE001 - relayed to the caller
+                error.append(exc)
+            finally:
+                done.set()
+                # drop every task reference BEFORE blocking on the next
+                # get(): a worker abandoned after an expiry parks here
+                # forever, and locals still pinning the crossing's
+                # closure would pin its pooled destination blocks with
+                # it (the per-call thread died and dropped them; the
+                # persistent worker must shed them explicitly)
+                del fn, result, error, done, item
+
+    def submit(self, fn: Callable) -> tuple:
+        result: list = []
+        error: list = []
+        done = threading.Event()
+        self._q.put((fn, result, error, done))
+        return result, error, done
+
+    def stop(self) -> None:
+        """Clean shutdown (owner close). Never call after an expiry —
+        the sentinel would queue behind the wedged call forever, which
+        is harmless but pointless; abandoned workers just leak."""
+        self._q.put(None)
+
+
+def guarded_call(fn: Callable, deadline_s: Optional[float], *, op: str,
+                 path: str, frame: Optional[int] = None,
+                 worker: Optional[GuardWorker] = None):
+    """Run one native crossing under a wall-clock deadline. With no
+    deadline this is a direct call (the production path). With one, the
+    call runs on a DAEMON thread (a hung native call must never block
+    interpreter exit) — the caller's persistent `worker` when provided
+    (io/video owners reuse one across their per-frame/per-chunk
+    crossings), else a fresh thread — and an expiry abandons it:
+    forensics recorded through the watchdog's stack-dump surface, the
+    heartbeat finished as "timeout", MediaDeadlineExpired raised to the
+    caller — whose owner must then poison the handle (io/video marks
+    the reader/writer closed; the native handle is deliberately leaked,
+    because closing it under a thread still inside the call is a
+    use-after-free)."""
+    if deadline_s is None:
+        return fn()
+    from ..telemetry.heartbeat import HEARTBEATS
+    from ..telemetry.watchdog import dump_all_stacks
+
+    hb = HEARTBEATS.register(
+        f"media:{op}:{os.path.basename(path)}"[:120], kind="task"
+    )
+    if worker is not None:
+        result, error, done = worker.submit(fn)
+    else:
+        result = []
+        error = []
+        done = threading.Event()
+
+        def _run() -> None:
+            try:
+                result.append(fn())
+            except BaseException as exc:  # noqa: BLE001 - relayed to the caller
+                error.append(exc)
+            finally:
+                done.set()
+
+        threading.Thread(
+            target=_run, name=f"media-{op}-deadline", daemon=True
+        ).start()
+    if not done.wait(timeout=deadline_s):
+        hb.finish("timeout")
+        _DEADLINE_EXPIRED.inc()
+        tm.emit(
+            "media_deadline_expired", op=op, path=os.path.basename(path),
+            frame=frame, deadline_s=deadline_s, stacks=dump_all_stacks(),
+        )
+        raise MediaDeadlineExpired(
+            f"{op} {path}"
+            + (f" @frame {frame}" if frame is not None else "")
+            + f": no progress within the {deadline_s:g}s media deadline "
+            "(PC_MEDIA_DEADLINE_S) — native call abandoned, handle "
+            "leaked; forensics in the event log"
+        )
+    if error:
+        hb.finish("fail")
+        raise error[0]
+    hb.finish("ok")
+    return result[0]

@@ -1,0 +1,243 @@
+"""Supervised-subprocess SRC validation: first-contact hostile-input gate.
+
+A SRC upload is the one input the chain cannot trust: a truncated or
+garbage stream surfaces as a native error (contained), but a hostile
+one can WEDGE the decoder (decompression bomb) or crash it outright —
+and a native crash takes the whole replica with it, not just the unit.
+``PC_ISOLATE_DECODE=1`` (docs/ROBUSTNESS.md) moves first-contact
+decodes into a supervised child process:
+
+    parent (replica)                       child (this module's __main__)
+      validate_src(path) ──runner.shell──▶  probe + full decode of path
+      ├─ rc 0          → ok {frames, geometry}  (PC_MEDIA_FAULTS rides
+      ├─ rc 3          → ChainError kind="poison"   the inherited env,
+      ├─ crash signal  → ChainError kind="poison"   so the CI hang
+      │   (SEGV/ABRT/…: the decoder died ON the     self-test injects
+      │    bytes)                                   into the child)
+      ├─ other death   → ChainError kind="transient"
+      │   (OOM SIGKILL, rc 1 traceback, broken env — the bytes were
+      │    never judged; a healthy digest must not quarantine)
+      └─ timeout       → ChainError kind="transient"
+
+The verdict mapping is the serve failure taxonomy's front line: a
+stream the decoder rejects or dies on is POISON (serve quarantines its
+content digest fleet-wide — retrying hostile bytes on another replica
+just crashes another replica), while a timeout stays TRANSIENT (a
+loaded host produces the same symptom; the attempts budget bounds the
+retries and a genuine bomb ends terminal `failed`).
+
+The child is a full process, so a hang is KILLED (runner.shell's
+timeout kills the child group), an abandoned native thread leaks
+nothing in the parent, and a SIGSEGV in third-party codec internals is
+an exit status instead of a replica obituary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+from typing import Optional
+
+from .. import telemetry as tm
+from ..utils.runner import ChainError, shell
+
+_ISOLATED = tm.counter(
+    "chain_isolated_decodes_total",
+    "supervised first-contact SRC validations, by verdict",
+    ("verdict",),
+)
+
+#: child exit code for a contained media rejection (vs. an uncaught
+#: crash, which the kernel reports as a signal)
+_RC_MEDIA_ERROR = 3
+
+#: default wall budget for one first-contact validation when
+#: PC_MEDIA_DEADLINE_S is unset: generous (a long clean SRC must pass)
+#: but finite (a bomb must not own the worker forever)
+DEFAULT_DEADLINE_S = 300.0
+
+
+def isolate_decode_enabled() -> bool:
+    """The PC_ISOLATE_DECODE gate (off by default: the subprocess costs
+    one interpreter start per first-contact SRC)."""
+    # plan-exempt: (validation-only routing: the child decodes and DISCARDS frames — it never produces artifact bytes, it only decides whether the replica may touch the SRC at all)
+    return os.environ.get("PC_ISOLATE_DECODE", "").strip().lower() in (
+        "1", "true", "yes", "on"
+    )
+
+
+#: signals that mean the DECODER CRASHED on the bytes (a verdict about
+#: the input) — as opposed to environmental deaths (SIGKILL from the
+#: OOM killer, SIGTERM from a supervisor…) which say nothing about the
+#: SRC and must never durably quarantine a healthy digest
+_CRASH_SIGNALS = frozenset(
+    getattr(signal, name)
+    for name in ("SIGSEGV", "SIGBUS", "SIGILL", "SIGFPE", "SIGABRT",
+                 "SIGTRAP", "SIGSYS")
+    if hasattr(signal, name)
+)
+
+
+def classify_isolation_result(returncode: int, stdout: str,
+                              stderr: str) -> dict:
+    """Pure verdict mapping for one finished child (unit-testable
+    without spawning): {"verdict": ok|poison|transient, "detail": …,
+    report fields…}. Timeouts never reach here — runner.shell raises
+    before a returncode exists. Only verdicts ABOUT THE BYTES are
+    poison: a contained media rejection (rc 3) or a native-crash
+    signal. An environmental child death — OOM SIGKILL, a Python
+    traceback (rc 1), a broken child env — is transient: the bytes
+    were never judged, and poisoning the digest would park a healthy
+    upload fleet-wide behind an operator re-arm."""
+    from ..utils.fsio import last_json_line
+
+    report = last_json_line(stdout) or {}
+    if returncode == 0 and report.get("ok"):
+        return {"verdict": "ok", **report}
+    if returncode < 0:
+        if -returncode in _CRASH_SIGNALS:
+            return {
+                "verdict": "poison",
+                "detail": (
+                    f"decoder subprocess crashed with signal {-returncode} "
+                    "(native crash contained by PC_ISOLATE_DECODE)"
+                ),
+            }
+        return {
+            "verdict": "transient",
+            "detail": (
+                f"validator child died with signal {-returncode} "
+                "(environmental — OOM kill/supervisor, not a byte "
+                "verdict)"
+            ),
+        }
+    detail = report.get("error") or (stderr or "").strip()[-500:] or \
+        f"validator exited {returncode} with no report"
+    if returncode == _RC_MEDIA_ERROR:
+        return {"verdict": "poison", "detail": detail}
+    return {"verdict": "transient", "detail": detail}
+
+
+def validate_src(path: str, deadline_s: Optional[float] = None) -> dict:
+    """Run one supervised first-contact validation of `path`. Returns
+    the child's report on success; raises ChainError(kind="poison") for
+    rejected/crashing streams and ChainError(kind="transient") for a
+    timeout (see module doc). The PC_MEDIA_FAULTS/PC_MEDIA_DEADLINE_S
+    environment rides into the child unchanged."""
+    if deadline_s is None:
+        from .faults import media_deadline_s
+
+        deadline_s = media_deadline_s() or DEFAULT_DEADLINE_S
+    try:
+        proc = shell(
+            [sys.executable, "-m", "processing_chain_tpu.io.isolate", path],
+            check=False, timeout=deadline_s,
+        )
+    except ChainError as exc:
+        # runner.shell killed a child that blew the budget: the decoder
+        # HUNG on this input. Transient by policy (module doc).
+        _ISOLATED.labels(verdict="timeout").inc()
+        raise ChainError(
+            f"first-contact validation of {path} exceeded "
+            f"{deadline_s:g}s (decoder hang; child killed)",
+            kind="transient",
+        ) from exc
+    result = classify_isolation_result(
+        proc.returncode, proc.stdout, proc.stderr
+    )
+    _ISOLATED.labels(verdict=result["verdict"]).inc()
+    if result["verdict"] == "ok":
+        return result
+    raise ChainError(
+        f"first-contact validation rejected {path}: {result['detail']}",
+        kind=result["verdict"],
+    )
+
+
+# ----------------------------------------------------------- child side
+
+
+def _promised_frames(info: dict) -> int:
+    """The container's own frame-count promise for the video stream —
+    nb_frames when the muxer recorded it, else duration × avg fps. 0 =
+    no promise (VFR/stream formats); the frame-count check then stays
+    silent rather than guessing."""
+    video = next(
+        (s for s in info.get("streams", ())
+         if s.get("codec_type") == "video"), None,
+    )
+    if video is None:
+        return 0
+    promised = int(video.get("nb_frames") or 0)
+    if promised > 0:
+        return promised
+    duration = float(video.get("duration") or 0.0) or \
+        float(info.get("format", {}).get("duration") or 0.0)
+    try:
+        num, den = (int(x) for x in
+                    str(video.get("avg_frame_rate", "0/0")).split("/"))
+        fps = num / den if den else 0.0
+    except (TypeError, ValueError, ZeroDivisionError):
+        fps = 0.0
+    if duration > 0 and fps > 0:
+        return int(round(duration * fps))
+    return 0
+
+
+def _child_main(path: str) -> int:
+    """Probe + decode EVERY frame of `path`, discarding pixels (pooled
+    chunks released as they stream — constant memory at any length).
+    One JSON report line on stdout; exit 0 ok / 3 contained rejection;
+    anything the native layer crashes on becomes our exit signal.
+
+    The frame-count check is what upgrades the SILENT truncation shape
+    to a verdict: some libav builds tolerate a mid-GOP cut as an early
+    EOF with no error, and a chain fed such a stream would encode fewer
+    frames than the event list promises. A decode that falls well short
+    of the container's own frame count (tolerance: >3 frames AND >10%,
+    so metadata rounding and B-frame delay never convict a clean file)
+    is a contained rejection, exactly like a loud decode error."""
+    from . import medialib
+    from .bufpool import DEFAULT_POOL
+    from .video import VideoReader
+
+    try:
+        info = medialib.probe(path)
+        frames = 0
+        with VideoReader(path) as reader:
+            geometry = (reader.width, reader.height)
+            for chunk in reader.iter_chunks():
+                frames += int(chunk[0].shape[0])
+                DEFAULT_POOL.release(*chunk)
+        promised = _promised_frames(info)
+        if promised > 0 and promised - frames > 3 and \
+                frames < promised * 0.9:
+            print(json.dumps({
+                "ok": False,
+                "error": (
+                    f"silent truncation: container promises ~{promised} "
+                    f"frames, decoder delivered {frames} with no error "
+                    f"({path})"
+                ),
+            }))
+            return _RC_MEDIA_ERROR
+        print(json.dumps({
+            "ok": True,
+            "frames": frames,
+            "width": geometry[0],
+            "height": geometry[1],
+            "format": info["format"]["format_name"],
+        }))
+        return 0
+    except medialib.MediaError as exc:
+        print(json.dumps({"ok": False, "error": str(exc)[:800]}))
+        return _RC_MEDIA_ERROR
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 2:
+        print(json.dumps({"ok": False, "error": "usage: isolate <path>"}))
+        sys.exit(2)
+    sys.exit(_child_main(sys.argv[1]))

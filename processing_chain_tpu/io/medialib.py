@@ -130,7 +130,17 @@ MV_FIELDS = 7
 
 
 class MediaError(RuntimeError):
-    pass
+    """A native media-boundary failure. `kind` is the serve failure
+    taxonomy's surface (docs/SERVE.md "Failure taxonomy"): raisers that
+    KNOW the failure class tag it "transient" (full disk, wedged host),
+    "permanent" (bad parameters) or "poison" (hostile input bytes — the
+    SRC itself is the problem; serve quarantines its content digest
+    fleet-wide). None = no claim; serve/scheduler.classify_failure
+    falls back to exception-type heuristics."""
+
+    def __init__(self, *args, kind: Optional[str] = None) -> None:
+        super().__init__(*args)
+        self.kind = kind
 
 
 def _build(force: bool = False) -> None:

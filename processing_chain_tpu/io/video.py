@@ -8,13 +8,14 @@ stacks them into (T, H, W) tensors per plane.
 from __future__ import annotations
 
 import ctypes as ct
+import os
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
 import numpy as np
 
 from .. import telemetry as tm
-from . import bufpool, medialib
+from . import bufpool, faults, medialib
 from .medialib import MediaError, MPVideoDesc
 
 _IO_BATCH = tm.counter(
@@ -105,10 +106,25 @@ class VideoReader:
         self.path = path
         self._start = float(start)
         self._window = float(duration)
+        #: media-fault hooks (io/faults, docs/ROBUSTNESS.md): one env
+        #: lookup per OPEN; None in production — nothing per frame
+        self._faults = faults.decoder_faults(path)
+        self._deadline = faults.media_deadline_s()
+        #: lazy persistent deadline worker (faults.GuardWorker); only
+        #: ever created when a deadline is set
+        self._guard_worker = None
+        #: stream frame cursor — every decode error names the frame it
+        #: died at, not just the file
+        self._frames_out = 0
         lib = medialib.ensure_loaded()
         err = ct.create_string_buffer(512)
-        self._h = lib.mp_decoder_open_t(
-            path.encode(), start, duration, threads, err, 512
+        # the OPEN is a native crossing too: a hostile container can
+        # wedge the demuxer's probe before a single frame exists
+        self._h = self._guard(
+            lambda: lib.mp_decoder_open_t(
+                path.encode(), start, duration, threads, err, 512
+            ),
+            op="decoder-open",
         )
         if not self._h:
             raise MediaError(f"open {path}: {err.value.decode()}")
@@ -186,6 +202,29 @@ class VideoReader:
         if tm.enabled():
             _DECODER_OPENS.inc()
 
+    def _guard(self, fn, op: str, frame: Optional[int] = None):
+        """Run one native crossing under the PC_MEDIA_DEADLINE_S budget
+        (direct call when unset). Crossings reuse ONE persistent guard
+        worker per reader (faults.GuardWorker — a thread per crossing
+        would tax the per-chunk hot path). An expiry POISONS this
+        reader: the abandoned worker may still be inside the native
+        call, so the handle is deliberately leaked — close() becomes a
+        no-op — and the reader refuses further use."""
+        if self._deadline is None:
+            return fn()
+        if self._guard_worker is None:
+            self._guard_worker = faults.GuardWorker(
+                f"media-guard:{os.path.basename(self.path)}")
+        try:
+            return faults.guarded_call(
+                fn, self._deadline, op=op, path=self.path, frame=frame,
+                worker=self._guard_worker,
+            )
+        except faults.MediaDeadlineExpired:
+            self._h = None
+            self._guard_worker = None  # wedged: abandoned with the call
+            raise
+
     def _deinterleave(self, raw: np.ndarray) -> tuple[np.ndarray, ...]:
         """Packed 422 row bytes [h, 2w] → planar (y, u, v) copies,
         table-driven from PACKED_FORMATS."""
@@ -217,15 +256,43 @@ class VideoReader:
         for b, shape in zip(blocks, self._raw_plane_shapes):
             assert b.flags["C_CONTIGUOUS"] and b.dtype == self.dtype
             assert b.shape[0] >= max_frames and b.shape[1:] == shape
+        if self._faults is not None:
+            injected_eof = self._faults.check(max_frames)
+            if injected_eof is not None:  # injected short read: silent EOF
+                return 0, np.zeros(0, np.float64)
+            # bound the window so a short-read delivers exactly its
+            # promised frames before the injected EOF
+            max_frames = self._faults.cap_frames(max_frames)
         pts = np.zeros(max_frames, np.float64)
         ptrs = [b.ctypes.data_as(u8p) for b in blocks]
         ptrs += [None] * (4 - len(ptrs))
-        n = lib.mp_decoder_next_batch(
-            self._h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], max_frames,
-            pts.ctypes.data_as(ct.POINTER(ct.c_double)), err, 512,
-        )
+        # the handle is BOUND before the crossing: a deadline expiry
+        # nulls self._h to poison the reader, and the abandoned thread
+        # must keep using the (deliberately leaked) live handle, not
+        # discover a NULL mid-flight
+        h = self._h
+
+        def _native() -> int:
+            if self._faults is not None:
+                # the injected hang runs INSIDE the guarded crossing,
+                # exactly where a real wedged decoder would sit
+                self._faults.hang("decode")
+            return lib.mp_decoder_next_batch(
+                h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], max_frames,
+                pts.ctypes.data_as(ct.POINTER(ct.c_double)), err, 512,
+            )
+
+        n = self._guard(_native, op="decode", frame=self._frames_out)
         if n < 0:
-            raise MediaError(f"decode {self.path}: {err.value.decode()}")
+            # forensics contract (docs/ROBUSTNESS.md): source path +
+            # stream frame index + the native av_errstr text, bounded
+            raise MediaError(
+                f"decode {self.path} @frame {self._frames_out}: "
+                f"{err.value.decode()[:500]}"
+            )
+        self._frames_out += int(n)
+        if self._faults is not None:
+            self._faults.advance(int(n))
         if tm.enabled():
             _IO_BATCH_DECODE.inc()
         return int(n), pts[: int(n)]
@@ -249,7 +316,21 @@ class VideoReader:
                 pool.acquire((chunk,) + shape, self.dtype)
                 for shape in self._raw_plane_shapes
             ]
-            n, _pts = self._decode_batch_into(raw_blocks, chunk)
+            try:
+                n, _pts = self._decode_batch_into(raw_blocks, chunk)
+            except faults.MediaDeadlineExpired:
+                # the abandoned native call may still WRITE into these
+                # blocks whenever it unwedges: recycling them would hand
+                # scribble-prone memory to the next consumer.
+                del raw_blocks  # chainlint: ownership-transfer (leaked deliberately with the poisoned handle — the abandoned native thread can still scribble into the blocks whenever it unwedges; docs/ROBUSTNESS.md)
+                raise
+            except BaseException:
+                # a mid-stream decode failure (corrupt input, injected
+                # fault) must not strand pooled blocks: the
+                # media-crashcheck matrix asserts zero leaked blocks
+                # across the whole corrupt corpus
+                pool.release(*raw_blocks)
+                raise
             if n == 0:
                 pool.release(*raw_blocks)
                 return
@@ -280,19 +361,37 @@ class VideoReader:
         while True:
             if not self._h:
                 raise MediaError(f"{self.path}: reader is closed")
+            if self._faults is not None and \
+                    self._faults.check(1) is not None:
+                return  # injected short read: silent EOF
             planes = tuple(
                 np.zeros(shape, self.dtype) for shape in self._raw_plane_shapes
             )
             ptrs = [p.ctypes.data_as(u8p) for p in planes] + [None] * (4 - len(planes))
             pts = ct.c_double()
-            ret = lib.mp_decoder_next(
-                self._h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], ct.byref(pts),
-                err, 512,
-            )
+
+            def _native(pl=ptrs, pt=pts, h=self._h) -> int:
+                # handle bound at definition: an expiry nulls self._h
+                # (reader poisoned) while the abandoned thread keeps
+                # the leaked live handle
+                if self._faults is not None:
+                    self._faults.hang("decode")
+                return lib.mp_decoder_next(
+                    h, pl[0], pl[1], pl[2], pl[3], ct.byref(pt),
+                    err, 512,
+                )
+
+            ret = self._guard(_native, op="decode", frame=self._frames_out)
             if ret == 0:
                 return
             if ret < 0:
-                raise MediaError(f"decode {self.path}: {err.value.decode()}")
+                raise MediaError(
+                    f"decode {self.path} @frame {self._frames_out}: "
+                    f"{err.value.decode()[:500]}"
+                )
+            self._frames_out += 1
+            if self._faults is not None:
+                self._faults.advance(1)
             if self._packed_offsets is not None:
                 planes = self._deinterleave(planes[0])
             yield Frame(planes=planes, pts=pts.value, pix_fmt=self.pix_fmt)
@@ -381,6 +480,9 @@ class VideoReader:
         if self._h:
             medialib.ensure_loaded().mp_decoder_close(self._h)
             self._h = None
+        if self._guard_worker is not None:
+            self._guard_worker.stop()
+            self._guard_worker = None
 
     def __enter__(self) -> "VideoReader":
         return self
@@ -426,6 +528,13 @@ class VideoWriter:
         audio_bitrate_kbps: float = 0,
     ) -> None:
         self.path = path
+        #: media-fault hooks (io/faults): one env lookup per OPEN
+        self._faults = faults.encoder_faults(path)
+        self._deadline = faults.media_deadline_s()
+        #: lazy persistent deadline worker (faults.GuardWorker); only
+        #: ever created when a deadline is set
+        self._guard_worker = None
+        self._frames_in = 0
         lib = medialib.ensure_loaded()
         err = ct.create_string_buffer(512)
         self._h = lib.mp_encoder_open(
@@ -440,16 +549,53 @@ class VideoWriter:
             raise MediaError(f"encoder open {path} ({codec}): {err.value.decode()}")
         self._closed = False
 
+    def _guard(self, fn, op: str):
+        """Deadline guard, mirroring VideoReader._guard (one persistent
+        GuardWorker — write() crosses per FRAME): an expiry poisons the
+        writer (handle leaked — a thread is still inside the native
+        call) so close() is a no-op."""
+        if self._deadline is None:
+            return fn()
+        if self._guard_worker is None:
+            self._guard_worker = faults.GuardWorker(
+                f"media-guard:{os.path.basename(self.path)}")
+        try:
+            return faults.guarded_call(
+                fn, self._deadline, op=op, path=self.path,
+                frame=self._frames_in, worker=self._guard_worker,
+            )
+        except faults.MediaDeadlineExpired:
+            self._h = None
+            self._closed = True
+            self._guard_worker = None  # wedged: abandoned with the call
+            raise
+
     def write(self, *planes: np.ndarray) -> None:
         if not self._h:
             raise MediaError(f"{self.path}: writer is closed")
+        if self._faults is not None:
+            self._faults.check(1)
         lib = medialib.ensure_loaded()
         err = ct.create_string_buffer(512)
         u8p = ct.POINTER(ct.c_uint8)
         arrs = [np.ascontiguousarray(p) for p in planes if p is not None]
         ptrs = [a.ctypes.data_as(u8p) for a in arrs] + [None] * (4 - len(arrs))
-        if lib.mp_encoder_write_video(self._h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], err, 512) < 0:
-            raise MediaError(f"encode {self.path}: {err.value.decode()}")
+        h = self._h  # bound pre-crossing: expiry nulls self._h
+
+        def _native() -> int:
+            if self._faults is not None:
+                self._faults.hang("encode")
+            return lib.mp_encoder_write_video(
+                h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], err, 512
+            )
+
+        ret = self._guard(_native, op="encode")
+        if ret < 0:
+            raise MediaError(
+                f"encode {self.path} @frame {self._frames_in}: "
+                f"{err.value.decode()[:500]}"
+            )
+        self._frames_in += 1
 
     def write_batch(self, *planes: np.ndarray) -> None:
         """Encode a [T, h, w] stack per plane in ONE native crossing (one
@@ -473,11 +619,25 @@ class VideoWriter:
             )
         if t == 0:
             return
+        if self._faults is not None:
+            self._faults.check(t)
         ptrs = [a.ctypes.data_as(u8p) for a in arrs] + [None] * (4 - len(arrs))
-        if lib.mp_encoder_write_video_batch(
-            self._h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], t, err, 512,
-        ) < 0:
-            raise MediaError(f"encode {self.path}: {err.value.decode()}")
+        h = self._h  # bound pre-crossing: expiry nulls self._h
+
+        def _native() -> int:
+            if self._faults is not None:
+                self._faults.hang("encode")
+            return lib.mp_encoder_write_video_batch(
+                h, ptrs[0], ptrs[1], ptrs[2], ptrs[3], t, err, 512,
+            )
+
+        ret = self._guard(_native, op="encode")
+        if ret < 0:
+            raise MediaError(
+                f"encode {self.path} @frame {self._frames_in}: "
+                f"{err.value.decode()[:500]}"
+            )
+        self._frames_in += t
         if tm.enabled():
             _IO_BATCH_ENCODE.inc()
 
@@ -495,13 +655,30 @@ class VideoWriter:
             raise MediaError(f"audio encode {self.path}: {err.value.decode()}")
 
     def close(self) -> None:
-        if self._h and not self._closed:
-            self._closed = True
-            err = ct.create_string_buffer(512)
-            ret = medialib.ensure_loaded().mp_encoder_close(self._h, err, 512)
-            self._h = None
-            if ret < 0:
-                raise MediaError(f"close {self.path}: {err.value.decode()}")
+        try:
+            if self._h and not self._closed:
+                self._closed = True
+                err = ct.create_string_buffer(512)
+                h, self._h = self._h, None
+                # the close flushes delayed frames + finalizes the
+                # container: a crossing that can hang like any other
+                ret = self._guard(
+                    lambda: medialib.ensure_loaded().mp_encoder_close(
+                        h, err, 512
+                    ),
+                    op="encoder-close",
+                )
+                if ret < 0:
+                    raise MediaError(
+                        f"close {self.path} after {self._frames_in} "
+                        f"frames: {err.value.decode()[:500]}"
+                    )
+        finally:
+            # a deadline expiry nulled the worker (abandoned, wedged);
+            # any other exit stops the idle worker cleanly
+            if self._guard_worker is not None:
+                self._guard_worker.stop()
+                self._guard_worker = None
 
     def __enter__(self) -> "VideoWriter":
         return self

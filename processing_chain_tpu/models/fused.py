@@ -53,12 +53,20 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from .. import telemetry as tm
 from ..engine import prefetch as pfe
 from ..engine.jobs import clear_inprogress, mark_inprogress
 from ..ops import overlay as ov
+from ..utils.log import get_logger
 from ..utils.runner import ChainError
 from . import avpvs as av
 from . import cpvs as cp
+
+_MEMBERS_DEGRADED = tm.counter(
+    "chain_fused_members_degraded_total",
+    "fused fan-out members aborted mid-stream and left to the staged "
+    "partial path",
+)
 
 
 def fused_p04_enabled() -> bool:
@@ -262,6 +270,7 @@ class _ContextPipeline:
     def __init__(self, out_path: str, plan: dict, pp, w: int, h: int,
                  pix_fmt: str, avpvs_fps: float, audio, srate: int,
                  rawvideo: bool, chunk: int) -> None:
+        self.out_path = out_path
         self._transform = cp.make_cpvs_transform(plan, pp, pix_fmt, rawvideo)
         out_rate = cp.cpvs_out_rate(plan, avpvs_fps)
         vw, has_audio = cp.open_cpvs_writer(
@@ -365,6 +374,7 @@ class _PreviewPipeline:
 
     def __init__(self, out_path: str, w: int, h: int, pix_fmt: str,
                  avpvs_fps: float, audio, srate: int) -> None:
+        self.out_path = out_path
         self._transform = cp.make_preview_transform(pix_fmt)
         vw, has_audio = cp.open_preview_writer(
             out_path, w, h, avpvs_fps, audio, srate
@@ -420,6 +430,15 @@ class FusedFanout:
         self._closed = False
         self._pipelines: list = []
         self._marked: list[str] = []
+        #: output path -> error summary for members that failed MID-
+        #: STREAM (encoder write/close error, injected ENOSPC, …): the
+        #: member is aborted and dropped — its partial output removed,
+        #: its sentinel cleared, its job NOT completed — while every
+        #: healthy member keeps streaming and settles normally. The
+        #: staged partial path rebuilds exactly the degraded members
+        #: (p04/stalling warm-skip sees them as due), which is the
+        #: graceful-degrade contract of docs/ROBUSTNESS.md.
+        self.degraded: dict[str, str] = {}
         self._stall_writer = None
         self._stall_stream = None
         self._compositor = None
@@ -453,6 +472,15 @@ class FusedFanout:
         if self.preview_job is not None:
             jobs.append(self.preview_job)
         return jobs
+
+    def stall_settled(self) -> bool:
+        """True when the staged stalling pass has nothing to redo for
+        this PVS: either there is no stalling member, or the fused
+        render carried it to completion. False = the member degraded
+        mid-stream and the orchestrator must plan the staged
+        apply_stalling instead of skipping it."""
+        return self.stall_job is None or \
+            self.stall_job.output_path not in self.degraded
 
     # ------------------------------------------------------------ start
 
@@ -506,17 +534,26 @@ class FusedFanout:
                 )
             mark_inprogress(job.output_path)
             self._marked.append(job.output_path)
-            self._pipelines.append(_ContextPipeline(
-                job.output_path, plan, pp, w, h, pix_fmt, avpvs_fps,
-                ctx_audio, srate, self._rawvideo, chunk,
-            ))
+            try:
+                self._pipelines.append(_ContextPipeline(
+                    job.output_path, plan, pp, w, h, pix_fmt, avpvs_fps,
+                    ctx_audio, srate, self._rawvideo, chunk,
+                ))
+            except Exception as exc:  # noqa: BLE001 - member containment
+                # a member whose WRITER cannot even open (ENOSPC on the
+                # third context) degrades like a mid-stream failure:
+                # dropped to the staged partial path, siblings unharmed
+                self._drop_member(job.output_path, exc)
         if self.preview_job is not None:
             mark_inprogress(self.preview_job.output_path)
             self._marked.append(self.preview_job.output_path)
-            self._pipelines.append(_PreviewPipeline(
-                self.preview_job.output_path, w, h, pix_fmt, avpvs_fps,
-                final_audio, srate,
-            ))
+            try:
+                self._pipelines.append(_PreviewPipeline(
+                    self.preview_job.output_path, w, h, pix_fmt,
+                    avpvs_fps, final_audio, srate,
+                ))
+            except Exception as exc:  # noqa: BLE001 - member containment
+                self._drop_member(self.preview_job.output_path, exc)
         return self.feed
 
     # ------------------------------------------------------------- flow
@@ -531,8 +568,54 @@ class FusedFanout:
             self._feed_final(planes)
 
     def _feed_final(self, planes: list) -> None:
-        for pipe in self._pipelines:
-            pipe.feed(planes)
+        for pipe in list(self._pipelines):
+            try:
+                pipe.feed(planes)
+            except Exception as exc:  # noqa: BLE001 - member containment
+                self._degrade_member(pipe, exc)
+
+    def _degrade_member(self, pipe, exc: BaseException) -> None:
+        """Contain one CPVS/preview member failure: abort THAT member
+        (partial output removed, sentinel cleared, job left un-run for
+        the staged partial path) and keep every other member streaming.
+        A failure in the shared machinery (stall compositor, the AVPVS
+        lane itself) still aborts the whole fan-out via the wave's
+        abort sweep — containment is per-member by construction."""
+        self._pipelines.remove(pipe)
+        pipe.abort()
+        self._drop_member(pipe.out_path, exc)
+
+    def _drop_member(self, out: str, exc: BaseException) -> None:
+        self.degraded[out] = f"{type(exc).__name__}: {exc}"[:500]
+        if out in self._marked:
+            self._marked.remove(out)
+        if os.path.isfile(out):
+            try:
+                os.unlink(out)
+            except OSError:
+                pass
+        clear_inprogress(out)
+        _MEMBERS_DEGRADED.inc()
+        tm.emit("fused_member_degraded", output=os.path.basename(out),
+                pvs=self.pvs.pvs_id, error=self.degraded[out])
+        get_logger().warning(
+            "fused fan-out %s: member %s aborted mid-stream (%s) — "
+            "falling back to the staged partial path; %d member(s) "
+            "still streaming",
+            self.pvs.pvs_id, os.path.basename(out), self.degraded[out],
+            len(self._pipelines),
+        )
+
+    def _degrade_stall(self, exc: BaseException) -> None:
+        """The stalled-AVPVS member failed mid-stream: drop ITS writer
+        and output, but keep compositing — the context pipelines
+        consume the composited frames from memory regardless."""
+        writer, self._stall_writer = self._stall_writer, None
+        try:
+            writer.close()
+        except Exception:  # noqa: BLE001 - teardown on the failure path
+            pass
+        self._drop_member(self.stall_job.output_path, exc)
 
     def _on_stall_record(self, frame_planes, stall, black, phase) -> None:
         self._srec.append((frame_planes, stall, black, phase))
@@ -554,7 +637,11 @@ class FusedFanout:
         # same arrays fan out to every context pipeline — what a decoder
         # of the stalled artifact would produce (lossless writeback)
         host = [np.asarray(o) for o in outs]
-        self._stall_writer.put(host)
+        if self._stall_writer is not None:
+            try:
+                self._stall_writer.put(host)
+            except Exception as exc:  # noqa: BLE001 - member containment
+                self._degrade_stall(exc)
         self._feed_final(host)
 
     # -------------------------------------------------------- lifecycle
@@ -570,9 +657,16 @@ class FusedFanout:
         if self._stall_stream is not None:
             self._stall_stream.finish()
             self._flush_stall_chunk()
-            self._stall_writer.close()
-        for pipe in self._pipelines:
-            pipe.finish()
+            if self._stall_writer is not None:
+                try:
+                    self._stall_writer.close()
+                except Exception as exc:  # noqa: BLE001 - member containment
+                    self._degrade_stall(exc)
+        for pipe in list(self._pipelines):
+            try:
+                pipe.finish()
+            except Exception as exc:  # noqa: BLE001 - member containment
+                self._degrade_member(pipe, exc)
 
     def close(self) -> None:
         """Finalize: flush + commit every member artifact under its own
@@ -589,6 +683,11 @@ class FusedFanout:
         if not self.engaged:
             return
         for job in self.member_jobs():
+            # degraded members commit NOTHING: their jobs stay un-run,
+            # so the staged partial path (p04 / the stalling pass) sees
+            # them as due and rebuilds exactly those artifacts
+            if job.output_path in self.degraded:
+                continue
             job.complete_externally()
 
     def abort(self) -> None:

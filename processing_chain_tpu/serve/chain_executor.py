@@ -77,6 +77,11 @@ class ChainExecutor:
         self._cache_lock = lockdebug.make_lock("serve_chain_cfgcache")
         self._configs: dict = {}       # guarded-by: _cache_lock
         self._complexity: dict = {}    # guarded-by: _cache_lock
+        #: SRC digests this replica already first-contact-validated
+        #: under PC_ISOLATE_DECODE (io/isolate): a clean verdict is a
+        #: property of the BYTES, so one supervised decode per digest
+        #: per replica, not per request
+        self._validated: set = set()   # guarded-by: _cache_lock
 
     # ------------------------------------------------------------ config
 
@@ -136,11 +141,25 @@ class ChainExecutor:
         """The byte-affecting knob values (store/plan_schema.py 'plan'
         inputs), folded into the unit plan so the manifest's inner
         hashes are a pure function of the plan (module docstring)."""
+        from ..io.medialib import MediaError
         from ..models import avpvs as av
         from ..ops.resize import plan_resize_method
 
-        codec = av.effective_avpvs_codec(pvs.get_pix_fmt_for_avpvs())
-        return {
+        # unprobeable SRC (deferred poison, config/domain.py): the
+        # parse already substituted a deterministic yuv420p stand-in
+        # (Segment._set_pix_fmt) so the unit can ENQUEUE and let
+        # execution convict the bytes through the failure taxonomy —
+        # a 400 here would bypass the digest quarantine entirely
+        # (docs/ROBUSTNESS.md)
+        probe_deferred = pvs.src.probe_error is not None
+        try:
+            pix_fmt = pvs.get_pix_fmt_for_avpvs()
+        except MediaError:
+            # defensive: a consumer that still reaches stream_info
+            pix_fmt = "yuv420p"
+            probe_deferred = True
+        codec = av.effective_avpvs_codec(pix_fmt)
+        knobs = {
             "avpvs_codec": codec,
             "ffv1_slices": (
                 av.ffv1_slices(av.ffv1_coding_threads())
@@ -149,6 +168,14 @@ class ChainExecutor:
             "resize": plan_resize_method(),
             "cpvs": {"rawvideo": False, "crf": 17},
         }
+        if probe_deferred:
+            # the plan was minted BLIND (fallback pix_fmt): say so in
+            # the identity, so it can never collide with the clean
+            # bytes' plan hash if the upload is later repaired and the
+            # record re-armed — blind plans and probed plans are
+            # different plans
+            knobs["probe_deferred"] = True
+        return knobs
 
     def plan(self, unit: Unit) -> dict:
         pvs = self._pvs_of(unit)
@@ -273,6 +300,59 @@ class ChainExecutor:
             params=dict(record_unit.get("params", {})),
         )
 
+    def src_digest(self, record_unit: dict) -> Optional[str]:
+        """Content digest of the unit's SRC file — the poison-
+        quarantine key. Rides the store's stat-keyed DigestCache, so
+        after the plan's own file_ref resolution this is a dict lookup,
+        not a re-hash. Total like bucket_key."""
+        try:
+            pvs = self._pvs_of(self._unit_from_record(record_unit))
+            store = store_runtime.active()
+            if store is not None:
+                return store.digests.digest(pvs.src.file_path)["sha256"]
+            return keys.hash_file(pvs.src.file_path)["sha256"]
+        except Exception:  # noqa: BLE001 - totality like bucket_key
+            return None
+
+    def _validate_first_contact(self, pvses: list) -> None:
+        """PC_ISOLATE_DECODE (io/isolate, docs/ROBUSTNESS.md): every
+        SRC digest this replica has not yet validated goes through one
+        supervised-subprocess decode BEFORE any stage touches it — a
+        hang is killed by the child's deadline, a native crash kills
+        the child, and both re-raise as classified ChainErrors (poison
+        / transient) instead of taking the replica down."""
+        from ..io.isolate import isolate_decode_enabled, validate_src
+
+        if not isolate_decode_enabled():
+            return
+        store = store_runtime.active()
+        for pvs in pvses:
+            path = pvs.src.file_path
+            try:
+                digest = (store.digests.digest(path)["sha256"]
+                          if store is not None
+                          else keys.hash_file(path)["sha256"])
+            except OSError as exc:
+                raise ChainError(
+                    f"SRC {path} unreadable at first contact: {exc}",
+                    kind="transient",
+                ) from exc
+            with self._cache_lock:
+                if digest in self._validated:
+                    continue
+            try:
+                validate_src(path)  # raises ChainError(kind=...) on verdict
+            except ChainError as exc:
+                # name the convicting digest on the verdict: the
+                # scheduler then parks exactly this SRC's members from
+                # a packed wave instead of retrying every sibling until
+                # a solo wave re-convicts (docs/ROBUSTNESS.md)
+                if exc.src_digest is None:
+                    exc.src_digest = digest
+                raise
+            with self._cache_lock:
+                self._validated.add(digest)
+
     # -------------------------------------------------------- execution
 
     def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
@@ -334,6 +414,10 @@ class ChainExecutor:
                 )
             pvses.append(pvs)
 
+        # first-contact hostile-input gate (PC_ISOLATE_DECODE): raises a
+        # classified ChainError BEFORE any stage touches the bytes
+        self._validate_first_contact(pvses)
+
         pool = min(_HOST_POOL, max(1, len(pvses)))
         av.set_default_fp_workers(min(_DEVICE_POOL, pool))
 
@@ -386,9 +470,11 @@ class ChainExecutor:
         stall_jobs = {}
         for pvs in pvses:
             fo = fanouts.get(pvs.pvs_id)
-            if fo is not None and fo.engaged:
+            if fo is not None and fo.engaged and fo.stall_settled():
                 # fused render produced + committed the stalled AVPVS;
-                # its job still carries the manifest's plan identity
+                # its job still carries the manifest's plan identity (a
+                # DEGRADED stalling member falls through to the staged
+                # pass — models/fused graceful-degrade contract)
                 if fo.stall_job is not None:
                     stall_jobs[pvs.pvs_id] = fo.stall_job
                 continue

@@ -83,6 +83,15 @@ class Executor(Protocol):
         DEFAULT_COST_S)."""
         ...
 
+    def src_digest(self, record_unit: dict) -> Optional[str]:
+        """Content digest of the unit's SRC bytes — the poison-
+        quarantine key (docs/SERVE.md "Failure taxonomy"): a `poison`
+        settle quarantines this digest fleet-wide, so every plan
+        referencing the same hostile upload fails fast. Same totality
+        contract as bucket_key (None = no digest, digest quarantine
+        simply never applies to the unit); never raise."""
+        ...
+
     def run_batch(self, units: list[Unit], outputs: list[str]) -> None:
         """Produce every output. Called inside engine.Job (sentinels,
         store commit, telemetry ride along)."""
@@ -116,6 +125,12 @@ class SyntheticExecutor:
                     error stand-in, exercising retry + backoff
         poison      fault injection: every attempt raises a PERMANENT
                     ChainError — exercises the quarantine path
+        poison_src  fault injection: every attempt raises a POISON
+                    ChainError — the corrupt-upload stand-in: the unit's
+                    SRC content digest is quarantined fleet-wide, so
+                    sibling plans sharing the SRC fail fast without
+                    executing (docs/ROBUSTNESS.md; the serve-chaos
+                    --corrupt-corpus workload rides this)
     """
 
     kind = "synthetic"
@@ -154,10 +169,12 @@ class SyntheticExecutor:
                     raise ValueError(
                         f"params.{key} must be a number, got {params[key]!r}"
                     ) from None
-        if not isinstance(params.get("poison", False), bool):
-            raise ValueError(
-                f"params.poison must be a boolean, got {params['poison']!r}"
-            )
+        for flag in ("poison", "poison_src"):
+            if not isinstance(params.get(flag, False), bool):
+                raise ValueError(
+                    f"params.{flag} must be a boolean, got "
+                    f"{params[flag]!r}"
+                )
 
     def bucket_key(self, record_unit: dict) -> Optional[tuple]:
         try:
@@ -182,11 +199,35 @@ class SyntheticExecutor:
         except (AttributeError, TypeError, ValueError):
             return None
 
-    @staticmethod
-    def _inject_failures(params: dict, output: str) -> None:
+    def src_digest(self, record_unit: dict) -> Optional[str]:
+        """Synthetic SRCs have no file bytes; their digest is the
+        deterministic hash of the (database, src) identity — which is
+        exactly what makes the poison-sweep fleet semantics testable:
+        every unit naming one SRC shares one digest."""
+        try:
+            return hashlib.sha256(
+                f"synthetic:{record_unit['database']}:{record_unit['src']}"
+                .encode()
+            ).hexdigest()
+        except (KeyError, TypeError, AttributeError):
+            return None
+
+    def _inject_failures(self, unit: Unit, output: str) -> None:
         """Scripted fault injection (chaos/soak harnesses only; see the
         class docstring). Raises BEFORE any bytes are produced, so an
         injected failure never leaves a half-made artifact behind."""
+        params = unit.params
+        if params.get("poison_src"):
+            # attributed verdict: naming the digest on the exception is
+            # what the real executor does (first-contact validation),
+            # and it is what lets the scheduler convict the SRC from a
+            # packed wave instead of waiting for a solo-wave retry
+            raise ChainError(
+                f"injected poison SRC for {output} (corrupt upload "
+                "stand-in)", kind="poison",
+                src_digest=self.src_digest(
+                    {"database": unit.database, "src": unit.src}),
+            )
         if params.get("poison"):
             raise ChainError(
                 f"injected permanent failure for {output}",
@@ -212,7 +253,7 @@ class SyntheticExecutor:
         record_waves(len(units))
         for unit, output in zip(units, outputs):
             params = unit.params
-            self._inject_failures(params, output)
+            self._inject_failures(unit, output)
             work_ms = float(params.get("work_ms", 0) or 0)
             if work_ms > 0:
                 time.sleep(work_ms / 1000.0)

@@ -103,6 +103,10 @@ _QUARANTINED = tm.counter(
     "chain_serve_quarantined_total",
     "plans quarantined after a permanent failure",
 )
+_SRC_POISONED = tm.counter(
+    "chain_serve_poisoned_total",
+    "SRC content digests quarantined after a poison verdict",
+)
 
 # --------------------------------------------------------------------------
 # The record state machine, declared ONCE. Three consumers share this
@@ -127,6 +131,7 @@ TRANSITIONS = frozenset({
     ("running", "failed"),        # fail: attempts budget exhausted
     ("running", "queued"),        # retry/steal/revert/recovery re-arm
     ("running", "quarantined"),   # permanent failure: retrying is futile
+    ("queued", "quarantined"),    # poison sweep: the record's SRC content digest was quarantined fleet-wide
     ("failed", "queued"),         # re-arm: a fresh request retries the plan
     ("done", "queued"),           # re-arm: the store evicted the artifact
     ("quarantined", "queued"),    # re-arm: operator cleared the quarantine
@@ -229,6 +234,12 @@ class JobRecord:
     #: the scheduler packs waves by it, admission sums it per tenant,
     #: and the settle-time feedback loop grades it against reality
     cost_s: float = 0.0
+    #: content digest of the unit's SRC (Executor.src_digest, stamped
+    #: at enqueue): the poison-quarantine key — one hostile upload is
+    #: quarantined by its BYTES, so every plan referencing it (any HRC,
+    #: any tenant, any replica) fails fast instead of burning its own
+    #: retry budget rediscovering the same poison (docs/ROBUSTNESS.md)
+    src_digest: Optional[str] = None
 
     def to_json(self) -> dict:
         return {
@@ -255,6 +266,7 @@ class JobRecord:
             "notBefore": self.not_before,
             "settledEpoch": self.settled_epoch,
             "costS": self.cost_s,
+            "srcDigest": self.src_digest,
         }
 
     @classmethod
@@ -284,6 +296,7 @@ class JobRecord:
             not_before=float(data.get("notBefore", 0.0)),
             settled_epoch=data.get("settledEpoch"),
             cost_s=float(data.get("costS", 0.0) or 0.0),
+            src_digest=data.get("srcDigest"),
         )
 
 
@@ -842,6 +855,7 @@ class DurableQueue:
         output: str,
         trace_id: Optional[str] = None,
         cost_s: float = 0.0,
+        src_digest: Optional[str] = None,
     ) -> tuple[JobRecord, str]:
         """Enqueue one unit (or attach to its in-flight twin). Returns
         (record, outcome) with outcome ∈ new | attached | done |
@@ -849,9 +863,40 @@ class DurableQueue:
         hash already exists and now also answers `request_id`; `done` =
         the record completed earlier (the caller should serve from the
         store — and re-enqueue via `rearm` if the store lost the
-        bytes); `quarantined` = the plan failed permanently and will
-        not retry until an operator re-arms it (the request is attached
+        bytes); `quarantined` = the plan failed permanently — or its
+        SRC content digest sits in the poison registry — and will not
+        retry until an operator re-arms it (the request is attached
         for forensics, nothing is scheduled)."""
+        note: dict = {}
+        record, outcome = self._enqueue_locked(
+            plan_hash, plan, unit, tenant, priority, request_id, output,
+            trace_id, cost_s, src_digest, note,
+        )
+        if note.get("poisoned"):
+            # the record was swept through the poison edge inside the
+            # locked section; telemetry is emitted HERE, outside the
+            # queue lock (module convention — the span journal already
+            # carries the transition)
+            _QUARANTINED.inc()
+            tm.emit("serve_quarantined", job=record.job_id,
+                    plan=record.plan_hash, error=record.error,
+                    attempts=record.attempts)
+        return record, outcome
+
+    def _enqueue_locked(
+        self,
+        plan_hash: str,
+        plan: dict,
+        unit: dict,
+        tenant: str,
+        priority: int,
+        request_id: str,
+        output: str,
+        trace_id: Optional[str],
+        cost_s: float,
+        src_digest: Optional[str],
+        note: dict,
+    ) -> tuple[JobRecord, str]:
 
         def _attach_ids(record: JobRecord) -> bool:
             changed = False
@@ -868,10 +913,15 @@ class DurableQueue:
                 # not treat a known-heavy in-flight unit as free
                 record.cost_s = float(cost_s)
                 changed = True
+            if src_digest and record.src_digest != src_digest:
+                record.src_digest = src_digest
+                changed = True
             return changed
 
         with self._lock:
             with self._flock():
+                poison = self._read_poison(src_digest) if src_digest \
+                    else None
                 existing_id = self._by_plan.get(plan_hash)
                 if existing_id is None and \
                         time.time() - self._last_refresh > 0.25:
@@ -890,6 +940,14 @@ class DurableQueue:
                     record = self._read_disk(existing_id) or \
                         self._jobs[existing_id]
                     if record.state in _ATTACHABLE:
+                        if poison is not None and record.state == "queued":
+                            # poisoned SRC: this queued record must not
+                            # wait out the scheduler just to rediscover
+                            # the quarantine — fail it fast here
+                            _attach_ids(record)
+                            self._quarantine_poisoned_locked(record, poison)
+                            note["poisoned"] = True
+                            return record, "quarantined"
                         if _attach_ids(record):
                             self.spans.append(
                                 "attach", job=record.job_id,
@@ -925,6 +983,13 @@ class DurableQueue:
                         epoch=record.epoch, requests=record.requests,
                         traces=record.trace_ids, rearm=True,
                     )
+                    if poison is not None:
+                        # the plan would retry, but its SRC bytes are
+                        # quarantined: park it through the declared
+                        # poison-sweep edge instead of scheduling it
+                        self._quarantine_poisoned_locked(record, poison)
+                        note["poisoned"] = True
+                        return record, "quarantined"
                     self._persist(record)
                     self._absorb(record)
                     self._set_depth_gauge()
@@ -950,6 +1015,7 @@ class DurableQueue:
                     enqueued_at=now,
                     queued_at=now,
                     cost_s=max(0.0, float(cost_s)),
+                    src_digest=src_digest,
                 )
                 self._next_id += 1
                 self.spans.append(
@@ -958,6 +1024,15 @@ class DurableQueue:
                     requests=record.requests, traces=record.trace_ids,
                     tenant=tenant, priority=priority,
                 )
+                if poison is not None:
+                    # a fresh plan against a poisoned SRC: the record
+                    # exists for forensics (which requests asked, what
+                    # the poison verdict was) but parks immediately —
+                    # fail-fast is the whole point of the digest
+                    # registry (docs/ROBUSTNESS.md)
+                    self._quarantine_poisoned_locked(record, poison)
+                    note["poisoned"] = True
+                    return record, "quarantined"
                 self._persist(record)
                 self._absorb(record)
                 self._set_depth_gauge()
@@ -1001,6 +1076,166 @@ class DurableQueue:
                 self._absorb(record)
                 self._set_depth_gauge()
                 return record
+
+    # -------------------------------------------------- poison registry
+    #
+    # One JSON file per quarantined SRC content digest under
+    # <root>/poison/ — durable, shared by every replica over the root
+    # (reads are whole-file; writes hold the flock like any queue
+    # mutation). A digest lands here when an execution settles with the
+    # `poison` failure kind (docs/SERVE.md "Failure taxonomy"): the SRC
+    # BYTES are hostile, so every plan referencing them — any HRC, any
+    # tenant, any replica — fails fast instead of rediscovering the
+    # poison one retry budget at a time. `tools serve-admin poison`
+    # is the operator surface (ls / rearm).
+
+    def _poison_path(self, digest: str) -> str:
+        return os.path.join(self.root, "poison", digest + ".json")
+
+    # holds-lock: _lock
+    def _read_poison(self, digest: str) -> Optional[dict]:
+        try:
+            with open(self._poison_path(digest)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    # holds-lock: _lock
+    def _quarantine_poisoned_locked(self, record: JobRecord,
+                                    poison: dict) -> None:
+        """Park one queued record whose SRC digest is poisoned, through
+        the declared poison-sweep edge. Telemetry stays with the
+        callers (events must not be emitted under the queue lock)."""
+        # queue-transition: queued -> quarantined (poison sweep: the record's SRC digest was quarantined fleet-wide)
+        record.state = "quarantined"
+        record.error = (
+            f"SRC digest {record.src_digest} is quarantined: "
+            f"{poison.get('error', 'poisoned input')}"
+        )[:500]
+        record.error_kind = "poison"
+        record.done_at = time.time()
+        record.settled_epoch = record.epoch
+        self.spans.append(
+            "quarantine", job=record.job_id, plan=record.plan_hash,
+            state="quarantined", epoch=record.epoch,
+            requests=record.requests, traces=record.trace_ids,
+            error=record.error, kind="poison",
+        )
+        self._persist(record)
+        self._clear_sentinel(record.job_id)
+        self._absorb(record)
+        self._set_depth_gauge()
+
+    def poison_src(self, digest: str, src: Optional[str] = None,
+                   error: str = "", by_job: Optional[str] = None
+                   ) -> list[JobRecord]:
+        """Quarantine one SRC content digest fleet-wide: register it
+        durably, then sweep every QUEUED record carrying it through the
+        declared poison edge (running records settle on their own — the
+        epoch fence makes interfering with a live execution wrong).
+        Returns the swept records so the caller can fail their
+        waiters. Idempotent: re-poisoning an already-registered digest
+        only re-runs the sweep."""
+        if not digest:
+            return []
+        swept: list[JobRecord] = []
+        with self._lock:
+            with self._flock():
+                path = self._poison_path(digest)
+                existing = self._read_poison(digest)
+                doc = existing or {
+                    "digest": digest,
+                    "src": src,
+                    "error": str(error)[:500],
+                    "job": by_job,
+                    "poisonedAt": time.time(),
+                }
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                atomic_write_json(path, doc, durable=True, sort_keys=True)
+                self._refresh_locked()  # peers' records join the sweep
+                for job_id, record in list(self._queued.items()):
+                    if record.src_digest != digest:
+                        continue
+                    disk = self._read_disk(job_id) or record
+                    if disk.state != "queued" or disk.src_digest != digest:
+                        self._absorb(disk)
+                        continue
+                    self._quarantine_poisoned_locked(disk, doc)
+                    swept.append(disk)
+        if existing is None:
+            # one counter tick / event per DIGEST, not per convicted
+            # record: re-poisoning an already-registered digest (a
+            # second attributed member of the same wave, a rearm that
+            # re-convicts) only re-runs the sweep — the swept records
+            # below still carry their own serve_quarantined forensics
+            _SRC_POISONED.inc()
+            tm.emit("serve_src_poisoned", digest=digest, src=src,
+                    error=str(error)[:500], job=by_job,
+                    swept=[r.job_id for r in swept])
+        for record in swept:
+            _QUARANTINED.inc()
+            tm.emit("serve_quarantined", job=record.job_id,
+                    plan=record.plan_hash, error=record.error,
+                    attempts=record.attempts)
+        return swept
+
+    def src_poisoned(self, digest: str) -> Optional[dict]:
+        """The poison registry entry for one digest (None = clean)."""
+        if not digest:
+            return None
+        with self._lock:
+            return self._read_poison(digest)
+
+    def poisoned_digests(self) -> list[dict]:
+        """Every registered poison entry (operator/admin surface)."""
+        entries: list[dict] = []
+        poison_dir = os.path.join(self.root, "poison")
+        try:
+            names = sorted(os.listdir(poison_dir))
+        except OSError:
+            return entries
+        with self._lock:
+            for name in names:
+                if name.endswith(".json"):
+                    doc = self._read_poison(name[:-5])
+                    if doc is not None:
+                        entries.append(doc)
+        return entries
+
+    def rearm_src(self, digest: str) -> dict:
+        """Operator re-arm of one poisoned digest (docs/ROBUSTNESS.md
+        "Quarantine & re-arm"): drop the registry entry, then re-arm
+        every quarantined record that carries the digest so a fresh
+        request (or the records' own waiters) can retry against the
+        repaired SRC. Returns {"digest", "was_poisoned", "rearmed"}."""
+        rearmed: list[str] = []
+        with self._lock:
+            with self._flock():
+                was = self._read_poison(digest) is not None
+                try:
+                    os.unlink(self._poison_path(digest))
+                except FileNotFoundError:
+                    pass
+                self._refresh_locked()
+                for job_id, record in list(self._jobs.items()):
+                    if record.src_digest != digest:
+                        continue
+                    disk = self._read_disk(job_id) or record
+                    if disk.state != "quarantined":
+                        continue
+                    self._rearm_locked(disk)
+                    self.spans.append(
+                        "enqueue", job=disk.job_id, plan=disk.plan_hash,
+                        state="queued", epoch=disk.epoch,
+                        requests=disk.requests, traces=disk.trace_ids,
+                        rearm=True,
+                    )
+                    self._persist(disk)
+                    self._absorb(disk)
+                    rearmed.append(job_id)
+                self._set_depth_gauge()
+        return {"digest": digest, "was_poisoned": was, "rearmed": rearmed}
 
     # ------------------------------------------------------- scheduling
 

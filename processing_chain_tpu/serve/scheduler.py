@@ -66,11 +66,19 @@ _TRANSIENT_TYPES = (OSError, MemoryError, TimeoutError, ConnectionError)
 _PERMANENT_TYPES = (ValueError, TypeError, KeyError, AssertionError)
 
 
+#: the declared failure kinds (docs/SERVE.md "Failure taxonomy"):
+#: `poison` is `permanent` plus a fleet-wide verdict about the SRC
+#: BYTES — the settle path additionally quarantines the record's SRC
+#: content digest so every plan referencing it fails fast.
+FAILURE_KINDS = ("transient", "permanent", "poison")
+
+
 def classify_failure(exc: BaseException) -> str:
-    """'transient' or 'permanent' for one execution failure. Walks the
-    cause/context chain (the wave barrier and the runner both wrap the
-    executor's exception): an explicit ChainError `kind` anywhere wins;
-    otherwise the first recognizably-environmental or
+    """'transient', 'permanent' or 'poison' for one execution failure.
+    Walks the cause/context chain (the wave barrier and the runner both
+    wrap the executor's exception): an explicit `kind` attribute
+    anywhere wins — ChainError and io.medialib.MediaError both carry
+    one — otherwise the first recognizably-environmental or
     recognizably-deterministic type decides. Unknown shapes default to
     transient — the attempts budget still bounds them, and retrying an
     unknown is cheaper than quarantining a recoverable plan."""
@@ -79,9 +87,9 @@ def classify_failure(exc: BaseException) -> str:
     verdict: Optional[str] = None
     while cursor is not None and id(cursor) not in seen:
         seen.add(id(cursor))
-        if isinstance(cursor, ChainError) and \
-                getattr(cursor, "kind", None) in ("transient", "permanent"):
-            return cursor.kind
+        kind = getattr(cursor, "kind", None)
+        if kind in FAILURE_KINDS:
+            return kind
         if verdict is None:
             if isinstance(cursor, _TRANSIENT_TYPES):
                 verdict = "transient"
@@ -89,6 +97,22 @@ def classify_failure(exc: BaseException) -> str:
                 verdict = "permanent"
         cursor = cursor.__cause__ or cursor.__context__
     return verdict or "transient"
+
+
+def extract_src_digest(exc: BaseException) -> Optional[str]:
+    """The convicting SRC content digest a `poison` verdict carries
+    (ChainError(src_digest=…), docs/ROBUSTNESS.md), walked through the
+    cause/context chain like classify_failure. None = unattributed —
+    the settle path then falls back to solo-wave blame."""
+    seen: set = set()
+    cursor: Optional[BaseException] = exc
+    while cursor is not None and id(cursor) not in seen:
+        seen.add(id(cursor))
+        digest = getattr(cursor, "src_digest", None)
+        if digest:
+            return str(digest)
+        cursor = cursor.__cause__ or cursor.__context__
+    return None
 
 #: stride virtual-time scale (anything ≫ max weight works; power of two
 #: keeps the passes exact in floats far past any realistic uptime)
@@ -478,6 +502,10 @@ class Scheduler:
         log = get_logger()
         store = store_runtime.active()
         kind = classify_failure(exc)
+        # an attributed poison verdict names the convicting digest on
+        # the exception — wave packing then never decides who parks
+        poison_digest = extract_src_digest(exc) if kind == "poison" \
+            else None
         suspects = sum(1 for r in batch if r.job_id not in settled)
         for record in batch:
             if record.job_id in settled:
@@ -492,15 +520,59 @@ class Scheduler:
                 if committed:
                     self._complete(record, settled)
                     continue
-                if kind == "permanent" and suspects == 1:
+                # blame attribution: a deterministic verdict parks a
+                # record when (a) it owned the failed wave alone, or
+                # (b) the poison verdict NAMES this record's SRC digest
+                # (extract_src_digest) — an attributed conviction from
+                # any wave shape. A mis-attributed sibling keeps
+                # retrying under backoff; a poison record whose budget
+                # is spent quarantines anyway (terminal either way, and
+                # 'failed' would hide it from the operator's quarantine
+                # surface) but convicts NO digest — fleet-wide blame
+                # needs solo ownership or an attributed verdict. An
+                # EXONERATED record — the verdict names a different
+                # digest — never rides that clause: it settles 'failed'
+                # like any spent budget instead of parking a healthy
+                # plan behind an operator re-arm.
+                attributed = (
+                    kind == "poison" and poison_digest is not None
+                    and record.src_digest == poison_digest
+                )
+                exonerated = (
+                    kind == "poison" and poison_digest is not None
+                    and record.src_digest is not None
+                    and record.src_digest != poison_digest
+                )
+                budget_spent = record.attempts + 1 >= self.max_attempts
+                if kind in ("permanent", "poison") and \
+                        (suspects == 1 or attributed or
+                         (kind == "poison" and budget_spent
+                          and not exonerated)):
                     quarantined = self.queue.quarantine(
-                        record.job_id, error=repr(exc),
+                        record.job_id, error=repr(exc), kind=kind,
                     )
                     settled.add(record.job_id)
                     if quarantined is not None:
-                        log.error("serve: job %s quarantined (permanent "
-                                  "failure): %r", record.job_id, exc)
+                        log.error("serve: job %s quarantined (%s "
+                                  "failure): %r", record.job_id, kind, exc)
                         self.on_failed(quarantined)
+                        if kind == "poison" and record.src_digest and \
+                                ((suspects == 1 and not exonerated)
+                                 or attributed):
+                            # the verdict is about the SRC BYTES, not
+                            # this one plan: quarantine the content
+                            # digest fleet-wide and fail every queued
+                            # sibling referencing it — one hostile
+                            # upload must burn ONE attempts budget,
+                            # not one per (HRC × tenant × replica)
+                            swept = self.queue.poison_src(
+                                record.src_digest,
+                                src=record.unit.get("src"),
+                                error=repr(exc), by_job=record.job_id,
+                            )
+                            for sibling in swept:
+                                settled.add(sibling.job_id)
+                                self.on_failed(sibling)
                     continue
                 requeue = record.attempts + 1 < self.max_attempts
                 failed = self.queue.fail(

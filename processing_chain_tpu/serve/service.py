@@ -415,6 +415,8 @@ class ChainServeService:
                         doc["tenant"], doc["priority"], req_id,
                         unit_doc["output"], trace_id=doc.get("trace"),
                         cost_s=float(unit_doc.get("cost_s", 0.0) or 0.0),
+                        src_digest=unit_doc.get("src_digest")
+                        or self.executor.src_digest(unit_doc["unit"]),
                     )
                 elif record.state == "quarantined":
                     # the plan failed PERMANENTLY while the request
@@ -483,21 +485,22 @@ class ChainServeService:
                 # never a durable record
                 plan = self.executor.plan(unit)
                 plan_hash = self.store.plan_hash(plan)
+                record_unit = {
+                    "database": unit.database, "src": unit.src,
+                    "hrc": unit.hrc, "params": unit.params,
+                    "pvs_id": unit.pvs_id,
+                }
                 unit_docs[unit.pvs_id] = {
                     "plan": plan_hash,
                     "planPayload": plan,
                     "output": self.executor.output_name(unit, plan_hash),
                     "cost_s": round(cost.predict_unit_cost(
-                        self.executor, {
-                            "database": unit.database, "src": unit.src,
-                            "hrc": unit.hrc, "params": unit.params,
-                            "pvs_id": unit.pvs_id,
-                        }), 4),
-                    "unit": {
-                        "database": unit.database, "src": unit.src,
-                        "hrc": unit.hrc, "params": unit.params,
-                        "pvs_id": unit.pvs_id,
-                    },
+                        self.executor, record_unit), 4),
+                    # the poison-quarantine key (docs/ROBUSTNESS.md):
+                    # stamped at the front door so the queue record can
+                    # fail fast against the digest registry at enqueue
+                    "src_digest": self.executor.src_digest(record_unit),
+                    "unit": record_unit,
                 }
                 plans[plan_hash] = unit_docs[unit.pvs_id]
         except api.RequestError:
@@ -582,6 +585,7 @@ class ChainServeService:
                 normalized["tenant"], normalized["priority"], req_id,
                 unit_doc["output"], trace_id=trace_id,
                 cost_s=unit_doc["cost_s"],
+                src_digest=unit_doc.get("src_digest"),
             )
             if outcome == "done":
                 # the queue remembers a completion the store no longer

@@ -153,9 +153,11 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
         # hit/miss decision to be about THIS run's input, not a stale one
         for pvs in eligible:
             fo = fanouts.get(pvs)
-            if fo is not None and fo.engaged:
+            if fo is not None and fo.engaged and fo.stall_settled():
                 # the fused render already produced AND committed the
-                # stalled AVPVS from the in-memory stream
+                # stalled AVPVS from the in-memory stream (a DEGRADED
+                # stalling member falls through to the staged pass —
+                # models/fused graceful-degrade contract)
                 continue
             stall_runner.add(av.apply_stalling(pvs, spinner_path=spinner))
         stall_runner.run()

@@ -141,6 +141,30 @@ ENV_INPUTS: dict[str, dict] = {
         "reason": "multi-process barrier namespace (parallel/distributed "
                   "rendezvous files); no artifact byte depends on it",
     },
+    "PC_MEDIA_FAULTS": {
+        "status": "exempt",
+        "reason": "test/CI/chaos fault injection at the native media "
+                  "boundary (io/faults.py): every clause aborts the "
+                  "consuming execution (exception or EOF-kill) before "
+                  "any artifact commits, so no committed byte ever "
+                  "depends on it; production never sets it "
+                  "(docs/ROBUSTNESS.md)",
+    },
+    "PC_MEDIA_DEADLINE_S": {
+        "status": "exempt",
+        "reason": "wall-clock budget on native decode/encode crossings "
+                  "(io/faults.guarded_call): an expiry aborts the "
+                  "crossing with MediaDeadlineExpired before any "
+                  "artifact commits; the frames delivered by surviving "
+                  "crossings are identical at any budget",
+    },
+    "PC_ISOLATE_DECODE": {
+        "status": "exempt",
+        "reason": "first-contact SRC validation routing (io/isolate.py): "
+                  "the supervised child decodes and DISCARDS frames — "
+                  "it decides whether the replica may touch the SRC at "
+                  "all, and never produces artifact bytes",
+    },
     "JAX_NUM_PROCESSES": {
         "status": "exempt",
         "reason": "process topology shards WHICH process renders each "

@@ -76,6 +76,7 @@ METRICS: dict[str, str] = {
     "chain_serve_fenced_settles_total": "counter",
     "chain_serve_claim_reverts_total": "counter",
     "chain_serve_quarantined_total": "counter",
+    "chain_serve_poisoned_total": "counter",
     # serve/ SLO phase histograms, per (tenant × priority-class) —
     # merged across replicas by telemetry/fleet.py and graded against
     # SLO_BANDS below (docs/TELEMETRY.md "Fleet observability")
@@ -104,6 +105,12 @@ METRICS: dict[str, str] = {
     "chain_bufpool_free_bytes": "gauge",
     "chain_bufpool_outstanding_bytes": "gauge",
     "chain_device_memory_bytes": "gauge",
+    # io/faults.py + io/isolate.py + models/fused.py — hostile-input
+    # hardening (docs/ROBUSTNESS.md)
+    "chain_media_faults_injected_total": "counter",
+    "chain_media_deadline_expired_total": "counter",
+    "chain_isolated_decodes_total": "counter",
+    "chain_fused_members_degraded_total": "counter",
 }
 
 #: structured event-log record names (docs/TELEMETRY.md "Event schema")
@@ -135,9 +142,13 @@ EVENTS: frozenset = frozenset({
     "serve_settle_fenced",     # serve/queue.py — stale-epoch settle refused
     "serve_claim_reverted",    # serve/queue.py — mid-claim disk error undone
     "serve_quarantined",   # serve/queue.py — permanent failure parked
+    "serve_src_poisoned",  # serve/queue.py — SRC digest quarantined fleet-wide
     "serve_admission_rejected",  # serve/cost.py — over-budget POST refused
     "serve_wave",          # serve/scheduler.py — one wave dispatched
     "priors_extract",      # priors/model.py — one extraction pass finished
+    "media_fault_injected",    # io/faults.py — PC_MEDIA_FAULTS clause fired
+    "media_deadline_expired",  # io/faults.py — native crossing abandoned
+    "fused_member_degraded",   # models/fused.py — member dropped mid-stream
 
     "log",             # WARNING+ console records bridged into the log
 })

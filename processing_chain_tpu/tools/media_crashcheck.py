@@ -1,0 +1,857 @@
+"""`tools media-crashcheck` — hostile-input proof for the byte path.
+
+`tools queue-crashcheck` (PR 8) proves the durable-write surface
+settles correctly under fault injection at every atomic-write boundary;
+this is its twin for the NATIVE MEDIA boundary (docs/ROBUSTNESS.md): a
+generated corrupt corpus — truncated mid-GOP, garbage header,
+zero-byte, wrong-codec container, mid-stream geometry flip — driven
+through the decoder surface, through p01–p04, and through chain-serve,
+asserting that every unit terminates with the right disposition and
+that nothing leaks:
+
+  * **reader matrix** — each corrupt member through `VideoReader`:
+    the expected failure class fires (open rejection vs mid-stream
+    MediaError carrying the `path @frame N` forensics contract), the
+    bufpool ends with ZERO outstanding blocks and the process fd count
+    is unchanged;
+  * **injection matrix** — every `PC_MEDIA_FAULTS` kind against a
+    CLEAN file (decode-error, short-read, geometry-flip, enospc), plus
+    the deadline self-test: an injected native hang must be abandoned
+    within the configured `PC_MEDIA_DEADLINE_S` budget (wall-clock
+    measured and reported — the CI gate that proves the deadline
+    actually fires), the reader poisoned, the expiry classified
+    transient;
+  * **chain leg** — each corrupt member as the SRC of a tiny database
+    through the stage CLI: the run fails as a CLASSIFIED error (exit
+    code, not a traceback), no partial artifact and no `.inprogress`
+    sentinel survives, the bufpool stays clean;
+  * **serve leg** — a real `chain-serve` service (chain executor,
+    `PC_ISOLATE_DECODE=1`, wave width 1) over clean + corrupt SRCs:
+    clean units `done` with verified store artifacts, corrupt units
+    POISON-quarantined **by content digest** (the registry holds the
+    files' sha256), queued siblings swept without executing
+    (attempts == 0), a second request against the same digest parks at
+    POST time, and the operator rearm → re-conviction roundtrip works
+    (`tools serve-admin poison`); zero partial store artifacts.
+
+Prints one JSON report line (the `MEDIA_FAULTS_*.json` artifact
+committed with the PR) and exits nonzero on any violated expectation.
+
+    python -m processing_chain_tpu tools media-crashcheck
+        [--frames 48] [--deadline-s 0.75] [--hang-s 6]
+        [--timeout-s 240] [--skip-serve] [--skip-chain]
+        [--out FILE] [--root DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Optional, Sequence
+
+from ..utils.fsio import atomic_write_json, atomic_write_text
+from ..utils.log import get_logger
+
+#: corrupt-corpus member -> how the decoder surface must dispose of it.
+#: `open-error`   = the demuxer/decoder rejects the container outright;
+#: `stream-error` = the open succeeds and a MediaError fires mid-stream
+#:                  (carrying the `@frame` forensics contract);
+#: `short-or-error` = libav tolerates the damage as a silent early EOF
+#:                  on some builds and errors on others — both contain
+#:                  (fewer frames than promised, or a classified error),
+#:                  and the serve leg's first-contact frame-count check
+#:                  is what upgrades the silent shape to a verdict.
+CORPUS = {
+    "trunc_gop": "short-or-error",   # valid h264, cut mid-GOP
+    "garbage": "open-error",         # 64 KiB of deterministic noise
+    "zero_byte": "open-error",       # 0-byte file
+    "wrong_codec": "open-error",     # valid RIFF/WAVE audio container
+    "geom_flip": "stream-error",     # mid-stream geometry change
+}
+
+_W, _H, _FPS = 160, 90, 24
+
+
+# ------------------------------------------------------------- corpus
+
+
+def _write_clean(path: str, frames: int, w: int = _W, h: int = _H,
+                 codec: str = "ffv1", gop: int = 1) -> None:
+    import numpy as np
+
+    from ..io.video import VideoWriter
+
+    with VideoWriter(path, codec, w, h, "yuv420p", (_FPS, 1),
+                     gop=gop) as wr:
+        xx, yy = np.meshgrid(np.arange(w), np.arange(h))
+        for f in range(frames):
+            y = ((np.sin((xx + 4 * f) / 23) + np.cos((yy + f) / 17))
+                 * 50 + 120).astype(np.uint8)
+            u = np.full((h // 2, w // 2), 128, np.uint8)
+            v = np.full((h // 2, w // 2), 118, np.uint8)
+            wr.write(y, u, v)
+
+
+def _write_wav(path: str, seconds: float = 0.5, rate: int = 8000) -> None:
+    """A VALID audio-only RIFF/WAVE container: the wrong-codec shape —
+    a well-formed file of the wrong kind, not random bytes."""
+    import struct
+
+    n = int(seconds * rate)
+    data = struct.pack("<%dh" % n, *([0] * n))
+    hdr = (b"RIFF" + struct.pack("<I", 36 + len(data)) + b"WAVE"
+           + b"fmt " + struct.pack("<IHHIIHH", 16, 1, 1, rate,
+                                   rate * 2, 2, 16)
+           + b"data" + struct.pack("<I", len(data)))
+    # chainlint: disable=atomic-write (corpus generation into a private tmp dir)
+    with open(path, "wb") as f:
+        f.write(hdr + data)
+
+
+def make_corrupt_corpus(root: str, frames: int) -> dict:
+    """Generate the corpus; returns {member: path} plus 'clean'."""
+    import numpy as np
+
+    os.makedirs(root, exist_ok=True)
+    paths = {"clean": os.path.join(root, "clean.avi")}
+    _write_clean(paths["clean"], frames)
+
+    # truncated mid-GOP: an INTER-coded stream (one I-frame, the rest
+    # P) cut at 55% — the damage lands inside the open GOP
+    full = os.path.join(root, "trunc_src.avi")
+    _write_clean(full, frames, codec="libx264", gop=max(2, frames))
+    paths["trunc_gop"] = os.path.join(root, "trunc_gop.avi")
+    size = os.path.getsize(full)
+    with open(full, "rb") as f:
+        head = f.read(int(size * 0.55))
+    # chainlint: disable=atomic-write (corpus generation into a private tmp dir)
+    with open(paths["trunc_gop"], "wb") as f:
+        f.write(head)
+    os.unlink(full)
+
+    paths["garbage"] = os.path.join(root, "garbage.avi")
+    rng = np.random.default_rng(15)
+    # chainlint: disable=atomic-write (corpus generation into a private tmp dir)
+    with open(paths["garbage"], "wb") as f:
+        f.write(rng.integers(0, 256, 65536, np.uint8).tobytes())
+
+    paths["zero_byte"] = os.path.join(root, "zero_byte.avi")
+    # chainlint: disable=atomic-write (corpus generation into a private tmp dir)
+    with open(paths["zero_byte"], "wb") as f:
+        pass
+
+    paths["wrong_codec"] = os.path.join(root, "wrong_codec.avi")
+    _write_wav(paths["wrong_codec"])
+
+    # mid-stream geometry flip: a clean stream whose decode flips
+    # geometry at frame 8 via the injection layer (authoring a real
+    # container whose parameter sets flip mid-stream is exactly the
+    # fiddly thing io/faults exists to make deterministic; media.cpp's
+    # rejection shape is what the clause raises)
+    paths["geom_flip"] = os.path.join(root, "geom_flip.avi")
+    _write_clean(paths["geom_flip"], frames)
+    return paths
+
+
+def _fault_env(member: str, path: str) -> dict:
+    """PC_MEDIA_FAULTS clauses a corpus member needs (geom_flip is
+    injection-driven; everything else is real bytes)."""
+    if member == "geom_flip":
+        return {"PC_MEDIA_FAULTS":
+                f"geometry-flip@frame=8,match={os.path.basename(path)},"
+                "times=0"}
+    return {}
+
+
+class _EnvPatch:
+    """Scoped os.environ overlay (None = remove)."""
+
+    def __init__(self, **values) -> None:
+        self._values = values
+        self._saved: dict = {}
+
+    def __enter__(self) -> "_EnvPatch":
+        for key, value in self._values.items():
+            self._saved[key] = os.environ.get(key)
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = str(value)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for key, old in self._saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+# ------------------------------------------------------------ accounting
+
+
+def _bufpool_outstanding() -> int:
+    from ..io.bufpool import DEFAULT_POOL
+
+    return int(DEFAULT_POOL.stats()["outstanding"])
+
+
+def _open_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
+def _sweep_leaks(failures: list, where: str, fds_before: int) -> None:
+    """Zero leaked bufpool blocks; fd count back to baseline (a couple
+    of retries ride out lazily-closed writer threads)."""
+    import gc
+
+    gc.collect()  # drop traceback↔frame cycles from caught failures
+    out = _bufpool_outstanding()
+    if out:
+        failures.append(f"{where}: {out} bufpool block(s) leaked")
+    for _ in range(20):
+        if fds_before < 0 or _open_fds() <= fds_before:
+            return
+        time.sleep(0.1)
+    failures.append(
+        f"{where}: fd count {_open_fds()} above baseline {fds_before}")
+
+
+# --------------------------------------------------------- reader matrix
+
+
+def _drain_reader(path: str) -> dict:
+    """Decode every frame of `path` through the chunked reader,
+    releasing pooled blocks as they stream; returns {frames} or raises."""
+    from ..io.bufpool import DEFAULT_POOL
+    from ..io.video import VideoReader
+
+    frames = 0
+    with VideoReader(path) as reader:
+        for chunk in reader.iter_chunks():
+            frames += int(chunk[0].shape[0])
+            DEFAULT_POOL.release(*chunk)
+    return {"frames": frames}
+
+
+def reader_matrix(paths: dict, frames: int, failures: list) -> dict:
+    """Each corrupt member through the decoder surface; dispositions
+    per the CORPUS table, leak accounting per member."""
+    from ..io.medialib import MediaError
+
+    results: dict = {}
+    for member, expect in CORPUS.items():
+        path = paths[member]
+        fds = _open_fds()
+        observed: dict = {"expect": expect}
+        with _EnvPatch(**(_fault_env(member, path) or
+                          {"PC_MEDIA_FAULTS": None})):
+            _reset_faults()
+            try:
+                observed.update(_drain_reader(path))
+                observed["outcome"] = "eof"
+            except MediaError as exc:
+                observed["outcome"] = "media-error"
+                observed["error"] = str(exc)[:200]
+            except Exception as exc:  # noqa: BLE001 - matrix verdict
+                observed["outcome"] = f"unexpected:{type(exc).__name__}"
+                observed["error"] = str(exc)[:200]
+        results[member] = observed
+        ok = {
+            "open-error": observed["outcome"] == "media-error",
+            "stream-error": observed["outcome"] == "media-error"
+            and "@frame" in observed.get("error", ""),
+            "short-or-error":
+                observed["outcome"] == "media-error"
+                or (observed["outcome"] == "eof"
+                    and observed.get("frames", frames) < frames),
+        }[expect]
+        if not ok:
+            failures.append(
+                f"reader[{member}]: expected {expect}, observed "
+                f"{observed['outcome']} ({observed.get('error', '')[:80]}"
+                f" frames={observed.get('frames')})")
+        if observed["outcome"] == "media-error" and \
+                path not in observed.get("error", ""):
+            failures.append(
+                f"reader[{member}]: MediaError does not name the source "
+                f"path (forensics contract): {observed['error'][:120]}")
+        _sweep_leaks(failures, f"reader[{member}]", fds)
+    return results
+
+
+def _reset_faults() -> None:
+    from ..io import faults
+
+    faults.reset_fire_counts()
+
+
+# ------------------------------------------------------ injection matrix
+
+
+def injection_matrix(paths: dict, frames: int, deadline_s: float,
+                     hang_s: float, failures: list) -> dict:
+    """Every PC_MEDIA_FAULTS kind against the CLEAN file, including the
+    deadline self-test (the hang must be abandoned within budget)."""
+    import numpy as np
+
+    from ..io.medialib import MediaError
+    from ..io.video import VideoWriter
+
+    clean = paths["clean"]
+    base = os.path.basename(clean)
+    results: dict = {}
+
+    # decode-error at a mid-stream frame: classified, frame-attributed
+    fds = _open_fds()
+    with _EnvPatch(PC_MEDIA_FAULTS=f"decode-error@frame=10,match={base}"):
+        _reset_faults()
+        try:
+            _drain_reader(clean)
+            failures.append("inject[decode-error]: no error raised")
+            results["decode_error"] = {"outcome": "eof"}
+        except MediaError as exc:
+            results["decode_error"] = {"outcome": "media-error",
+                                       "error": str(exc)[:200]}
+            if "@frame" not in str(exc):
+                failures.append(
+                    "inject[decode-error]: error lacks the @frame "
+                    f"forensics: {exc}")
+    _sweep_leaks(failures, "inject[decode-error]", fds)
+
+    # short-read: silent EOF after exactly N frames, NO error
+    with _EnvPatch(PC_MEDIA_FAULTS=f"short-read@frame=12,match={base}"):
+        _reset_faults()
+        try:
+            got = _drain_reader(clean)
+            results["short_read"] = got
+            if got["frames"] != 12:
+                failures.append(
+                    f"inject[short-read]: {got['frames']} frames "
+                    "delivered, expected exactly 12")
+        except MediaError as exc:
+            failures.append(f"inject[short-read]: raised {exc!r}, "
+                            "expected a silent early EOF")
+
+    # geometry-flip: the media.cpp mid-stream rejection shape
+    with _EnvPatch(PC_MEDIA_FAULTS=f"geometry-flip@frame=6,match={base}"):
+        _reset_faults()
+        try:
+            _drain_reader(clean)
+            failures.append("inject[geometry-flip]: no error raised")
+        except MediaError as exc:
+            results["geometry_flip"] = {"error": str(exc)[:200]}
+            if "geometry" not in str(exc):
+                failures.append(
+                    f"inject[geometry-flip]: unexpected shape: {exc}")
+
+    # enospc on the encode write: the full-disk shape, an OSError with
+    # the real errno so classify_failure reads it transient
+    import errno as errno_mod
+
+    enc_path = os.path.join(os.path.dirname(clean), "enospc_out.avi")
+    with _EnvPatch(PC_MEDIA_FAULTS="enospc@frame=3,match=enospc_out"):
+        _reset_faults()
+        try:
+            y = np.full((_H, _W), 128, np.uint8)
+            u = np.full((_H // 2, _W // 2), 128, np.uint8)
+            v = np.full((_H // 2, _W // 2), 128, np.uint8)
+            with VideoWriter(enc_path, "ffv1", _W, _H, "yuv420p",
+                             (_FPS, 1)) as wr:
+                for _ in range(8):
+                    wr.write(y, u, v)
+            failures.append("inject[enospc]: encode completed")
+        except OSError as exc:
+            results["enospc"] = {"errno": exc.errno}
+            if exc.errno != errno_mod.ENOSPC:
+                failures.append(
+                    f"inject[enospc]: errno {exc.errno}, expected ENOSPC")
+        finally:
+            if os.path.isfile(enc_path):
+                os.unlink(enc_path)
+
+    # THE DEADLINE SELF-TEST: an injected native hang (longer than the
+    # whole gate's patience) must be abandoned within the configured
+    # budget — this is the claim "a hung decoder call cannot own a
+    # worker" made empirical. The reader must come back poisoned.
+    from ..io import faults as faults_mod
+    from ..io.bufpool import DEFAULT_POOL
+    from ..io.video import VideoReader
+
+    with _EnvPatch(
+        PC_MEDIA_FAULTS=f"hang@seconds={hang_s:g},op=decode,match={base}",
+        PC_MEDIA_DEADLINE_S=f"{deadline_s:g}",
+    ):
+        _reset_faults()
+        t0 = time.perf_counter()
+        reader = VideoReader(clean)
+        try:
+            for chunk in reader.iter_chunks():
+                DEFAULT_POOL.release(*chunk)
+            failures.append("inject[hang]: decode completed — the hang "
+                            "never fired")
+            elapsed = time.perf_counter() - t0
+        except faults_mod.MediaDeadlineExpired as exc:
+            elapsed = time.perf_counter() - t0
+            results["hang_deadline"] = {
+                "deadline_s": deadline_s,
+                "hang_s": hang_s,
+                "abandoned_after_s": round(elapsed, 3),
+                "kind": getattr(exc, "kind", None),
+            }
+            if elapsed > deadline_s + 2.0:
+                failures.append(
+                    f"inject[hang]: abandoned after {elapsed:.2f}s — far "
+                    f"past the {deadline_s:g}s budget")
+            if getattr(exc, "kind", None) != "transient":
+                failures.append(
+                    "inject[hang]: expiry not classified transient "
+                    f"(kind={getattr(exc, 'kind', None)!r})")
+            try:
+                next(iter(reader.iter_chunks()))
+                failures.append("inject[hang]: poisoned reader still "
+                                "decodes")
+            except faults_mod.MediaError:
+                pass  # refused: the poisoned-handle contract
+        # the abandoned thread still sleeps inside the injected hang,
+        # holding its blocks — DELIBERATELY leaked with the handle. A
+        # real worker dies here; this harness lives on, so wait for the
+        # abandoned thread to run out and drop them before later legs
+        # do their own zero-leak accounting. The frames that held the
+        # blocks sit in traceback↔frame cycles: only the cyclic GC
+        # returns them.
+        import gc
+
+        deadline = time.monotonic() + hang_s + 15.0
+        while time.monotonic() < deadline and \
+                DEFAULT_POOL.stats()["outstanding"]:
+            gc.collect()
+            time.sleep(0.2)
+        if DEFAULT_POOL.stats()["outstanding"]:
+            failures.append(
+                "inject[hang]: abandoned blocks never settled after the "
+                "hang ran out")
+    return results
+
+
+# ------------------------------------------------------------- chain leg
+
+
+_DB_YAML = """\
+databaseId: {db}
+syntaxVersion: 6
+type: short
+qualityLevelList:
+  Q0: {{index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24}}
+codingList:
+  VC01: {{type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}}
+srcList:
+  SRC000: SRC000.avi
+hrcList:
+  HRC000: {{videoCodingId: VC01, eventList: [[Q0, 2]]}}
+  HRC001: {{videoCodingId: VC01, eventList: [[Q0, 1]]}}
+pvsList:
+  - {db}_SRC000_HRC000
+  - {db}_SRC000_HRC001
+postProcessingList:
+  - {{type: pc, displayWidth: 160, displayHeight: 90, codingWidth: 160, codingHeight: 90, displayFrameRate: 24}}
+"""
+
+
+def _residue(db_dir: str) -> list[str]:
+    """Partial artifacts / sentinels left under a database dir."""
+    bad = []
+    for base, _dirs, names in os.walk(db_dir):
+        for name in names:
+            if name.endswith(".inprogress") or name.endswith(".part") \
+                    or name.endswith(".tmp"):
+                bad.append(os.path.relpath(os.path.join(base, name),
+                                           db_dir))
+    return bad
+
+
+def chain_leg(paths: dict, root: str, failures: list) -> dict:
+    """Each corrupt member as SRC000 of a tiny database through the
+    stage CLI: classified failure, zero residue, zero leaks. The clean
+    member must pass p01–p04 in the same harness (the corpus is only
+    proof if the pipeline it fails is one that works)."""
+    from ..cli import main as cli_main
+
+    results: dict = {}
+    members = ["clean", *CORPUS]
+    for i, member in enumerate(members):
+        db = f"P2SXM{60 + i}"
+        db_dir = os.path.join(root, "chain", db)
+        os.makedirs(os.path.join(db_dir, "srcVid"), exist_ok=True)
+        atomic_write_text(os.path.join(db_dir, db + ".yaml"),
+                          _DB_YAML.format(db=db))
+        shutil.copyfile(paths[member],
+                        os.path.join(db_dir, "srcVid", "SRC000.avi"))
+        yaml_path = os.path.join(db_dir, db + ".yaml")
+        fds = _open_fds()
+        observed: dict = {}
+        with _EnvPatch(**(_fault_env(member, "SRC000.avi") or
+                          {"PC_MEDIA_FAULTS": None})):
+            _reset_faults()
+            stage_rcs: dict = {}
+            try:
+                for stage in ("p01", "p02", "p03", "p04"):
+                    rc = cli_main([stage, "-c", yaml_path,
+                                   "--skip-requirements"])
+                    stage_rcs[stage] = rc
+                    if rc != 0:
+                        break
+                observed = {"stages": stage_rcs, "outcome": "exit"}
+            except BaseException as exc:  # noqa: BLE001 - matrix verdict
+                observed = {"stages": stage_rcs,
+                            "outcome": f"raise:{type(exc).__name__}",
+                            "error": str(exc)[:200]}
+        results[member] = observed
+        if member == "clean":
+            if observed["outcome"] != "exit" or \
+                    any(rc != 0 for rc in observed["stages"].values()):
+                failures.append(
+                    f"chain[clean]: the control run failed: {observed}")
+        else:
+            terminal_ok = observed["outcome"] == "exit" and \
+                any(rc != 0 for rc in observed["stages"].values())
+            if not terminal_ok:
+                failures.append(
+                    f"chain[{member}]: expected a CLASSIFIED nonzero "
+                    f"exit, observed {observed} — an unclassified "
+                    "traceback (or a clean pass) is a containment "
+                    "failure")
+        residue = _residue(db_dir)
+        if residue:
+            failures.append(f"chain[{member}]: residue after the run: "
+                            f"{residue[:5]}")
+        _sweep_leaks(failures, f"chain[{member}]", fds)
+    return results
+
+
+# ------------------------------------------------------------- serve leg
+
+
+def _post(url: str, payload: dict, timeout: float = 60.0) -> dict:
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return json.loads(exc.read().decode())
+
+
+def _serve_corpus(root: str, paths: dict, frames: int) -> dict:
+    """A chain-executor database whose srcVid holds the clean SRC and
+    two REAL corrupt members (the ones whose bytes are hostile without
+    injection help)."""
+    db = "P2SXM75"
+    db_dir = os.path.join(root, "serve-corpus", db)
+    os.makedirs(os.path.join(db_dir, "srcVid"), exist_ok=True)
+    members = {"SRC000": "clean", "SRC001": "trunc_gop", "SRC002":
+               "garbage"}
+    for src, member in members.items():
+        shutil.copyfile(paths[member],
+                        os.path.join(db_dir, "srcVid", src + ".avi"))
+    hrc_rows = "\n".join(
+        f"  HRC{i:03d}: {{videoCodingId: VC01, eventList: [[Q0, 2]]}}"
+        for i in range(3)
+    )
+    pvs_rows = "\n".join(
+        f"  - {db}_{src}_HRC{i:03d}" for src in members for i in range(3)
+    )
+    config = os.path.join(db_dir, db + ".yaml")
+    atomic_write_text(config, (
+        f"databaseId: {db}\n"
+        "syntaxVersion: 6\n"
+        "type: short\n"
+        "qualityLevelList:\n"
+        "  Q0: {index: 0, videoCodec: h264, videoBitrate: 200, "
+        "width: 160, height: 90, fps: 24}\n"
+        "codingList:\n"
+        "  VC01: {type: video, encoder: libx264, passes: 1, "
+        "iFrameInterval: 1, preset: ultrafast}\n"
+        "srcList:\n"
+        + "\n".join(f"  {s}: {s}.avi" for s in members) + "\n"
+        f"hrcList:\n{hrc_rows}\n"
+        f"pvsList:\n{pvs_rows}\n"
+        "postProcessingList:\n"
+        "  - {type: pc, displayWidth: 160, displayHeight: 90, "
+        "codingWidth: 160, codingHeight: 90, displayFrameRate: 24}\n"
+    ))
+    return {"database": db, "config": config, "dir": db_dir,
+            "members": members}
+
+
+def _disk_records(serve_root: str) -> list[dict]:
+    """Every queue record from disk — the durable truth, exactly the
+    surface `tools serve-chaos` audits."""
+    records = []
+    jobs_dir = os.path.join(serve_root, "queue", "jobs")
+    try:
+        names = os.listdir(jobs_dir)
+    except OSError:
+        return records
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(jobs_dir, name)) as f:
+                records.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return records
+
+
+def serve_leg(paths: dict, root: str, frames: int, timeout_s: float,
+              failures: list) -> dict:
+    """The end-to-end poison story against a REAL chain-serve service
+    (see module doc)."""
+    from ..serve.service import ChainServeService
+    from ..store import runtime as store_runtime
+    from ..store.keys import hash_file
+
+    corpus = _serve_corpus(root, paths, frames)
+    serve_root = os.path.join(root, "serve")
+    results: dict = {}
+    with _EnvPatch(PC_ISOLATE_DECODE="1", PC_MEDIA_FAULTS=None,
+                   JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu")):
+        service = ChainServeService(
+            root=serve_root, port=0, executor="chain", workers=1,
+            wave_width=1, max_attempts=3, poll_s=0.2,
+        ).start()
+        try:
+            url = service.server.url + "/v1/requests"
+
+            def _wait_terminal(req_id: str) -> dict:
+                deadline = time.monotonic() + timeout_s
+                while time.monotonic() < deadline:
+                    doc = service.request_status(req_id) or {}
+                    if doc.get("state") in ("done", "failed"):
+                        return doc
+                    time.sleep(0.2)
+                return {"state": "timeout"}
+
+            # clean SRC: the control — all units done, artifacts real
+            clean = _post(url, {
+                "tenant": "ok", "priority": "interactive",
+                "database": corpus["database"],
+                "srcs": ["SRC000"], "hrcs": ["HRC000"],
+                "params": {"config": corpus["config"]},
+            })
+            doc = _wait_terminal(clean["request"])
+            results["clean_state"] = doc.get("state")
+            if doc.get("state") != "done":
+                failures.append(
+                    f"serve[clean]: ended {doc.get('state')!r} "
+                    f"({doc.get('error')})")
+
+            # corrupt SRCs, two HRCs each: the FIRST failing unit's
+            # poison verdict must sweep its queued sibling by digest
+            convicted: dict = {}
+            for src in ("SRC001", "SRC002"):
+                resp = _post(url, {
+                    "tenant": "hostile", "priority": "normal",
+                    "database": corpus["database"],
+                    "srcs": [src], "hrcs": ["HRC000", "HRC001"],
+                    "params": {"config": corpus["config"]},
+                })
+                doc = _wait_terminal(resp["request"])
+                convicted[src] = doc
+                if doc.get("state") != "failed":
+                    failures.append(
+                        f"serve[{src}]: ended {doc.get('state')!r}, "
+                        "expected failed (poison)")
+
+            queue = service.queue
+            poisoned = {e["digest"]: e for e in queue.poisoned_digests()}
+            results["poisoned_digests"] = len(poisoned)
+            for src in ("SRC001", "SRC002"):
+                digest = hash_file(os.path.join(
+                    corpus["dir"], "srcVid", src + ".avi"))["sha256"]
+                if digest not in poisoned:
+                    failures.append(
+                        f"serve[{src}]: content digest {digest[:12]}… "
+                        "not in the poison registry")
+                records = [r for r in _disk_records(serve_root)
+                           if r.get("srcDigest") == digest]
+                if not records:
+                    failures.append(f"serve[{src}]: no queue records "
+                                    "carry its digest")
+                for r in records:
+                    if r.get("state") != "quarantined":
+                        failures.append(
+                            f"serve[{src}]: record {r.get('job')} ended "
+                            f"{r.get('state')!r}, expected quarantined")
+                    if r.get("errorKind") != "poison":
+                        failures.append(
+                            f"serve[{src}]: record {r.get('job')} kind "
+                            f"{r.get('errorKind')!r}, expected poison")
+                swept = [r for r in records if not r.get("attempts")]
+                if not swept:
+                    failures.append(
+                        f"serve[{src}]: no sibling was swept without "
+                        "executing (attempts==0) — fail-fast never "
+                        "happened")
+                results[f"{src}_records"] = {
+                    "total": len(records),
+                    "swept_without_executing": len(swept),
+                }
+
+            # a SECOND request against a poisoned digest parks at POST
+            # time: new plan, zero executions
+            digest1 = hash_file(os.path.join(
+                corpus["dir"], "srcVid", "SRC001.avi"))["sha256"]
+            before = {r.get("job") for r in _disk_records(serve_root)}
+            resp = _post(url, {
+                "tenant": "other", "priority": "normal",
+                "database": corpus["database"],
+                "srcs": ["SRC001"], "hrcs": ["HRC002"],
+                "params": {"config": corpus["config"]},
+            })
+            doc = _wait_terminal(resp["request"])
+            results["failfast_state"] = doc.get("state")
+            if doc.get("state") != "failed":
+                failures.append(
+                    "serve[failfast]: second request against the "
+                    f"poisoned digest ended {doc.get('state')!r}")
+            late = [r for r in _disk_records(serve_root)
+                    if r.get("job") not in before
+                    and r.get("srcDigest") == digest1]
+            if not late:
+                failures.append("serve[failfast]: the second request "
+                                "minted no record to audit")
+            for r in late:
+                if r.get("attempts") or r.get("state") != "quarantined":
+                    failures.append(
+                        f"serve[failfast]: record {r.get('job')} "
+                        f"state={r.get('state')} attempts="
+                        f"{r.get('attempts')} — it EXECUTED against a "
+                        "known-poisoned digest")
+
+            # operator roundtrip: rearm unparks every record under the
+            # digest; the still-corrupt bytes re-convict
+            rearm = queue.rearm_src(digest1)
+            results["rearm"] = {"was_poisoned": rearm["was_poisoned"],
+                                "rearmed": len(rearm["rearmed"])}
+            if not rearm["was_poisoned"] or not rearm["rearmed"]:
+                failures.append(f"serve[rearm]: {rearm}")
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                states = {r.get("state")
+                          for r in _disk_records(serve_root)
+                          if r.get("srcDigest") == digest1}
+                if states <= {"quarantined", "failed"}:
+                    break
+                time.sleep(0.2)
+            else:
+                failures.append("serve[rearm]: re-armed records never "
+                                "re-settled")
+            if queue.src_poisoned(digest1) is None:
+                failures.append("serve[rearm]: the re-executed corrupt "
+                                "bytes were not re-convicted")
+
+            # zero partial store artifacts: every committed object
+            # verifies, no temp residue under the store root
+            store = store_runtime.active()
+            from ..store.store import StoreCorruption
+
+            for manifest in store.iter_manifests():
+                for digest in manifest.all_digests():
+                    try:
+                        store.verify_object(digest)
+                    except StoreCorruption as exc:
+                        failures.append(f"serve: corrupt store object "
+                                        f"({exc})")
+            residue = _residue(os.path.join(serve_root, "store"))
+            if residue:
+                failures.append(
+                    f"serve: store temp residue: {residue[:5]}")
+        finally:
+            service.stop()
+            store_runtime.configure(None)
+    return results
+
+
+# ----------------------------------------------------------------- main
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools media-crashcheck",
+        description="corrupt-corpus proof for the native media "
+                    "boundary (docs/ROBUSTNESS.md)",
+    )
+    p.add_argument("--frames", type=int, default=48)
+    p.add_argument("--deadline-s", type=float, default=0.75,
+                   help="PC_MEDIA_DEADLINE_S for the hang self-test")
+    p.add_argument("--hang-s", type=float, default=6.0,
+                   help="injected hang length (must dwarf the deadline)")
+    p.add_argument("--timeout-s", type=float, default=240.0)
+    p.add_argument("--skip-serve", action="store_true")
+    p.add_argument("--skip-chain", action="store_true")
+    p.add_argument("--out", default=None,
+                   help="also write the report JSON here")
+    p.add_argument("--root", default=None,
+                   help="working dir (default: a fresh temp dir)")
+    args = p.parse_args(argv)
+    log = get_logger()
+
+    root = args.root or tempfile.mkdtemp(prefix="media-crashcheck-")
+    os.makedirs(root, exist_ok=True)
+    failures: list[str] = []
+    report: dict = {"frames": args.frames, "deadline_s": args.deadline_s,
+                    "root": root}
+    t0 = time.perf_counter()
+
+    paths = make_corrupt_corpus(os.path.join(root, "corpus"), args.frames)
+    report["corpus"] = sorted(CORPUS)
+    log.info("media-crashcheck: corpus of %d corrupt members + 1 clean "
+             "under %s", len(CORPUS), root)
+
+    report["reader"] = reader_matrix(paths, args.frames, failures)
+    log.info("media-crashcheck: reader matrix done (%d findings)",
+             len(failures))
+    report["inject"] = injection_matrix(
+        paths, args.frames, args.deadline_s, args.hang_s, failures)
+    log.info("media-crashcheck: injection matrix done (%d findings)",
+             len(failures))
+    if not args.skip_chain:
+        report["chain"] = chain_leg(paths, root, failures)
+        log.info("media-crashcheck: chain leg done (%d findings)",
+                 len(failures))
+    if not args.skip_serve:
+        report["serve"] = serve_leg(paths, root, args.frames,
+                                    args.timeout_s, failures)
+        log.info("media-crashcheck: serve leg done (%d findings)",
+                 len(failures))
+
+    report["wall_s"] = round(time.perf_counter() - t0, 2)
+    report["failures"] = failures
+    report["ok"] = not failures
+    print(json.dumps(report, sort_keys=True))
+    if args.out:
+        atomic_write_json(args.out, report)
+    if failures:
+        log.error("media-crashcheck: %d violated expectation(s):\n  %s",
+                  len(failures), "\n  ".join(failures))
+        return 1
+    log.info("media-crashcheck: OK (%ss)", report["wall_s"])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -120,6 +120,19 @@ def _scenario(q: DurableQueue) -> None:
         assert stolen >= 1, "expired lease was not stolen"
         fenced = peer.complete(r4.job_id)
         assert fenced is None, "fenced settle was accepted"
+        # poison-SRC quarantine: a QUEUED record carrying a poisoned
+        # content digest is swept through the declared poison edge
+        # (fault-injecting the registry write + the swept persist),
+        # then the operator re-arm unparks it for the drain
+        digest = "d" * 64
+        r5, _ = q.enqueue("p5", {"op": "t", "n": 5}, _unit(5), "t0",
+                          "normal", "req-f", "o5.bin", src_digest=digest)
+        swept = q.poison_src(digest, src="SRC005",
+                             error="hostile bytes",
+                             by_job=r5.job_id)  # queued -> quarantined
+        assert any(r.job_id == r5.job_id for r in swept), \
+            "poison sweep missed the queued record carrying the digest"
+        q.rearm_src(digest)                     # quarantined -> queued
         # drain whatever is queued now
         queued = [r.job_id for r in q.queued_snapshot()]
         for rec in q.claim(queued):

@@ -27,7 +27,15 @@ the invariants from disk, the store, and the survivors' /metrics:
   * quarantined records exist exactly for the poisoned plans;
   * warm-hit requests POSTed DURING the churn stay under the latency
     budget (default p50 < 50 ms) — replica death must not cost the
-    warm path its milliseconds.
+    warm path its milliseconds;
+  * with `--corrupt-corpus`: hostile-upload stand-ins (`poison_src`
+    units) are convicted into the SRC-digest poison registry, queued
+    siblings are swept without executing, a fresh request against a
+    convicted digest parks at POST time, and the registry holds
+    EXACTLY the injected digests (docs/ROBUSTNESS.md);
+  * with `--throughput-floor N`: done-units/s measured over the whole
+    churn window must stay at or above N (ROADMAP item 3: replica
+    death and poison churn must not starve the settle path).
 
 Prints one JSON report line (the `SERVE_CHAOS_*.json` artifact
 committed with the PR) and exits nonzero on any violated invariant.
@@ -71,7 +79,24 @@ _SCRAPED = (
     "chain_serve_fenced_settles_total",
     "chain_serve_quarantined_total",
     "chain_serve_claim_reverts_total",
+    "chain_serve_poisoned_total",
 )
+
+def _synthetic_digest(src: str, database: str = "P2STR01") -> str:
+    """The SyntheticExecutor's SRC content digest for one corpus SRC —
+    ONE source of truth (serve/executors.py src_digest), so the gate's
+    registry expectations can never drift from the executor's identity."""
+    from ..serve.executors import SyntheticExecutor
+
+    return SyntheticExecutor().src_digest(
+        {"database": database, "src": src})
+
+
+#: the --corrupt-corpus workload: hostile-upload stand-ins (the
+#: synthetic executor's `poison_src` param — every unit settles with
+#: the `poison` kind, quarantining the SRC's synthetic content digest
+#: fleet-wide, docs/ROBUSTNESS.md)
+_CORRUPT_SRCS = ("SRC950", "SRC951")
 
 
 # ------------------------------------------------------------ replicas
@@ -431,6 +456,39 @@ def run_chaos(args, root: str) -> dict:
             report["transient_request"] = transient["request"]
             report["poison_request"] = poison["request"]
 
+        if args.corrupt_corpus:
+            # the corrupt-upload workload (docs/ROBUSTNESS.md): two
+            # hostile SRCs × two HRCs from one tenant, POSTed INTO the
+            # churn — the first solo-wave conviction must quarantine
+            # each SRC's content digest and sweep its queued siblings
+            # without executing them
+            corrupt = _post_json(replicas[0].url + "/v1/requests", {
+                "tenant": "uploads", "priority": "normal",
+                "database": "P2STR01",
+                "srcs": list(_CORRUPT_SRCS),
+                "hrcs": ["HRC100", "HRC101"],
+                "params": {"poison_src": True, "geometry": [32, 18],
+                           "size_bytes": args.size_bytes},
+            })
+            expect_failed.add(corrupt["request"])
+            report["corrupt_request"] = corrupt["request"]
+
+        # ---- throughput sampler: done units over the churn window ----
+        thr_samples: list = []
+        thr_stop = threading.Event()
+
+        def _thr_sampler() -> None:
+            while not thr_stop.is_set():
+                done_now = sum(
+                    1 for r in _load_records(root).values()
+                    if r.get("state") == "done"
+                )
+                thr_samples.append((time.monotonic(), done_now))
+                thr_stop.wait(0.25)
+
+        thr_thread = threading.Thread(target=_thr_sampler, daemon=True)
+        thr_thread.start()
+
         # ---- chaos schedule ------------------------------------------
         zombie: Optional[_Replica] = None
         resume_timer: Optional[threading.Timer] = None
@@ -492,6 +550,36 @@ def run_chaos(args, root: str) -> dict:
                     f"at POST time (state {probe.get('state')})")
             time.sleep(0.05)
 
+        # ---- fail-fast: a fresh tenant hits a poisoned digest --------
+        if args.corrupt_corpus:
+            # wait for the first conviction to land in the registry
+            # (it needs a solo-wave verdict, which the jittered backoff
+            # delivers), then a NEW plan (fresh HRC) against the same
+            # SRC must park at enqueue — quarantined with zero
+            # executions — instead of burning its own attempts budget
+            digest0 = _synthetic_digest(_CORRUPT_SRCS[0])
+            registry0 = os.path.join(root, "queue", "poison",
+                                     digest0 + ".json")
+            deadline = time.monotonic() + args.timeout_s
+            while time.monotonic() < deadline and \
+                    not os.path.isfile(registry0):
+                time.sleep(0.2)
+            if not os.path.isfile(registry0):
+                failures.append(
+                    f"corrupt-corpus: digest of {_CORRUPT_SRCS[0]} never "
+                    "reached the poison registry")
+            failfast = _post_json(
+                [r for r in live() if r is not zombie][0].url
+                + "/v1/requests", {
+                    "tenant": "other", "priority": "normal",
+                    "database": "P2STR01",
+                    "srcs": [_CORRUPT_SRCS[0]], "hrcs": ["HRC103"],
+                    "params": {"poison_src": True, "geometry": [32, 18],
+                               "size_bytes": args.size_bytes},
+                })
+            expect_failed.add(failfast["request"])
+            report["corrupt_failfast_request"] = failfast["request"]
+
         # ---- zombie resume: its settles must be fenced, not accepted -
         if resume_timer is not None:
             resume_timer.join()
@@ -513,6 +601,26 @@ def run_chaos(args, root: str) -> dict:
         else:
             failures.append(f"timeout: still unsettled after "
                             f"{args.timeout_s}s: requests {pending[:5]}")
+
+        # ---- throughput floor during churn (ROADMAP item 3) ----------
+        thr_stop.set()
+        thr_thread.join(timeout=10.0)
+        churn_units_per_s: Optional[float] = None
+        if len(thr_samples) >= 2:
+            (t_a, n_a), (t_b, n_b) = thr_samples[0], thr_samples[-1]
+            if t_b > t_a:
+                churn_units_per_s = round((n_b - n_a) / (t_b - t_a), 3)
+        report["churn_throughput_units_per_s"] = churn_units_per_s
+        if args.throughput_floor > 0:
+            if churn_units_per_s is None:
+                failures.append("throughput floor: too few samples to "
+                                "measure churn throughput")
+            elif churn_units_per_s < args.throughput_floor:
+                failures.append(
+                    f"churn throughput {churn_units_per_s:.2f} units/s "
+                    f"under the {args.throughput_floor:g} units/s floor "
+                    "— replica death/poison churn is starving the "
+                    "settle path")
 
         # poisoned plan hashes, for the quarantine invariant
         docs = _load_requests(root)
@@ -562,13 +670,66 @@ def run_chaos(args, root: str) -> dict:
         report["units_total"] = units_total
         report["unique_plans"] = len(unique_plans)
 
+        # ---- corrupt-corpus invariants (docs/ROBUSTNESS.md) ----------
+        if args.corrupt_corpus:
+            records = _load_records(root)
+            expected_digests = {
+                _synthetic_digest(src) for src in _CORRUPT_SRCS
+            }
+            registry = set()
+            poison_dir = os.path.join(root, "queue", "poison")
+            try:
+                registry = {n[:-5] for n in os.listdir(poison_dir)
+                            if n.endswith(".json")}
+            except OSError:
+                pass
+            for digest in expected_digests - registry:
+                failures.append(f"corrupt-corpus: digest {digest[:12]}… "
+                                "missing from the poison registry")
+            for digest in registry - expected_digests:
+                failures.append(f"corrupt-corpus: digest {digest[:12]}… "
+                                "quarantined but never injected")
+            poison_recs = [r for r in records.values()
+                           if r.get("errorKind") == "poison"]
+            if not poison_recs:
+                failures.append("corrupt-corpus: no record settled with "
+                                "the poison kind")
+            for rec in poison_recs:
+                if rec.get("state") != "quarantined":
+                    failures.append(
+                        f"corrupt-corpus: poison record {rec.get('job')} "
+                        f"ended {rec.get('state')!r}, expected "
+                        "quarantined")
+            swept = [r for r in poison_recs
+                     if r.get("state") == "quarantined"
+                     and not r.get("attempts")]
+            if not swept:
+                failures.append(
+                    "corrupt-corpus: no sibling was swept without "
+                    "executing (attempts == 0) — digest fail-fast never "
+                    "fired")
+            report["corrupt_corpus"] = {
+                "digests": len(registry),
+                "poison_records": len(poison_recs),
+                "swept_without_executing": len(swept),
+            }
+
         # ---- invariants ----------------------------------------------
         failures.extend(check_invariants(root, poisoned_plans,
                                          expect_failed=expect_failed))
         if kills_done < args.kills:
             failures.append(f"only {kills_done}/{args.kills} kills were "
                             "delivered (fleet too small?)")
-        if args.stops > 0 and counters["chain_serve_lease_steals_total"] < 1:
+        # the /metrics scrape is a floor over the replicas still alive
+        # at capture time — a stealer killed LATER in the schedule took
+        # its counter with it. The durable span journal records every
+        # steal fleet-wide, so it is the authoritative count.
+        steals_observed = max(
+            counters["chain_serve_lease_steals_total"],
+            (report.get("fleet", {}).get("spans", {}) or {})
+            .get("by_phase", {}).get("steal", 0),
+        )
+        if args.stops > 0 and steals_observed < 1:
             failures.append(
                 "SIGSTOP zombie produced no lease steal — the run proved "
                 "nothing about fencing (lower --lease-s or raise "
@@ -609,6 +770,8 @@ def run_self_test(args, root: str) -> int:
     args.replicas, args.kills, args.stops = 1, 0, 0
     args.clients, args.srcs, args.hrcs = 2, 2, 2
     args.inject = False
+    args.corrupt_corpus = False
+    args.throughput_floor = 0.0
     args.warm_probes = 2
     args.work_ms = 5
     report = run_chaos(args, root)
@@ -731,6 +894,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--no-inject", dest="inject", action="store_false",
                    help="skip the transient/poison fault-injection "
                         "requests")
+    p.add_argument("--corrupt-corpus", action="store_true",
+                   help="drive the hostile-upload workload through the "
+                        "churn: poison-SRC units whose content digests "
+                        "must quarantine fleet-wide with fail-fast "
+                        "sweeps (docs/ROBUSTNESS.md)")
+    p.add_argument("--throughput-floor", type=float, default=0.0,
+                   help="minimum done-units/s over the churn window "
+                        "(0 = report only; ROADMAP item 3 gate)")
     p.add_argument("--timeout-s", type=float, default=180.0)
     p.add_argument("--out", default=None,
                    help="also write the JSON report here")

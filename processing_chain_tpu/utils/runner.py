@@ -61,11 +61,19 @@ class ChainError(RuntimeError):
     corrupt SRC — retrying burns the attempts budget on a determined
     outcome). `None` means the raiser made no claim; consumers fall
     back to exception-type heuristics (serve/scheduler.classify_failure).
+
+    `src_digest` attributes a `kind="poison"` verdict to the content
+    digest of the convicting SRC (docs/ROBUSTNESS.md): the raiser knows
+    WHICH file the decoder rejected, so a multi-unit wave failure still
+    convicts exactly the right digest — wave packing never decides who
+    gets quarantined.
     """
 
-    def __init__(self, *args, kind: Optional[str] = None) -> None:
+    def __init__(self, *args, kind: Optional[str] = None,
+                 src_digest: Optional[str] = None) -> None:
         super().__init__(*args)
         self.kind = kind
+        self.src_digest = src_digest
 
 
 class ParallelRunner:

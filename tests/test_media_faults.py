@@ -1,0 +1,669 @@
+"""Hostile-input hardening (docs/ROBUSTNESS.md): the media-fault
+injection layer, decode/encode deadlines, supervised first-contact
+isolation, the poison failure kind with SRC-digest quarantine, fused
+fan-out graceful degrade, and the truncated-input/ENOSPC satellites.
+
+The full corrupt-corpus proof lives in `tools media-crashcheck` (CI:
+media-fault-smoke); these tests pin the CONTRACTS each layer exposes —
+spec grammar, injection shapes, deadline semantics, verdict
+classification, registry sweep/re-arm — at unit granularity.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.io import faults
+from processing_chain_tpu.io.medialib import MediaError
+from processing_chain_tpu.serve.executors import SyntheticExecutor
+from processing_chain_tpu.serve.queue import DurableQueue
+from processing_chain_tpu.serve.scheduler import (
+    Scheduler,
+    classify_failure,
+    extract_src_digest,
+)
+from processing_chain_tpu.store import runtime as store_runtime
+from processing_chain_tpu.utils.runner import ChainError
+
+try:  # the native-boundary tests need libpcmedia
+    from processing_chain_tpu.io import medialib
+
+    medialib.ensure_loaded()
+    _NATIVE = True
+except MediaError:  # pragma: no cover - CI always builds it
+    _NATIVE = False
+
+needs_native = pytest.mark.skipif(
+    not _NATIVE, reason="native media boundary unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """No test leaks a fault spec, fire counts, or telemetry state."""
+    monkeypatch.delenv("PC_MEDIA_FAULTS", raising=False)
+    monkeypatch.delenv("PC_MEDIA_DEADLINE_S", raising=False)
+    monkeypatch.delenv("PC_ISOLATE_DECODE", raising=False)
+    faults.reset_fire_counts()
+    tm.reset()
+    yield
+    faults.reset_fire_counts()
+    store_runtime.configure(None)
+    tm.disable()
+    tm.reset()
+
+
+# ----------------------------------------------------- fault spec grammar
+
+
+def test_parse_spec_clauses_and_defaults():
+    spec = ("decode-error@frame=7,match=x.avi;"
+            "hang@seconds=1.5,op=encode,times=0;"
+            "short-read@frame=3;geometry-flip;enospc@frame=2,times=4")
+    clauses = faults.parse_spec(spec)
+    assert [c.kind for c in clauses] == [
+        "decode-error", "hang", "short-read", "geometry-flip", "enospc"]
+    dec, hang, short, flip, full = clauses
+    assert dec.frame == 7 and dec.match == "x.avi" and dec.times == 1
+    assert hang.seconds == 1.5 and hang.op == "encode" and hang.times == 0
+    assert short.frame == 3
+    assert flip.frame == 0  # frame-kinds default to frame 0
+    assert full.frame == 2 and full.times == 4
+
+
+@pytest.mark.parametrize("spec", [
+    "explode@frame=1",              # unknown kind
+    "decode-error@frame",           # not key=value
+    "decode-error@frame=x",         # not an int
+    "hang",                         # hang needs seconds > 0
+    "hang@seconds=0",
+    "hang@seconds=1,op=sideways",   # bad op
+    "decode-error@frame=1,bogus=2",  # unknown parameter
+])
+def test_malformed_specs_fail_loudly(spec):
+    """A typo'd chaos spec must raise at parse, not run faultless and
+    'prove' robustness it never tested."""
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(spec)
+
+
+def test_times_budget_is_process_wide_until_reset(monkeypatch):
+    monkeypatch.setenv("PC_MEDIA_FAULTS", "enospc@times=2,frame=0")
+    plan = faults.encoder_faults("/tmp/a.avi")
+    for _ in range(2):
+        with pytest.raises(OSError) as exc_info:
+            plan.check(1)
+        assert exc_info.value.errno == errno.ENOSPC
+    # budget spent: a third open sees no fault (the retry that succeeds)
+    plan2 = faults.encoder_faults("/tmp/a.avi")
+    plan2.check(1)
+    faults.reset_fire_counts()
+    with pytest.raises(OSError):
+        faults.encoder_faults("/tmp/a.avi").check(1)
+
+
+def test_zero_cost_when_unset():
+    assert faults.decoder_faults("/tmp/x.avi") is None
+    assert faults.encoder_faults("/tmp/x.avi") is None
+    assert faults.media_deadline_s() is None
+
+
+def test_match_filters_by_path_substring(monkeypatch):
+    monkeypatch.setenv("PC_MEDIA_FAULTS", "decode-error@frame=0,match=bad")
+    assert faults.decoder_faults("/srcs/good.avi") is None
+    assert faults.decoder_faults("/srcs/bad.avi") is not None
+
+
+# ------------------------------------------------------ deadline semantics
+
+
+def test_guarded_call_no_deadline_is_direct():
+    assert faults.guarded_call(
+        lambda: 42, None, op="decode", path="x.avi") == 42
+
+
+def test_guarded_call_abandons_past_deadline():
+    release = threading.Event()
+
+    def wedged():
+        release.wait(timeout=30.0)
+        return "late"
+
+    t0 = time.perf_counter()
+    with pytest.raises(faults.MediaDeadlineExpired) as exc_info:
+        faults.guarded_call(wedged, 0.3, op="decode", path="src.avi",
+                            frame=5)
+    elapsed = time.perf_counter() - t0
+    release.set()
+    assert elapsed < 5.0  # abandoned at the budget, not the hang length
+    msg = str(exc_info.value)
+    assert "src.avi" in msg and "@frame 5" in msg
+    assert exc_info.value.kind == "transient"
+    assert classify_failure(exc_info.value) == "transient"
+
+
+def test_guarded_call_relays_errors_and_results():
+    assert faults.guarded_call(
+        lambda: "ok", 5.0, op="decode", path="x.avi") == "ok"
+    with pytest.raises(ValueError, match="boom"):
+        faults.guarded_call(
+            lambda: (_ for _ in ()).throw(ValueError("boom")),
+            5.0, op="decode", path="x.avi")
+
+
+# ------------------------------------------------- failure classification
+
+
+def test_classify_poison_kind_wins_through_the_cause_chain():
+    inner = MediaError("hostile bytes", kind="poison")
+    try:
+        try:
+            raise inner
+        except MediaError as exc:
+            raise RuntimeError("wave wrapper") from exc
+    except RuntimeError as wrapped:
+        assert classify_failure(wrapped) == "poison"
+    assert classify_failure(ChainError("x", kind="poison")) == "poison"
+    assert classify_failure(MediaError("x", kind="transient")) == \
+        "transient"
+    assert classify_failure(MediaError("unclassified")) == "transient"
+
+
+def test_extract_src_digest_walks_the_chain():
+    digest = "a" * 64
+    inner = ChainError("rejected", kind="poison", src_digest=digest)
+    try:
+        try:
+            raise inner
+        except ChainError as exc:
+            raise ChainError("task wrapper") from exc
+    except ChainError as wrapped:
+        assert extract_src_digest(wrapped) == digest
+    assert extract_src_digest(ValueError("no digest")) is None
+
+
+# ------------------------------------------- native boundary injection
+
+
+def _write_clean(path, frames=24, w=160, h=90, codec="ffv1"):
+    from processing_chain_tpu.io.video import VideoWriter
+
+    with VideoWriter(str(path), codec, w, h, "yuv420p", (24, 1),
+                     gop=1) as wr:
+        rng = np.random.default_rng(7)
+        for _ in range(frames):
+            wr.write(rng.integers(0, 255, (h, w), np.uint8),
+                     np.full((h // 2, w // 2), 128, np.uint8),
+                     np.full((h // 2, w // 2), 128, np.uint8))
+
+
+def _drain(path):
+    from processing_chain_tpu.io.bufpool import DEFAULT_POOL
+    from processing_chain_tpu.io.video import VideoReader
+
+    frames = 0
+    with VideoReader(str(path)) as reader:
+        for chunk in reader.iter_chunks():
+            frames += int(chunk[0].shape[0])
+            DEFAULT_POOL.release(*chunk)
+    return frames
+
+
+@needs_native
+def test_injected_decode_error_names_path_and_frame(tmp_path, monkeypatch):
+    clean = tmp_path / "clean.avi"
+    _write_clean(clean)
+    monkeypatch.setenv("PC_MEDIA_FAULTS",
+                       "decode-error@frame=10,match=clean.avi")
+    with pytest.raises(MediaError) as exc_info:
+        _drain(clean)
+    msg = str(exc_info.value)
+    assert str(clean) in msg and "@frame" in msg
+
+
+@needs_native
+def test_injected_short_read_delivers_exactly_n_frames(tmp_path,
+                                                       monkeypatch):
+    clean = tmp_path / "clean.avi"
+    _write_clean(clean)
+    monkeypatch.setenv("PC_MEDIA_FAULTS",
+                       "short-read@frame=9,match=clean.avi")
+    assert _drain(clean) == 9  # silent EOF: no error, fewer frames
+
+
+@needs_native
+def test_injected_hang_is_killed_within_the_deadline(tmp_path,
+                                                     monkeypatch):
+    """The deadline self-test at unit granularity: an injected native
+    hang far longer than the budget is abandoned at the budget, the
+    expiry classifies transient, and the reader comes back poisoned."""
+    from processing_chain_tpu.io.video import VideoReader
+
+    clean = tmp_path / "clean.avi"
+    _write_clean(clean, frames=8)
+    monkeypatch.setenv("PC_MEDIA_FAULTS",
+                       "hang@seconds=20,op=decode,match=clean.avi")
+    monkeypatch.setenv("PC_MEDIA_DEADLINE_S", "0.4")
+    tm.enable()
+    reader = VideoReader(str(clean))
+    t0 = time.perf_counter()
+    with pytest.raises(faults.MediaDeadlineExpired):
+        for chunk in reader.iter_chunks():  # pragma: no cover
+            pass
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, f"abandoned after {elapsed:.1f}s, budget 0.4s"
+    with pytest.raises(MediaError, match="closed"):
+        next(iter(reader.iter_chunks()))
+    assert (tm.REGISTRY.sum_series(
+        "chain_media_deadline_expired_total", None) or 0) >= 1
+
+
+@needs_native
+def test_injected_enospc_fails_the_encode_write(tmp_path, monkeypatch):
+    from processing_chain_tpu.io.video import VideoWriter
+
+    out = tmp_path / "out.avi"
+    monkeypatch.setenv("PC_MEDIA_FAULTS", "enospc@frame=2,match=out.avi")
+    with pytest.raises(OSError) as exc_info:
+        with VideoWriter(str(out), "ffv1", 160, 90, "yuv420p",
+                         (24, 1)) as wr:
+            for _ in range(6):
+                wr.write(np.zeros((90, 160), np.uint8),
+                         np.zeros((45, 80), np.uint8),
+                         np.zeros((45, 80), np.uint8))
+    assert exc_info.value.errno == errno.ENOSPC
+    assert classify_failure(exc_info.value) == "transient"
+
+
+# --------------------------------------------- supervised isolation mode
+
+
+def test_classify_isolation_result_matrix():
+    from processing_chain_tpu.io.isolate import classify_isolation_result
+
+    ok = classify_isolation_result(
+        0, json.dumps({"ok": True, "frames": 5}), "")
+    assert ok["verdict"] == "ok" and ok["frames"] == 5
+    crash = classify_isolation_result(-11, "", "")
+    assert crash["verdict"] == "poison" and "signal 11" in crash["detail"]
+    rejected = classify_isolation_result(
+        3, json.dumps({"ok": False, "error": "bad header"}), "")
+    assert rejected["verdict"] == "poison"
+    assert rejected["detail"] == "bad header"
+    # environmental deaths are NOT byte verdicts: an OOM SIGKILL or a
+    # Python traceback (rc 1) must never durably quarantine the digest
+    oom = classify_isolation_result(-9, "", "")
+    assert oom["verdict"] == "transient" and "signal 9" in oom["detail"]
+    env = classify_isolation_result(1, "", "stderr tail")
+    assert env["verdict"] == "transient" and "stderr" in env["detail"]
+
+
+@needs_native
+def test_validate_src_verdicts_end_to_end(tmp_path):
+    """One real supervised child per verdict class: a clean SRC passes
+    with its frame count, garbage bytes convict as poison."""
+    from processing_chain_tpu.io.isolate import validate_src
+
+    clean = tmp_path / "clean.avi"
+    _write_clean(clean, frames=6)
+    report = validate_src(str(clean))
+    assert report["verdict"] == "ok" and report["frames"] == 6
+
+    garbage = tmp_path / "garbage.avi"
+    garbage.write_bytes(np.random.default_rng(3).integers(
+        0, 256, 4096, np.uint8).tobytes())
+    with pytest.raises(ChainError) as exc_info:
+        validate_src(str(garbage))
+    assert exc_info.value.kind == "poison"
+    assert classify_failure(exc_info.value) == "poison"
+
+
+@needs_native
+def test_validate_src_silent_truncation_is_poison(tmp_path, monkeypatch):
+    """The first-contact frame-count check: a stream that ends EARLY
+    with no error (injected short-read riding the inherited env into
+    the child — the shape a libav build that tolerates a mid-GOP cut
+    produces) falls well short of the container's frame promise and
+    convicts as poison, not ok."""
+    from processing_chain_tpu.io.isolate import validate_src
+
+    clean = tmp_path / "clean.avi"
+    _write_clean(clean, frames=24)
+    monkeypatch.setenv("PC_MEDIA_FAULTS",
+                       "short-read@frame=6,match=clean.avi")
+    with pytest.raises(ChainError) as exc_info:
+        validate_src(str(clean))
+    assert exc_info.value.kind == "poison"
+    assert "silent truncation" in str(exc_info.value)
+
+
+def test_promised_frames_tolerates_metadata_imprecision():
+    from processing_chain_tpu.io.isolate import _promised_frames
+
+    assert _promised_frames({"streams": [
+        {"codec_type": "video", "nb_frames": 24}]}) == 24
+    # no nb_frames: duration x avg fps
+    assert _promised_frames({"streams": [
+        {"codec_type": "video", "nb_frames": 0, "duration": 2.0,
+         "avg_frame_rate": "24/1"}]}) == 48
+    # no usable promise -> 0 (the check stays silent)
+    assert _promised_frames({"streams": [
+        {"codec_type": "video", "nb_frames": 0, "duration": 0.0,
+         "avg_frame_rate": "0/0"}]}) == 0
+    assert _promised_frames({"streams": []}) == 0
+
+
+@needs_native
+def test_validate_src_hang_is_transient_and_child_killed(tmp_path,
+                                                         monkeypatch):
+    """A decoder hang in the child blows the deadline: runner.shell
+    kills the child process group and the verdict stays transient (a
+    loaded host produces the same symptom)."""
+    from processing_chain_tpu.io.isolate import validate_src
+
+    clean = tmp_path / "clean.avi"
+    _write_clean(clean, frames=6)
+    # the spec rides the inherited env into the child (module contract)
+    monkeypatch.setenv("PC_MEDIA_FAULTS",
+                       "hang@seconds=60,op=decode,match=clean.avi")
+    t0 = time.perf_counter()
+    with pytest.raises(ChainError) as exc_info:
+        validate_src(str(clean), deadline_s=3.0)
+    assert exc_info.value.kind == "transient"
+    assert time.perf_counter() - t0 < 30.0
+
+
+# ------------------------------------- poison registry + queue semantics
+
+
+def _unit(src="SRC100", pvs="P2STR01_SRC100_HRC100"):
+    return {"database": "P2STR01", "src": src, "hrc": "HRC100",
+            "params": {}, "pvs_id": pvs}
+
+
+def test_poison_src_sweeps_queued_records_by_digest(tmp_path):
+    queue = DurableQueue(str(tmp_path / "q"))
+    digest = "c" * 64
+    r1, _ = queue.enqueue("p" * 64, {"op": "t", "k": 1}, _unit(), "acme",
+                          "normal", "req-1", "a.bin", src_digest=digest)
+    r2, _ = queue.enqueue("q" * 64, {"op": "t", "k": 2}, _unit(), "acme",
+                          "normal", "req-2", "b.bin", src_digest=digest)
+    r3, _ = queue.enqueue("r" * 64, {"op": "t", "k": 3},
+                          _unit(src="SRC101"), "acme", "normal",
+                          "req-3", "c.bin", src_digest="d" * 64)
+    swept = queue.poison_src(digest, src="SRC100", error="hostile",
+                             by_job=r1.job_id)
+    assert {r.job_id for r in swept} == {r1.job_id, r2.job_id}
+    counts = queue.counts()
+    assert counts.get("quarantined") == 2 and counts.get("queued") == 1
+    for rec in swept:
+        assert rec.error_kind == "poison" and rec.attempts == 0
+    assert queue.src_poisoned(digest)["error"] == "hostile"
+    assert queue.src_poisoned("d" * 64) is None
+    # the registry is durable: a fresh queue over the same root sees it
+    queue.close()
+    reloaded = DurableQueue(str(tmp_path / "q"))
+    assert reloaded.src_poisoned(digest) is not None
+    assert r3.job_id  # untouched sibling digest still queued
+    reloaded.close()
+
+
+def test_enqueue_against_poisoned_digest_parks_at_post_time(tmp_path):
+    queue = DurableQueue(str(tmp_path / "q"))
+    digest = "e" * 64
+    queue.poison_src(digest, src="SRC100", error="already convicted")
+    record, outcome = queue.enqueue(
+        "f" * 64, {"op": "t", "k": 9}, _unit(), "acme", "normal",
+        "req-new", "x.bin", src_digest=digest)
+    assert outcome == "quarantined"
+    assert record.state == "quarantined"
+    assert record.error_kind == "poison" and record.attempts == 0
+    # attach to the parked record also reports quarantined, not attached
+    _, outcome2 = queue.enqueue(
+        "f" * 64, {"op": "t", "k": 9}, _unit(), "acme", "normal",
+        "req-more", "x.bin", src_digest=digest)
+    assert outcome2 == "quarantined"
+
+
+def test_rearm_src_unparks_records_and_allows_retry(tmp_path):
+    queue = DurableQueue(str(tmp_path / "q"))
+    digest = "b" * 64
+    r1, _ = queue.enqueue("g" * 64, {"op": "t", "k": 1}, _unit(), "acme",
+                          "normal", "req-1", "a.bin", src_digest=digest)
+    queue.poison_src(digest, error="hostile")
+    assert queue.counts().get("quarantined") == 1
+    result = queue.rearm_src(digest)
+    assert result["was_poisoned"] and result["rearmed"] == [r1.job_id]
+    assert queue.counts() == {"queued": 1}
+    assert queue.src_poisoned(digest) is None
+    # idempotent: re-arming a clean digest is a no-op report
+    again = queue.rearm_src(digest)
+    assert not again["was_poisoned"] and again["rearmed"] == []
+
+
+def test_scheduler_poison_settle_convicts_the_digest_fleet_wide(tmp_path):
+    """The end-to-end settle story with the synthetic executor's
+    poison_src fault: the executed unit quarantines, its SRC digest
+    lands in the registry, the queued sibling (same SRC, different
+    plan) is swept WITHOUT executing, and an unrelated SRC completes."""
+    tm.enable()
+    syn = SyntheticExecutor()
+    try:
+        queue = DurableQueue(str(tmp_path / "q"))
+        bad1 = {**_unit(), "params": {"poison_src": True,
+                                      "geometry": [32, 18]}}
+        bad2 = {**bad1, "pvs_id": "P2STR01_SRC100_HRC101",
+                "hrc": "HRC101"}
+        good = {**_unit(src="SRC200", pvs="P2STR01_SRC200_HRC100"),
+                "params": {"geometry": [32, 18]}}
+        digest = syn.src_digest(bad1)
+        assert digest == syn.src_digest(bad2) != syn.src_digest(good)
+        job_ids = [
+            queue.enqueue("1" * 64, {"op": "t", "k": 1}, bad1, "acme",
+                          "normal", "req-1", "b1.bin",
+                          src_digest=digest)[0].job_id,
+            queue.enqueue("2" * 64, {"op": "t", "k": 2}, bad2, "acme",
+                          "normal", "req-2", "b2.bin",
+                          src_digest=digest)[0].job_id,
+            queue.enqueue("3" * 64, {"op": "t", "k": 3}, good, "acme",
+                          "normal", "req-3", "ok.bin",
+                          src_digest=syn.src_digest(good))[0].job_id,
+        ]
+        sched = Scheduler(queue, syn, str(tmp_path / "a"), workers=1,
+                          wave_width=1).start()
+        try:
+            assert sched.wait_idle(timeout=30.0)
+        finally:
+            sched.stop()
+        counts = queue.counts()
+        assert counts.get("done") == 1
+        assert counts.get("quarantined") == 2
+        assert queue.src_poisoned(digest) is not None
+        records = {jid: queue.record(jid) for jid in job_ids}
+        swept = [r for r in records.values()
+                 if r.state == "quarantined" and r.attempts == 0]
+        assert swept, "no sibling was swept without executing"
+        for rec in records.values():
+            if rec.state == "quarantined":
+                assert rec.error_kind == "poison"
+    finally:
+        tm.disable()
+        store_runtime.configure(None)
+
+
+# ------------------------------------------------- fused graceful degrade
+
+
+@needs_native
+@pytest.mark.slow
+def test_fused_member_degrades_to_staged_partial_path(tmp_path):
+    """A mid-stream encoder fault in ONE fused CPVS member aborts that
+    member only: siblings + the stalled AVPVS settle from the fused
+    pass, the degraded member leaves no partial output, and the staged
+    p04 pass rebuilds exactly it (docs/ROBUSTNESS.md)."""
+    from processing_chain_tpu.cli import main as cli_main
+    from test_fused import SHORT_YAML
+    from test_pipeline_e2e import write_db
+
+    yaml_path = write_db(tmp_path, "P2SXM92", SHORT_YAML,
+                         {"SRC000.avi": dict(n=24)})
+    db = os.path.dirname(yaml_path)
+    assert cli_main(["p01", "-c", yaml_path, "--skip-requirements"]) == 0
+    assert cli_main(["p02", "-c", yaml_path, "--skip-requirements"]) == 0
+
+    degraded_member = "P2SXM92_SRC000_HRC000_PC.avi"
+    os.environ["PC_FUSE_P04"] = "1"
+    os.environ["PC_MEDIA_FAULTS"] = (
+        f"enospc@frame=4,match={degraded_member}")
+    faults.reset_fire_counts()
+    tm.enable()
+    before = tm.REGISTRY.sum_series(
+        "chain_fused_members_degraded_total", None) or 0.0
+    try:
+        assert cli_main(
+            ["p03", "-c", yaml_path, "--skip-requirements"]) == 0
+    finally:
+        os.environ.pop("PC_MEDIA_FAULTS", None)
+    after = tm.REGISTRY.sum_series(
+        "chain_fused_members_degraded_total", None) or 0.0
+    assert after - before == 1.0
+
+    # the degraded member left nothing; siblings + stalling settled
+    assert not os.path.exists(os.path.join(db, "cpvs", degraded_member))
+    assert not os.path.exists(
+        os.path.join(db, "cpvs", degraded_member + ".inprogress"))
+    assert os.path.isfile(
+        os.path.join(db, "avpvs", "P2SXM92_SRC000_HRC002.avi"))
+    assert os.path.isfile(
+        os.path.join(db, "cpvs", "P2SXM92_SRC000_HRC002_PC.avi"))
+
+    # the staged partial path rebuilds exactly the degraded member
+    try:
+        assert cli_main(
+            ["p04", "-c", yaml_path, "--skip-requirements"]) == 0
+    finally:
+        os.environ.pop("PC_FUSE_P04", None)
+    rebuilt = os.path.join(db, "cpvs", degraded_member)
+    assert os.path.isfile(rebuilt)
+    frames = _drain(rebuilt)
+    assert frames > 0
+
+
+# --------------------------------------------------- satellite: store
+
+
+@needs_native
+def test_store_commit_under_enospc_degrades_cleanly(tmp_path,
+                                                    monkeypatch):
+    """ENOSPC during object ingestion: the tmp dir is swept, no torn
+    manifest exists (a later warm lookup is a clean miss, not a corrupt
+    hit), and the failure classifies transient — serve settles it under
+    the retry budget, not quarantine."""
+    from processing_chain_tpu.store import store as store_mod
+    from processing_chain_tpu.store.store import ArtifactStore
+
+    artifact = tmp_path / "artifact.avi"
+    _write_clean(artifact, frames=4)
+    store = ArtifactStore(str(tmp_path / "store"))
+
+    real = store_mod._link_or_copy
+
+    def failing(srcpath, dst):
+        real(srcpath, dst)  # bytes land first: the torn-write shape
+        raise OSError(errno.ENOSPC, "No space left on device", dst)
+
+    monkeypatch.setattr(store_mod, "_link_or_copy", failing)
+    plan_hash = "5" * 64
+    with pytest.raises(OSError) as exc_info:
+        store.commit(plan_hash, str(artifact), producer="test")
+    assert exc_info.value.errno == errno.ENOSPC
+    assert classify_failure(exc_info.value) == "transient"
+    monkeypatch.setattr(store_mod, "_link_or_copy", real)
+    assert os.listdir(store.tmp_dir) == []  # swept, not stranded
+    assert not os.path.isfile(store.manifest_path(plan_hash))
+    assert store.lookup(plan_hash) is None
+    # the retry (disk freed) commits cleanly over the same store
+    manifest = store.commit(plan_hash, str(artifact), producer="test")
+    assert store.lookup(plan_hash) is not None
+    store.verify_object(manifest.object, deep=True)
+
+
+# --------------------------------- satellite: truncated-input degrades
+
+
+@needs_native
+def test_framesizes_degrade_on_truncated_and_garbage_input(tmp_path):
+    """io/framesizes on hostile bytes: a mid-GOP truncation degrades to
+    FEWER sizes — a clean prefix plus at most one torn tail packet
+    reported at its truncated length, never a crash or a fabricated
+    size; garbage and zero-byte containers raise a MediaError naming
+    the path."""
+    from processing_chain_tpu.io import framesizes
+
+    clean = tmp_path / "clean.avi"
+    _write_clean(clean, frames=24, codec="libx264")
+    sizes = framesizes.get_framesize_h264(str(clean))
+    assert len(sizes) == 24
+
+    data = clean.read_bytes()
+    trunc = tmp_path / "trunc.avi"
+    trunc.write_bytes(data[: int(len(data) * 0.55)])
+    degraded = framesizes.get_framesize_h264(str(trunc))
+    assert 0 < len(degraded) < 24
+    # clean prefix; the final packet may be the torn one, reported at
+    # its truncated (smaller, still positive) size
+    assert degraded[:-1] == sizes[: len(degraded) - 1]
+    assert 0 < degraded[-1] <= sizes[len(degraded) - 1]
+
+    garbage = tmp_path / "garbage.avi"
+    garbage.write_bytes(np.random.default_rng(1).integers(
+        0, 256, 4096, np.uint8).tobytes())
+    with pytest.raises(MediaError) as exc_info:
+        framesizes.get_framesize_h264(str(garbage))
+    assert str(garbage) in str(exc_info.value)
+
+    zero = tmp_path / "zero.avi"
+    zero.write_bytes(b"")
+    with pytest.raises(MediaError):
+        framesizes.get_framesize_h264(str(zero))
+
+
+@needs_native
+def test_priors_extract_degrades_on_truncated_input(tmp_path):
+    """priors/extract on hostile bytes: truncation degrades to the
+    decodable prefix with ZERO leaked pooled blocks; garbage raises a
+    MediaError naming the path, also leak-free."""
+    from processing_chain_tpu.io.bufpool import DEFAULT_POOL
+    from processing_chain_tpu.priors import extract as pext
+
+    clean = tmp_path / "clean.avi"
+    _write_clean(clean, frames=24, codec="libx264")
+    base = DEFAULT_POOL.stats()["outstanding"]
+    full = pext.extract_priors(str(clean))
+    assert len(full.pts) == 24
+
+    data = clean.read_bytes()
+    trunc = tmp_path / "trunc.avi"
+    trunc.write_bytes(data[: int(len(data) * 0.55)])
+    degraded = pext.extract_priors(str(trunc))
+    assert 0 < len(degraded.pts) < 24
+    n = len(degraded.pts)
+    np.testing.assert_array_equal(
+        degraded.pkt_size[:-1], full.pkt_size[: n - 1])
+    assert 0 < degraded.pkt_size[-1] <= full.pkt_size[n - 1]
+    assert DEFAULT_POOL.stats()["outstanding"] == base
+
+    garbage = tmp_path / "garbage.avi"
+    garbage.write_bytes(np.random.default_rng(2).integers(
+        0, 256, 4096, np.uint8).tobytes())
+    with pytest.raises(MediaError) as exc_info:
+        pext.extract_priors(str(garbage))
+    assert str(garbage) in str(exc_info.value)
+    assert DEFAULT_POOL.stats()["outstanding"] == base
