@@ -254,7 +254,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
         "clean-logs", "run-report", "store", "chain-top", "chain-profile",
         "bench-compare", "chain-lint", "chain-serve", "serve-soak",
         "queue-crashcheck", "serve-chaos", "media-crashcheck",
-        "serve-admin", "fleet-top", "trace",
+        "serve-admin", "fleet-top", "trace", "store-heat",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -270,6 +270,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import store_admin
 
             return store_admin.main(rest)
+        if name == "store-heat":
+            from .tools import store_heat
+
+            return store_heat.main(rest)
         if name == "chain-top":
             from .tools import chain_top
 

@@ -36,10 +36,14 @@ class StorePressure:
         budget_bytes: Optional[int],
         active_plans: Callable[[], set],
         min_interval_s: float = 5.0,
+        heat=None,
     ) -> None:
         self.store = store
         self.budget_bytes = budget_bytes
         self.active_plans = active_plans
+        #: store.heat.HeatLedger (optional): evictions land in the
+        #: forensics journal so later re-reads count as regret
+        self.heat = heat
         self.min_interval_s = float(min_interval_s)
         self._lock = lockdebug.make_lock("serve_pressure")
         self._last = 0.0          # guarded-by: _lock
@@ -66,6 +70,7 @@ class StorePressure:
             pins = set(self.active_plans())
             summary = store_gc.enforce_budget(
                 self.store, self.budget_bytes, extra_pins=pins,
+                heat=self.heat,
             )
             _GC_EVICTED.inc(summary["bytes_freed"])
             tm.emit(

@@ -49,6 +49,7 @@ from typing import NamedTuple, Optional
 
 from .. import telemetry as tm
 from ..telemetry import catalog as tm_catalog
+from ..store import heat as store_heat
 from ..store import runtime as store_runtime
 from ..store.store import StoreCorruption
 from ..telemetry import live
@@ -82,6 +83,21 @@ _E2E_SECONDS = tm.histogram(
     "— the SLO layer's third phase next to queue-wait and execution",
     ("tenant", "priority"),
     buckets=tm_catalog.SLO_LATENCY_BUCKETS,
+)
+_READ_TTFB_SECONDS = tm.histogram(
+    "chain_serve_read_ttfb_seconds",
+    "artifact read time-to-first-byte (request to headers+first chunk "
+    "on the wire; a 304 observes here only), per tenant/size class — "
+    "graded against READ_SLO_BANDS by the fleet view",
+    ("tenant", "size_class"),
+    buckets=tm_catalog.READ_LATENCY_BUCKETS,
+)
+_READ_SECONDS = tm.histogram(
+    "chain_serve_read_seconds",
+    "artifact full-stream read latency (request to last byte), per "
+    "tenant/size class",
+    ("tenant", "size_class"),
+    buckets=tm_catalog.READ_LATENCY_BUCKETS,
 )
 
 _HASH_LEN = 64  # sha256 hex
@@ -147,6 +163,12 @@ class ChainServeService:
             replica=replica, lease_s=lease_s,
         )
         self.replica = self.queue.replica
+        #: the read-path flight recorder (store/heat.py): per-replica
+        #: access journal + eviction-regret detector, shared with the
+        #: GC pressure hook so evictions land with forensics
+        self.heat = store_heat.HeatLedger(
+            self.store.root, replica=self.replica
+        )
         self.poll_s = max(0.05, float(poll_s))
         self.info_path = info_path or os.path.join(
             self.root, "serve-info.json"
@@ -166,7 +188,8 @@ class ChainServeService:
         #: plan hash -> request ids still waiting on it
         self._plan_waiters: dict[str, set] = {}  # guarded-by: _lock
         self.pressure = StorePressure(
-            self.store, store_budget_bytes, self.active_plans
+            self.store, store_budget_bytes, self.active_plans,
+            heat=self.heat,
         )
         #: cost-aware serving knobs (docs/SERVE.md "Cost-aware
         #: scheduling & admission"); budgets of None disable each gate
@@ -217,6 +240,7 @@ class ChainServeService:
             "executor": self.executor.kind,
             "replica": self.replica,
             "replica_epoch": self.queue.replica_epoch,
+            "store": self.store.root,
         })
         get_logger().info(
             "chain-serve: %s (root %s, replica %s, executor %s, queue: %s)",
@@ -236,6 +260,7 @@ class ChainServeService:
         # releases this replica's leases/liveness so a successor (or a
         # peer) can reclaim any still-running work immediately
         self.queue.close()
+        self.heat.close()
         if self.store is not None:
             self.store.digests.save()
 
@@ -589,8 +614,11 @@ class ChainServeService:
             )
             if outcome == "done":
                 # the queue remembers a completion the store no longer
-                # holds (evicted): re-arm the same record
+                # holds (evicted): re-arm the same record. If the
+                # eviction was recent, this rebuild is eviction REGRET —
+                # the budget forced recomputation of bytes we had.
                 self.queue.rearm(record.job_id)
+                self.heat.note_read_or_rebuild(plan_hash, via="rebuild")
                 outcome = "new"
             if outcome == "quarantined":
                 # permanent failure on record: the request fails NOW
@@ -956,7 +984,17 @@ class ChainServeService:
             return self._json(404, {"error": f"unknown request {req_id!r}"})
         return self._json(200, doc)
 
+    @staticmethod
+    def _etag_matches(header: str, etag: str) -> bool:
+        """Strong If-None-Match comparison (RFC 9110 §13.1.2): the plan
+        hash IS the content address, so weak tags (`W/"…"`) never
+        match — a weak validator on a CAS key is a client bug."""
+        if header.strip() == "*":
+            return True
+        return any(c.strip() == etag for c in header.split(","))
+
     def _h_artifact(self, req: live.WebRequest):
+        t0 = time.perf_counter()
         key = req.path[len("/v1/artifacts/"):]
         if len(key) != _HASH_LEN or any(
             c not in "0123456789abcdef" for c in key
@@ -967,6 +1005,8 @@ class ChainServeService:
             return self._json(404, {"error": "no store configured"})
         manifest = self.store.lookup(key)
         if manifest is None:
+            # a recently-evicted plan re-requested = eviction regret
+            self.heat.note_read_or_rebuild(key, via="read")
             return self._json(404, {"error": "unknown artifact (expired "
                                              "or never built; re-POST the "
                                              "request to rebuild)"})
@@ -976,6 +1016,28 @@ class ChainServeService:
             return self._json(404, {"error": "artifact failed verification; "
                                              "re-POST the request to rebuild"})
         self.store.touch(manifest)
+        size = int(manifest.object.get("size", 0))
+        size_class = tm_catalog.read_size_class(size)
+        tenant = req.query.get("tenant", "")
+        # the plan hash is a content address: it IS the strong ETag, and
+        # the bytes behind it are immutable — cache forever
+        etag = f'"{key}"'
+        extra = {"ETag": etag,
+                 "Cache-Control": "public, max-age=31536000, immutable"}
+        inm = req.headers.get("if-none-match")
+        if inm and self._etag_matches(inm, etag):
+            # conditional GET hit: no body, fd never opened — the
+            # cheapest read the plane can serve. An edge-class hit in
+            # the heat ledger (mode=not_modified), TTFB-only in the SLO
+            # layer (there is no stream to time).
+            ttfb = time.perf_counter() - t0
+            _READ_TTFB_SECONDS.labels(
+                tenant=tenant, size_class=size_class).observe(ttfb)
+            self.heat.record_read(
+                key, 0, mode="not_modified", size=size,
+                size_class=size_class, tenant=tenant, ttfb_s=ttfb,
+            )
+            return 304, "application/octet-stream", b"", extra
         # streamed from disk (live.FileBody): artifacts are video-scale.
         # Open the fd HERE, not in the reply: the GC pressure hook can
         # evict the object between this check and the streaming loop,
@@ -985,6 +1047,7 @@ class ChainServeService:
         try:
             fileobj = open(path, "rb")
         except FileNotFoundError:
+            self.heat.note_read_or_rebuild(key, via="read")
             return self._json(404, {"error": "artifact evicted; re-POST "
                                              "the request to rebuild"})
         except OSError as exc:
@@ -994,9 +1057,32 @@ class ChainServeService:
             get_logger().warning("serve: artifact open failed: %r", exc)
             return self._json(500, {"error": "artifact temporarily "
                                              "unavailable; retry"})
+
+        ttfb_box: list = []
+
+        def _on_first_byte() -> None:
+            ttfb_box.append(time.perf_counter() - t0)
+            _READ_TTFB_SECONDS.labels(
+                tenant=tenant, size_class=size_class
+            ).observe(ttfb_box[0])
+
+        def _on_complete(sent: int, ok: bool) -> None:
+            dur = time.perf_counter() - t0
+            if ok:
+                _READ_SECONDS.labels(
+                    tenant=tenant, size_class=size_class).observe(dur)
+            # the ledger records every stream, aborted ones included —
+            # bytes left the disk either way
+            self.heat.record_read(
+                key, sent, mode="full", size=size, size_class=size_class,
+                tenant=tenant,
+                ttfb_s=ttfb_box[0] if ttfb_box else None, dur_s=dur,
+            )
+
         return 200, "application/octet-stream", live.FileBody(
-            path, fileobj=fileobj
-        )
+            path, fileobj=fileobj,
+            on_first_byte=_on_first_byte, on_complete=_on_complete,
+        ), extra
 
     # ------------------------------------------------------ test helpers
 

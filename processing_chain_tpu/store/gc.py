@@ -41,6 +41,7 @@ def collect(
     min_object_age_s: float = 3600.0,
     now: Optional[float] = None,
     extra_pins: Optional[set] = None,
+    heat=None,
 ) -> dict:
     """Run one mark-and-sweep pass; returns the summary dict that
     `tools store gc` renders and serve's pressure hook consumes.
@@ -52,7 +53,14 @@ def collect(
     claim. Summary keys beyond the per-phase detail: `bytes_freed`
     (orphans + evictions), `objects_evicted` (object files actually
     unlinked), `pins_honored` (manifests the LRU pass skipped because
-    durable or ephemeral pins protect them)."""
+    durable or ephemeral pins protect them).
+
+    `heat` (a store.heat.HeatLedger) turns on eviction forensics: every
+    victim's evidence dict — the same one `report["victims"]` carries,
+    the `store_evict` event ships, and `tools store gc` prints — is
+    journaled so a later read/rebuild of the plan can be recognized as
+    eviction regret (docs/STORE.md "Access heat & eviction
+    forensics")."""
     log = get_logger()
     now = time.time() if now is None else now
     report = {
@@ -61,6 +69,10 @@ def collect(
         "orphans_removed": 0,
         "orphan_bytes": 0,
         "evicted_manifests": [],
+        #: per-victim evidence dicts (LRU victims AND orphans), the
+        #: forensics record: reason, last-used age, recorded reads,
+        #: freed bytes, and the budget that triggered the pass
+        "victims": [],
         "evicted_bytes": 0,
         "kept_manifests": 0,
         "kept_bytes": 0,
@@ -105,12 +117,22 @@ def collect(
             continue
         path = store.object_path(sha)
         try:
-            if now - os.stat(path).st_mtime < min_object_age_s:
+            age_s = now - os.stat(path).st_mtime
+            if age_s < min_object_age_s:
                 continue
             if not dry_run:
                 os.unlink(path)
             report["orphans_removed"] += 1
             report["orphan_bytes"] += size
+            evidence = {
+                "object": sha,
+                "reason": "orphan",
+                "age_s": round(max(0.0, age_s), 3),
+                "freed_bytes": size,
+            }
+            report["victims"].append(evidence)
+            if heat is not None and not dry_run:
+                heat.record_eviction(evidence)
         except OSError:
             continue
 
@@ -126,6 +148,10 @@ def collect(
         report["pins_honored"] = sum(
             1 for _, m in manifests if m.plan_hash in pins
         )
+        # recorded read counts from the heat ledger, fetched ONCE per
+        # pass (it merges every replica's journal) — the "what did this
+        # plan's history look like" half of the eviction evidence
+        recorded_reads = heat.read_counts() if heat is not None else {}
         while manifests and referenced_bytes(manifests) > size_budget_bytes:
             victim_i = next(
                 (i for i, (_, m) in enumerate(manifests)
@@ -138,12 +164,22 @@ def collect(
                     "manifest is pinned", size_budget_bytes,
                 )
                 break
-            _, victim = manifests.pop(victim_i)
+            victim_mtime, victim = manifests.pop(victim_i)
             survivors: set[str] = set()
             for _, m in manifests:
                 survivors.update(_manifest_digests(m))
             doomed = _manifest_digests(victim) - survivors
             freed = sum(sizes.get(sha, 0) for sha in doomed)
+            evidence = {
+                "plan": victim.plan_hash,
+                "producer": victim.producer,
+                "reason": "over_budget",
+                "last_used_age_s": round(max(0.0, now - victim_mtime), 3),
+                "reads": recorded_reads.get(victim.plan_hash, 0),
+                "freed_bytes": freed,
+                "objects": len(doomed),
+                "budget_bytes": size_budget_bytes,
+            }
             if not dry_run:
                 store._drop_manifest(victim.plan_hash)
                 for sha in doomed:
@@ -152,9 +188,15 @@ def collect(
                     except OSError:
                         pass
                 STORE_EVICTIONS.inc()
-                tm.emit("store_evict", plan=victim.plan_hash,
-                        producer=victim.producer, freed_bytes=freed)
+                # the event carries the full evidence, not aggregates:
+                # the operator render, the forensics journal, and this
+                # event stay in agreement because all three ship the
+                # same dict
+                tm.emit("store_evict", **evidence)
+                if heat is not None:
+                    heat.record_eviction(evidence)
             report["evicted_manifests"].append(victim.plan_hash)
+            report["victims"].append(evidence)
             report["evicted_bytes"] += freed
             report["objects_evicted"] += len(doomed)
 
@@ -172,6 +214,7 @@ def enforce_budget(
     size_budget_bytes: int,
     extra_pins: Optional[set] = None,
     dry_run: bool = False,
+    heat=None,
 ) -> dict:
     """The LRU size-budget path as a programmatic API: one collect()
     pass tuned for a LONG-RUNNING caller (serve's pressure hook) — tmp
@@ -185,4 +228,5 @@ def enforce_budget(
         size_budget_bytes=size_budget_bytes,
         dry_run=dry_run,
         extra_pins=extra_pins,
+        heat=heat,
     )

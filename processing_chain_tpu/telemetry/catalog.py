@@ -62,6 +62,12 @@ METRICS: dict[str, str] = {
     "chain_store_corrupt_total": "counter",
     "chain_store_object_bytes": "gauge",
     "chain_store_objects": "gauge",
+    # store/heat.py — the access-heat ledger: read accounting and the
+    # eviction-regret cache-undersizing signal (docs/STORE.md "Access
+    # heat & eviction forensics")
+    "chain_store_reads_total": "counter",
+    "chain_store_read_bytes_total": "counter",
+    "chain_store_eviction_regret_total": "counter",
     # serve/ — the always-on processing service (docs/SERVE.md)
     "chain_serve_requests_total": "counter",
     "chain_serve_units_total": "counter",
@@ -83,6 +89,11 @@ METRICS: dict[str, str] = {
     "chain_serve_queue_wait_seconds": "histogram",
     "chain_serve_execution_seconds": "histogram",
     "chain_serve_e2e_seconds": "histogram",
+    # serve/ read-path SLO histograms, per (tenant × size class) —
+    # TTFB and full-stream latency of /v1/artifacts, merged by
+    # telemetry/fleet.py and graded against READ_SLO_BANDS below
+    "chain_serve_read_ttfb_seconds": "histogram",
+    "chain_serve_read_seconds": "histogram",
     # serve/cost.py — predicted-cost model: per-tenant accounting,
     # admission refusals, and the observed-vs-predicted audit trail
     # (docs/SERVE.md "Cost-aware scheduling & admission")
@@ -137,6 +148,8 @@ EVENTS: frozenset = frozenset({
     "serve_request_done",  # serve/service.py — request completed/failed
     "serve_requeued",      # serve/queue.py — interrupted job requeued
     "serve_gc",            # serve/pressure.py — budget pass ran
+    "store_regret",        # store/heat.py — recently-evicted plan re-read
+                           # or rebuilt (cache undersizing)
     "serve_lease_stolen",  # serve/queue.py — dead/expired lease reclaimed
     "serve_lease_lost",    # serve/queue.py — heartbeat found its lease gone
     "serve_settle_fenced",     # serve/queue.py — stale-epoch settle refused
@@ -185,4 +198,50 @@ SLO_TARGET_FRACTION = 0.99
 SLO_LATENCY_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
     30.0, 60.0, 120.0, 300.0, 600.0, 1800.0, 3600.0,
+)
+
+# ------------------------------------------------------ read-path SLOs
+#
+# The artifact read path (/v1/artifacts, docs/SERVE.md) is graded per
+# (tenant × SIZE class), not priority class: a 300 MiB render and a
+# 200 KiB thumbnail cannot share a latency band, and the reader does
+# not send a priority. Two phases: read_ttfb_s (request → first body
+# byte; what an edge cache feels) and read_s (request → last byte).
+# A 304 answer observes TTFB only — there is no stream to time.
+
+#: artifact size (bytes, exclusive upper bound; None = unbounded)
+#: -> size-class label, checked in order
+READ_SIZE_CLASSES: tuple = (
+    (1 << 20, "lt1m"),
+    (16 << 20, "lt16m"),
+    (256 << 20, "lt256m"),
+    (None, "ge256m"),
+)
+
+
+def read_size_class(nbytes: int) -> str:
+    """The size-class label of one artifact's byte count."""
+    for bound, label in READ_SIZE_CLASSES:
+        if bound is None or nbytes < bound:
+            return label
+    return READ_SIZE_CLASSES[-1][1]
+
+
+#: read phase -> {size class -> band, seconds}
+READ_SLO_BANDS: dict[str, dict[str, float]] = {
+    "read_ttfb_s": {"lt1m": 0.05, "lt16m": 0.1, "lt256m": 0.25,
+                    "ge256m": 0.5},
+    "read_s": {"lt1m": 0.25, "lt16m": 2.5, "lt256m": 30.0,
+               "ge256m": 120.0},
+}
+
+#: bucket layout of the two read histograms: sub-millisecond floor
+#: (a warm 304 answers in microseconds; SLO_LATENCY_BUCKETS' 5 ms
+#: floor would flatten the whole TTFB distribution into one bucket)
+#: and, as above, extended past every READ_SLO_BANDS band so a breach
+#: is always representable. The same test pins max(band) <=
+#: max(finite bucket).
+READ_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )

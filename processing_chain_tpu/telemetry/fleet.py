@@ -44,6 +44,7 @@ import urllib.request
 from typing import Iterable, Optional
 
 from ..serve import spans as serve_spans
+from ..store import heat as store_heat
 from . import catalog
 
 #: SLO phase -> the metric whose histogram measures it
@@ -51,6 +52,14 @@ PHASE_METRICS = {
     "queue_wait_s": "chain_serve_queue_wait_seconds",
     "execution_s": "chain_serve_execution_seconds",
     "e2e_s": "chain_serve_e2e_seconds",
+}
+
+#: read-path SLO phase -> metric (per tenant × size class; graded
+#: against catalog.READ_SLO_BANDS — docs/STORE.md "Access heat &
+#: eviction forensics")
+READ_PHASE_METRICS = {
+    "read_ttfb_s": "chain_serve_read_ttfb_seconds",
+    "read_s": "chain_serve_read_seconds",
 }
 
 #: per-tenant cost-accounting counters merged into the /fleet "cost"
@@ -346,6 +355,46 @@ def slo_report(merged: dict) -> dict:
     return report
 
 
+def read_slo_report(merged: dict) -> dict:
+    """slo_report's read-path sibling: grade the merged artifact-read
+    histograms against catalog.READ_SLO_BANDS. Returns {tenant:
+    {size_class: {phase: cell}}} with the same cell shape, so the
+    fleet-top renderer formats both reports through one code path."""
+    report: dict = {}
+    for (name, _), series in sorted(merged.items()):
+        phase = next(
+            (p for p, metric in READ_PHASE_METRICS.items()
+             if metric == name),
+            None,
+        )
+        if phase is None:
+            continue
+        labels = series["labels"]
+        tenant = labels.get("tenant", "")
+        size_class = labels.get("size_class", "")
+        cell: dict = {"count": series["count"]}
+        for frac in PERCENTILES:
+            est = percentile_from_buckets(series["buckets"], frac)
+            cell[f"p{int(frac * 100)}"] = \
+                round(est, 6) if est is not None else None
+        band_s = catalog.READ_SLO_BANDS.get(phase, {}).get(size_class)
+        cell["band_s"] = band_s
+        if band_s is None:
+            cell["within_band"] = None
+            cell["ok"] = None
+        else:
+            within = band_fraction(series["buckets"], band_s)
+            cell["within_band"] = \
+                round(within, 4) if within is not None else None
+            cell["ok"] = (
+                None if within is None
+                else within >= catalog.SLO_TARGET_FRACTION
+            )
+        report.setdefault(tenant, {}).setdefault(
+            size_class, {})[phase] = cell
+    return report
+
+
 # ------------------------------------------------------- durable truth
 
 
@@ -388,7 +437,8 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
     replicas: list[dict] = []
     parsed: list[dict] = []
     parsed_counters: list[dict] = []
-    for info in discover_replicas(root):
+    infos = discover_replicas(root)
+    for info in infos:
         entry = {
             "replica": info.get("replica"),
             "replica_epoch": info.get("replica_epoch"),
@@ -425,7 +475,8 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
                 rendered = text.decode(errors="replace")
                 parsed.append(parse_histograms(
                     rendered,
-                    [*PHASE_METRICS.values(), COST_ERROR_METRIC],
+                    [*PHASE_METRICS.values(),
+                     *READ_PHASE_METRICS.values(), COST_ERROR_METRIC],
                 ))
                 parsed_counters.append(
                     parse_counters(rendered, COST_COUNTERS)
@@ -434,6 +485,13 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
             entry["error"] = "unreachable"
         replicas.append(entry)
     merged_hists = merge_histograms(parsed)
+    # the store root each replica declared in its serve-info (the serve
+    # daemon may be pointed at a shared store outside the serve root);
+    # newest registration wins, default to the conventional layout
+    store_root = os.path.join(root, "store")
+    for info in sorted(infos, key=lambda d: d.get("info_mtime", 0.0)):
+        if info.get("store"):
+            store_root = info["store"]
     return {
         "schema": 1,
         "generated_at": round(time.time(), 3),
@@ -444,6 +502,14 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
         "requests": request_counts(root),
         "slo": slo_report(merged_hists),
         "slo_bands": catalog.SLO_BANDS,
+        # artifact read-path grades per (tenant × size class) — the
+        # TTFB/full-stream histograms of serve/service.py's
+        # /v1/artifacts handler, merged like the phase histograms
+        "read_slo": read_slo_report(merged_hists),
+        "read_slo_bands": catalog.READ_SLO_BANDS,
+        # tail-sampled heat-ledger summary (store/heat.py): read/304/
+        # regret/eviction counts over the fleet's journals
+        "heat": store_heat.journal_stats(store_heat.heat_dir(store_root)),
         # per-tenant predicted/observed seconds + admission refusals,
         # merged across replicas (serve/cost.py)
         "cost": {

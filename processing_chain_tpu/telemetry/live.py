@@ -137,6 +137,7 @@ class WebRequest:
     path: str                     # decoded path, query stripped
     query: dict = field(default_factory=dict)
     body: bytes = b""
+    headers: dict = field(default_factory=dict)  # lowercased names
 
 
 @dataclass
@@ -150,13 +151,25 @@ class FileBody:
     an artifact between the handler's check and the reply's streaming
     loop) should open the file themselves and pass `fileobj`: the open
     descriptor keeps the bytes alive for the whole response even if the
-    path is unlinked mid-stream. `_reply` closes it either way."""
+    path is unlinked mid-stream. `_reply` closes it either way.
+
+    `on_first_byte` fires after the response headers are on the wire —
+    the closest observable to the client's TTFB without kernel help —
+    and `on_complete(sent_bytes, ok)` fires exactly once when the
+    stream ends, with `ok=False` on a disconnect or disk failure.
+    Callback exceptions are swallowed: observability hooks must never
+    break the stream they time."""
 
     path: str
     fileobj: Optional[BinaryIO] = None
+    on_first_byte: Optional[Callable[[], None]] = None
+    on_complete: Optional[Callable[[int, bool], None]] = None
 
 
 #: handler signature: WebRequest -> (status code, content type, body)
+#: or (code, content type, body, extra-headers dict) — the 4-tuple form
+#: lets a handler attach response headers (ETag, Cache-Control) without
+#: the registry growing a second dispatch path
 Handler = Callable[[WebRequest], Tuple[int, str, Union[str, bytes, FileBody]]]
 
 
@@ -285,14 +298,20 @@ class _Handler(BaseHTTPRequestHandler):
         req = WebRequest(
             method=method, path=path,
             query=dict(parse_qsl(split.query)), body=body,
+            headers={k.lower(): v for k, v in self.headers.items()},
         )
+        extra: Optional[dict] = None
         try:
-            code, ctype, payload = handler(req)
+            result = handler(req)
+            if len(result) == 4:
+                code, ctype, payload, extra = result
+            else:
+                code, ctype, payload = result
         except Exception as exc:  # noqa: BLE001 - one bad handler must not kill the surface
             code, ctype, payload = 500, "application/json", json.dumps(
                 {"error": repr(exc)[:300]}
             )
-        self._reply(code, ctype, payload)
+        self._reply(code, ctype, payload, extra)
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         self._dispatch("GET")
@@ -303,10 +322,26 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self) -> None:  # noqa: N802 - http.server API
         self._dispatch("DELETE")
 
+    @staticmethod
+    def _fire(cb, *args) -> None:
+        # FileBody callbacks are observability hooks (read-path SLO
+        # timers, the heat ledger); a broken one must not truncate the
+        # stream it is supposed to time
+        if cb is None:
+            return
+        try:
+            cb(*args)
+        except Exception:  # noqa: BLE001
+            get_logger().warning("live: body callback failed",
+                                 exc_info=True)
+
     def _reply(self, code: int, ctype: str,
-               body: Union[str, bytes, FileBody]) -> None:
+               body: Union[str, bytes, FileBody],
+               extra: Optional[dict] = None) -> None:
         try:
             if isinstance(body, FileBody):
+                sent = 0
+                ok = False
                 f = body.fileobj
                 try:
                     if f is None:
@@ -315,20 +350,28 @@ class _Handler(BaseHTTPRequestHandler):
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(size))
+                    for name, value in (extra or {}).items():
+                        self.send_header(name, value)
                     self.end_headers()
+                    self._fire(body.on_first_byte)
                     while True:
                         chunk = f.read(1 << 20)
                         if not chunk:
                             break
                         self.wfile.write(chunk)
+                        sent += len(chunk)
+                    ok = True
                 finally:
                     if f is not None:
                         f.close()
+                    self._fire(body.on_complete, sent, ok)
                 return
             data = body.encode() if isinstance(body, str) else body
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for name, value in (extra or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError):

@@ -108,6 +108,16 @@ def render(view: dict, note: str = "") -> str:
             f"spans: {span_stats['total']}{tail_note} "
             + " ".join(f"{k}={v}" for k, v in sorted(by_phase.items()))
         )
+    heat = view.get("heat", {})
+    if heat.get("total"):
+        tail_note = " (recent window)" if heat.get("sampled") else ""
+        lines.append(
+            f"reads: {heat.get('reads', 0)}{tail_note} "
+            f"full={heat.get('full', 0)} 304={heat.get('not_modified', 0)} "
+            f"served={heat.get('bytes_served', 0) / 1e6:.1f}MB "
+            f"evictions={heat.get('evictions', 0)} "
+            f"regrets={heat.get('regrets', 0)}"
+        )
     fleet_cost = view.get("cost", {})
     if fleet_cost.get("tenants") or fleet_cost.get("rejected"):
         lines.append("")
@@ -146,6 +156,19 @@ def render(view: dict, note: str = "") -> str:
                 if cell is None:
                     continue
                 lines.append(f"    {phase:<13} {_fmt_cell(cell)}")
+    read_slo = view.get("read_slo", {})
+    if read_slo:
+        lines.append("")
+        lines.append("read SLO (artifact TTFB / full stream, per "
+                     "tenant × size class):")
+        for tenant in sorted(read_slo):
+            for size_class in sorted(read_slo[tenant]):
+                lines.append(f"  {tenant or '(any)'}/{size_class}:")
+                for phase in ("read_ttfb_s", "read_s"):
+                    cell = read_slo[tenant][size_class].get(phase)
+                    if cell is None:
+                        continue
+                    lines.append(f"    {phase:<13} {_fmt_cell(cell)}")
     return "\n".join(lines) + "\n"
 
 

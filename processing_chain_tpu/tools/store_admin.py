@@ -23,6 +23,7 @@ import time
 from typing import Optional, Sequence
 
 from ..store import gc as store_gc
+from ..store import heat as store_heat
 from ..store.store import ArtifactStore, StoreCorruption
 from ..utils.log import get_logger
 
@@ -137,9 +138,16 @@ def _cmd_verify(store: ArtifactStore, deep: bool, drop: bool) -> int:
 
 def _cmd_gc(store: ArtifactStore, max_bytes: Optional[int], dry_run: bool,
             tmp_max_age: float, min_object_age: float) -> int:
+    # a real (non-dry) pass journals its evictions so the serve fleet's
+    # regret detector sees operator-driven evictions too — the CLI and
+    # the pressure hook must not keep separate forensic truths
+    heat = None if dry_run else store_heat.HeatLedger(
+        store.root, replica="store-gc"
+    )
     report = store_gc.collect(
         store, size_budget_bytes=max_bytes, dry_run=dry_run,
         tmp_max_age_s=tmp_max_age, min_object_age_s=min_object_age,
+        heat=heat,
     )
     tag = "[dry-run] " if dry_run else ""
     print(f"{tag}tmp swept:        {report['tmp_removed']}")
@@ -147,13 +155,25 @@ def _cmd_gc(store: ArtifactStore, max_bytes: Optional[int], dry_run: bool,
           f"({_human_bytes(report['orphan_bytes'])})")
     print(f"{tag}manifests evicted:{len(report['evicted_manifests']):>2} "
           f"({_human_bytes(report['evicted_bytes'])})")
-    for ph in report["evicted_manifests"]:
-        print(f"{tag}  evict {ph[:12]}")
+    # per-victim evidence: the SAME dicts the store_evict events and
+    # the heat ledger's forensics journal carry (store/gc.py)
+    for v in report["victims"]:
+        if v["reason"] == "orphan":
+            print(f"{tag}  orphan {v['object'][:12]}  "
+                  f"age {v['age_s'] / 3600:.1f}h  "
+                  f"freed {_human_bytes(v['freed_bytes'])}")
+        else:
+            print(f"{tag}  evict {v['plan'][:12]}  over budget  "
+                  f"last used {v['last_used_age_s'] / 3600:.1f}h ago  "
+                  f"{v['reads']} recorded read(s)  "
+                  f"freed {_human_bytes(v['freed_bytes'])}")
     print(f"{tag}kept:             {report['kept_manifests']} manifest(s), "
           f"{_human_bytes(report['kept_bytes'])}")
     print(f"{tag}freed:            {_human_bytes(report['bytes_freed'])} "
           f"({report['objects_evicted']} object(s)); "
           f"{report['pins_honored']} pin(s) honored")
+    if heat is not None:
+        heat.close()
     return 0
 
 
