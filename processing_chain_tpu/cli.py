@@ -255,6 +255,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
         "bench-compare", "chain-lint", "chain-serve", "serve-soak",
         "queue-crashcheck", "serve-chaos", "media-crashcheck",
         "serve-admin", "fleet-top", "trace", "store-heat",
+        "store-tiers",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -274,6 +275,10 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import store_heat
 
             return store_heat.main(rest)
+        if name == "store-tiers":
+            from .tools import store_tiers
+
+            return store_tiers.main(rest)
         if name == "chain-top":
             from .tools import chain_top
 

@@ -49,11 +49,29 @@ class StorePressure:
         self._last = 0.0          # guarded-by: _lock
         self._running = False     # guarded-by: _lock
 
+    def _tier_overflow(self) -> bool:
+        """True when any non-last tier outgrew its OWN byte budget —
+        demotion pressure (store/gc.py "demote before evict") exists
+        even when no TOTAL budget is configured."""
+        tiers = getattr(self.store, "tiers", None)
+        if tiers is None or not tiers.multi:
+            return False
+        return any(
+            t.budget_bytes is not None
+            and t.bytes_held() > t.budget_bytes
+            for t in tiers.tiers[:-1]
+        )
+
     def maybe_collect(self, force: bool = False) -> Optional[dict]:
         """One throttled budget check; the GC pass itself runs OUTSIDE
         the lock (it walks the store) with reentry suppressed. Returns
-        the gc summary when a pass ran, else None."""
-        if self.store is None or not self.budget_bytes:
+        the gc summary when a pass ran, else None. The pass runs when
+        the TOTAL budget is exceeded (eviction pressure) or when any
+        tier outgrew its own budget (demotion pressure)."""
+        if self.store is None:
+            return None
+        tiers = getattr(self.store, "tiers", None)
+        if not self.budget_bytes and (tiers is None or not tiers.multi):
             return None
         with self._lock:
             now = time.monotonic()
@@ -65,7 +83,9 @@ class StorePressure:
             self._running = True
         try:
             stats = self.store.stats()
-            if not force and stats["bytes"] <= self.budget_bytes:
+            over_total = bool(
+                self.budget_bytes and stats["bytes"] > self.budget_bytes)
+            if not force and not over_total and not self._tier_overflow():
                 return None
             pins = set(self.active_plans())
             summary = store_gc.enforce_budget(
@@ -77,14 +97,16 @@ class StorePressure:
                 "serve_gc",
                 bytes_freed=summary["bytes_freed"],
                 objects_evicted=summary["objects_evicted"],
+                demoted_bytes=summary.get("demoted_bytes", 0),
                 pins_honored=summary["pins_honored"],
                 kept_bytes=summary["kept_bytes"],
             )
-            if summary["bytes_freed"]:
+            if summary["bytes_freed"] or summary.get("demoted_bytes"):
                 get_logger().info(
-                    "serve gc: freed %d bytes (%d objects), %d pin(s) "
-                    "honored, %d bytes kept",
+                    "serve gc: freed %d bytes (%d objects), demoted %d "
+                    "bytes, %d pin(s) honored, %d bytes kept",
                     summary["bytes_freed"], summary["objects_evicted"],
+                    summary.get("demoted_bytes", 0),
                     summary["pins_honored"], summary["kept_bytes"],
                 )
             return summary

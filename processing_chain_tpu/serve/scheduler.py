@@ -276,6 +276,10 @@ class Scheduler:
         self._lock = lockdebug.make_lock("serve_sched")
         self._wake = threading.Event()
         self._stop = threading.Event()
+        #: drain gate (docs/SERVE.md "Draining a replica"): while set,
+        #: _next_batch claims nothing — in-flight waves finish, queued
+        #: work stays for peers or for resume()
+        self._draining = threading.Event()
         self._threads: list[threading.Thread] = []
 
     # --------------------------------------------------------- lifecycle
@@ -301,6 +305,20 @@ class Scheduler:
     def notify(self) -> None:
         """New work arrived (submit path); wake idle workers now."""
         self._wake.set()
+
+    def drain(self) -> None:
+        """Stop claiming new work; waves already dispatched finish and
+        settle normally (their leases stay live). Idempotent."""
+        self._draining.set()
+
+    def resume(self) -> None:
+        """Leave draining: claiming resumes with the next wake."""
+        self._draining.clear()
+        self._wake.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
 
     # --------------------------------------------------------- main loop
 
@@ -358,6 +376,10 @@ class Scheduler:
             except Exception:  # noqa: BLE001 - any key failure = unbatchable
                 return None
 
+        if self._draining.is_set():
+            # draining: never claim — queued work is for peers (or for
+            # resume()); waves already in flight settle on their own
+            return []
         with self._lock:
             queued = self.queue.queued_snapshot()
             if not queued:

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import secrets
 import threading
 import time
@@ -134,6 +135,7 @@ class ChainServeService:
         wave_width: int = 4,
         store_root: Optional[str] = None,
         store_budget_bytes: Optional[int] = None,
+        store_tiers: Optional[str] = None,
         tenant_weights: Optional[dict] = None,
         max_attempts: int = 2,
         request_retention: int = 10_000,
@@ -156,7 +158,8 @@ class ChainServeService:
         tm.enable()
         self.executor = make_executor(executor)
         self.store = store_runtime.configure(
-            store_root or os.path.join(self.root, "store")
+            store_root or os.path.join(self.root, "store"),
+            tiers=store_tiers,
         )
         self.queue = DurableQueue(
             os.path.join(self.root, "queue"),
@@ -211,10 +214,20 @@ class ChainServeService:
             wave_budget_s=wave_budget_s,
             on_done=self._on_job_done, on_failed=self._on_job_failed,
         )
+        #: graceful drain (docs/SERVE.md "Draining a replica"): while
+        #: True the scheduler claims nothing; flipped by POST /v1/drain
+        #: or SIGUSR1, reported by /healthz and serve-info
+        self._draining = False               # guarded-by: _lock
+        self._t0 = time.monotonic()
         routes = live.default_routes()
         routes.add("/v1/requests", self._h_requests, methods=("GET", "POST"))
         routes.add_prefix("/v1/requests/", self._h_request)
         routes.add_prefix("/v1/artifacts/", self._h_artifact)
+        routes.add("/v1/drain", self._h_drain, methods=("POST",))
+        # replaces the default liveness route: same shape, plus the
+        # replica's drain state — a draining replica is still HEALTHY
+        # (200), it is just not claiming work
+        routes.add("/healthz", self._h_healthz)
         routes.add("/fleet", self._h_fleet)
         self.server = live.LiveServer(port, host=host, routes=routes)
         self._recover_requests()
@@ -232,6 +245,17 @@ class ChainServeService:
             name="chain-serve-maintenance", daemon=True,
         )
         self._poll_thread.start()
+        self._write_info()
+        get_logger().info(
+            "chain-serve: %s (root %s, replica %s, executor %s, queue: %s)",
+            self.server.url, self.root, self.replica, self.executor.kind,
+            self.queue.recovery,
+        )
+        return self
+
+    def _write_info(self) -> None:
+        with self._lock:
+            state = "draining" if self._draining else "ok"
         atomic_write_json(self.info_path, {
             "pid": os.getpid(),
             "port": self.server.port,
@@ -241,13 +265,39 @@ class ChainServeService:
             "replica": self.replica,
             "replica_epoch": self.queue.replica_epoch,
             "store": self.store.root,
+            "state": state,
         })
-        get_logger().info(
-            "chain-serve: %s (root %s, replica %s, executor %s, queue: %s)",
-            self.server.url, self.root, self.replica, self.executor.kind,
-            self.queue.recovery,
-        )
-        return self
+
+    def drain(self) -> dict:
+        """Flip this replica to draining (docs/SERVE.md "Draining a
+        replica"): the scheduler stops claiming, in-flight waves finish
+        and settle normally, queued work stays for peers (or for
+        resume()). Idempotent; reported by /healthz and serve-info."""
+        with self._lock:
+            was = self._draining
+            self._draining = True
+        if not was:
+            self.scheduler.drain()
+            self._write_info()
+            tm.emit("serve_drain", replica=self.replica,
+                    state="draining")
+            get_logger().info("chain-serve: replica %s draining",
+                              self.replica)
+        return {"replica": self.replica, "state": "draining"}
+
+    def resume(self) -> dict:
+        """Rejoin after a drain: the scheduler claims again with its
+        next wake. Idempotent."""
+        with self._lock:
+            was = self._draining
+            self._draining = False
+        if was:
+            self.scheduler.resume()
+            self._write_info()
+            tm.emit("serve_drain", replica=self.replica, state="ok")
+            get_logger().info("chain-serve: replica %s resumed",
+                              self.replica)
+        return {"replica": self.replica, "state": "ok"}
 
     def stop(self) -> None:
         self._poll_stop.set()
@@ -968,6 +1018,33 @@ class ChainServeService:
         except api.RequestError as exc:
             return self._json(400, {"error": str(exc)})
 
+    def _h_healthz(self, req: live.WebRequest):
+        """Liveness plus drain state. A draining replica answers 200 —
+        it is healthy, it is just not claiming work — so probes keep
+        passing while `tools serve-chaos` cycles a drain/join."""
+        with self._lock:
+            state = "draining" if self._draining else "ok"
+        return 200, "application/json", json.dumps({
+            "status": state,
+            "pid": os.getpid(),
+            "replica": self.replica,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+        })
+
+    def _h_drain(self, req: live.WebRequest):
+        """POST /v1/drain: body `{}` (or empty) drains; `{"resume":
+        true}` rejoins. SIGUSR1 on the daemon is the signal-shaped
+        equivalent of the drain half (tools/chain_serve.py)."""
+        try:
+            payload = json.loads(req.body.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            return self._json(400, {"error": "body is not valid JSON"})
+        if not isinstance(payload, dict):
+            return self._json(400, {"error": "body must be a JSON object"})
+        if payload.get("resume"):
+            return self._json(200, self.resume())
+        return self._json(200, self.drain())
+
     def _h_fleet(self, req: live.WebRequest):
         """The merged fleet view (telemetry/fleet.py): every replica
         over this root — discovered via their serve-info files — plus
@@ -992,6 +1069,38 @@ class ChainServeService:
         if header.strip() == "*":
             return True
         return any(c.strip() == etag for c in header.split(","))
+
+    @staticmethod
+    def _parse_range(header: Optional[str], size: int):
+        """RFC 9110 §14.2 single-range parse against a known size.
+        Returns `(start, length)`, the string `"unsatisfiable"` (→ 416
+        with `Content-Range: bytes */size`), or None when there is no
+        range to honor — absent header, other units, multi-range, and
+        malformed specs all serve the full body, as the spec allows."""
+        if not header:
+            return None
+        m = re.fullmatch(r"bytes=(\d*)-(\d*)", header.strip())
+        if m is None:
+            return None
+        first, last = m.group(1), m.group(2)
+        if not first and not last:
+            return None
+        if not first:
+            # suffix range: the final N bytes
+            n = int(last)
+            if n == 0 or size == 0:
+                return "unsatisfiable"
+            n = min(n, size)
+            return size - n, n
+        start = int(first)
+        if start >= size:
+            return "unsatisfiable"
+        if not last:
+            return start, size - start
+        end = int(last)
+        if end < start:
+            return None
+        return start, min(end, size - 1) - start + 1
 
     def _h_artifact(self, req: live.WebRequest):
         t0 = time.perf_counter()
@@ -1023,6 +1132,7 @@ class ChainServeService:
         # the bytes behind it are immutable — cache forever
         etag = f'"{key}"'
         extra = {"ETag": etag,
+                 "Accept-Ranges": "bytes",
                  "Cache-Control": "public, max-age=31536000, immutable"}
         inm = req.headers.get("if-none-match")
         if inm and self._etag_matches(inm, etag):
@@ -1038,14 +1148,34 @@ class ChainServeService:
                 size_class=size_class, tenant=tenant, ttfb_s=ttfb,
             )
             return 304, "application/octet-stream", b"", extra
+        # RFC 9110 single-range parse against the manifest's size —
+        # BEFORE any fd opens, so an unsatisfiable range costs nothing.
+        # An If-Range validator that fails the strong compare drops the
+        # range (full 200), per §13.1.5.
+        rng = self._parse_range(req.headers.get("range"), size)
+        if rng == "unsatisfiable":
+            extra416 = dict(extra)
+            extra416["Content-Range"] = f"bytes */{size}"
+            return (416, "application/json",
+                    json.dumps({"error": "requested range not "
+                                         "satisfiable", "size": size}),
+                    extra416)
+        if rng is not None:
+            if_range = req.headers.get("if-range")
+            if if_range and if_range.strip() != etag:
+                rng = None
         # streamed from disk (live.FileBody): artifacts are video-scale.
         # Open the fd HERE, not in the reply: the GC pressure hook can
         # evict the object between this check and the streaming loop,
         # and an open descriptor keeps the bytes alive for this response
         # (a post-eviction GET is an honest 404, never a truncated 200).
-        path = self.store.object_path(manifest.object["sha256"])
+        # The open is tier-routed (store/tiers.py): a warm/cold hit is
+        # promoted read-through, and the tier the bytes were FOUND in
+        # lands in the heat journal with the read.
         try:
-            fileobj = open(path, "rb")
+            hit_tier, path, fileobj, _ = self.store.open_object_read(
+                manifest.object["sha256"], plan=key, heat=self.heat,
+            )
         except FileNotFoundError:
             self.heat.note_read_or_rebuild(key, via="read")
             return self._json(404, {"error": "artifact evicted; re-POST "
@@ -1057,6 +1187,17 @@ class ChainServeService:
             get_logger().warning("serve: artifact open failed: %r", exc)
             return self._json(500, {"error": "artifact temporarily "
                                              "unavailable; retry"})
+
+        status = 200
+        mode = "full"
+        offset = 0
+        length = None
+        if rng is not None:
+            offset, length = rng
+            status = 206
+            mode = "range"
+            extra["Content-Range"] = (
+                f"bytes {offset}-{offset + length - 1}/{size}")
 
         ttfb_box: list = []
 
@@ -1072,15 +1213,17 @@ class ChainServeService:
                 _READ_SECONDS.labels(
                     tenant=tenant, size_class=size_class).observe(dur)
             # the ledger records every stream, aborted ones included —
-            # bytes left the disk either way
+            # bytes left the disk either way. Ranged reads are their
+            # own mode so hot-set accounting can tell a sampler from a
+            # full consumer.
             self.heat.record_read(
-                key, sent, mode="full", size=size, size_class=size_class,
-                tenant=tenant,
+                key, sent, mode=mode, size=size, size_class=size_class,
+                tenant=tenant, tier=hit_tier,
                 ttfb_s=ttfb_box[0] if ttfb_box else None, dur_s=dur,
             )
 
-        return 200, "application/octet-stream", live.FileBody(
-            path, fileobj=fileobj,
+        return status, "application/octet-stream", live.FileBody(
+            path or "", fileobj=fileobj, offset=offset, length=length,
             on_first_byte=_on_first_byte, on_complete=_on_complete,
         ), extra
 

@@ -15,13 +15,20 @@ policy can be more than a guess. This module is that recorder:
     last flush never interleaves mid-line, and readers tolerate a torn
     final line. Journals merge fleet-wide by simple concatenation —
     per-replica files never contend across processes.
-  * **Three record kinds** (the `kind` field):
+  * **Four record kinds** (the `kind` field):
       - `read`   — one artifact read: `plan`, `mode` (`full` — bytes
-        streamed — or `not_modified` — a conditional GET answered 304,
-        an edge-class hit whose bytes the client's cache already holds),
-        `bytes` actually served, the artifact `size` and `size_class`,
+        streamed — `not_modified` — a conditional GET answered 304,
+        an edge-class hit whose bytes the client's cache already holds —
+        or `range` — a single byte range streamed as a 206), `bytes`
+        actually served, the `tier` the bytes were found in when the
+        store is tiered, the artifact `size` and `size_class`,
         `tenant`, and the measured `ttfb_s`/`dur_s` when the serve
         layer observed them.
+      - `move`   — one tier placement move (store/tiers.py): `op`
+        (`promote` | `demote`), `from_tier`, `to_tier`, `bytes`, and
+        the owning `plan` when known. Written only AFTER the source
+        copy is deleted, so a crashed move never journals and a
+        retried one journals exactly once.
       - `evict`  — one GC eviction with its evidence (store/gc.py):
         `reason` (`over_budget` | `orphan`), `last_used_age_s`,
         recorded `reads`, `freed_bytes`, and the `budget_bytes`
@@ -55,7 +62,8 @@ from ..utils.log import get_logger
 READS = tm.counter(
     "chain_store_reads_total",
     "artifact reads recorded by the heat ledger, by mode "
-    "(full = bytes streamed; not_modified = conditional GET hit)",
+    "(full = bytes streamed; not_modified = conditional GET hit; "
+    "range = a single byte range streamed as a 206)",
     ("mode",),
 )
 READ_BYTES = tm.counter(
@@ -166,9 +174,12 @@ class HeatLedger:
                     size: Optional[int] = None,
                     size_class: Optional[str] = None,
                     tenant: str = "",
+                    tier: Optional[str] = None,
                     ttfb_s: Optional[float] = None,
                     dur_s: Optional[float] = None) -> None:
-        """One artifact read (full stream or conditional-GET 304)."""
+        """One artifact read (full stream, single-range 206, or
+        conditional-GET 304). `tier` is the store tier the read found
+        the bytes in (docs/STORE.md "Tier hierarchy")."""
         READS.labels(mode=mode).inc()
         if nbytes:
             READ_BYTES.inc(int(nbytes))
@@ -179,6 +190,8 @@ class HeatLedger:
             "bytes": int(nbytes),
             "tenant": tenant,
         }
+        if tier is not None:
+            record["tier"] = tier
         if size is not None:
             record["size"] = int(size)
         if size_class is not None:
@@ -188,6 +201,13 @@ class HeatLedger:
         if dur_s is not None:
             record["dur_s"] = round(dur_s, 6)
         self._append(record)
+
+    def record_move(self, evidence: dict) -> None:
+        """One tier placement move, with the evidence store/tiers.py
+        assembled (shared shape with the `store_promote`/`store_demote`
+        events). Called AFTER the source delete — see the crash-safety
+        ordering note in the module docstring."""
+        self._append({"kind": "move", **evidence})
 
     def record_eviction(self, evidence: dict) -> None:
         """One GC eviction, with the per-victim evidence store/gc.py
@@ -347,18 +367,20 @@ def aggregate(root: str) -> dict:
     regrets and evictions."""
     per_plan: dict = {}
     by_replica: dict = {}
-    totals = {"reads": 0, "full": 0, "not_modified": 0, "bytes": 0,
-              "regrets": 0, "evictions": 0}
+    by_tier: dict = {}
+    totals = {"reads": 0, "full": 0, "not_modified": 0, "range": 0,
+              "bytes": 0, "regrets": 0, "evictions": 0,
+              "promotions": 0, "demotions": 0}
     for record in read_journals(root):
         kind = record.get("kind")
         if kind == "read":
             plan = record.get("plan") or "?"
             entry = per_plan.setdefault(plan, {
-                "reads": 0, "full": 0, "not_modified": 0, "bytes": 0,
-                "last_ts": 0.0, "size": 0,
+                "reads": 0, "full": 0, "not_modified": 0, "range": 0,
+                "bytes": 0, "last_ts": 0.0, "size": 0, "tiers": {},
             })
             mode = record.get("mode")
-            if mode not in ("full", "not_modified"):
+            if mode not in ("full", "not_modified", "range"):
                 mode = "full"
             nbytes = int(record.get("bytes") or 0)
             entry["reads"] += 1
@@ -368,6 +390,12 @@ def aggregate(root: str) -> dict:
                                    record.get("ts", 0.0))
             if record.get("size"):
                 entry["size"] = max(entry["size"], int(record["size"]))
+            tier = record.get("tier")
+            if tier:
+                entry["tiers"][tier] = entry["tiers"].get(tier, 0) + 1
+                t = by_tier.setdefault(tier, {"reads": 0, "bytes": 0})
+                t["reads"] += 1
+                t["bytes"] += nbytes
             rep = by_replica.setdefault(record.get("replica", "?"),
                                         {"reads": 0, "bytes": 0})
             rep["reads"] += 1
@@ -375,12 +403,17 @@ def aggregate(root: str) -> dict:
             totals["reads"] += 1
             totals[mode] += 1
             totals["bytes"] += nbytes
+        elif kind == "move":
+            if record.get("op") == "promote":
+                totals["promotions"] += 1
+            else:
+                totals["demotions"] += 1
         elif kind == "evict":
             totals["evictions"] += 1
         elif kind == "regret":
             totals["regrets"] += 1
     return {"per_plan": per_plan, "by_replica": by_replica,
-            "totals": totals}
+            "by_tier": by_tier, "totals": totals}
 
 
 def plan_size(entry: dict) -> int:
@@ -424,8 +457,8 @@ def journal_stats(root: str, tail_bytes: int = 1 << 19) -> dict:
     the tail window — the counts then cover the recent window, not all
     time (no silent cap)."""
     stats = {"files": 0, "bytes": 0, "total": 0, "reads": 0, "full": 0,
-             "not_modified": 0, "bytes_served": 0, "evictions": 0,
-             "regrets": 0, "sampled": False}
+             "not_modified": 0, "range": 0, "bytes_served": 0,
+             "evictions": 0, "regrets": 0, "moves": 0, "sampled": False}
     try:
         names = sorted(os.listdir(root))
     except OSError:
@@ -454,10 +487,12 @@ def journal_stats(root: str, tail_bytes: int = 1 << 19) -> dict:
                     if kind == "read":
                         stats["reads"] += 1
                         mode = record.get("mode")
-                        if mode in ("full", "not_modified"):
+                        if mode in ("full", "not_modified", "range"):
                             stats[mode] += 1
                         stats["bytes_served"] += \
                             int(record.get("bytes") or 0)
+                    elif kind == "move":
+                        stats["moves"] += 1
                     elif kind == "evict":
                         stats["evictions"] += 1
                     elif kind == "regret":

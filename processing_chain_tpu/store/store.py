@@ -30,10 +30,9 @@ rawvideo muxed into AVI reads back as garbage) before it can be cached.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-import shutil
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
@@ -42,6 +41,9 @@ from .. import telemetry as tm
 from ..utils.fsio import atomic_write
 from ..utils.log import get_logger
 from . import keys
+from .backends import BackendIntegrityError
+from .backends.local import _link_or_copy
+from .tiers import TieredStore
 from ..utils import lockdebug, plandebug
 
 STORE_HITS = tm.counter(
@@ -133,14 +135,6 @@ class Manifest:
         return [self.object, *self.sidecars.values(), *self.extras.values()]
 
 
-def _link_or_copy(src: str, dst: str) -> None:
-    try:
-        os.link(src, dst)
-    except OSError:
-        # cross-device stores (or filesystems without hardlinks) copy
-        shutil.copyfile(src, dst)
-
-
 def _probe_readback(path: str) -> Optional[dict]:
     """Open a media container and decode one frame; a summary dict on
     success, None for non-media files or when the native media boundary
@@ -183,13 +177,21 @@ class ArtifactStore:
     writes, and the digest cache and adoption ledger carry their own
     locks (commit-time hash re-resolution runs on JobRunner workers)."""
 
-    def __init__(self, root: str) -> None:
+    def __init__(self, root: str, tier_spec: Optional[str] = None) -> None:
         self.root = os.path.abspath(root)
         self.objects_dir = os.path.join(self.root, "objects")
         self.manifests_dir = os.path.join(self.root, "manifests")
         self.tmp_dir = os.path.join(self.root, "tmp")
         for d in (self.objects_dir, self.manifests_dir, self.tmp_dir):
             os.makedirs(d, exist_ok=True)
+        # the tier hierarchy (docs/STORE.md "Tier hierarchy"): index 0 is
+        # ALWAYS this root's own objects/ directory, so a bare root is
+        # just a one-tier config and opens with zero migration
+        if tier_spec:
+            self.tiers = TieredStore.from_spec(
+                tier_spec, self.objects_dir, self.tmp_dir)
+        else:
+            self.tiers = TieredStore.single(self.objects_dir, self.tmp_dir)
         self.digests = keys.DigestCache(os.path.join(self.root, "digest-cache.json"))
         self._pins_path = os.path.join(self.root, "pins.json")
         #: lazily-built set of output paths the store has ever bound
@@ -358,36 +360,15 @@ class ArtifactStore:
     # -------------------------------------------------------------- commit
 
     def _ingest(self, path: str) -> dict:
-        """Hash `path` and place its bytes under objects/ atomically;
-        returns the digest dict. Identical objects dedupe by construction.
-        The tmp name is pid+thread-unique: two workers committing
-        byte-identical companions would otherwise share one tmp path and
-        truncate it under each other."""
+        """Hash `path` and commit its bytes into the hot tier atomically
+        (tmp + rename with a pid+thread-unique scratch name; the backend
+        stamps ingestion-time mtime so GC's min-object-age guard holds);
+        returns the digest dict. Identical objects dedupe by construction
+        — across every tier: bytes already held cold are not re-ingested
+        hot, the read path promotes them when they earn it."""
         digest = keys.hash_file(path)
-        obj = self.object_path(digest["sha256"])
-        if not os.path.isfile(obj):
-            os.makedirs(os.path.dirname(obj), exist_ok=True)
-            tmp = os.path.join(
-                self.tmp_dir,
-                f"{digest['sha256']}.{os.getpid()}.{threading.get_ident()}.part",
-            )
-            try:
-                _link_or_copy(path, tmp)
-                os.replace(tmp, obj)
-            except BaseException:
-                if os.path.isfile(tmp):
-                    os.unlink(tmp)
-                raise
-            try:
-                # hardlinked objects inherit the SOURCE file's mtime — an
-                # adopted years-old artifact would land already "old" and
-                # GC's min_object_age orphan guard (the defense against
-                # sweeping an object whose manifest is milliseconds from
-                # being written) would not protect it. Stamp ingestion
-                # time explicitly.
-                os.utime(obj)
-            except OSError:
-                pass
+        if self.tiers.locate(digest["sha256"]) is None:
+            self.tiers.hot.backend.put(path, digest["sha256"])
             if self._gauge_stats is not None:
                 self._gauge_stats["objects"] += 1
                 self._gauge_stats["bytes"] += digest["size"]
@@ -471,30 +452,55 @@ class ArtifactStore:
     def verify_object(self, digest: dict, deep: bool = False) -> None:
         """Raise StoreCorruption unless the stored object matches its
         digest: size always, head digest always, full digest when small
-        or `deep`."""
-        obj = self.object_path(digest["sha256"])
-        try:
-            size = os.stat(obj).st_size
-        except OSError as exc:
-            raise StoreCorruption(f"object {digest['sha256'][:12]} missing") from exc
+        or `deep`. The object may live in ANY tier; a cold-tier copy is
+        verified through the backend's streamed read — the same checks,
+        at whichever boundary holds the bytes."""
+        sha = digest["sha256"]
+        located = self.tiers.head(sha)
+        if located is None:
+            raise StoreCorruption(f"object {sha[:12]} missing")
+        tier, size = located
         if size != digest["size"]:
             raise StoreCorruption(
-                f"object {digest['sha256'][:12]}: size {size} != recorded "
-                f"{digest['size']}"
+                f"object {sha[:12]}: size {size} != recorded "
+                f"{digest['size']} (tier {tier.name})"
             )
-        if deep or size <= _FULL_VERIFY_MAX:
-            found = keys.hash_file(obj)
-            if found["sha256"] != digest["sha256"]:
-                raise StoreCorruption(
-                    f"object {digest['sha256'][:12]}: content digest mismatch"
-                )
-        else:
-            with open(obj, "rb") as f:
-                head = f.read(1 << 20)
-            if keys.sha256_hex(head) != digest["head_sha256"]:
-                raise StoreCorruption(
-                    f"object {digest['sha256'][:12]}: head digest mismatch"
-                )
+        obj = tier.backend.local_path(sha)
+        if obj is not None:
+            if deep or size <= _FULL_VERIFY_MAX:
+                found = keys.hash_file(obj)
+                if found["sha256"] != sha:
+                    raise StoreCorruption(
+                        f"object {sha[:12]}: content digest mismatch "
+                        f"(tier {tier.name})"
+                    )
+            else:
+                with open(obj, "rb") as f:
+                    head = f.read(1 << 20)
+                if keys.sha256_hex(head) != digest["head_sha256"]:
+                    raise StoreCorruption(
+                        f"object {sha[:12]}: head digest mismatch "
+                        f"(tier {tier.name})"
+                    )
+        else:  # no filesystem path (object tier): stream the same checks
+            with tier.backend.open_read(sha) as f:
+                if deep or size <= _FULL_VERIFY_MAX:
+                    hasher = hashlib.sha256()
+                    while True:
+                        block = f.read(1 << 20)
+                        if not block:
+                            break
+                        hasher.update(block)
+                    if hasher.hexdigest() != sha:
+                        raise StoreCorruption(
+                            f"object {sha[:12]}: content digest mismatch "
+                            f"(tier {tier.name})"
+                        )
+                elif keys.sha256_hex(f.read(1 << 20)) != digest["head_sha256"]:
+                    raise StoreCorruption(
+                        f"object {sha[:12]}: head digest mismatch "
+                        f"(tier {tier.name})"
+                    )
 
     def drop_corrupt_objects(self, manifest: Manifest) -> None:
         """Unlink every object of `manifest` that fails verification. The
@@ -506,9 +512,7 @@ class ArtifactStore:
             try:
                 self.verify_object(digest, deep=True)
             except StoreCorruption:
-                try:
-                    os.unlink(self.object_path(digest["sha256"]))
-                except OSError:
+                if not self.tiers.delete_everywhere(digest["sha256"]):
                     continue
                 if self._gauge_stats is not None:
                     self._gauge_stats["objects"] -= 1
@@ -517,6 +521,14 @@ class ArtifactStore:
     def _materialize_one(self, digest: dict, dest: str) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
         obj = self.object_path(digest["sha256"])
+        if not os.path.isfile(obj) and self.tiers.multi:
+            # the bytes live in a colder tier: promote first (digest-
+            # verified at the boundary they cross), then hardlink from
+            # the hot copy exactly like the flat-store path
+            try:
+                self.tiers.promote(digest["sha256"])
+            except BackendIntegrityError as exc:
+                raise StoreCorruption(str(exc)) from exc
         try:
             if os.path.samefile(obj, dest):
                 # dest already IS the object (hardlink). Linking through
@@ -619,30 +631,61 @@ class ArtifactStore:
                         self._materialize_one(digest, path)
             self.touch(manifest)
             return True
-        except OSError as exc:
+        except (OSError, StoreCorruption) as exc:
             get_logger().warning(
                 "store: could not materialize %s -> %s (%s); rebuilding",
                 manifest.plan_hash[:12], dest, exc,
             )
             return False
 
+    # ------------------------------------------------------- tiered reads
+
+    def locate_tier(self, sha256: str) -> Optional[str]:
+        """The name of the hottest tier holding the object, or None."""
+        tier = self.tiers.locate(sha256)
+        return tier.name if tier is not None else None
+
+    def open_object_read(
+        self, sha256: str, plan: Optional[str] = None, heat=None,
+    ) -> tuple:
+        """Open an object for serving: `(hit_tier, path, fileobj, size)`.
+
+        The hit tier is the one the read FOUND the bytes in (counted in
+        `chain_store_tier_hits_total` and journaled with the read); a
+        non-hot hit is promoted read-through first — digest-verified at
+        the boundary it crosses — and then served from the hot copy's
+        fd, falling back to a direct backend stream when the promotion
+        cannot complete (e.g. hot disk full). `path` is None when the
+        serving tier has no filesystem path (a direct cold stream)."""
+        from .tiers import TIER_HITS
+
+        located = self.tiers.head(sha256)
+        if located is None:
+            raise FileNotFoundError(f"object {sha256[:12]} in no tier")
+        tier, size = located
+        hit = tier.name
+        TIER_HITS.labels(tier=hit).inc()
+        if tier is not self.tiers.hot and self.tiers.promote_on_read:
+            try:
+                self.tiers.promote(sha256, plan=plan, heat=heat)
+                path = self.object_path(sha256)
+                return hit, path, open(path, "rb"), size
+            except (OSError, BackendIntegrityError) as exc:
+                get_logger().warning(
+                    "store: read-through promotion of %s from %s failed "
+                    "(%s); serving from %s directly",
+                    sha256[:12], hit, exc, hit,
+                )
+        path = tier.backend.local_path(sha256)
+        return hit, path, tier.backend.open_read(sha256), size
+
     # ----------------------------------------------------------- accounting
 
     def iter_objects(self) -> Iterator[tuple[str, int]]:
-        """(sha256, size) for every object on disk."""
-        try:
-            shards = sorted(os.listdir(self.objects_dir))
-        except OSError:
-            return
-        for shard in shards:
-            shard_dir = os.path.join(self.objects_dir, shard)
-            if not os.path.isdir(shard_dir):
-                continue
-            for name in sorted(os.listdir(shard_dir)):
-                try:
-                    yield name, os.stat(os.path.join(shard_dir, name)).st_size
-                except OSError:
-                    continue
+        """(sha256, size) for every object across all tiers, deduped to
+        the hottest copy (a mid-move duplicate counts once)."""
+        for sha, size, _tier in self.tiers.iter_objects():
+            yield sha, size
 
     def stats(self) -> dict:
         n = 0
@@ -653,8 +696,11 @@ class ArtifactStore:
         manifests = sum(
             1 for f in os.listdir(self.manifests_dir) if f.endswith(".json")
         ) if os.path.isdir(self.manifests_dir) else 0
-        return {"objects": n, "bytes": total, "manifests": manifests,
-                "pins": len(self.pins())}
+        out = {"objects": n, "bytes": total, "manifests": manifests,
+               "pins": len(self.pins())}
+        if self.tiers.multi:
+            out["tiers"] = self.tiers.tier_stats()
+        return out
 
     def update_gauges(self, full: bool = False) -> None:
         """Refresh the byte/object gauges. The full objects/ walk runs
@@ -666,5 +712,6 @@ class ArtifactStore:
         if full or self._gauge_stats is None:
             s = self.stats()
             self._gauge_stats = {"objects": s["objects"], "bytes": s["bytes"]}
+            self.tiers.update_gauges()
         STORE_BYTES.set(self._gauge_stats["bytes"])
         STORE_OBJECTS.set(self._gauge_stats["objects"])

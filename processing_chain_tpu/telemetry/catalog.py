@@ -68,6 +68,12 @@ METRICS: dict[str, str] = {
     "chain_store_reads_total": "counter",
     "chain_store_read_bytes_total": "counter",
     "chain_store_eviction_regret_total": "counter",
+    # store/tiers.py — hot/warm/cold placement over pluggable CAS
+    # backends (docs/STORE.md "Tier hierarchy")
+    "chain_store_tier_hits_total": "counter",
+    "chain_store_tier_promotions_total": "counter",
+    "chain_store_tier_demotions_total": "counter",
+    "chain_store_tier_bytes": "gauge",
     # serve/ — the always-on processing service (docs/SERVE.md)
     "chain_serve_requests_total": "counter",
     "chain_serve_units_total": "counter",
@@ -150,6 +156,9 @@ EVENTS: frozenset = frozenset({
     "serve_gc",            # serve/pressure.py — budget pass ran
     "store_regret",        # store/heat.py — recently-evicted plan re-read
                            # or rebuilt (cache undersizing)
+    "store_promote",       # store/tiers.py — object moved toward hot
+    "store_demote",        # store/tiers.py — object moved toward cold
+    "serve_drain",         # serve/service.py — replica drain state flipped
     "serve_lease_stolen",  # serve/queue.py — dead/expired lease reclaimed
     "serve_lease_lost",    # serve/queue.py — heartbeat found its lease gone
     "serve_settle_fenced",     # serve/queue.py — stale-epoch settle refused

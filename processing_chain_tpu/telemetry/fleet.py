@@ -70,6 +70,14 @@ COST_COUNTERS = (
     "chain_serve_cost_observed_seconds_total",
     "chain_serve_cost_rejected_total",
 )
+#: per-tier placement metrics merged into the /fleet "store_tiers"
+#: section (store/tiers.py; docs/STORE.md "Tier hierarchy")
+TIER_METRICS = (
+    "chain_store_tier_hits_total",
+    "chain_store_tier_promotions_total",
+    "chain_store_tier_demotions_total",
+    "chain_store_tier_bytes",
+)
 #: the observed/predicted audit histogram (same section)
 COST_ERROR_METRIC = "chain_serve_cost_error_ratio"
 
@@ -206,6 +214,36 @@ def merge_counters(parsed: Iterable[dict]) -> dict:
             })
             into["value"] += series["value"]
     return merged
+
+
+def tier_report(parsed: list) -> dict:
+    """The /fleet "store_tiers" section from each replica's tier
+    metrics: per-tier hit counts merged by SUM (every replica's reads
+    are distinct events) with fleet-wide hit ratios, promotion/demotion
+    move counts likewise, and per-tier bytes merged by MAX — the gauge
+    reports SHARED store state, so summing replicas would multiply one
+    disk by the fleet size."""
+    tiers: dict = {}
+    for counters in parsed:
+        for (name, _), entry in counters.items():
+            tier = entry["labels"].get("tier", "?")
+            t = tiers.setdefault(tier, {
+                "hits": 0, "promotions": 0, "demotions": 0, "bytes": 0,
+            })
+            value = entry["value"]
+            if name == "chain_store_tier_hits_total":
+                t["hits"] += int(value)
+            elif name == "chain_store_tier_promotions_total":
+                t["promotions"] += int(value)
+            elif name == "chain_store_tier_demotions_total":
+                t["demotions"] += int(value)
+            elif name == "chain_store_tier_bytes":
+                t["bytes"] = max(t["bytes"], int(value))
+    total_hits = sum(t["hits"] for t in tiers.values())
+    for t in tiers.values():
+        t["hit_ratio"] = (
+            round(t["hits"] / total_hits, 4) if total_hits else 0.0)
+    return {"tiers": tiers, "hits_total": total_hits}
 
 
 def cost_report(counters: dict, error_hist: dict) -> dict:
@@ -437,6 +475,7 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
     replicas: list[dict] = []
     parsed: list[dict] = []
     parsed_counters: list[dict] = []
+    parsed_tiers: list[dict] = []
     infos = discover_replicas(root)
     for info in infos:
         entry = {
@@ -481,6 +520,9 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
                 parsed_counters.append(
                     parse_counters(rendered, COST_COUNTERS)
                 )
+                parsed_tiers.append(
+                    parse_counters(rendered, TIER_METRICS)
+                )
         else:
             entry["error"] = "unreachable"
         replicas.append(entry)
@@ -510,6 +552,10 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
         # tail-sampled heat-ledger summary (store/heat.py): read/304/
         # regret/eviction counts over the fleet's journals
         "heat": store_heat.journal_stats(store_heat.heat_dir(store_root)),
+        # per-tier placement: fleet-merged hit counts/ratios and move
+        # totals (store/tiers.py; docs/STORE.md "Tier hierarchy") —
+        # empty tiers dict for single-tier fleets
+        "store_tiers": tier_report(parsed_tiers),
         # per-tenant predicted/observed seconds + admission refusals,
         # merged across replicas (serve/cost.py)
         "cost": {

@@ -162,6 +162,12 @@ class FileBody:
 
     path: str
     fileobj: Optional[BinaryIO] = None
+    #: single-range serving (RFC 9110 `Range: bytes=…` → 206): seek to
+    #: `offset` and stream exactly `length` bytes. Defaults stream the
+    #: whole file; `length` also serves as the Content-Length when set,
+    #: so handlers can bound a stream without a second fstat
+    offset: int = 0
+    length: Optional[int] = None
     on_first_byte: Optional[Callable[[], None]] = None
     on_complete: Optional[Callable[[int, bool], None]] = None
 
@@ -346,7 +352,14 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     if f is None:
                         f = open(body.path, "rb")
-                    size = os.fstat(f.fileno()).st_size
+                    if body.length is not None:
+                        size = body.length
+                    else:
+                        size = max(
+                            0,
+                            os.fstat(f.fileno()).st_size - body.offset)
+                    if body.offset:
+                        f.seek(body.offset)
                     self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(size))
@@ -354,13 +367,15 @@ class _Handler(BaseHTTPRequestHandler):
                         self.send_header(name, value)
                     self.end_headers()
                     self._fire(body.on_first_byte)
-                    while True:
-                        chunk = f.read(1 << 20)
+                    remaining = size
+                    while remaining > 0:
+                        chunk = f.read(min(1 << 20, remaining))
                         if not chunk:
                             break
                         self.wfile.write(chunk)
                         sent += len(chunk)
-                    ok = True
+                        remaining -= len(chunk)
+                    ok = remaining == 0
                 finally:
                     if f is not None:
                         f.close()

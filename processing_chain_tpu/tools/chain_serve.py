@@ -16,6 +16,7 @@ moment the server is up — scripts that started the daemon with
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import threading
 from typing import Optional, Sequence
@@ -60,6 +61,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--store-budget", default=None,
                         help="store size budget, bytes (suffixes K/M/G ok); "
                              "GC pressure evicts LRU past it")
+    parser.add_argument("--store-tiers", default=None, metavar="SPEC",
+                        help="tiered store placement, e.g. "
+                             "'hot@64M,shared=/mnt/warm@2G,object=/mnt/cold'"
+                             " (docs/STORE.md \"Tier hierarchy\"; default "
+                             "PC_STORE_TIERS, else single-tier)")
     parser.add_argument("--max-attempts", type=int, default=2,
                         help="execution attempts per job before it fails")
     parser.add_argument("--tenant-weight", action="append", default=[],
@@ -104,6 +110,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from ..telemetry.live import StatusFileWriter
 
     budget = _parse_bytes(args.store_budget) if args.store_budget else None
+    # plan-exempt: (names WHERE artifact bytes are placed, never what they contain)
+    tiers = args.store_tiers or os.environ.get("PC_STORE_TIERS")
     service = ChainServeService(
         root=args.root,
         port=args.port,
@@ -113,6 +121,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         wave_width=args.wave_width,
         store_root=args.store,
         store_budget_bytes=budget,
+        store_tiers=tiers,
         tenant_weights=_parse_tenant_weights(args.tenant_weight),
         max_attempts=args.max_attempts,
         replica=args.replica_id,
@@ -133,6 +142,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+
+    def _on_drain_signal(signum, frame) -> None:
+        # SIGUSR1 toggles drain: the operator's no-HTTP path to the
+        # same state flip POST /v1/drain performs (docs/SERVE.md
+        # "Draining a replica"). A second SIGUSR1 resumes.
+        if service.scheduler.draining:
+            get_logger().info("chain-serve: SIGUSR1 — resuming")
+            service.resume()
+        else:
+            get_logger().info("chain-serve: SIGUSR1 — draining "
+                              "(again to resume)")
+            service.drain()
+
+    if hasattr(signal, "SIGUSR1"):
+        signal.signal(signal.SIGUSR1, _on_drain_signal)
     service.start()
     status_writer = None
     if args.status_file:

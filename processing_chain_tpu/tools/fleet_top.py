@@ -114,9 +114,30 @@ def render(view: dict, note: str = "") -> str:
         lines.append(
             f"reads: {heat.get('reads', 0)}{tail_note} "
             f"full={heat.get('full', 0)} 304={heat.get('not_modified', 0)} "
+            f"range={heat.get('range', 0)} "
             f"served={heat.get('bytes_served', 0) / 1e6:.1f}MB "
             f"evictions={heat.get('evictions', 0)} "
             f"regrets={heat.get('regrets', 0)}"
+        )
+    store_tiers = view.get("store_tiers", {})
+    if store_tiers.get("tiers"):
+        order = {"hot": 0, "warm": 1, "cold": 2}
+        parts = []
+        for name, t in sorted(store_tiers["tiers"].items(),
+                              key=lambda kv: (order.get(kv[0], 9),
+                                              kv[0])):
+            parts.append(
+                f"{name} hits={t.get('hits', 0)}"
+                f"({t.get('hit_ratio', 0.0) * 100:.0f}%) "
+                f"{t.get('bytes', 0) / 1e6:.1f}MB"
+            )
+        moves = sum(t.get("promotions", 0)
+                    for t in store_tiers["tiers"].values())
+        demotes = sum(t.get("demotions", 0)
+                      for t in store_tiers["tiers"].values())
+        lines.append(
+            "tiers: " + "  ".join(parts)
+            + f"  promotions={moves} demotions={demotes}"
         )
     fleet_cost = view.get("cost", {})
     if fleet_cost.get("tenants") or fleet_cost.get("rejected"):

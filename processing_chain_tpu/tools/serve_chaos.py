@@ -28,6 +28,10 @@ the invariants from disk, the store, and the survivors' /metrics:
   * warm-hit requests POSTed DURING the churn stay under the latency
     budget (default p50 < 50 ms) — replica death must not cost the
     warm path its milliseconds;
+  * a voluntary drain/join cycle mid-churn (docs/SERVE.md "Draining a
+    replica"): POST /v1/drain flips one replica to `draining` — its
+    /healthz and serve-info must advertise it — and `{"resume": true}`
+    returns it to rotation with /healthz back to `ok`;
   * with `--corrupt-corpus`: hostile-upload stand-ins (`poison_src`
     units) are convicted into the SRC-digest poison registry, queued
     siblings are swept without executing, a fresh request against a
@@ -549,6 +553,53 @@ def run_chaos(args, root: str) -> dict:
                     f"warm probe {probe.get('request')} was not answered "
                     f"at POST time (state {probe.get('state')})")
             time.sleep(0.05)
+
+        # ---- drain/join cycle: one replica bows out and rejoins ------
+        # (docs/SERVE.md "Draining a replica"): POST /v1/drain flips
+        # the replica to draining — /healthz and serve-info advertise
+        # it, the scheduler stops claiming, peers absorb the queue —
+        # then {"resume": true} puts it back in rotation. Run INSIDE
+        # the churn so the fleet proves it survives a voluntary exit
+        # on top of the involuntary ones.
+        candidates = [r for r in live() if r is not zombie]
+        if len(candidates) >= 2:
+            drained = candidates[-1]
+            drain_info: dict = {"replica":
+                                f"r{drained.index}-g{drained.generation}"}
+            _post_json(drained.url + "/v1/drain", {}, timeout=10.0)
+            with urllib.request.urlopen(drained.url + "/healthz",
+                                        timeout=5.0) as resp:
+                health = json.load(resp)
+            drain_info["healthz_draining"] = health.get("status")
+            if health.get("status") != "draining":
+                failures.append(
+                    f"drained replica's /healthz reports "
+                    f"{health.get('status')!r}, expected 'draining'")
+            info_path = os.path.join(
+                root, f"replica-{drained.index}-"
+                      f"g{drained.generation}.json")
+            try:
+                with open(info_path) as f:
+                    drain_info["info_state"] = json.load(f).get("state")
+            except (OSError, ValueError):
+                drain_info["info_state"] = None
+            if drain_info["info_state"] != "draining":
+                failures.append(
+                    "drained replica's serve-info never flipped to "
+                    f"'draining' (saw {drain_info['info_state']!r})")
+            # the fleet keeps settling while one member sits out
+            time.sleep(max(0.5, args.poll_s))
+            _post_json(drained.url + "/v1/drain", {"resume": True},
+                       timeout=10.0)
+            with urllib.request.urlopen(drained.url + "/healthz",
+                                        timeout=5.0) as resp:
+                health = json.load(resp)
+            drain_info["healthz_resumed"] = health.get("status")
+            if health.get("status") != "ok":
+                failures.append(
+                    f"resumed replica's /healthz reports "
+                    f"{health.get('status')!r}, expected 'ok'")
+            report["drain_cycle"] = drain_info
 
         # ---- fail-fast: a fresh tenant hits a poisoned digest --------
         if args.corrupt_corpus:

@@ -6,9 +6,12 @@
                          [--tmp-max-age S] [--min-object-age S]
     tools store pin      [--store DIR] HASH [--label TEXT]
     tools store unpin    [--store DIR] HASH
+    tools store tier     [--store DIR] ls
+    tools store tier     [--store DIR] promote|demote HASH
 
 The store root resolves like the pipeline's: --store DIR, else
-PC_STORE_DIR. `verify` deep-checks every manifest's objects and exits 1
+PC_STORE_DIR; the placement spec (hot/warm/cold tiers, docs/STORE.md
+"Tier hierarchy") resolves from --tiers SPEC, else PC_STORE_TIERS. `verify` deep-checks every manifest's objects and exits 1
 when corruption is found (counted in chain_store_corrupt_total); with
 --drop, corrupt manifests are removed so the next pipeline run rebuilds
 exactly those artifacts. `gc` is store.gc.collect with a human report —
@@ -49,7 +52,8 @@ def _parse_bytes(text: str) -> int:
     return int(float(text) * mult)
 
 
-def _open_store(root: Optional[str]) -> ArtifactStore:
+def _open_store(root: Optional[str],
+                tiers: Optional[str] = None) -> ArtifactStore:
     root = root or os.environ.get("PC_STORE_DIR") or ""
     if not root:
         raise ValueError(
@@ -60,7 +64,9 @@ def _open_store(root: Optional[str]) -> ArtifactStore:
         # root must error, not mkdir an empty tree and report a false
         # "verified 0 ok" all-clear
         raise ValueError(f"store root {root} does not exist")
-    return ArtifactStore(root)
+    # plan-exempt: (names WHERE artifact bytes are placed, never what they contain)
+    tiers = tiers or os.environ.get("PC_STORE_TIERS") or None
+    return ArtifactStore(root, tier_spec=tiers)
 
 
 def _cmd_ls(store: ArtifactStore) -> int:
@@ -153,17 +159,28 @@ def _cmd_gc(store: ArtifactStore, max_bytes: Optional[int], dry_run: bool,
     print(f"{tag}tmp swept:        {report['tmp_removed']}")
     print(f"{tag}orphans removed:  {report['orphans_removed']} "
           f"({_human_bytes(report['orphan_bytes'])})")
+    if report["demotions"]:
+        print(f"{tag}demoted:          {len(report['demotions'])} "
+              f"object(s) ({_human_bytes(report['demoted_bytes'])})")
+        for d in report["demotions"]:
+            print(f"{tag}  demote {d['object'][:12]}  "
+                  f"{d['from_tier']} -> {d['to_tier']}  "
+                  f"{d.get('reads', 0)} recorded read(s)  "
+                  f"{_human_bytes(d['bytes'])}")
     print(f"{tag}manifests evicted:{len(report['evicted_manifests']):>2} "
           f"({_human_bytes(report['evicted_bytes'])})")
     # per-victim evidence: the SAME dicts the store_evict events and
-    # the heat ledger's forensics journal carry (store/gc.py)
+    # the heat ledger's forensics journal carry (store/gc.py) — tier
+    # included, so the render says which tier the bytes actually left
     for v in report["victims"]:
         if v["reason"] == "orphan":
             print(f"{tag}  orphan {v['object'][:12]}  "
+                  f"tier {v.get('tier', 'hot')}  "
                   f"age {v['age_s'] / 3600:.1f}h  "
                   f"freed {_human_bytes(v['freed_bytes'])}")
         else:
             print(f"{tag}  evict {v['plan'][:12]}  over budget  "
+                  f"from {v.get('tier', 'hot')}  "
                   f"last used {v['last_used_age_s'] / 3600:.1f}h ago  "
                   f"{v['reads']} recorded read(s)  "
                   f"freed {_human_bytes(v['freed_bytes'])}")
@@ -177,6 +194,65 @@ def _cmd_gc(store: ArtifactStore, max_bytes: Optional[int], dry_run: bool,
     return 0
 
 
+def _cmd_tier(store: ArtifactStore, action: str,
+              ref: Optional[str]) -> int:
+    """Placement inspection and manual moves (docs/STORE.md "Tier
+    hierarchy"). `promote`/`demote` accept a plan hash (moves every
+    object the manifest references) or a bare object sha256."""
+    tiers = store.tiers
+    if action == "ls":
+        stats = tiers.tier_stats()
+        for t in tiers.tiers:
+            s = stats[t.name]
+            budget = (_human_bytes(t.budget_bytes)
+                      if t.budget_bytes else "-")
+            print(f"{t.name:<8} {t.backend.kind:<7} "
+                  f"{s['objects']:>6} object(s)  "
+                  f"{_human_bytes(s['bytes']):>10}  budget {budget}")
+        if not tiers.multi:
+            print("-- single-tier store (no --tiers / PC_STORE_TIERS "
+                  "spec in force)")
+        return 0
+    if not ref:
+        raise ValueError(f"tier {action} needs a plan hash or object "
+                         "sha256")
+    manifest = store.lookup(ref)
+    if manifest is not None:
+        shas = [(d["sha256"], ref) for d in manifest.all_digests()]
+    else:
+        shas = [(ref, None)]
+    # manual moves journal like automatic ones: the forensics trail
+    # must not have operator-shaped holes
+    heat = store_heat.HeatLedger(store.root, replica="store-admin")
+    status = 0
+    try:
+        for sha, plan in shas:
+            src = tiers.locate(sha)
+            if src is None:
+                print(f"absent  {sha[:12]}: in no tier")
+                status = 1
+                continue
+            if action == "promote":
+                evidence = tiers.promote(sha, plan=plan, heat=heat)
+                if evidence is None:
+                    print(f"noop    {sha[:12]}: already hot")
+                    continue
+            else:
+                i = tiers.tiers.index(src)
+                if i == len(tiers.tiers) - 1:
+                    print(f"noop    {sha[:12]}: already in last tier "
+                          f"({src.name})")
+                    continue
+                evidence = tiers.demote(sha, src, tiers.tiers[i + 1],
+                                        plan=plan, heat=heat)
+            print(f"{evidence['op']:<8}{evidence['object'][:12]}  "
+                  f"{evidence['from_tier']} -> {evidence['to_tier']}  "
+                  f"{_human_bytes(evidence['bytes'])}")
+    finally:
+        heat.close()
+    return status
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     # --store is accepted both before and after the subcommand (the
     # docs show the natural `tools store verify --store DIR` order).
@@ -185,6 +261,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument("--store", default=argparse.SUPPRESS, metavar="DIR",
                         help="store root (default: PC_STORE_DIR)")
+    common.add_argument("--tiers", default=argparse.SUPPRESS,
+                        metavar="SPEC",
+                        help="hot/warm/cold placement spec "
+                        "(default: PC_STORE_TIERS; see docs/STORE.md)")
     parser = argparse.ArgumentParser(prog="tools store", description=__doc__,
                                      parents=[common])
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -212,9 +292,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p_pin.add_argument("--label", default="")
     p_unpin = sub.add_parser("unpin", help="remove a pin", parents=[common])
     p_unpin.add_argument("plan_hash")
+    p_tier = sub.add_parser("tier", help="tier placement: inspect and "
+                            "move objects", parents=[common])
+    p_tier.add_argument("action", choices=("ls", "promote", "demote"))
+    p_tier.add_argument("ref", nargs="?", default=None,
+                        help="plan hash or object sha256 "
+                        "(promote/demote)")
     args = parser.parse_args(argv)
 
-    store = _open_store(getattr(args, "store", None))
+    store = _open_store(getattr(args, "store", None),
+                        getattr(args, "tiers", None))
     if args.cmd == "ls":
         return _cmd_ls(store)
     if args.cmd == "verify":
@@ -223,6 +310,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_bytes = _parse_bytes(args.max_bytes) if args.max_bytes else None
         return _cmd_gc(store, max_bytes, args.dry_run, args.tmp_max_age,
                        args.min_object_age)
+    if args.cmd == "tier":
+        return _cmd_tier(store, args.action, args.ref)
     if args.cmd == "pin":
         store.pin(args.plan_hash, args.label)
         get_logger().info("pinned %s", args.plan_hash[:12])

@@ -86,6 +86,7 @@ def _cmd_report(source: str, top: int, as_json: bool) -> int:
             "totals": totals,
             "ratio_304": round(ratio_304, 4),
             "by_replica": agg["by_replica"],
+            "by_tier": agg["by_tier"],
             "working_set_curve": _downsample(curve),
         }, sort_keys=True))
         return 0
@@ -94,24 +95,38 @@ def _cmd_report(source: str, top: int, as_json: bool) -> int:
         return 0
     print(f"heat ledger: {root}")
     print(f"reads: {totals['reads']} (full={totals['full']} "
-          f"304={totals['not_modified']}, 304 ratio {ratio_304:.1%})  "
+          f"304={totals['not_modified']} range={totals['range']}, "
+          f"304 ratio {ratio_304:.1%})  "
           f"bytes served: {totals['bytes'] / 1e6:.1f} MB")
     print(f"evictions: {totals['evictions']}  "
-          f"regrets: {totals['regrets']}")
+          f"regrets: {totals['regrets']}  "
+          f"promotions: {totals['promotions']}  "
+          f"demotions: {totals['demotions']}")
     for rep in sorted(agg["by_replica"]):
         entry = agg["by_replica"][rep]
         print(f"  replica {rep:<28} reads {entry['reads']:>6}  "
               f"bytes {entry['bytes'] / 1e6:9.1f} MB")
+    if agg["by_tier"]:
+        print("reads by tier (where the byte was found):")
+        for tier in sorted(agg["by_tier"]):
+            entry = agg["by_tier"][tier]
+            frac = (entry["reads"] / totals["reads"]
+                    if totals["reads"] else 0.0)
+            print(f"  tier {tier:<8} reads {entry['reads']:>6} "
+                  f"({frac:6.1%})  bytes {entry['bytes'] / 1e6:9.1f} MB")
     by_reads = sorted(agg["per_plan"].items(),
                       key=lambda kv: -kv[1]["reads"])[:top]
     if by_reads:
         print(f"top {len(by_reads)} plans by reads:")
         for plan, entry in by_reads:
             age = time.time() - entry["last_ts"] if entry["last_ts"] else 0
+            tiers = "".join(
+                f" {t}={n}" for t, n in sorted(entry["tiers"].items()))
             print(f"  {plan[:12]}  reads {entry['reads']:>5} "
                   f"(304 {entry['not_modified']})  "
                   f"{store_heat.plan_size(entry) / 1e6:7.2f} MB  "
-                  f"last read {age / 60:.1f}m ago")
+                  f"last read {age / 60:.1f}m ago"
+                  + (f"  tiers:{tiers}" if tiers else ""))
     by_bytes = sorted(agg["per_plan"].items(),
                       key=lambda kv: -kv[1]["bytes"])[:top]
     if by_bytes:

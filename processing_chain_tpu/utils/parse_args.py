@@ -178,6 +178,13 @@ def build_parser(name: str, script: Optional[int] = None) -> argparse.ArgumentPa
         help="ignore --store and PC_STORE_DIR: plain skip-existing "
         "semantics for this run",
     )
+    parser.add_argument(
+        "--store-tiers", default=None, metavar="SPEC",
+        help="hot/warm/cold placement for the artifact store "
+        "(docs/STORE.md \"Tier hierarchy\"): e.g. "
+        "'hot@64M,shared=/mnt/warm@2G,object=/mnt/cold' "
+        "(default: PC_STORE_TIERS env, else a single-tier store)",
+    )
     return parser
 
 

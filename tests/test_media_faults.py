@@ -566,26 +566,26 @@ def test_store_commit_under_enospc_degrades_cleanly(tmp_path,
     manifest exists (a later warm lookup is a clean miss, not a corrupt
     hit), and the failure classifies transient — serve settles it under
     the retry budget, not quarantine."""
-    from processing_chain_tpu.store import store as store_mod
+    from processing_chain_tpu.store.backends import local as local_mod
     from processing_chain_tpu.store.store import ArtifactStore
 
     artifact = tmp_path / "artifact.avi"
     _write_clean(artifact, frames=4)
     store = ArtifactStore(str(tmp_path / "store"))
 
-    real = store_mod._link_or_copy
+    real = local_mod._link_or_copy
 
     def failing(srcpath, dst):
         real(srcpath, dst)  # bytes land first: the torn-write shape
         raise OSError(errno.ENOSPC, "No space left on device", dst)
 
-    monkeypatch.setattr(store_mod, "_link_or_copy", failing)
+    monkeypatch.setattr(local_mod, "_link_or_copy", failing)
     plan_hash = "5" * 64
     with pytest.raises(OSError) as exc_info:
         store.commit(plan_hash, str(artifact), producer="test")
     assert exc_info.value.errno == errno.ENOSPC
     assert classify_failure(exc_info.value) == "transient"
-    monkeypatch.setattr(store_mod, "_link_or_copy", real)
+    monkeypatch.setattr(local_mod, "_link_or_copy", real)
     assert os.listdir(store.tmp_dir) == []  # swept, not stranded
     assert not os.path.isfile(store.manifest_path(plan_hash))
     assert store.lookup(plan_hash) is None

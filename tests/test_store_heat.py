@@ -175,8 +175,9 @@ def test_aggregate_totals_equal_per_replica_sums(tmp_path):
     assert totals["bytes"] == sum(r["bytes"] for r in reps.values()) == 1300
     assert totals["full"] == 4 and totals["not_modified"] == 1
     assert agg["per_plan"][PLAN_A] == {
-        "reads": 4, "full": 3, "not_modified": 1, "bytes": 300,
-        "last_ts": agg["per_plan"][PLAN_A]["last_ts"], "size": 100,
+        "reads": 4, "full": 3, "not_modified": 1, "range": 0,
+        "bytes": 300, "last_ts": agg["per_plan"][PLAN_A]["last_ts"],
+        "size": 100, "tiers": {},
     }
 
 
