@@ -95,6 +95,15 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
                 log_mod.get_logger().warning(
                     "cannot stream events to %s: %s", telemetry_dir, exc
                 )
+            # the device-plane wave journal rides the same stamp so
+            # run-report can join it (parallel/meshobs.py; appends are
+            # flushed per record — crash-truncation safe like the
+            # event stream above)
+            from .parallel import meshobs
+
+            meshobs.attach_journal(os_mod.path.join(
+                telemetry_dir, f"meshobs_{run_stamp}"
+            ))
         telemetry.emit("run_start", name=name, argv=list(argv))
     # the watchdog rides the live surface or its own flags — NOT bare
     # --telemetry: coarse units of work (a long encode job) beat only on
@@ -217,6 +226,9 @@ def _dispatch(stage: Optional[str], argv: Sequence[str]) -> int:
             # contract) so the next run's plan hashing pays stats, not reads
             store.digests.save()
         if telemetry_dir:
+            from .parallel import meshobs
+
+            meshobs.detach_journal()
             _write_telemetry(
                 telemetry_dir, status, time.perf_counter() - t0,
                 stamp=run_stamp,
@@ -255,7 +267,7 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
         "bench-compare", "chain-lint", "chain-serve", "serve-soak",
         "queue-crashcheck", "serve-chaos", "media-crashcheck",
         "serve-admin", "fleet-top", "trace", "store-heat",
-        "store-tiers",
+        "store-tiers", "mesh-top", "mesh-report",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -279,6 +291,14 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import store_tiers
 
             return store_tiers.main(rest)
+        if name == "mesh-top":
+            from .tools import mesh_top
+
+            return mesh_top.main(rest)
+        if name == "mesh-report":
+            from .tools import mesh_report
+
+            return mesh_report.main(rest)
         if name == "chain-top":
             from .tools import chain_top
 

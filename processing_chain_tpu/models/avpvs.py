@@ -866,12 +866,21 @@ def create_avpvs_wo_buffer_batch(
                                     fan.finish_streams
                                     if fan is not None else None
                                 ),
+                                # wave-journal identity (meshobs): the
+                                # PVS, plus the segment index for long
+                                # tests split into per-segment lanes
+                                name=(
+                                    pvs.pvs_id if spec["kind"] == "short"
+                                    else f"{pvs.pvs_id}.seg{spec['idx']:04d}"
+                                ),
                             ))
                         p03_batch.run_bucket(
                             lanes, mesh, dh, dw, "bicubic",
                             fr.chroma_subsampling(pix_fmt),
                             ten_bit="10" in pix_fmt,
                             chunk=chunk_frames(),
+                            bucket=p03_batch.bucket_label(
+                                dh, dw, "10" in pix_fmt, sh, sw),
                         )
                 except BaseException:
                     # the writers were opened (files created/truncated): a

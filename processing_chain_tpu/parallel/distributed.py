@@ -12,10 +12,39 @@ from __future__ import annotations
 
 import os
 import re
+import time as _time
 from typing import Optional
 
+from .. import telemetry as tm
 from ..config.errors import ConfigError
 from ..utils.log import get_logger
+
+_COLLECTIVE_BYTES = tm.counter(
+    "chain_dist_collective_bytes_total",
+    "payload bytes of explicitly-recorded cross-process collectives "
+    "(record_collective — the DCN dryrun and the distributed stage "
+    "drivers), by op",
+    ("op",),
+)
+_BARRIER_SECONDS = tm.counter(
+    "chain_dist_barrier_seconds_total",
+    "seconds each host spent waiting in the filesystem stage barrier, "
+    "by stage",
+    ("stage",),
+)
+
+
+def record_collective(op: str, nbytes: int,
+                      seconds: Optional[float] = None) -> None:
+    """One cross-process collective, recorded by the caller that knows
+    the payload (jax gives no per-collective hook): bytes land in the
+    chain_dist_collective_bytes_total counter and a `dist_collective`
+    event — the multi-process lane was telemetry-silent before this."""
+    _COLLECTIVE_BYTES.labels(op=op).inc(int(nbytes))
+    fields = {"op": op, "bytes": int(nbytes)}
+    if seconds is not None:
+        fields["seconds"] = round(seconds, 6)
+    tm.emit("dist_collective", **fields)
 
 
 def initialize(
@@ -41,14 +70,22 @@ def initialize(
         # plan-exempt: (process topology shards which host renders each lane; per-artifact bytes are topology-invariant)
         else int(os.environ.get("JAX_PROCESS_ID", "0"))
     )
+    t0 = _time.perf_counter()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+    init_s = _time.perf_counter() - t0
     get_logger().info(
         "distributed: process %d/%d, %d global devices",
         process_id, num_processes, jax.device_count(),
+    )
+    tm.emit(
+        "dist_init", process_id=process_id, processes=num_processes,
+        devices=jax.device_count(),
+        local_devices=jax.local_device_count(),
+        seconds=round(init_s, 3),
     )
     return True
 
@@ -181,6 +218,13 @@ def fs_barrier(
             hb.beat(done=num - len(missing))
         if not missing:
             hb.finish("ok")
+            waited = time.monotonic() - t0
+            _BARRIER_SECONDS.labels(stage=stage).inc(waited)
+            # completion record: the waiting-side reports above fire only
+            # every report_every_s, so a fast barrier would otherwise
+            # leave no trace at all in the event log
+            tm.emit("barrier_wait", stage=stage, host=pid,
+                    waited_s=round(waited, 3), missing=[], done=True)
             return
         now = time.monotonic()
         if hb.cancelled:

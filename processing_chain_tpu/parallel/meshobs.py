@@ -1,0 +1,466 @@
+"""Device-plane flight recorder: wave occupancy accounting + compile ledger.
+
+The compute plane that justifies "TPU-native" was dark: the wave driver
+(parallel/p03_batch.py) materializes padding waste (`dst[i] = 0` for
+exhausted lanes, tail-repeat pads) and then throws the accounting away,
+and XLA recompiles are invisible. The FAST doctrine applies to telemetry
+too — the driver already KNOWS every valid/pad slot per dispatched step;
+this module records it instead of re-measuring it:
+
+  * **Per-wave occupancy** — every dispatched wave-step (one
+    [n_pvs, t_step] block through the sharded step) records its bucket,
+    lanes, and frame-slot breakdown:
+      - `valid`          slots carrying real frames,
+      - `pad_tail`       tail-repeat padding of a partial block,
+      - `pad_exhausted`  slots burned by exhausted lanes riding the wave
+                         until the longest lane finishes,
+      - `pad_mesh`       batch-axis padding up to the mesh "pvs" size.
+    By construction valid + pads == n_pvs × t_step (the dispatched slot
+    count) — the invariant the readers and the mesh-obs-smoke CI job
+    re-check per record.
+  * **Compile ledger** — the step builder is `functools.cache`d per
+    (mesh, geometry), so one geometry flip costs exactly one recompile;
+    every first dispatch records its bucket, triggering geometry, and
+    compile-inclusive seconds (the same first-call split
+    pipeline._instrument_step flags on the features steps).
+  * **One journal file per replica** (`<dir>/<replica>.jsonl`), the
+    spans.py/heat.py discipline verbatim: appends are flushed (not
+    fsynced), O_APPEND with the predecessor's torn tail sealed before
+    the first append, readers tolerate a torn final line, and a disk
+    fault degrades to a logged warning — the recorder observes the wave
+    loop, it must never sink it. Wave records carry the lane names in
+    wave order: the lane→wave ordering evidence ROADMAP item 1(a)'s
+    lane-ordered fused delivery needs.
+
+Metrics (`chain_mesh_*`, telemetry/catalog.py) update whether or not a
+journal is attached; the journal is attached per run (`--telemetry DIR`
+runs write `DIR/meshobs_<stamp>/`) or per serve root (`<root>/meshobs`).
+Readers (`aggregate`, `journal_stats`) serve `tools mesh-top`, the
+run-report "mesh efficiency" section, and the /status "mesh" section.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Optional
+
+from .. import telemetry as tm
+from ..utils import lockdebug
+from ..utils.log import get_logger
+
+WAVES = tm.counter(
+    "chain_mesh_waves_total",
+    "dispatched device wave-steps (one [n_pvs, t_step] block through the "
+    "sharded step), per geometry bucket",
+    ("bucket",),
+)
+SLOTS = tm.counter(
+    "chain_mesh_wave_slots_total",
+    "frame-slots of dispatched wave-steps by occupancy kind (valid = real "
+    "frames; pad_tail = tail-repeat padding; pad_exhausted = exhausted "
+    "lanes riding the wave; pad_mesh = batch-axis padding) — the kinds "
+    "sum to the dispatched slot count",
+    ("bucket", "kind"),
+)
+WAVE_SECONDS = tm.histogram(
+    "chain_mesh_wave_seconds",
+    "wall seconds per dispatched wave-step, dispatch to outputs ready "
+    "(the overlapped next-block host assembly is excluded)",
+    ("bucket",),
+)
+WASTE = tm.gauge(
+    "chain_mesh_waste_fraction",
+    "running padded-slot fraction of all dispatched slots per bucket "
+    "(0 = every slot carried a real frame)",
+    ("bucket",),
+)
+RECOMPILES = tm.counter(
+    "chain_mesh_recompiles_total",
+    "XLA compiles of device steps per geometry bucket — one geometry "
+    "flip costs exactly one recompile (the step builder is cached per "
+    "(mesh, geometry); revisiting a bucket is a cache hit)",
+    ("bucket",),
+)
+COMPILE_SECONDS = tm.counter(
+    "chain_mesh_compile_seconds_total",
+    "compile-inclusive seconds of first dispatches per bucket (trace + "
+    "XLA compile + the first step's compute)",
+    ("bucket",),
+)
+
+#: occupancy kinds of one dispatched frame-slot, in render order
+SLOT_KINDS = ("valid", "pad_tail", "pad_exhausted", "pad_mesh")
+
+_SAFE_NAME = re.compile(r"[^A-Za-z0-9._-]+")
+
+
+def mesh_dir(root: str) -> str:
+    """The journal directory convention of one serve root."""
+    return os.path.join(os.path.abspath(root), "meshobs")
+
+
+def _journal_name(replica: str) -> str:
+    return _SAFE_NAME.sub("_", replica) + ".jsonl"
+
+
+def _new_agg() -> dict:
+    return {"waves": 0, "valid": 0, "pad_tail": 0, "pad_exhausted": 0,
+            "pad_mesh": 0, "dispatched": 0, "step_s": 0.0,
+            "recompiles": 0, "compile_s": 0.0}
+
+
+class MeshRecorder:
+    """The process-wide wave/compile recorder. Metrics and the in-memory
+    per-bucket aggregate (the /status "mesh" section) always update;
+    journal lines are written only while a journal is attached.
+
+    Thread-safe: the wave driver, the serve executor pool and /status
+    reads all go through one recorder. Appends are flushed per record
+    and never raise (heat.py discipline)."""
+
+    def __init__(self) -> None:
+        self._lock = lockdebug.make_lock("meshobs")
+        self._dir: Optional[str] = None   # guarded-by: _lock
+        self._replica = "host0"           # guarded-by: _lock
+        self._path: Optional[str] = None  # guarded-by: _lock
+        self._f = None                    # guarded-by: _lock
+        self._seq = 0                     # guarded-by: _lock
+        self._buckets: dict = {}          # guarded-by: _lock
+
+    # -------------------------------------------------------- journal
+
+    def attach_journal(self, journal_dir: str,
+                       replica: str = "host0") -> None:
+        """Point the recorder at a per-run/per-root journal directory.
+        Idempotent per (dir, replica); re-attaching elsewhere closes the
+        previous journal stream."""
+        with self._lock:
+            path = os.path.join(os.path.abspath(journal_dir),
+                                _journal_name(replica))
+            if path == self._path:
+                return
+            f, self._f = self._f, None
+            self._dir = os.path.abspath(journal_dir)
+            self._replica = replica
+            self._path = path
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def detach_journal(self) -> None:
+        with self._lock:
+            f, self._f = self._f, None
+            self._dir = self._path = None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def _seal_torn_tail(self) -> None:
+        """A predecessor SIGKILLed mid-write leaves a torn final line;
+        terminate it before O_APPEND glues our first record onto it
+        (store/heat.py discipline)."""
+        try:
+            with open(self._path, "rb+") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() == 0:
+                    return
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) != b"\n":
+                    f.write(b"\n")
+        except FileNotFoundError:
+            return
+        except OSError:
+            pass  # the append itself will surface a real disk fault
+
+    # holds-lock: _lock
+    def _append_locked(self, record: dict) -> None:
+        """One journal record (spans.py discipline). Never raises; a
+        no-op while no journal is attached."""
+        if self._path is None:
+            return
+        record.setdefault("ts", round(time.time(), 6))
+        record["replica"] = self._replica
+        record["pid"] = os.getpid()
+        self._seq += 1
+        record["seq"] = self._seq
+        try:
+            if self._f is None:
+                os.makedirs(self._dir, exist_ok=True)
+                self._seal_torn_tail()
+                self._f = open(self._path, "a")
+            self._f.write(json.dumps(record, sort_keys=True) + "\n")
+            self._f.flush()
+        except (OSError, ValueError):
+            get_logger().warning(
+                "meshobs: could not append %s record",
+                record.get("kind"), exc_info=True)
+            try:
+                if self._f is not None:
+                    self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+    # --------------------------------------------------------- writes
+
+    def record_wave(self, bucket: str, *, wave: int, block: int,
+                    lanes: list, n_pvs: int, t_step: int, valid: int,
+                    pad_tail: int, pad_exhausted: int, pad_mesh: int,
+                    step_s: float, first: bool = False) -> None:
+        """One dispatched wave-step with its full slot breakdown.
+        `lanes` is the lane names in wave order (the lane→wave ordering
+        evidence); `first` flags the compile-inclusive first dispatch of
+        the bucket's step."""
+        dispatched = n_pvs * t_step
+        WAVES.labels(bucket=bucket).inc()
+        SLOTS.labels(bucket=bucket, kind="valid").inc(valid)
+        SLOTS.labels(bucket=bucket, kind="pad_tail").inc(pad_tail)
+        SLOTS.labels(bucket=bucket, kind="pad_exhausted").inc(pad_exhausted)
+        SLOTS.labels(bucket=bucket, kind="pad_mesh").inc(pad_mesh)
+        WAVE_SECONDS.labels(bucket=bucket).observe(step_s)
+        record = {
+            "kind": "wave", "bucket": bucket, "wave": wave,
+            "block": block, "lanes": list(lanes), "n_pvs": n_pvs,
+            "t_step": t_step, "valid": valid, "pad_tail": pad_tail,
+            "pad_exhausted": pad_exhausted, "pad_mesh": pad_mesh,
+            "dispatched": dispatched, "step_s": round(step_s, 6),
+        }
+        if first:
+            record["first"] = True
+        with self._lock:
+            agg = self._buckets.setdefault(bucket, _new_agg())
+            agg["waves"] += 1
+            agg["valid"] += valid
+            agg["pad_tail"] += pad_tail
+            agg["pad_exhausted"] += pad_exhausted
+            agg["pad_mesh"] += pad_mesh
+            agg["dispatched"] += dispatched
+            agg["step_s"] += step_s
+            waste = waste_fraction(agg)
+            self._append_locked(record)
+        WASTE.labels(bucket=bucket).set(waste)
+        tm.emit("mesh_wave", bucket=bucket, wave=wave, block=block,
+                lanes=len(lanes), valid=valid, pad_tail=pad_tail,
+                pad_exhausted=pad_exhausted, pad_mesh=pad_mesh,
+                step_s=round(step_s, 6))
+
+    def record_compile(self, bucket: str, *, step: str, geometry: dict,
+                       seconds: float) -> None:
+        """One first dispatch of a compiled step: the compile-ledger
+        entry with the triggering geometry."""
+        RECOMPILES.labels(bucket=bucket).inc()
+        COMPILE_SECONDS.labels(bucket=bucket).inc(seconds)
+        record = {
+            "kind": "compile", "bucket": bucket, "step": step,
+            "geometry": dict(geometry), "seconds": round(seconds, 6),
+        }
+        with self._lock:
+            agg = self._buckets.setdefault(bucket, _new_agg())
+            agg["recompiles"] += 1
+            agg["compile_s"] += seconds
+            self._append_locked(record)
+        tm.emit("mesh_compile", bucket=bucket, step=step,
+                seconds=round(seconds, 6), **{
+                    k: v for k, v in geometry.items()
+                    if isinstance(v, (str, int, float, bool))
+                })
+
+    # --------------------------------------------------------- reads
+
+    def summary(self) -> Optional[dict]:
+        """The /status "mesh" section: per-bucket occupancy/waste/
+        recompile aggregates since process start. None (section
+        skipped) until the first wave dispatches."""
+        with self._lock:
+            if not self._buckets:
+                return None
+            buckets = {
+                b: {**agg, "step_s": round(agg["step_s"], 4),
+                    "compile_s": round(agg["compile_s"], 4),
+                    "waste_fraction": waste_fraction(agg)}
+                for b, agg in self._buckets.items()
+            }
+            journal = self._path
+        return {
+            "buckets": buckets,
+            "waves": sum(a["waves"] for a in buckets.values()),
+            "recompiles": sum(a["recompiles"] for a in buckets.values()),
+            "journal": journal,
+        }
+
+    def close(self) -> None:
+        self.detach_journal()
+
+
+#: the process-wide recorder the wave driver and /status share
+RECORDER = MeshRecorder()
+
+
+def attach_journal(journal_dir: str, replica: str = "host0") -> None:
+    RECORDER.attach_journal(journal_dir, replica)
+
+
+def detach_journal() -> None:
+    RECORDER.detach_journal()
+
+
+def waste_fraction(agg: dict) -> float:
+    """Padded-slot fraction of one aggregate entry (0.0 when nothing
+    dispatched)."""
+    dispatched = agg.get("dispatched", 0)
+    if not dispatched:
+        return 0.0
+    pads = (agg.get("pad_tail", 0) + agg.get("pad_exhausted", 0)
+            + agg.get("pad_mesh", 0))
+    return round(pads / dispatched, 4)
+
+
+# ---------------------------------------------------------------- readers
+
+
+def read_journal(path: str) -> list[dict]:
+    """One journal file; tolerates torn lines (heat.py contract: every
+    complete record stands, the at-most-one interrupted write is
+    skipped)."""
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue  # torn line: every complete record stands
+                if isinstance(record, dict):
+                    out.append(record)
+    except OSError:
+        return []
+    return out
+
+
+def read_journals(root: str) -> list[dict]:
+    """Every replica's wave journal under `root`, merged and ordered by
+    (ts, replica, seq)."""
+    records: list[dict] = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return []
+    for name in names:
+        if name.endswith(".jsonl"):
+            records.extend(read_journal(os.path.join(root, name)))
+    records.sort(key=lambda r: (r.get("ts", 0.0), r.get("replica", ""),
+                                r.get("seq", 0)))
+    return records
+
+
+def aggregate(root: str) -> dict:
+    """Full-history journal rollup for mesh-top / run-report: per-bucket
+    occupancy, waste, recompiles and the per-wave lane schedule, plus
+    the per-record valid+pads == dispatched invariant verdict (any
+    violation is a driver accounting bug, reported — never dropped)."""
+    buckets: dict = {}
+    schedule: dict = {}
+    violations = 0
+    for record in read_journals(root):
+        kind = record.get("kind")
+        bucket = record.get("bucket") or "?"
+        agg = buckets.setdefault(bucket, _new_agg())
+        if kind == "wave":
+            agg["waves"] += 1
+            for slot_kind in SLOT_KINDS:
+                agg[slot_kind] += int(record.get(slot_kind) or 0)
+            agg["dispatched"] += int(record.get("dispatched") or 0)
+            agg["step_s"] += float(record.get("step_s") or 0.0)
+            total = sum(int(record.get(k) or 0) for k in SLOT_KINDS)
+            if total != int(record.get("dispatched") or 0):
+                violations += 1
+            if record.get("block") == 0:
+                schedule.setdefault(bucket, []).append({
+                    "wave": record.get("wave"),
+                    "lanes": record.get("lanes", []),
+                })
+        elif kind == "compile":
+            agg["recompiles"] += 1
+            agg["compile_s"] += float(record.get("seconds") or 0.0)
+    for bucket, agg in buckets.items():
+        agg["waste_fraction"] = waste_fraction(agg)
+        agg["step_s"] = round(agg["step_s"], 4)
+        agg["compile_s"] = round(agg["compile_s"], 4)
+    totals = _new_agg()
+    for agg in buckets.values():
+        for key in totals:
+            totals[key] += agg[key]
+    totals["waste_fraction"] = waste_fraction(totals)
+    totals["step_s"] = round(totals["step_s"], 4)
+    totals["compile_s"] = round(totals["compile_s"], 4)
+    return {"buckets": buckets, "totals": totals, "schedule": schedule,
+            "invariant_violations": violations}
+
+
+def journal_stats(root: str, tail_bytes: int = 1 << 19) -> dict:
+    """Cheap summary for the few-seconds-cadence surfaces (/fleet):
+    total size from stat, counts parsed from each journal's TAIL;
+    `sampled: true` flags a journal exceeding the tail window (the
+    counts then cover the recent window — no silent cap)."""
+    stats = {"files": 0, "bytes": 0, "waves": 0, "compiles": 0,
+             "valid": 0, "padded": 0, "sampled": False}
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return stats
+    for name in names:
+        if not name.endswith(".jsonl"):
+            continue
+        path = os.path.join(root, name)
+        try:
+            size = os.stat(path).st_size
+            with open(path) as f:
+                if size > tail_bytes:
+                    stats["sampled"] = True
+                    f.seek(size - tail_bytes)
+                    f.readline()  # discard the mid-record partial
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        record = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail (or mid-window garbage)
+                    if record.get("kind") == "wave":
+                        stats["waves"] += 1
+                        stats["valid"] += int(record.get("valid") or 0)
+                        stats["padded"] += sum(
+                            int(record.get(k) or 0)
+                            for k in SLOT_KINDS if k != "valid")
+                    elif record.get("kind") == "compile":
+                        stats["compiles"] += 1
+        except OSError:
+            continue
+        stats["files"] += 1
+        stats["bytes"] += size
+    return stats
+
+
+# the /status "mesh" section: registered at import so every surface that
+# imports the wave driver (runs, serve, tools) exposes it for free
+def _status_section(query) -> Optional[dict]:
+    return RECORDER.summary()
+
+
+try:
+    from ..telemetry import live as _live
+
+    _live.STATUS_PROVIDERS.setdefault("mesh", _status_section)
+except ImportError:  # pragma: no cover - circular-import guard only
+    pass

@@ -42,6 +42,7 @@ from .. import telemetry as tm
 from ..io import bufpool
 from ..telemetry import profiling
 from ..utils.device import shard_map as _shard_map
+from . import meshobs
 
 _XFER_SECONDS = tm.counter(
     "chain_device_transfer_seconds_total",
@@ -78,6 +79,9 @@ class Lane:
     #: its downstream encoders here, so open codec contexts are bounded
     #: by the live lanes, not the wave width
     on_done: Optional[Callable[[], None]] = None
+    #: identity in the wave journal (parallel/meshobs.py) — the lane→wave
+    #: ordering evidence; empty = positional "lane<i>" fallback
+    name: str = ""
 
 
 def _rechunk(
@@ -214,6 +218,23 @@ def sort_lanes(lanes: list[Lane]) -> list[Lane]:
     return sorted(lanes, key=lambda ln: -ln.n_frames_hint)
 
 
+#: step identities already dispatched at least once — the compile
+#: ledger's first-dispatch detector. `_sharded_resize_step` is
+#: functools.cached, so each compiled step lives for the process and its
+#: id() is stable: a NEW id here means XLA traced+compiled, a seen id is
+#: a cache hit (one geometry flip = exactly one recompile).
+_DISPATCHED_STEPS: set[int] = set()
+
+
+def bucket_label(dst_h: int, dst_w: int, ten_bit: bool,
+                 src_h: int = 0, src_w: int = 0) -> str:
+    """Canonical bucket label for the mesh metrics/journal. Callers that
+    know the full bucket key (models/avpvs, serve executors) pass the
+    source geometry; the driver-side fallback labels by destination."""
+    src = f"{src_h}x{src_w}" if src_h and src_w else "?"
+    return f"{src}->{dst_h}x{dst_w}@{'10' if ten_bit else '8'}bit"
+
+
 def run_bucket(
     lanes: list[Lane],
     mesh,
@@ -224,13 +245,16 @@ def run_bucket(
     ten_bit: bool = False,
     *,
     chunk: int,
+    bucket: Optional[str] = None,
 ) -> None:
     """Drive one geometry bucket of lanes through the sharded step in
     waves of the mesh's "pvs" size. `chunk` is the global frame budget per
     step across the time axis — callers pass their own memory knob
     (models/avpvs passes its CHUNK) so the two paths cannot silently
     diverge. Callers that must bound open decoders/encoders should pass
-    wave-sized lane groups (≤ mesh "pvs" size), as models/avpvs does."""
+    wave-sized lane groups (≤ mesh "pvs" size), as models/avpvs does.
+    `bucket` labels the wave journal / chain_mesh_* metrics
+    (parallel/meshobs.py); callers knowing the full bucket key pass it."""
     import jax
 
     from .mesh import batch_sharding
@@ -247,6 +271,21 @@ def run_bucket(
     step = _sharded_resize_step(
         mesh, dst_h, dst_w, kernel, sub_h, sub_w, ten_bit, donate
     )
+    if bucket is None:
+        bucket = bucket_label(dst_h, dst_w, ten_bit)
+    # compile ledger: a step id never dispatched before compiles on its
+    # first call — the first block's timing is compile-inclusive and
+    # lands as this bucket's ledger entry (meshobs.record_compile)
+    compile_state = {
+        "pending": id(step) not in _DISPATCHED_STEPS,
+        "geometry": {
+            "dst_h": dst_h, "dst_w": dst_w, "kernel": kernel,
+            "sub_h": sub_h, "sub_w": sub_w, "ten_bit": ten_bit,
+            "t_step": t_step, "mesh": "x".join(
+                str(v) for v in mesh.shape.values()),
+        },
+    }
+    _DISPATCHED_STEPS.add(id(step))
 
     from contextlib import ExitStack
 
@@ -266,11 +305,18 @@ def run_bucket(
                 for ln in wave
             ]
             _drive_wave(wave, iters, n_pvs, step, sharding, mesh, dst_h,
-                        dst_w, ten_bit)
+                        dst_w, ten_bit, bucket=bucket,
+                        wave_index=w0 // n_pvs, t_step=t_step,
+                        compile_state=compile_state,
+                        lane_names=[ln.name or f"lane{w0 + i}"
+                                    for i, ln in enumerate(wave)])
 
 
 def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
-                dst_h: int, dst_w: int, ten_bit: bool, pool=None) -> None:
+                dst_h: int, dst_w: int, ten_bit: bool, pool=None, *,
+                bucket: str = "?", wave_index: int = 0, t_step: int = 0,
+                compile_state: Optional[dict] = None,
+                lane_names: Optional[list] = None) -> None:
     """Fully overlapped wave loop: while the jitted step for block k is in
     flight, the next t_step blocks are pulled from the lane prefetchers,
     assembled into the OTHER of two pooled [B, T, H, W] wave buffers, and
@@ -352,15 +398,32 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
             _XFER_PUT_B.inc(sum(b.nbytes for b in bufs) + prev.nbytes)
         return dev, valids
 
+    lane_names = lane_names or [f"lane{i}" for i in range(len(wave))]
+    block = 0
     nxt = gather_put()
     while nxt is not None:
         planes, valids = nxt
+        # occupancy of THIS dispatched block, from the valid mask the
+        # assembly above already computed (satellite fix: the burned
+        # `dst[i] = 0` slots are recorded, not discarded). t_step may be
+        # 0 on direct legacy calls — derived from the device block then.
+        ts = t_step or int(planes[0].shape[1])
+        valid = sum(valids)
+        pad_tail = sum(ts - v for v in valids if v)
+        pad_exhausted = ts * sum(1 for v in valids if not v)
+        pad_mesh = (n_pvs - len(wave)) * ts
+        t0 = time.perf_counter()
         out = step(*planes, jax.device_put(prev, prev_sharding), first)
         # overlap: decode + assemble + upload block k+1 while the
         # step for block k runs (dispatch above is async)
+        t_gather0 = time.perf_counter()
         nxt = gather_put()
+        t_gather1 = time.perf_counter()
         if tm.enabled():
-            with profiling.maybe_span("device:wave_step"):
+            with profiling.maybe_span(
+                    "device:wave_step", bucket=bucket, wave=wave_index,
+                    valid=valid, pad_tail=pad_tail,
+                    pad_exhausted=pad_exhausted, pad_mesh=pad_mesh):
                 out = jax.block_until_ready(out)
             t_get = time.perf_counter()
             with profiling.maybe_span("transfer:device_get"):
@@ -371,6 +434,24 @@ def _drive_wave(wave, iters, n_pvs, step, sharding, mesh,
         else:
             host = [np.asarray(o) for o in out[:3]]
             si_h, ti_h = np.asarray(out[3]), np.asarray(out[4])
+        # dispatch→outputs-ready wall seconds, the overlapped host
+        # assembly of block k+1 excluded
+        step_s = max(
+            0.0, (time.perf_counter() - t0) - (t_gather1 - t_gather0))
+        first_dispatch = bool(compile_state
+                              and compile_state.get("pending"))
+        meshobs.RECORDER.record_wave(
+            bucket, wave=wave_index, block=block, lanes=lane_names,
+            n_pvs=n_pvs, t_step=ts, valid=valid, pad_tail=pad_tail,
+            pad_exhausted=pad_exhausted, pad_mesh=pad_mesh,
+            step_s=step_s, first=first_dispatch)
+        if first_dispatch:
+            compile_state["pending"] = False
+            meshobs.RECORDER.record_compile(
+                bucket, step="wave_step",
+                geometry=compile_state.get("geometry", {}),
+                seconds=step_s)
+        block += 1
         for i, ln in enumerate(wave):
             if valids[i]:
                 ln.emit([h[i][: valids[i]] for h in host])

@@ -84,6 +84,14 @@ def _instrument_step(fn, step: str):
             state["first"] = False
             tm.emit("device_step", step=step, first=True,
                     duration_s=round(dur, 4))
+            # the compile ledger (parallel/meshobs.py): the first call is
+            # the compile-inclusive one — one entry per instrumented step,
+            # keyed by the step name (its compile identity: one jit per
+            # make_* call, cached per geometry by the callers)
+            from . import meshobs
+
+            meshobs.RECORDER.record_compile(
+                step, step=step, geometry={}, seconds=dur)
         return out
 
     return call

@@ -375,9 +375,11 @@ class DeviceWaveExecutor(SyntheticExecutor):
             lanes.append(p03_batch.Lane(
                 chunks=iter([yuv]), emit=collected[i].append,
                 n_frames_hint=n,
+                name=unit.pvs_id,  # wave-journal identity (meshobs)
             ))
         p03_batch.run_bucket(
             lanes, self._mesh(), dh, dw, "bicubic", (2, 2), False, chunk=8,
+            bucket=p03_batch.bucket_label(dh, dw, False, sh, sw),
         )
         for i, output in enumerate(outputs):
             planes = [

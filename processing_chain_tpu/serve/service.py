@@ -172,6 +172,16 @@ class ChainServeService:
         self.heat = store_heat.HeatLedger(
             self.store.root, replica=self.replica
         )
+        # the device-plane flight recorder (parallel/meshobs.py): the
+        # wave executors record into this root's journal under this
+        # replica's name — /fleet merges the per-replica files.
+        # Imported lazily: the parallel package pulls in jax, which a
+        # synthetic-only service must not pay at module import.
+        from ..parallel import meshobs
+
+        meshobs.attach_journal(
+            meshobs.mesh_dir(self.root), replica=self.replica
+        )
         self.poll_s = max(0.05, float(poll_s))
         self.info_path = info_path or os.path.join(
             self.root, "serve-info.json"

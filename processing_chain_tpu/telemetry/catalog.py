@@ -122,6 +122,18 @@ METRICS: dict[str, str] = {
     "chain_bufpool_free_bytes": "gauge",
     "chain_bufpool_outstanding_bytes": "gauge",
     "chain_device_memory_bytes": "gauge",
+    # parallel/meshobs.py — device-plane flight recorder: per-wave
+    # occupancy/waste accounting and the compile ledger (docs/PERF.md
+    # "my waves are wasteful")
+    "chain_mesh_waves_total": "counter",
+    "chain_mesh_wave_slots_total": "counter",
+    "chain_mesh_wave_seconds": "histogram",
+    "chain_mesh_waste_fraction": "gauge",
+    "chain_mesh_recompiles_total": "counter",
+    "chain_mesh_compile_seconds_total": "counter",
+    # parallel/distributed.py — multi-process (DCN) visibility
+    "chain_dist_collective_bytes_total": "counter",
+    "chain_dist_barrier_seconds_total": "counter",
     # io/faults.py + io/isolate.py + models/fused.py — hostile-input
     # hardening (docs/ROBUSTNESS.md)
     "chain_media_faults_injected_total": "counter",
@@ -171,6 +183,13 @@ EVENTS: frozenset = frozenset({
     "media_fault_injected",    # io/faults.py — PC_MEDIA_FAULTS clause fired
     "media_deadline_expired",  # io/faults.py — native crossing abandoned
     "fused_member_degraded",   # models/fused.py — member dropped mid-stream
+    "mesh_wave",       # parallel/meshobs.py — one wave-step dispatched,
+                       # with its valid/pad slot breakdown
+    "mesh_compile",    # parallel/meshobs.py — first dispatch of a step:
+                       # one compile-ledger entry with its geometry
+    "dist_init",       # parallel/distributed.py — jax.distributed joined
+    "dist_collective", # parallel/distributed.py — one cross-process
+                       # collective with its payload bytes
 
     "log",             # WARNING+ console records bridged into the log
 })

@@ -78,6 +78,15 @@ TIER_METRICS = (
     "chain_store_tier_demotions_total",
     "chain_store_tier_bytes",
 )
+
+#: device-plane wave counters (parallel/meshobs.py) — all cumulative
+#: per-replica event counts, so the fleet merge is a plain sum
+MESH_METRICS = (
+    "chain_mesh_waves_total",
+    "chain_mesh_wave_slots_total",
+    "chain_mesh_recompiles_total",
+    "chain_mesh_compile_seconds_total",
+)
 #: the observed/predicted audit histogram (same section)
 COST_ERROR_METRIC = "chain_serve_cost_error_ratio"
 
@@ -244,6 +253,44 @@ def tier_report(parsed: list) -> dict:
         t["hit_ratio"] = (
             round(t["hits"] / total_hits, 4) if total_hits else 0.0)
     return {"tiers": tiers, "hits_total": total_hits}
+
+
+def mesh_report(parsed: list) -> dict:
+    """The /fleet "mesh" section from each replica's chain_mesh_*
+    counters (parallel/meshobs.py): per geometry bucket, fleet-summed
+    wave counts, the valid/pad slot split with the derived waste
+    fraction, and the compile ledger (every replica compiles its own
+    steps, so recompiles sum too). Empty buckets dict when no replica
+    has dispatched a wave."""
+    buckets: dict = {}
+    for counters in parsed:
+        for (name, _), entry in counters.items():
+            bucket = entry["labels"].get("bucket", "?")
+            b = buckets.setdefault(bucket, {
+                "waves": 0, "valid": 0, "padded": 0,
+                "recompiles": 0, "compile_s": 0.0,
+            })
+            value = entry["value"]
+            if name == "chain_mesh_waves_total":
+                b["waves"] += int(value)
+            elif name == "chain_mesh_wave_slots_total":
+                if entry["labels"].get("kind") == "valid":
+                    b["valid"] += int(value)
+                else:
+                    b["padded"] += int(value)
+            elif name == "chain_mesh_recompiles_total":
+                b["recompiles"] += int(value)
+            elif name == "chain_mesh_compile_seconds_total":
+                b["compile_s"] = round(b["compile_s"] + value, 4)
+    for b in buckets.values():
+        total = b["valid"] + b["padded"]
+        b["waste_fraction"] = (
+            round(b["padded"] / total, 4) if total else 0.0)
+    return {
+        "buckets": buckets,
+        "waves": sum(b["waves"] for b in buckets.values()),
+        "recompiles": sum(b["recompiles"] for b in buckets.values()),
+    }
 
 
 def cost_report(counters: dict, error_hist: dict) -> dict:
@@ -476,6 +523,7 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
     parsed: list[dict] = []
     parsed_counters: list[dict] = []
     parsed_tiers: list[dict] = []
+    parsed_mesh: list[dict] = []
     infos = discover_replicas(root)
     for info in infos:
         entry = {
@@ -523,6 +571,9 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
                 parsed_tiers.append(
                     parse_counters(rendered, TIER_METRICS)
                 )
+                parsed_mesh.append(
+                    parse_counters(rendered, MESH_METRICS)
+                )
         else:
             entry["error"] = "unreachable"
         replicas.append(entry)
@@ -556,6 +607,10 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
         # totals (store/tiers.py; docs/STORE.md "Tier hierarchy") —
         # empty tiers dict for single-tier fleets
         "store_tiers": tier_report(parsed_tiers),
+        # device-plane wave occupancy/waste/compile ledger, summed over
+        # live replicas (parallel/meshobs.py; docs/PERF.md "My waves
+        # are wasteful") — empty buckets dict until a wave dispatches
+        "mesh": mesh_report(parsed_mesh),
         # per-tenant predicted/observed seconds + admission refusals,
         # merged across replicas (serve/cost.py)
         "cost": {

@@ -74,15 +74,23 @@ _QDEPTH = gauge(
 )
 _DEVMEM = gauge(
     "chain_device_memory_bytes",
-    "jax device memory stats, summed over local devices",
-    ("kind",),
+    "jax device memory stats per local device (device=\"all\" carries "
+    "the fleet-of-devices sum)",
+    ("device", "kind"),
 )
 
 #: Verdicts the attribution engine can return.
 VERDICTS = (
     "decode_bound", "transfer_bound", "compute_bound", "encode_bound",
-    "balanced",
+    "balanced", "fragmentation_bound",
 )
+
+#: a "balanced" run whose mesh waves padded away at least this fraction
+#: of their dispatched frame-slots is reclassified fragmentation_bound —
+#: no single component dominates because the device time itself is spent
+#: on padding, and "balanced" would hide the one thing to fix
+#: (docs/PERF.md "my waves are wasteful")
+FRAGMENTATION_WASTE_THRESHOLD = 0.25
 
 #: component -> (metric name, label filter) — the measured seconds each
 #: verdict is grounded in. "decode" and "encode" are the BLOCKED times of
@@ -105,17 +113,19 @@ def active() -> bool:
     return _ACTIVE
 
 
-def maybe_span(name: str):
+def maybe_span(name: str, **meta):
     """A tracer span while a `--profile` capture is active, else a no-op
     context — THE gate for the per-chunk lane spans, expressed once so a
-    future change (e.g. a sampling rate) has one home."""
+    future change (e.g. a sampling rate) has one home. `meta` rides the
+    span into the merged Chrome trace as `args` (the wave spans carry
+    their valid/pad slot breakdown this way)."""
     if not _ACTIVE:
         from contextlib import nullcontext
 
         return nullcontext()
     from ..utils import tracing
 
-    return tracing.span(name)
+    return tracing.span(name, **meta)
 
 
 # ---------------------------------------------------------------- sampling
@@ -186,27 +196,34 @@ class _CpuTracker:
 _SHARED_CPU = _CpuTracker()
 
 
-def _device_memory() -> dict[str, float]:
-    """jax device memory stats summed over local devices — ONLY when a
-    backend already exists (sampling must never trigger backend init,
-    which can block on a remote tunnel)."""
+def _device_memory() -> tuple[dict[str, float], dict[str, dict]]:
+    """(summed totals, per-device stats) of jax device memory — ONLY
+    when a backend already exists (sampling must never trigger backend
+    init, which can block on a remote tunnel). Per-device entries are
+    keyed "<platform>:<id>" — the `device` label of
+    chain_device_memory_bytes."""
     jax_mod = sys.modules.get("jax")
     if jax_mod is None:
-        return {}
+        return {}, {}
     try:
         from jax._src import xla_bridge as xb
 
         if not getattr(xb, "_backends", None):
-            return {}
+            return {}, {}
         totals: dict[str, float] = {}
+        per_device: dict[str, dict] = {}
         for dev in jax_mod.local_devices():
             stats = dev.memory_stats() or {}
+            entry: dict = {}
             for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
                 if key in stats:
                     totals[key] = totals.get(key, 0.0) + float(stats[key])
-        return totals
+                    entry[key] = float(stats[key])
+            if entry:
+                per_device[f"{dev.platform}:{dev.id}"] = entry
+        return totals, per_device
     except Exception:  # noqa: BLE001 - best-effort on every backend/runtime
-        return {}
+        return {}, {}
 
 
 def sample_resources(
@@ -233,9 +250,11 @@ def sample_resources(
         "queues": {name: entry["depth"] for name, entry in queues.items()},
     }
     if include_device:
-        devmem = _device_memory()
+        devmem, per_device = _device_memory()
         if devmem:
             sample["device_memory"] = devmem
+        if per_device:
+            sample["device_memory_by_device"] = per_device
     if REGISTRY.enabled:
         if sample["rss_bytes"] is not None:
             _RSS.set(sample["rss_bytes"])
@@ -256,7 +275,11 @@ def sample_resources(
         for name in gone:
             _QDEPTH.labels(queue=name).set(0)
         for kind, val in sample.get("device_memory", {}).items():
-            _DEVMEM.labels(kind=kind).set(val)
+            _DEVMEM.labels(device="all", kind=kind).set(val)
+        for dev_label, stats in sample.get(
+                "device_memory_by_device", {}).items():
+            for kind, val in stats.items():
+                _DEVMEM.labels(device=dev_label, kind=kind).set(val)
     return sample
 
 
@@ -282,6 +305,10 @@ def format_resource_peaks(peaks: dict) -> list[str]:
         lines.append(
             f"peak device memory: {peaks['device_memory_bytes'] / 1e6:.0f} MB"
         )
+    for dev_label, val in sorted(
+            peaks.get("device_memory_by_device", {}).items()):
+        lines.append(
+            f"peak device memory {dev_label}: {val / 1e6:.0f} MB")
     return lines
 
 
@@ -318,6 +345,15 @@ def resource_peaks(timeseries: dict) -> dict:
     )
     if dev:
         peaks["device_memory_bytes"] = dev
+    per_device: dict = {}
+    for s in samples:
+        for dev_label, stats in s.get("device_memory_by_device",
+                                      {}).items():
+            per_device[dev_label] = max(
+                per_device.get(dev_label, 0),
+                stats.get("peak_bytes_in_use", 0))
+    if per_device:
+        peaks["device_memory_by_device"] = per_device
     return peaks
 
 
@@ -419,6 +455,7 @@ class ResourceMonitor:
 _TRACE_EVENT_KINDS = (
     "stage_start", "stage_end", "job_start", "job_end", "device_step",
     "task_stalled", "task_hard_timeout", "task_recovered", "barrier_wait",
+    "mesh_compile", "dist_init", "dist_collective",
 )
 
 
@@ -687,7 +724,39 @@ def attribute_run(metrics: dict, events: Sequence[dict]) -> dict[str, dict]:
     if not verdicts and metrics:
         components, missing = components_from_metrics(metrics)
         verdicts["run"] = classify_components(components, missing)
+    # bucket-fragmentation input (parallel/meshobs.py): a run whose
+    # device time is mostly padding has no dominant component to blame —
+    # the flat profile IS the symptom, and "balanced" would bury it
+    waste = mesh_waste_from_metrics(metrics) if metrics else None
+    if waste is not None:
+        for result in verdicts.values():
+            result["mesh_waste_fraction"] = waste
+            if (result.get("verdict") == "balanced"
+                    and not result.get("insufficient_data")
+                    and waste >= FRAGMENTATION_WASTE_THRESHOLD):
+                result["verdict"] = "fragmentation_bound"
     return verdicts
+
+
+def mesh_waste_from_metrics(metrics: dict) -> Optional[float]:
+    """Padded-slot fraction of all dispatched wave slots, from the
+    chain_mesh_wave_slots_total series of a metrics snapshot. None when
+    the wave driver never dispatched (no series) — absence of evidence,
+    not a 0.0 measurement."""
+    series = metrics.get("chain_mesh_wave_slots_total",
+                         {}).get("series", [])
+    valid = padded = 0.0
+    for s in series:
+        kind = s.get("labels", {}).get("kind")
+        value = float(s.get("value", 0.0))
+        if kind == "valid":
+            valid += value
+        elif kind:
+            padded += value
+    total = valid + padded
+    if total <= 0:
+        return None
+    return round(padded / total, 4)
 
 
 # ------------------------------------------------------------ orchestration

@@ -452,7 +452,13 @@ def _attribution_section(run: RunData) -> list[str]:
                 + (f"; {contributors}" if contributors else "") + ")"
             )
         else:
-            lines.append(f"  {stage}: {v['verdict']} — {contributors}")
+            line = f"  {stage}: {v['verdict']} — {contributors}"
+            if v["verdict"] == "fragmentation_bound":
+                line += (f" (mesh waste "
+                         f"{v.get('mesh_waste_fraction', 0.0):.1%} — "
+                         "see the mesh efficiency section / "
+                         "`tools mesh-top`)")
+            lines.append(line)
         if v.get("missing"):
             lines.append(
                 f"    unmeasured: {', '.join(v['missing'])} (no series "
@@ -510,6 +516,71 @@ def _device_section(run: RunData) -> list[str]:
     return lines
 
 
+def _mesh_section(run: RunData) -> list[str]:
+    """Mesh efficiency (parallel/meshobs.py): per-bucket wave occupancy,
+    padding waste and the compile ledger. The run's wave journal
+    (`meshobs_<stamp>/`, written alongside the event stream) is the
+    preferred source — it survives crashes and carries the lane→wave
+    schedule; the chain_mesh_* series are the fallback for runs whose
+    journal was moved or pruned."""
+    journal_dir = os.path.join(run.directory, f"meshobs_{run.stamp}")
+    if os.path.isdir(journal_dir):
+        # lazy: meshobs itself is jax-free, but its package pulls jax —
+        # only pay that when a wave journal actually exists
+        from ..parallel import meshobs
+
+        agg = meshobs.aggregate(journal_dir)
+        if agg["buckets"]:
+            lines = []
+            for bucket, a in sorted(agg["buckets"].items()):
+                lines.append(
+                    f"  {bucket}: {a['waves']} wave(s), {a['valid']} valid"
+                    f" + {a['pad_tail']} tail / {a['pad_exhausted']} "
+                    f"exhausted / {a['pad_mesh']} mesh pad slots — waste "
+                    f"{a['waste_fraction']:.1%}, {a['recompiles']} "
+                    f"compile(s) ({a['compile_s']:.2f}s)"
+                )
+            tot = agg["totals"]
+            if len(agg["buckets"]) > 1:
+                lines.append(
+                    f"  total: waste {tot['waste_fraction']:.1%} over "
+                    f"{tot['dispatched']} dispatched slots, "
+                    f"{tot['recompiles']} compile(s)"
+                )
+            if agg["invariant_violations"]:
+                lines.append(
+                    f"  !! {agg['invariant_violations']} wave record(s) "
+                    "broke valid+pad == dispatched (driver accounting bug)"
+                )
+            lines.append(f"  journal: {journal_dir}")
+            return lines
+    slots = _by_label(run, "chain_mesh_wave_slots_total", "bucket")
+    if not slots:
+        return []
+    waves = _by_label(run, "chain_mesh_waves_total", "bucket")
+    recompiles = _by_label(run, "chain_mesh_recompiles_total", "bucket")
+    lines = []
+    for bucket in sorted(waves):
+        valid = _value(run, "chain_mesh_wave_slots_total",
+                       bucket=bucket, kind="valid")
+        padded = sum(
+            _value(run, "chain_mesh_wave_slots_total",
+                   bucket=bucket, kind=kind)
+            for kind in ("pad_tail", "pad_exhausted", "pad_mesh")
+        )
+        total = valid + padded
+        waste = padded / total if total else 0.0
+        n_compiles = int(float(
+            recompiles.get(bucket, {}).get("value", 0)))
+        lines.append(
+            f"  {bucket}: "
+            f"{int(float(waves[bucket].get('value', 0)))} wave(s), "
+            f"{int(valid)} valid + {int(padded)} pad slots — waste "
+            f"{waste:.1%}, {n_compiles} compile(s)"
+        )
+    return lines
+
+
 def render_report(run: RunData) -> str:
     parts = [
         "\n".join(_header_section(run)),
@@ -540,6 +611,9 @@ def render_report(run: RunData) -> str:
     device = _device_section(run)
     if device:
         parts.append("\n".join(device))
+    mesh = _mesh_section(run)
+    if mesh:
+        parts.append("mesh efficiency:\n" + "\n".join(mesh))
     warnings = [
         e for e in _events(run, "log")
         if e.get("level") in ("WARNING", "ERROR", "CRITICAL")

@@ -139,6 +139,16 @@ def render(view: dict, note: str = "") -> str:
             "tiers: " + "  ".join(parts)
             + f"  promotions={moves} demotions={demotes}"
         )
+    mesh = view.get("mesh", {})
+    if mesh.get("buckets"):
+        parts = []
+        for name, b in sorted(mesh["buckets"].items()):
+            parts.append(
+                f"{name} waves={b.get('waves', 0)} "
+                f"waste={b.get('waste_fraction', 0.0) * 100:.1f}% "
+                f"compiles={b.get('recompiles', 0)}"
+            )
+        lines.append("mesh: " + "  ".join(parts))
     fleet_cost = view.get("cost", {})
     if fleet_cost.get("tenants") or fleet_cost.get("rejected"):
         lines.append("")

@@ -1,0 +1,307 @@
+"""mesh-report: the mesh-scaling report generator (MESH_OBS_r18.json).
+
+    tools mesh-report sweep [--devices 8] [--frames 8] [--out FILE]
+                            [--journal DIR]
+
+The measured acceptance harness for the device-plane flight recorder
+(parallel/meshobs.py, docs/PERF.md "My waves are wasteful"): a toy
+mixed-geometry corpus driven through the REAL wave driver
+(parallel/p03_batch.run_bucket) on a virtual CPU mesh, with the wave
+journal attached, producing the three scaling curves the ROADMAP's
+mesh-efficiency evidence needs:
+
+  * **throughput vs lane count** — the same geometry bucket at 1×, 2×
+    and 4× the mesh width: valid frames/second per sweep point, each
+    point's journal re-checked for the valid+pad == dispatched
+    invariant;
+  * **waste vs bucket spread** — uniform lane lengths against a
+    deliberately ragged mix in one bucket: the padded-slot fraction
+    must rise with the spread (tail-repeat + exhausted-lane pads are
+    REAL dispatched work, the accounting must show it);
+  * **compile ledger** — three distinct geometries then a REVISIT of
+    the first: recompiles == distinct geometries, and the revisit adds
+    none (one geometry flip = exactly one recompile);
+  * **RSS / device-memory plateau** — a resource snapshot after every
+    sweep point: the wave driver's double-buffered assembly must not
+    scale host memory with lane count.
+
+XLA fixes its host device count at first backend init, so the sweep
+re-execs itself into a clean child process with JAX_PLATFORMS=cpu and
+the forced device count (same hazard note as
+__graft_entry__.dryrun_multichip); the parent only relays output.
+
+Prints one JSON report line and exits 1 when any invariant fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Optional, Sequence
+
+from ..utils.fsio import atomic_write_text
+from ..utils.log import get_logger
+
+
+def _reexec_child(args, argv: Sequence[str]) -> int:
+    """Re-run this tool in a subprocess whose XLA host-device count is
+    forced BEFORE any backend exists (nothing in this process — env,
+    jax config, initialized backends — is mutated)."""
+    import re
+
+    from ..utils.runner import shell
+
+    env = dict(os.environ)
+    env["_PC_MESH_REPORT_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={args.devices}"
+    ).strip()
+    proc = shell(
+        [sys.executable, "-m", "processing_chain_tpu.cli",
+         "tools", "mesh-report", *argv],
+        check=False, timeout=1800, env=env,
+    )
+    # the child's report (JSON + progress) belongs on OUR streams
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    return proc.returncode
+
+
+def _run_lanes(mesh, lengths, dh, dw, journal_dir, *, ten_bit=False,
+               chunk=8, sh=36, sw=64):
+    """One sweep point: `lengths[i]` frames of synthetic YUV per lane,
+    through run_bucket with the journal attached to `journal_dir`.
+    Returns (aggregate, elapsed_s, emitted_frames)."""
+    import numpy as np
+
+    from ..parallel import meshobs, p03_batch
+
+    rng = np.random.default_rng(0x18)
+    outs: list[list] = [[] for _ in lengths]
+    lanes = []
+    for i, n in enumerate(lengths):
+        yuv = [
+            rng.integers(0, 255, size=(n, sh, sw), dtype=np.uint8),
+            rng.integers(0, 255, size=(n, sh // 2, sw // 2), dtype=np.uint8),
+            rng.integers(0, 255, size=(n, sh // 2, sw // 2), dtype=np.uint8),
+        ]
+        lanes.append(p03_batch.Lane(
+            chunks=iter([yuv]), emit=outs[i].append,
+            n_frames_hint=n, name=f"lane{i:02d}",
+        ))
+    meshobs.attach_journal(journal_dir, replica="sweep")
+    t0 = time.perf_counter()
+    p03_batch.run_bucket(
+        lanes, mesh, dh, dw, "bicubic", (2, 2), ten_bit, chunk=chunk,
+        bucket=p03_batch.bucket_label(dh, dw, ten_bit, sh, sw),
+    )
+    elapsed = time.perf_counter() - t0
+    meshobs.detach_journal()
+    emitted = sum(
+        sum(blk[0].shape[0] for blk in out) for out in outs
+    )
+    return meshobs.aggregate(journal_dir), elapsed, emitted
+
+
+def _check_point(tag: str, agg: dict, want_valid: int,
+                 failures: list) -> None:
+    tot = agg["totals"]
+    if agg["invariant_violations"]:
+        failures.append(
+            f"{tag}: {agg['invariant_violations']} wave record(s) broke "
+            "valid+pad == dispatched")
+    if tot["valid"] != want_valid:
+        failures.append(
+            f"{tag}: journal counts {tot['valid']} valid slots, the "
+            f"corpus has {want_valid} frames")
+    padded = tot["pad_tail"] + tot["pad_exhausted"] + tot["pad_mesh"]
+    if tot["valid"] + padded != tot["dispatched"]:
+        failures.append(
+            f"{tag}: totals {tot['valid']}+{padded} != "
+            f"{tot['dispatched']} dispatched")
+
+
+def _cmd_sweep(args, argv: Sequence[str]) -> int:
+    log = get_logger()
+    if os.environ.get("_PC_MESH_REPORT_CHILD") != "1":
+        return _reexec_child(args, argv)
+
+    import jax
+
+    from .. import telemetry as tm
+    from ..parallel import meshobs
+    from ..parallel.mesh import make_mesh
+    from ..telemetry import profiling
+
+    tm.enable()
+    journal_root = args.journal or tempfile.mkdtemp(prefix="mesh-report-")
+    devices = jax.devices("cpu")[:args.devices]
+    if len(devices) != args.devices:
+        log.error("mesh-report: need %d devices, have %d (child env "
+                  "did not take)", args.devices, len(devices))
+        return 1
+    time_parallel = 2 if args.devices % 2 == 0 else 1
+    mesh = make_mesh(devices, time_parallel=time_parallel)
+    n_pvs = mesh.shape["pvs"]
+    t_step = max(1, 8 // mesh.shape["time"]) * mesh.shape["time"]
+    report: dict = {
+        "devices": args.devices,
+        "mesh": dict(mesh.shape),
+        "t_step": t_step,
+        "journal_root": journal_root,
+    }
+    failures: list[str] = []
+
+    # ---- throughput vs lane count: same bucket, 1x/2x/4x mesh width --
+    # warmup dispatch first: the sweep points must all ride the SAME
+    # compiled step, or point 1 silently carries the XLA compile
+    _run_lanes(mesh, [t_step] * n_pvs, 72, 128,
+               os.path.join(journal_root, "warmup"), chunk=t_step)
+    scaling = []
+    for mult in (1, 2, 4):
+        lanes_n = n_pvs * mult
+        lengths = [args.frames] * lanes_n
+        jdir = os.path.join(journal_root, f"scale_{lanes_n:03d}")
+        agg, elapsed, emitted = _run_lanes(
+            mesh, lengths, 72, 128, jdir, chunk=t_step)
+        _check_point(f"scale x{mult}", agg, sum(lengths), failures)
+        if emitted != sum(lengths):
+            failures.append(
+                f"scale x{mult}: {emitted} frames emitted, "
+                f"{sum(lengths)} decoded")
+        scaling.append({
+            "lanes": lanes_n,
+            "frames": sum(lengths),
+            "waves": agg["totals"]["waves"],
+            "seconds": round(elapsed, 4),
+            "frames_per_s": round(sum(lengths) / elapsed, 2),
+            "waste_fraction": agg["totals"]["waste_fraction"],
+        })
+        sample = profiling.sample_resources()
+        devmem = sample.get("device_memory", {})
+        scaling[-1]["rss_bytes"] = sample.get("rss_bytes")
+        scaling[-1]["device_bytes_in_use"] = devmem.get("bytes_in_use")
+    report["scaling"] = scaling
+    rss = [p["rss_bytes"] for p in scaling if p["rss_bytes"]]
+    if len(rss) >= 2 and rss[0]:
+        # the wave driver double-buffers ONE wave regardless of lane
+        # count — host memory must plateau, not scale with lanes
+        report["rss_plateau_ratio"] = round(rss[-1] / rss[0], 3)
+        if report["rss_plateau_ratio"] > 3.0:
+            failures.append(
+                f"RSS grew {report['rss_plateau_ratio']}x from "
+                f"{scaling[0]['lanes']} to {scaling[-1]['lanes']} lanes "
+                "— the wave buffers are not plateauing")
+
+    # ---- waste vs bucket spread: uniform vs ragged lengths -----------
+    uniform = [t_step] * n_pvs
+    ragged = [t_step if i % 2 else max(1, t_step // 4)
+              for i in range(n_pvs)]
+    frag = {}
+    for tag, lengths in (("uniform", uniform), ("ragged", ragged)):
+        jdir = os.path.join(journal_root, f"frag_{tag}")
+        agg, _, _ = _run_lanes(mesh, lengths, 72, 128, jdir, chunk=t_step)
+        _check_point(f"frag {tag}", agg, sum(lengths), failures)
+        tot = agg["totals"]
+        frag[tag] = {
+            "lengths": lengths,
+            "waste_fraction": tot["waste_fraction"],
+            "pad_tail": tot["pad_tail"],
+            "pad_exhausted": tot["pad_exhausted"],
+            "pad_mesh": tot["pad_mesh"],
+        }
+    report["fragmentation"] = frag
+    if frag["uniform"]["waste_fraction"] != 0.0:
+        failures.append(
+            "t_step-aligned uniform lanes padded "
+            f"{frag['uniform']['waste_fraction']:.2%} — nothing should "
+            "pad when every lane fills its blocks")
+    if frag["ragged"]["waste_fraction"] <= frag["uniform"]["waste_fraction"]:
+        failures.append(
+            "ragged lanes show no more waste than uniform ones — the "
+            "pad accounting is not seeing the spread")
+
+    # ---- compile ledger: 3 geometries, then revisit the first. All
+    # three are FRESH in this process (the sweeps above used 72x128):
+    # the compile detector is process-global, so a geometry the sweep
+    # already compiled would correctly land its ledger entry THERE.
+    ledger_dir = os.path.join(journal_root, "compiles")
+    geometries = [
+        dict(dh=80, dw=144, ten_bit=False),
+        dict(dh=90, dw=160, ten_bit=False),
+        dict(dh=80, dw=144, ten_bit=True),
+    ]
+    for geo in geometries + [geometries[0]]:  # the revisit
+        agg, _, _ = _run_lanes(
+            mesh, [t_step] * n_pvs, geo["dh"], geo["dw"], ledger_dir,
+            ten_bit=geo["ten_bit"], chunk=t_step)
+    recompiles = agg["totals"]["recompiles"]
+    report["compile_ledger"] = {
+        "distinct_geometries": len(geometries),
+        "dispatch_rounds": len(geometries) + 1,
+        "recompiles": recompiles,
+        "buckets": {b: e["recompiles"] for b, e in agg["buckets"].items()},
+    }
+    if recompiles != len(geometries):
+        failures.append(
+            f"{recompiles} recompile(s) over {len(geometries)} distinct "
+            f"geometries + 1 revisit — one geometry flip must cost "
+            "exactly one recompile")
+
+    # ---- the journal itself: cheap stats + metric cross-check --------
+    stats = meshobs.journal_stats(ledger_dir)
+    report["ledger_journal"] = stats
+    if not stats["waves"]:
+        failures.append("the compile-ledger journal holds no wave "
+                        "records")
+    waste = profiling.mesh_waste_from_metrics(tm.REGISTRY.snapshot())
+    report["metrics_waste_fraction"] = waste
+    if waste is None:
+        failures.append("chain_mesh_wave_slots_total carries no series "
+                        "— the metrics side of the recorder is dark")
+
+    report["failures"] = failures
+    report["ok"] = not failures
+    line = json.dumps(report, sort_keys=True)
+    print(line)
+    if args.out:
+        atomic_write_text(args.out, line + "\n")
+    if failures:
+        for f in failures:
+            log.error("mesh-report sweep: %s", f)
+        return 1
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    parser = argparse.ArgumentParser(
+        prog="tools mesh-report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_sweep = sub.add_parser(
+        "sweep", help="mesh-occupancy scaling sweep on a virtual CPU mesh")
+    p_sweep.add_argument("--devices", type=int, default=8,
+                         help="virtual CPU device count for the mesh")
+    p_sweep.add_argument("--frames", type=int, default=8,
+                         help="frames per lane in the throughput sweep")
+    p_sweep.add_argument("--out", default=None,
+                         help="write the JSON report here too")
+    p_sweep.add_argument("--journal", default=None,
+                         help="journal root (default: fresh temp dir)")
+    args = parser.parse_args(argv)
+    return _cmd_sweep(args, argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

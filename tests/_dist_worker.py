@@ -22,8 +22,13 @@ def main() -> None:
 
     jax.config.update("jax_platforms", "cpu")
 
+    from processing_chain_tpu import telemetry as tm
     from processing_chain_tpu.parallel import distributed as dist
 
+    # telemetry on BEFORE initialize so the dist_init event and the
+    # collective-bytes counters below are captured (DCN visibility: the
+    # multi-process lane used to run telemetry-dark)
+    tm.enable()
     assert dist.initialize(coordinator, num, pid) is True
     assert jax.process_count() == num, jax.process_count()
     assert jax.device_count() == num  # 1 CPU device per process
@@ -47,6 +52,7 @@ def main() -> None:
         (num, 4, 8, 8),
     )
     total = float(jax.jit(jnp.sum)(garr))  # cross-process psum
+    dist.record_collective("psum", local.nbytes)
 
     # per-lane device compute stays local; fully_replicated gather crosses
     per_lane = jax.jit(
@@ -54,6 +60,7 @@ def main() -> None:
         out_shardings=NamedSharding(mesh, P(None)),
     )(garr)
     lanes = [float(v) for v in np.asarray(per_lane)]
+    dist.record_collective("all_gather", per_lane.nbytes)
 
     # the REAL production step over the cross-process mesh with the TIME
     # axis sharded across the two processes: the TI halo ppermute in
@@ -80,6 +87,9 @@ def main() -> None:
 
     step = make_sharded_step(tmesh, h * 2, w * 2, "lanczos")
     _, _, _, si, ti = step(g(fy), g(fu), g(fv))
+    # the TI halo: one upscaled luma frame per time-shard boundary rides
+    # the cross-process ppermute inside the step
+    dist.record_collective("ppermute_halo", (h * 2) * (w * 2))
     rep = NamedSharding(tmesh, P(None))
     si_host = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(si))[0]
     ti_host = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(ti))[0]
@@ -95,6 +105,7 @@ def main() -> None:
         and ti_host[t_loc] > 0.0
     )
 
+    events = tm.EVENTS.records()
     print(json.dumps({
         "pid": pid,
         "process_count": jax.process_count(),
@@ -104,6 +115,14 @@ def main() -> None:
         "lanes": lanes,
         "sharded_step_ok": step_ok,
         "si_all_lanes": [float(x) for x in si_host.reshape(-1)],
+        # DCN visibility (parallel/distributed.py telemetry): the parent
+        # test asserts the multi-process lane is no longer dark
+        "collective_bytes": tm.REGISTRY.sum_series(
+            "chain_dist_collective_bytes_total"),
+        "dist_init_events": sum(
+            1 for e in events if e.get("event") == "dist_init"),
+        "dist_collective_events": sum(
+            1 for e in events if e.get("event") == "dist_collective"),
     }))
 
 

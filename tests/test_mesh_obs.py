@@ -1,0 +1,308 @@
+"""The device-plane flight recorder (parallel/meshobs.py, ISSUE 18):
+wave-accounting invariants driven through the real run_bucket driver on
+the 8-device virtual mesh (valid + pads == dispatched, per wave and in
+aggregate), the one-geometry-flip-one-recompile compile-ledger
+regression, journal torn-tail crash safety (including a real SIGKILLed
+writer) with restart-without-double-counting, the mesh-top renderer,
+the fleet merge, DCN collective telemetry, and the fragmentation_bound
+attribution flip in telemetry/profiling.py.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu import telemetry as tm
+from processing_chain_tpu.parallel import distributed as dist
+from processing_chain_tpu.parallel import make_mesh, meshobs, p03_batch
+from processing_chain_tpu.telemetry import fleet, profiling
+
+
+@pytest.fixture(autouse=True)
+def clean_recorder():
+    tm.reset()
+    yield
+    meshobs.detach_journal()
+    tm.disable()
+    tm.reset()
+
+
+def _lanes(lengths, outs, sh=36, sw=64, seed=11):
+    """run_bucket lanes over random YUV420 of the given frame counts."""
+    rng = np.random.default_rng(seed)
+    lanes = []
+    for i, n in enumerate(lengths):
+        yuv = [
+            rng.integers(0, 255, size=(n, sh, sw), dtype=np.uint8),
+            rng.integers(0, 255, size=(n, sh // 2, sw // 2), dtype=np.uint8),
+            rng.integers(0, 255, size=(n, sh // 2, sw // 2), dtype=np.uint8),
+        ]
+        lanes.append(p03_batch.Lane(
+            chunks=iter([yuv]), emit=outs[i].append, n_frames_hint=n,
+            name=f"lane{i:02d}",
+        ))
+    return lanes
+
+
+# ------------------------------------------------- wave accounting
+
+
+def test_run_bucket_wave_accounting_invariant(devices8, tmp_path):
+    """The tentpole invariant, via the real driver: every journaled wave
+    splits its n_pvs * t_step device slots exactly into valid frames and
+    the three pad kinds — uneven lane lengths force tail pads, exhausted
+    rides AND a mesh pad in the second wave."""
+    mesh = make_mesh(devices8, time_parallel=2)
+    lengths = [11, 4, 2, 7, 5]  # 5 lanes on a 4-pvs mesh -> two waves
+    outs = {i: [] for i in range(len(lengths))}
+    bucket = p03_batch.bucket_label(72, 128, False, 36, 64)
+    meshobs.attach_journal(str(tmp_path), replica="t0")
+    p03_batch.run_bucket(
+        _lanes(lengths, outs), mesh, 72, 128, "bicubic", (2, 2), False,
+        chunk=4, bucket=bucket,
+    )
+    meshobs.detach_journal()
+
+    agg = meshobs.aggregate(str(tmp_path))
+    assert agg["invariant_violations"] == 0
+    tot = agg["totals"]
+    assert tot["waves"] > 0
+    assert tot["valid"] == sum(lengths)
+    padded = tot["pad_tail"] + tot["pad_exhausted"] + tot["pad_mesh"]
+    assert tot["valid"] + padded == tot["dispatched"]
+    assert tot["pad_mesh"] > 0  # wave 2 runs 1 lane on a 4-pvs mesh
+    assert tot["pad_exhausted"] > 0  # short lanes idle out mid-wave
+    assert 0.0 < tot["waste_fraction"] < 1.0
+    # per-record invariant, not just the rollup
+    for rec in meshobs.read_journals(str(tmp_path)):
+        if rec.get("kind") != "wave":
+            continue
+        split = sum(int(rec[k]) for k in meshobs.SLOT_KINDS)
+        assert split == rec["dispatched"] == rec["n_pvs"] * rec["t_step"]
+        assert rec["replica"] == "t0" and rec["seq"] > 0
+    # lane -> wave ordering evidence: longest lanes ride wave 0
+    sched = agg["schedule"][bucket]
+    waves = {e["wave"]: e["lanes"] for e in sched}
+    assert waves[0] == ["lane00", "lane03", "lane04", "lane01"]
+    assert waves[1] == ["lane02"]
+
+
+def test_one_geometry_flip_one_recompile(devices8, tmp_path):
+    """The compile-ledger regression: bucket A -> B -> A again must
+    ledger exactly one compile per geometry — the revisit reuses the
+    cached step, so a geometry flip costs one recompile, never two.
+    Geometries are unique to this test: the step cache is process-wide."""
+    mesh = make_mesh(devices8, time_parallel=2)
+    geoms = [(68, 120), (76, 136), (68, 120)]  # A, B, A-revisit
+    meshobs.attach_journal(str(tmp_path), replica="t0")
+    for dh, dw in geoms:
+        outs = {i: [] for i in range(2)}
+        p03_batch.run_bucket(
+            _lanes([3, 2], outs), mesh, dh, dw, "bicubic", (2, 2), False,
+            chunk=4, bucket=p03_batch.bucket_label(dh, dw, False, 36, 64),
+        )
+    meshobs.detach_journal()
+
+    agg = meshobs.aggregate(str(tmp_path))
+    assert agg["invariant_violations"] == 0
+    a = p03_batch.bucket_label(68, 120, False, 36, 64)
+    b = p03_batch.bucket_label(76, 136, False, 36, 64)
+    assert agg["buckets"][a]["recompiles"] == 1
+    assert agg["buckets"][b]["recompiles"] == 1
+    assert agg["totals"]["recompiles"] == 2  # 3 bucket runs, 2 compiles
+    # the ledger records the triggering geometry
+    compiles = sorted(
+        (r for r in meshobs.read_journals(str(tmp_path))
+         if r.get("kind") == "compile"),
+        key=lambda r: r["geometry"]["dst_h"])
+    assert [(r["geometry"]["dst_h"], r["geometry"]["dst_w"])
+            for r in compiles] == sorted(set(geoms))
+
+
+# ------------------------------------------------- journal crash safety
+
+
+def _record_wave(n=1, bucket="36x64->72x128@8bit", start=0):
+    for i in range(start, start + n):
+        meshobs.RECORDER.record_wave(
+            bucket, wave=i, block=0, lanes=["a", "b"], n_pvs=4,
+            t_step=8, valid=16, pad_tail=4, pad_exhausted=8, pad_mesh=4,
+            step_s=0.01,
+        )
+
+
+def test_torn_tail_is_skipped_and_restart_resumes(tmp_path):
+    """A torn final line (writer died mid-write) must cost at most that
+    one record: complete records stand, and a restarting writer seals
+    the tail so its first append does not glue onto the wreckage."""
+    meshobs.attach_journal(str(tmp_path), replica="t0")
+    _record_wave(2)
+    meshobs.detach_journal()
+    (journal,) = [n for n in os.listdir(tmp_path) if n.endswith(".jsonl")]
+    with open(tmp_path / journal, "a") as f:
+        f.write('{"kind": "wave", "bucket": "x", "valid": 3, "trunc')
+    records = meshobs.read_journal(str(tmp_path / journal))
+    assert len(records) == 2  # torn line skipped, both full records stand
+
+    # restart: same dir + replica; the seal must isolate the torn bytes
+    meshobs.attach_journal(str(tmp_path), replica="t0")
+    _record_wave(1, start=2)
+    meshobs.detach_journal()
+    agg = meshobs.aggregate(str(tmp_path))
+    assert agg["totals"]["waves"] == 3  # no double count, no lost seal
+    assert agg["invariant_violations"] == 0
+
+
+def test_sigkilled_writer_leaves_readable_journal(tmp_path):
+    """A real SIGKILL mid-append: every flushed record must survive, and
+    a surviving process appends past the wreckage without corruption."""
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid == 0:  # child: journal waves forever until killed
+        os.close(r)
+        try:
+            meshobs.attach_journal(str(tmp_path), replica="victim")
+            _record_wave(5)
+            os.write(w, b"x")  # >= 5 records flushed: parent may fire
+            i = 5
+            while True:
+                _record_wave(1, start=i)
+                i += 1
+        finally:
+            os._exit(0)
+    os.close(w)
+    assert os.read(r, 1) == b"x"
+    os.close(r)
+    time.sleep(0.2)
+    os.kill(pid, signal.SIGKILL)
+    os.waitpid(pid, 0)
+
+    agg = meshobs.aggregate(str(tmp_path))
+    assert agg["totals"]["waves"] >= 5  # everything flushed survived
+    assert agg["invariant_violations"] == 0
+    # survivor resumes into the same dir under its own replica file
+    meshobs.attach_journal(str(tmp_path), replica="survivor")
+    _record_wave(1)
+    meshobs.detach_journal()
+    after = meshobs.aggregate(str(tmp_path))
+    assert after["totals"]["waves"] == agg["totals"]["waves"] + 1
+
+
+# ------------------------------------------------- operator surfaces
+
+
+def test_mesh_top_renders_journal(tmp_path, capsys):
+    from processing_chain_tpu.tools import mesh_top
+
+    bucket = "36x64->72x128@8bit"
+    meshobs.attach_journal(str(tmp_path), replica="t0")
+    _record_wave(2, bucket=bucket)
+    meshobs.RECORDER.record_compile(
+        bucket, step="wave_step", geometry={"dst_h": 72}, seconds=0.5)
+    meshobs.detach_journal()
+
+    out = mesh_top.render(mesh_top.load_mesh(str(tmp_path)))
+    assert bucket in out
+    assert "waste" in out and "compiles" in out
+    assert "schedule" in out  # block-0 lane names journaled
+    assert mesh_top.main([str(tmp_path), "--once"]) == 0
+    assert bucket in capsys.readouterr().out
+    # an empty dir is a source error, not a blank frame
+    with pytest.raises(Exception):
+        mesh_top.load_mesh(str(tmp_path / "nothing"))
+
+
+def test_fleet_mesh_report_merges_replicas():
+    """/fleet "mesh" section: chain_mesh_* counters from two replicas
+    merge by SUM (each replica's waves and compiles are distinct
+    events), with the waste fraction derived fleet-wide."""
+    def prom(waves, valid, padded, recompiles):
+        return "\n".join([
+            f'chain_mesh_waves_total{{bucket="a"}} {waves}',
+            f'chain_mesh_wave_slots_total{{bucket="a",kind="valid"}} '
+            f'{valid}',
+            f'chain_mesh_wave_slots_total{{bucket="a",kind="pad_tail"}} '
+            f'{padded}',
+            f'chain_mesh_recompiles_total{{bucket="a"}} {recompiles}',
+            f'chain_mesh_compile_seconds_total{{bucket="a"}} 0.25',
+        ]) + "\n"
+
+    parsed = [fleet.parse_counters(prom(3, 30, 10, 1), fleet.MESH_METRICS),
+              fleet.parse_counters(prom(5, 50, 10, 1), fleet.MESH_METRICS)]
+    view = fleet.mesh_report(parsed)
+    assert view["waves"] == 8 and view["recompiles"] == 2
+    a = view["buckets"]["a"]
+    assert a["valid"] == 80 and a["padded"] == 20
+    assert a["waste_fraction"] == pytest.approx(0.2)
+    assert a["compile_s"] == pytest.approx(0.5)
+    assert fleet.mesh_report([]) == {"buckets": {}, "waves": 0,
+                                     "recompiles": 0}
+
+
+def test_status_provider_reports_mesh_section(tmp_path):
+    from processing_chain_tpu.telemetry import live
+
+    meshobs.attach_journal(str(tmp_path), replica="t0")
+    _record_wave(1, bucket="status-bucket")
+    meshobs.detach_journal()
+    section = live.STATUS_PROVIDERS["mesh"](None)
+    assert section and "status-bucket" in section["buckets"]
+    entry = section["buckets"]["status-bucket"]
+    assert entry["valid"] + entry["pad_tail"] + entry["pad_exhausted"] \
+        + entry["pad_mesh"] == entry["dispatched"]
+
+
+# ------------------------------------------------- DCN + attribution
+
+
+def test_record_collective_counter_and_event():
+    tm.enable()
+    dist.record_collective("psum", 1234, seconds=0.01)
+    dist.record_collective("all_gather", 766)
+    assert tm.REGISTRY.sum_series(
+        "chain_dist_collective_bytes_total") == 2000
+    events = [e for e in tm.EVENTS.records()
+              if e.get("event") == "dist_collective"]
+    assert len(events) == 2
+    assert events[0]["op"] == "psum" and events[0]["bytes"] == 1234
+
+
+def test_fragmentation_waste_flips_balanced_verdict():
+    """A flat profile over a mostly-padded mesh is fragmentation_bound,
+    not balanced: the waste IS the bottleneck (FRAGMENTATION_WASTE_
+    THRESHOLD in telemetry/profiling.py)."""
+    def metrics(valid, padded):
+        return {"chain_mesh_wave_slots_total": {"series": [
+            {"labels": {"bucket": "b", "kind": "valid"}, "value": valid},
+            {"labels": {"bucket": "b", "kind": "pad_tail"},
+             "value": padded},
+        ]}}
+
+    events = [{"event": "stage_end", "stage": "p03", "duration_s": 2.0,
+               "components": {"device": 1.0, "device_transfer": 0.9}}]
+    hot = profiling.attribute_run(metrics(40, 60), events)
+    assert hot["p03"]["verdict"] == "fragmentation_bound"
+    assert hot["p03"]["mesh_waste_fraction"] == pytest.approx(0.6)
+    cool = profiling.attribute_run(metrics(95, 5), events)
+    assert cool["p03"]["verdict"] == "balanced"
+    assert cool["p03"]["mesh_waste_fraction"] == pytest.approx(0.05)
+    # no wave series -> absence of evidence, nothing stamped
+    none = profiling.attribute_run({}, events)
+    assert "mesh_waste_fraction" not in none["p03"]
+
+
+def test_waste_from_metrics_snapshot_roundtrip(tmp_path):
+    """The metrics-snapshot path (report.py fallback when a run has no
+    journal): live chain_mesh_* series reproduce the journal's waste."""
+    tm.enable()
+    meshobs.attach_journal(str(tmp_path), replica="t0")
+    _record_wave(3)
+    meshobs.detach_journal()
+    snap = tm.REGISTRY.snapshot()
+    waste = profiling.mesh_waste_from_metrics(snap)
+    agg = meshobs.aggregate(str(tmp_path))
+    assert waste == pytest.approx(agg["totals"]["waste_fraction"])

@@ -358,6 +358,12 @@ def test_multiprocess_distributed_end_to_end(n_procs):
         # the production sharded step ran over the cross-process mesh and
         # each process's lane matches its local single-device reference
         assert rec["sharded_step_ok"] is True
+        # DCN telemetry (satellite of the mesh flight recorder): each
+        # worker recorded its init + the three explicit collectives, and
+        # the byte counter carries real payload sizes
+        assert rec["dist_init_events"] == 1
+        assert rec["dist_collective_events"] == 3
+        assert rec["collective_bytes"] > 0
     # both processes observed the SAME global per-lane features
     assert outs[0]["si_all_lanes"] == pytest.approx(
         outs[1]["si_all_lanes"], rel=1e-6
