@@ -21,7 +21,9 @@ import tempfile
 
 import numpy as np
 
-from . import medialib
+from .. import telemetry as tm
+from ..utils import lockdebug
+from . import medialib, sharedscan
 
 
 def _start_code_positions(data: np.ndarray) -> np.ndarray:
@@ -144,25 +146,63 @@ def ffprobe_av1_frame_info(filename: str, timeout: float = 300.0) -> dict:
 def get_framesize_av1(filename: str, force: bool = False) -> list[int]:
     """AV1: packet sizes from the native demuxer (reference :266-274 falls
     back to ffprobe pkt_size — kept here as the degrade path when the
-    native boundary cannot load, via `ffprobe_av1_frame_info`). `force` is
-    unused (the demuxer scan is always exact); the default matches the
-    three sibling parsers so a keyword caller sees uniform behavior."""
+    native boundary cannot load, via `ffprobe_av1_frame_info`). Served
+    from the shared post-encode scan (io/sharedscan.py) so a p01-primed
+    file costs no extra demux pass. `force` is unused (the demuxer scan
+    is always exact); the default matches the three sibling parsers so a
+    keyword caller sees uniform behavior."""
     try:
-        return [int(s) for s in medialib.scan_packets(filename, "video")["size"]]
+        return [int(s) for s in sharedscan.video(filename)["size"]]
     except medialib.MediaError:
         return ffprobe_av1_frame_info(filename)["size"]
 
 
+#: bounded result memo with the DigestCache stat-signature trust model
+#: (store/keys.py): repeat get_framesizes calls on an unchanged file —
+#: p02 rebuilds, priors difficulty, serve cost features — stop re-reading
+#: and re-parsing the whole bitstream. `force=True` bypasses AND refreshes.
+_CACHE_MAX = 256
+_cache_lock = lockdebug.make_lock("framesizes_cache")
+_cache: dict[str, list] = {}  # guarded-by: _cache_lock (insertion = LRU)
+
+_CACHE_HITS = tm.counter(
+    "chain_io_framesizes_cache_hits_total",
+    "get_framesizes served from the stat-keyed memo — a full bitstream "
+    "re-parse a consumer did NOT pay",
+)
+
+
 def get_framesizes(filename: str, codec: str, force: bool = False) -> list[int]:
+    try:
+        st = os.stat(filename)
+        key = f"{os.path.abspath(filename)}|{st.st_size}|{st.st_mtime_ns}|{codec}"
+    except OSError:
+        key = None  # let the parser raise its own error
+    if key is not None and not force:
+        with _cache_lock:
+            hit = _cache.get(key)
+            if hit is not None:
+                _cache.pop(key)
+                _cache[key] = hit
+        if hit is not None:
+            _CACHE_HITS.inc()
+            return list(hit)
     if codec == "h264":
-        return get_framesize_h264(filename, force)
-    if codec in ("h265", "hevc"):
-        return get_framesize_h265(filename, force)
-    if codec == "vp9":
-        return get_framesize_vp9(filename, force)
-    if codec == "av1":
-        return get_framesize_av1(filename, force)
-    raise ValueError(f"no exact frame-size parser for codec {codec!r}")
+        sizes = get_framesize_h264(filename, force)
+    elif codec in ("h265", "hevc"):
+        sizes = get_framesize_h265(filename, force)
+    elif codec == "vp9":
+        sizes = get_framesize_vp9(filename, force)
+    elif codec == "av1":
+        sizes = get_framesize_av1(filename, force)
+    else:
+        raise ValueError(f"no exact frame-size parser for codec {codec!r}")
+    if key is not None:
+        with _cache_lock:
+            _cache[key] = sizes
+            while len(_cache) > _CACHE_MAX:
+                _cache.pop(next(iter(_cache)))
+    return list(sizes)
 
 
 def merge_superframes(vfi, sizes_col="size", dts_col="dts"):

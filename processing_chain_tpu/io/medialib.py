@@ -12,6 +12,7 @@ import subprocess
 from typing import Optional
 
 import numpy as np
+from .. import telemetry as tm
 from ..utils import lockdebug
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
@@ -25,6 +26,18 @@ _SO_PATH = os.environ.get(
 
 _lock = lockdebug.make_lock("medialib")
 _lib: Optional[ct.CDLL] = None  # guarded-by: _lock
+
+#: every bitstream walk over a written file that is NOT a decoder open —
+#: the decode-once invariant's second axis: chain_io_decoder_opens_total
+#: counts pixel decodes, this counts demux/parse passes. A cold run's
+#: packets/packets_all total should equal one pass per written file; more
+#: means a consumer bypassed the shared scan (io/sharedscan.py).
+_SCAN_PASSES = tm.counter(
+    "chain_io_scan_passes_total",
+    "bitstream demux/parse passes over a file "
+    "(op=packets|packets_all|annexb|ivf|priors)",
+    ("op",),
+)
 
 # swscale flag constants (libswscale/swscale.h)
 SWS_FAST_BILINEAR = 1
@@ -249,6 +262,23 @@ def ensure_loaded() -> ct.CDLL:
             ct.POINTER(ct.c_double), ct.POINTER(ct.c_int8), ct.c_long,
             ct.c_char_p, ct.c_int,
         ]
+        try:
+            # single-demux dual-stream scan: absent from prebuilt .so
+            # files older than the shared-scan boundary (toolchain-less
+            # hosts); scan_packets_all falls back to two passes then
+            lib.mp_scan_packets_all.restype = ct.c_int
+            lib.mp_scan_packets_all.argtypes = [
+                ct.c_char_p,
+                ct.POINTER(ct.c_int64), ct.POINTER(ct.c_double),
+                ct.POINTER(ct.c_double), ct.POINTER(ct.c_double),
+                ct.POINTER(ct.c_int8), ct.c_long, ct.POINTER(ct.c_long),
+                ct.POINTER(ct.c_int64), ct.POINTER(ct.c_double),
+                ct.POINTER(ct.c_double), ct.POINTER(ct.c_double),
+                ct.POINTER(ct.c_int8), ct.c_long, ct.POINTER(ct.c_long),
+                ct.c_char_p, ct.c_int,
+            ]
+        except AttributeError:
+            pass
         lib.mp_decoder_open.restype = ct.c_void_p
         lib.mp_decoder_open.argtypes = [
             ct.c_char_p, ct.c_double, ct.c_double, ct.c_char_p, ct.c_int,
@@ -468,6 +498,7 @@ def scan_packets(path: str, codec_type: str = "video") -> dict:
     """Per-packet size/pts/dts/duration/keyflag arrays (the ffprobe
     -show_packets replacement; reference lib/ffmpeg.py:636-769)."""
     lib = ensure_loaded()
+    _SCAN_PASSES.labels(op="packets").inc()
     ctype = 0 if codec_type == "video" else 1
     cap = 1 << 16
     while True:
@@ -497,6 +528,66 @@ def scan_packets(path: str, codec_type: str = "video") -> dict:
                 "key": key[:n].copy(),
             }
         cap = int(n) + 1024
+
+
+def scan_packets_all(path: str) -> dict:
+    """Both streams' packet arrays from ONE demux pass: {"video": <same
+    dict shape as scan_packets>, "audio": <same, or None when the
+    container has no audio stream>}. The shared post-encode scan's
+    native leg (io/sharedscan.py); falls back to two scan_packets
+    passes when the loaded .so predates the symbol."""
+    lib = ensure_loaded()
+    if not hasattr(lib, "mp_scan_packets_all"):
+        out = {"video": scan_packets(path, "video")}
+        try:
+            out["audio"] = scan_packets(path, "audio")
+        except MediaError:
+            out["audio"] = None
+        return out
+    _SCAN_PASSES.labels(op="packets_all").inc()
+    v_cap = a_cap = 1 << 16
+    while True:
+        v = {k: np.zeros(v_cap, dt) for k, dt in _PACKET_FIELDS}
+        a = {k: np.zeros(a_cap, dt) for k, dt in _PACKET_FIELDS}
+        nv = ct.c_long(0)
+        na = ct.c_long(0)
+        err = _err_buf()
+        ret = lib.mp_scan_packets_all(
+            path.encode(),
+            v["size"].ctypes.data_as(ct.POINTER(ct.c_int64)),
+            v["pts_time"].ctypes.data_as(ct.POINTER(ct.c_double)),
+            v["dts_time"].ctypes.data_as(ct.POINTER(ct.c_double)),
+            v["duration_time"].ctypes.data_as(ct.POINTER(ct.c_double)),
+            v["key"].ctypes.data_as(ct.POINTER(ct.c_int8)),
+            v_cap, ct.byref(nv),
+            a["size"].ctypes.data_as(ct.POINTER(ct.c_int64)),
+            a["pts_time"].ctypes.data_as(ct.POINTER(ct.c_double)),
+            a["dts_time"].ctypes.data_as(ct.POINTER(ct.c_double)),
+            a["duration_time"].ctypes.data_as(ct.POINTER(ct.c_double)),
+            a["key"].ctypes.data_as(ct.POINTER(ct.c_int8)),
+            a_cap, ct.byref(na),
+            err, 512,
+        )
+        if ret < 0:
+            raise MediaError(f"scan_packets_all({path}): {err.value.decode()}")
+        if nv.value <= v_cap and na.value <= a_cap:
+            return {
+                "video": {k: arr[: nv.value].copy() for k, arr in v.items()},
+                "audio": None if na.value < 0 else {
+                    k: arr[: na.value].copy() for k, arr in a.items()
+                },
+            }
+        v_cap = max(v_cap, int(nv.value) + 1024)
+        a_cap = max(a_cap, int(na.value) + 1024)
+
+
+_PACKET_FIELDS = (
+    ("size", np.int64),
+    ("pts_time", np.float64),
+    ("dts_time", np.float64),
+    ("duration_time", np.float64),
+    ("key", np.int8),
+)
 
 
 def sws_scale_plane(
@@ -591,6 +682,7 @@ def concat_video(paths: list, out_path: str) -> None:
 
 def extract_annexb(path: str, bsf_name: str, out_path: str) -> None:
     lib = ensure_loaded()
+    _SCAN_PASSES.labels(op="annexb").inc()
     err = _err_buf()
     if lib.mp_extract_annexb(path.encode(), bsf_name.encode(), out_path.encode(), err, 512) < 0:
         raise MediaError(f"extract_annexb({path}): {err.value.decode()}")
@@ -598,6 +690,7 @@ def extract_annexb(path: str, bsf_name: str, out_path: str) -> None:
 
 def extract_ivf(path: str, out_path: str) -> None:
     lib = ensure_loaded()
+    _SCAN_PASSES.labels(op="ivf").inc()
     err = _err_buf()
     if lib.mp_extract_ivf(path.encode(), out_path.encode(), err, 512) < 0:
         raise MediaError(f"extract_ivf({path}): {err.value.decode()}")
@@ -614,6 +707,7 @@ def priors_open(path: str, threads: int = 0) -> int:
     docs/PRIORS.md). Returns an opaque handle for priors_next_batch /
     priors_close."""
     lib = ensure_loaded()
+    _SCAN_PASSES.labels(op="priors").inc()
     err = _err_buf()
     handle = lib.mp_decoder_open_priors(path.encode(), threads, err, 512)
     if not handle:

@@ -16,7 +16,7 @@ import numpy as np
 import pandas as pd
 import yaml
 
-from . import medialib
+from . import medialib, sharedscan
 
 
 def _select(info: dict, codec_type: str) -> Optional[dict]:
@@ -60,15 +60,12 @@ class LibavProber:
         data = dict(v)
         data["video_duration"] = v["duration"]
         if sidecar_path:
+            scan = sharedscan.get_scan(file_path)
             sizes = {
-                "v": int(np.sum(medialib.scan_packets(file_path, "video")["size"])),
+                "v": int(np.sum(scan["video"]["size"])),
+                "a": int(np.sum(scan["audio"]["size"]))
+                if scan["audio"] is not None else 0,
             }
-            try:
-                sizes["a"] = int(
-                    np.sum(medialib.scan_packets(file_path, "audio")["size"])
-                )
-            except medialib.MediaError:
-                sizes["a"] = 0
             from ..utils.fsio import atomic_write_text
 
             atomic_write_text(sidecar_path, yaml.safe_dump(
@@ -100,7 +97,7 @@ def get_segment_info(
     video_duration = float(v["duration"]) if v["duration"] else 0.0
     if not video_duration:
         # derive from packet timing (reference :487-498)
-        video_pk = medialib.scan_packets(file_path, "video")
+        video_pk = sharedscan.video(file_path)
         dts = video_pk["dts_time"]
         dur = video_pk["duration_time"]
         valid = ~np.isnan(dts)
@@ -115,7 +112,7 @@ def get_segment_info(
         video_bitrate = round(float(v["bit_rate"]) / 1024.0, 2)
     else:
         if video_pk is None:
-            video_pk = medialib.scan_packets(file_path, "video")
+            video_pk = sharedscan.video(file_path)
         stream_size = int(np.sum(video_pk["size"]))
         video_bitrate = round((stream_size * 8 / 1024.0) / video_duration, 2)
 
@@ -138,7 +135,7 @@ def get_segment_info(
         if a["bit_rate"]:
             audio_bitrate = round(float(a["bit_rate"]) / 1024.0, 2)
         else:
-            stream_size = int(np.sum(medialib.scan_packets(file_path, "audio")["size"]))
+            stream_size = int(np.sum(sharedscan.audio(file_path)["size"]))
             audio_bitrate = (
                 round((stream_size * 8 / 1024.0) / audio_duration, 2)
                 if audio_duration
@@ -176,8 +173,10 @@ def _fix_durations(dts: np.ndarray, duration: np.ndarray) -> np.ndarray:
 
 def get_video_frame_info(file_path: str, segment_name: Optional[str] = None) -> pd.DataFrame:
     """Per-packet frame table in decoding order (reference :636-715):
-    columns segment/index/frame_type/dts/size/duration."""
-    pk = medialib.scan_packets(file_path, "video")
+    columns segment/index/frame_type/dts/size/duration. Routed through
+    the shared post-encode scan: when p01 primed the file this costs no
+    bitstream pass (io/sharedscan.py)."""
+    pk = sharedscan.video(file_path)
     n = len(pk["size"])
     duration = _fix_durations(pk["dts_time"], pk["duration_time"])
     return pd.DataFrame(
@@ -193,8 +192,9 @@ def get_video_frame_info(file_path: str, segment_name: Optional[str] = None) -> 
 
 
 def get_audio_frame_info(file_path: str, segment_name: Optional[str] = None) -> pd.DataFrame:
-    """Audio packet table (reference :744-769): segment/index/dts/size/duration."""
-    pk = medialib.scan_packets(file_path, "audio")
+    """Audio packet table (reference :744-769): segment/index/dts/size/
+    duration. Shared-scan routed like get_video_frame_info."""
+    pk = sharedscan.audio(file_path)
     n = len(pk["size"])
     return pd.DataFrame(
         {

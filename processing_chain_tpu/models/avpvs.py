@@ -698,13 +698,17 @@ def create_avpvs_wo_buffer_batch(
     Skip-existing/--force filtering happens in the stage (per-PVS), so
     every pvs passed here is due for (re)generation.
 
-    `fanouts` maps SHORT pvses to their fused-p04 fan-outs
-    (models/fused.FusedFanout, PC_FUSE_P04): each short lane's emit also
-    feeds the fan-out, the wave driver's Lane.on_done flushes it the
-    moment the lane exhausts, and its member artifacts commit right
-    after the lane's wave drains. Long tests keep the legacy staged
-    passes here — their per-segment lanes cross waves out of stream
-    order, which a streaming fan-out cannot consume."""
+    `fanouts` maps pvses to their fused-p04 fan-outs
+    (models/fused.FusedFanout, PC_FUSE_P04). Short: each lane's emit
+    also feeds the fan-out, the wave driver's Lane.on_done flushes it
+    the moment the lane exhausts, and its member artifacts commit right
+    after the lane's wave drains. Long: the wave schedule pins each
+    PVS's per-segment lanes to sequential waves in segment order
+    (parallel/p03_batch.plan_waves), so the fan-out consumes the same
+    continuous stream the single-device path feeds it — SRC audio is
+    decoded ONCE at fan-out start and reused by the assembly remux, and
+    a SegmentOrderedTap (models/fused) enforces the ordering contract
+    instead of buffering frames."""
     if not pvses:
         return None
     from contextlib import ExitStack
@@ -721,8 +725,13 @@ def create_avpvs_wo_buffer_batch(
         except BaseException:
             # sweep EVERY long-test tmp render, not just the failing
             # wave/PVS's: completed waves' full-resolution FFV1 tmps
-            # (potentially many GB) must not outlive a failed batch
+            # (potentially many GB) must not outlive a failed batch.
+            # abort() on an already-closed fan-out is a no-op, so this
+            # catches fan-outs the inner sweeps never reached.
             for spec in specs:
+                fan = spec.get("fanout")
+                if fan is not None:
+                    fan.abort()
                 if spec["kind"] == "long_seg" and os.path.isfile(spec["out"]):
                     os.unlink(spec["out"])
             for pvs_specs in assembly.values():
@@ -788,7 +797,6 @@ def create_avpvs_wo_buffer_batch(
         buckets: dict = {}
         for spec in specs:
             buckets.setdefault(spec["key"], []).append(spec)
-
         for (sh, sw, dh, dw, pix_fmt), entries in buckets.items():
             log.info(
                 "p03 batch: %d lane(s) %dx%d->%dx%d %s over mesh %s",
@@ -796,126 +804,174 @@ def create_avpvs_wo_buffer_batch(
             )
             # longest-first so each wave groups similar lengths
             entries.sort(key=lambda e: -e["seg"].duration)
-            for w0 in range(0, len(entries), n_pvs):
-                wave = entries[w0: w0 + n_pvs]
-                try:
-                    with ExitStack() as stack:
-                        lanes = []
-                        for spec in wave:
-                            pvs, out_path = spec["pvs"], spec["out"]
-                            w, h = spec["w"], spec["h"]
-                            tap = None
-                            fan = None
-                            if spec["kind"] == "short":
-                                audio, srate = _short_segment_audio(spec["seg"])
-                                reader = stack.enter_context(
-                                    VideoReader(spec["seg"].file_path)
-                                )
-                                rate, chunks = _short_rate_chunks(
-                                    pvs, reader, avpvs_src_fps, force_60_fps
-                                )
-                                fan = (fanouts or {}).get(pvs)
-                                if fan is not None:
-                                    # the fused p04 fan-out rides this
-                                    # lane's emits (PC_FUSE_P04);
-                                    # registered before start() so the
-                                    # wave's failure sweep aborts a
-                                    # fan-out that died mid-open
-                                    spec["fanout"] = fan
-                                    tap = fan.start(
-                                        rate, audio, srate, w, h, pix_fmt
-                                    )
-                                writer = stack.enter_context(
-                                    pf.AsyncWriter(_ffv1_writer(
-                                        out_path, w, h, pix_fmt, rate,
-                                        with_audio=audio is not None,
-                                        sample_rate=srate, audio_codec="flac",
-                                    ))
-                                )
-                                if audio is not None:
-                                    writer.write_audio(audio)
-                            else:
-                                rate = spec["rate"]
-                                chunks = _segment_canvas_chunks(
-                                    spec["seg"], rate
-                                )
-                                writer = stack.enter_context(
-                                    pf.AsyncWriter(_ffv1_writer(
-                                        out_path, w, h, pix_fmt, rate,
-                                        with_audio=False,
-                                    ))
-                                )
-                            sink = _BoundarySink(writer)
-                            feat = SiTiAccumulator()
-                            spec["feat"] = feat
-                            spec["sink"] = sink
-                            if tap is None:
-                                emit = sink.emit
-                            else:
-                                def emit(planes, _sink=sink, _tap=tap):
-                                    _sink.emit(planes)
-                                    _tap(planes)
-                            lanes.append(p03_batch.Lane(
-                                chunks=chunks,
-                                emit=emit,
-                                n_frames_hint=int(
-                                    round(spec["seg"].duration * rate)
-                                ),
-                                emit_features=feat.extend,
-                                on_done=(
-                                    fan.finish_streams
-                                    if fan is not None else None
-                                ),
-                                # wave-journal identity (meshobs): the
-                                # PVS, plus the segment index for long
-                                # tests split into per-segment lanes
-                                name=(
-                                    pvs.pvs_id if spec["kind"] == "short"
-                                    else f"{pvs.pvs_id}.seg{spec['idx']:04d}"
-                                ),
-                            ))
-                        p03_batch.run_bucket(
-                            lanes, mesh, dh, dw, "bicubic",
-                            fr.chroma_subsampling(pix_fmt),
-                            ten_bit="10" in pix_fmt,
-                            chunk=chunk_frames(),
-                            bucket=p03_batch.bucket_label(
-                                dh, dw, "10" in pix_fmt, sh, sw),
-                        )
-                except BaseException:
-                    # the writers were opened (files created/truncated): a
-                    # partial artifact must never survive to satisfy a
-                    # later run's skip-existing check
+
+        def group_of(spec):
+            # fan-out-attached long tests are ordered groups: their
+            # per-segment lanes must reach the fan-out in stream order.
+            # Everything else schedules freely (tmp renders are
+            # order-independent — assembly happens after the waves).
+            if spec["kind"] != "long_seg" or (fanouts or {}).get(spec["pvs"]) is None:
+                return None
+            return (spec["pvs"].pvs_id, spec["idx"])
+
+        # per-PVS fused state for long tests: the SegmentOrderedTap and
+        # the ONE SRC audio decode shared with the assembly remux below
+        fan_state: dict = {}
+        for (sh, sw, dh, dw, pix_fmt), wave in p03_batch.plan_waves(
+            buckets, n_pvs, group_of=group_of
+        ):
+            try:
+                with ExitStack() as stack:
+                    lanes = []
                     for spec in wave:
-                        fan = spec.get("fanout")
-                        if fan is not None:
-                            fan.abort()
-                        for p in (spec["out"], spec["final"]):
-                            if os.path.isfile(p):
-                                os.unlink(p)
-                        clear_inprogress(spec["final"])
-                        SiTiAccumulator.discard(spec["final"])
-                    raise
-                # short lanes are final the moment their wave drains
-                for spec in wave:
-                    if spec["kind"] == "short":
-                        spec["feat"].write(spec["out"])
-                        Job(
-                            label=f"avpvs {spec['pvs'].pvs_id}",
-                            output_path=spec["out"],
-                            fn=lambda: None,
-                            logfile_path=spec["pvs"].get_logfile_path(),
-                            provenance=_wo_buffer_provenance(
-                                spec["pvs"], spec["w"], spec["h"],
-                                spec["pix_fmt"],
+                        pvs, out_path = spec["pvs"], spec["out"]
+                        w, h = spec["w"], spec["h"]
+                        tap = None
+                        on_done = None
+                        if spec["kind"] == "short":
+                            audio, srate = _short_segment_audio(spec["seg"])
+                            reader = stack.enter_context(
+                                VideoReader(spec["seg"].file_path)
+                            )
+                            rate, chunks = _short_rate_chunks(
+                                pvs, reader, avpvs_src_fps, force_60_fps
+                            )
+                            fan = (fanouts or {}).get(pvs)
+                            if fan is not None:
+                                # the fused p04 fan-out rides this
+                                # lane's emits (PC_FUSE_P04);
+                                # registered before start() so the
+                                # wave's failure sweep aborts a
+                                # fan-out that died mid-open
+                                spec["fanout"] = fan
+                                tap = fan.start(
+                                    rate, audio, srate, w, h, pix_fmt
+                                )
+                                on_done = fan.finish_streams
+                            writer = stack.enter_context(
+                                pf.AsyncWriter(_ffv1_writer(
+                                    out_path, w, h, pix_fmt, rate,
+                                    with_audio=audio is not None,
+                                    sample_rate=srate, audio_codec="flac",
+                                ))
+                            )
+                            if audio is not None:
+                                writer.write_audio(audio)
+                        else:
+                            rate = spec["rate"]
+                            chunks = _segment_canvas_chunks(
+                                spec["seg"], rate
+                            )
+                            fan = (fanouts or {}).get(pvs)
+                            if fan is not None:
+                                spec["fanout"] = fan
+                                st = fan_state.get(pvs)
+                                if st is None:
+                                    # first lane of this PVS — segment 0
+                                    # by the plan_waves contract: decode
+                                    # SRC audio ONCE, start the fan-out,
+                                    # and order every later lane through
+                                    # the tap
+                                    total = float(sum(
+                                        s.get_segment_duration()
+                                        for s in pvs.segments
+                                    ))
+                                    samples, srate = _decode_stereo(
+                                        pvs.src.file_path, 0.0, total
+                                    )
+                                    from . import fused as fused_model
+
+                                    st = dict(
+                                        tap=fused_model.SegmentOrderedTap(
+                                            fan,
+                                            fan.start(rate, samples, srate,
+                                                      w, h, pix_fmt),
+                                            len(pvs.segments),
+                                        ),
+                                        fan=fan, audio=samples, srate=srate,
+                                    )
+                                    fan_state[pvs] = st
+                                tap = st["tap"].lane(spec["idx"])
+                                on_done = st["tap"].lane_done(spec["idx"])
+                            writer = stack.enter_context(
+                                pf.AsyncWriter(_ffv1_writer(
+                                    out_path, w, h, pix_fmt, rate,
+                                    with_audio=False,
+                                ))
+                            )
+                        sink = _BoundarySink(writer)
+                        feat = SiTiAccumulator()
+                        spec["feat"] = feat
+                        spec["sink"] = sink
+                        if tap is None:
+                            emit = sink.emit
+                        else:
+                            def emit(planes, _sink=sink, _tap=tap):
+                                _sink.emit(planes)
+                                _tap(planes)
+                        lanes.append(p03_batch.Lane(
+                            chunks=chunks,
+                            emit=emit,
+                            n_frames_hint=int(
+                                round(spec["seg"].duration * rate)
                             ),
-                        ).complete_externally()
-                        fan = spec.get("fanout")
-                        if fan is not None:
-                            # fan-out members (stalled AVPVS, CPVS,
-                            # preview) commit under their own plan
-                            # hashes now that the lane's wave drained
-                            fan.close()
+                            emit_features=feat.extend,
+                            on_done=on_done,
+                            # wave-journal identity (meshobs): the
+                            # PVS, plus the segment index for long
+                            # tests split into per-segment lanes
+                            name=(
+                                pvs.pvs_id if spec["kind"] == "short"
+                                else f"{pvs.pvs_id}.seg{spec['idx']:04d}"
+                            ),
+                        ))
+                    p03_batch.run_bucket(
+                        lanes, mesh, dh, dw, "bicubic",
+                        fr.chroma_subsampling(pix_fmt),
+                        ten_bit="10" in pix_fmt,
+                        chunk=chunk_frames(),
+                        bucket=p03_batch.bucket_label(
+                            dh, dw, "10" in pix_fmt, sh, sw),
+                    )
+            except BaseException:
+                # the writers were opened (files created/truncated): a
+                # partial artifact must never survive to satisfy a
+                # later run's skip-existing check. Abort EVERY started
+                # fan-out, not only this wave's — a long fan-out spans
+                # waves and its members are partial too.
+                for spec in wave:
+                    fan = spec.get("fanout")
+                    if fan is not None:
+                        fan.abort()
+                for st in fan_state.values():
+                    st["fan"].abort()
+                for spec in wave:
+                    for p in (spec["out"], spec["final"]):
+                        if os.path.isfile(p):
+                            os.unlink(p)
+                    clear_inprogress(spec["final"])
+                    SiTiAccumulator.discard(spec["final"])
+                raise
+            # short lanes are final the moment their wave drains
+            for spec in wave:
+                if spec["kind"] == "short":
+                    spec["feat"].write(spec["out"])
+                    Job(
+                        label=f"avpvs {spec['pvs'].pvs_id}",
+                        output_path=spec["out"],
+                        fn=lambda: None,
+                        logfile_path=spec["pvs"].get_logfile_path(),
+                        provenance=_wo_buffer_provenance(
+                            spec["pvs"], spec["w"], spec["h"],
+                            spec["pix_fmt"],
+                        ),
+                    ).complete_externally()
+                    fan = spec.get("fanout")
+                    if fan is not None:
+                        # fan-out members (stalled AVPVS, CPVS,
+                        # preview) commit under their own plan
+                        # hashes now that the lane's wave drained
+                        fan.close()
 
         # long-test assembly: native stream-copy concat of the tmp
         # renders + SRC audio remux + stitched feature sidecar
@@ -923,12 +979,20 @@ def create_avpvs_wo_buffer_batch(
             out_path = pvs_specs[0]["final"]
             cat_tmp = out_path + ".cat.tmp.avi"
             wav_tmp = out_path + ".audio.tmp.wav"
+            st = fan_state.get(pvs)
             try:
                 medialib.concat_video([s["out"] for s in pvs_specs], cat_tmp)
-                total = float(
-                    sum(s.get_segment_duration() for s in pvs.segments)
-                )
-                samples, srate = _decode_stereo(pvs.src.file_path, 0.0, total)
+                if st is not None:
+                    # the fan-out's start already decoded the full SRC
+                    # stereo span — the remux reuses it (decode-once)
+                    samples, srate = st["audio"], st["srate"]
+                else:
+                    total = float(
+                        sum(s.get_segment_duration() for s in pvs.segments)
+                    )
+                    samples, srate = _decode_stereo(
+                        pvs.src.file_path, 0.0, total
+                    )
                 _write_wav(wav_tmp, samples, srate)
                 medialib.remux(cat_tmp, out_path, audio_path=wav_tmp)
 
@@ -969,7 +1033,14 @@ def create_avpvs_wo_buffer_batch(
                         pvs_specs[0]["pix_fmt"],
                     ),
                 ).complete_externally()
+                if st is not None:
+                    # fan-out members commit now that the PVS's own
+                    # artifact landed (same order as the short path:
+                    # AVPVS first, members after)
+                    st["fan"].close()
             except BaseException:
+                if st is not None:
+                    st["fan"].abort()
                 if os.path.isfile(out_path):
                     os.unlink(out_path)
                 clear_inprogress(out_path)

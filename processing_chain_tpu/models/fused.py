@@ -707,3 +707,56 @@ class FusedFanout:
             if os.path.isfile(out):
                 os.unlink(out)
             clear_inprogress(out)
+
+
+class SegmentOrderedTap:
+    """The multi-lane → single-stream adapter for long tests on the batch
+    mesh path (models/avpvs.create_avpvs_wo_buffer_batch).
+
+    A long test renders one wave LANE PER SEGMENT, but a FusedFanout
+    consumes ONE continuous stream (exactly what the single-device path
+    feeds it from MultiSegmentPrefetcher). The wave scheduler
+    (parallel/p03_batch.plan_waves) pins a PVS's segment lanes to
+    sequential waves in segment order, so simply forwarding each lane's
+    emits yields the continuous stream with zero reorder buffering —
+    this class is the ENFORCEMENT point, not a buffer: an emit from any
+    lane other than the current segment means the scheduler's ordering
+    contract broke, and silently forwarding it would interleave segments
+    inside committed artifacts. It raises instead.
+
+    `lane(idx)` / `lane_done(idx)` hand each segment lane its emit tap
+    and its Lane.on_done; the last segment's on_done fires
+    `fanout.finish_streams()` — the same point in the stream where the
+    single-device path stops feeding. No locking: wave lanes emit from
+    the driver thread, and waves are sequential by construction."""
+
+    def __init__(self, fanout, feed, n_segments: int) -> None:
+        self._fanout = fanout
+        self._feed = feed
+        self._n = n_segments
+        self._current = 0
+
+    def _check(self, idx: int, what: str) -> None:
+        if idx != self._current:
+            raise ChainError(
+                f"fused lane ordering violated: {what} from segment "
+                f"{idx} while segment {self._current} is current "
+                f"(plan_waves contract)"
+            )
+
+    def lane(self, idx: int):
+        """Emit tap for segment `idx`'s wave lane."""
+        def emit(planes) -> None:
+            self._check(idx, "frames")
+            self._feed(planes)
+        return emit
+
+    def lane_done(self, idx: int):
+        """Lane.on_done for segment `idx`: advance; after the LAST
+        segment, flush + close the fan-out's downstream encoders."""
+        def done() -> None:
+            self._check(idx, "on_done")
+            self._current += 1
+            if self._current == self._n:
+                self._fanout.finish_streams()
+        return done

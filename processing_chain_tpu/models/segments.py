@@ -18,7 +18,7 @@ from ..config.domain import Segment
 from ..engine import prefetch as pfe
 from ..engine.jobs import Job
 from ..io.video import VideoReader, VideoWriter
-from ..io import medialib
+from ..io import medialib, sharedscan
 from ..ops import fps as fps_ops
 from ..store import keys as store_keys
 from ..utils.log import get_logger
@@ -349,6 +349,17 @@ def encode_segment(segment: Segment) -> Optional[Job]:
             if os.path.isfile(null_out):
                 os.unlink(null_out)
             raise
+        # shared-scan priming: the finished segment is still hot in page
+        # cache, so pay its one demux pass NOW — p02 frame tables, segment
+        # bitrates and serve cost features then read the cached arrays
+        # instead of re-walking the bitstream (io/sharedscan.py). Priming
+        # is an accelerator, never a gate: a scan failure surfaces where a
+        # consumer actually needs the data, with that consumer's context.
+        if os.environ.get("PC_SCAN_PRIME", "1") != "0":
+            try:
+                sharedscan.prime(out_path)
+            except (OSError, medialib.MediaError):
+                pass
         return out_path
 
     # plan payload (store/keys schema): everything that determines the

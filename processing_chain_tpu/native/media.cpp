@@ -372,6 +372,71 @@ EXPORT long mp_scan_packets(const char* path, int codec_type /*0 v, 1 a*/,
     return n;  // may exceed cap: caller re-allocates and re-scans
 }
 
+// One demux pass recording BOTH the best video and best audio stream's
+// packets (the shared post-encode scan: io/sharedscan.py). Array
+// semantics per stream match mp_scan_packets. Writes packet counts to
+// *n_video / *n_audio; either may exceed its cap (caller re-allocates
+// and re-scans). *n_audio is -1 when the container has no audio stream;
+// a missing video stream is an error to match mp_scan_packets(video).
+// Returns 0 on success, < 0 on error.
+EXPORT int mp_scan_packets_all(
+    const char* path,
+    int64_t* v_sizes, double* v_pts, double* v_dts, double* v_dur,
+    int8_t* v_key, long v_cap, long* n_video,
+    int64_t* a_sizes, double* a_pts, double* a_dts, double* a_dur,
+    int8_t* a_key, long a_cap, long* n_audio,
+    char* err, int errlen) {
+    AVFormatContext* fmt = nullptr;
+    int ret = avformat_open_input(&fmt, path, nullptr, nullptr);
+    if (ret < 0) {
+        set_err(err, errlen, "open_input: " + av_errstr(ret));
+        return -1;
+    }
+    if ((ret = avformat_find_stream_info(fmt, nullptr)) < 0) {
+        set_err(err, errlen, "find_stream_info: " + av_errstr(ret));
+        avformat_close_input(&fmt);
+        return -1;
+    }
+    int vidx = av_find_best_stream(fmt, AVMEDIA_TYPE_VIDEO, -1, -1, nullptr, 0);
+    if (vidx < 0) {
+        set_err(err, errlen, "no such stream");
+        avformat_close_input(&fmt);
+        return -2;
+    }
+    int aidx = av_find_best_stream(fmt, AVMEDIA_TYPE_AUDIO, -1, -1, nullptr, 0);
+    AVRational vtb = fmt->streams[vidx]->time_base;
+    AVRational atb = aidx >= 0 ? fmt->streams[aidx]->time_base : AVRational{1, 1};
+    AVPacket* pkt = av_packet_alloc();
+    long nv = 0, na = 0;
+    while (av_read_frame(fmt, pkt) >= 0) {
+        if (pkt->stream_index == vidx) {
+            if (nv < v_cap) {
+                v_sizes[nv] = pkt->size;
+                v_pts[nv] = ts_to_sec(pkt->pts, vtb);
+                v_dts[nv] = ts_to_sec(pkt->dts, vtb);
+                v_dur[nv] = pkt->duration > 0 ? pkt->duration * av_q2d(vtb) : NAN;
+                v_key[nv] = (pkt->flags & AV_PKT_FLAG_KEY) ? 1 : 0;
+            }
+            nv++;
+        } else if (aidx >= 0 && pkt->stream_index == aidx) {
+            if (na < a_cap) {
+                a_sizes[na] = pkt->size;
+                a_pts[na] = ts_to_sec(pkt->pts, atb);
+                a_dts[na] = ts_to_sec(pkt->dts, atb);
+                a_dur[na] = pkt->duration > 0 ? pkt->duration * av_q2d(atb) : NAN;
+                a_key[na] = (pkt->flags & AV_PKT_FLAG_KEY) ? 1 : 0;
+            }
+            na++;
+        }
+        av_packet_unref(pkt);
+    }
+    av_packet_free(&pkt);
+    avformat_close_input(&fmt);
+    *n_video = nv;
+    *n_audio = aidx >= 0 ? na : -1;
+    return 0;
+}
+
 // ---------------------------------------------------------------------------
 // Video decoding
 // ---------------------------------------------------------------------------

@@ -218,6 +218,82 @@ def sort_lanes(lanes: list[Lane]) -> list[Lane]:
     return sorted(lanes, key=lambda ln: -ln.n_frames_hint)
 
 
+def plan_waves(buckets: dict, n_pvs: int, group_of=None) -> list:
+    """Order bucketed lane entries into an executable wave schedule:
+    ``[(bucket_key, [entry, ...]), ...]``, each wave ≤ `n_pvs` entries
+    from ONE bucket (waves compile per geometry).
+
+    `group_of(entry)` -> None or ``(group_id, seq)`` pins ordered groups
+    — the fused long-test fan-outs, whose per-(PVS, segment) lanes must
+    reach the fan-out in stream order. The guarantee: a group's entries
+    appear in strictly increasing `seq` across the schedule, at most one
+    per wave. Waves execute sequentially and a wave's lanes fully drain
+    before the next wave starts (run_bucket), so schedule order IS
+    delivery order — segment k+1's first frame cannot reach a fan-out
+    before segment k's last (zero reorder buffering; models/fused
+    SegmentOrderedTap enforces the same invariant at the consumer).
+
+    With no `group_of` (or none pinned) this reduces exactly to the
+    historical per-bucket slicing, same waves in the same order. Pinned
+    groups may shrink waves below `n_pvs` (a deferred segment leaves its
+    slot to batch-axis padding); meshobs pad accounting stays truthful
+    automatically — `pad_mesh` records the burned slots.
+
+    A group's segments may span buckets (long tests ladder through
+    quality levels, so per-segment source geometry differs): the outer
+    round-robin alternates buckets until every entry is scheduled.
+    Always terminates — any round with pending entries schedules at
+    least one wave (each group's head is pending in some bucket, and
+    scanning that bucket either takes the head or fills a wave with
+    other work; both are progress)."""
+    if group_of is None:
+        group_of = lambda e: None  # noqa: E731
+    # per-group ascending seq queue: "next" = the group's smallest
+    # unscheduled seq (robust to non-contiguous numbering)
+    heads: dict = {}
+    for entries in buckets.values():
+        for e in entries:
+            g = group_of(e)
+            if g is not None:
+                heads.setdefault(g[0], []).append(g[1])
+    for q in heads.values():
+        q.sort(reverse=True)  # pop() from the tail = ascending order
+    pending = {key: list(entries) for key, entries in buckets.items()}
+    out: list = []
+    while True:
+        progressed = False
+        for key in list(pending):
+            entries = pending[key]
+            while entries:
+                wave, rest, in_wave = [], [], set()
+                for e in entries:
+                    g = group_of(e)
+                    if len(wave) >= n_pvs:
+                        rest.append(e)
+                    elif g is None:
+                        wave.append(e)
+                    elif g[0] not in in_wave and heads[g[0]][-1] == g[1]:
+                        wave.append(e)
+                        in_wave.add(g[0])
+                        heads[g[0]].pop()
+                    else:
+                        rest.append(e)  # not this group's turn yet
+                if not wave:
+                    break
+                out.append((key, wave))
+                progressed = True
+                entries = rest
+            pending[key] = entries
+        if not any(pending.values()):
+            return out
+        if not progressed:  # argued unreachable above; never spin
+            stuck = sum(len(v) for v in pending.values())
+            raise RuntimeError(
+                f"plan_waves: no schedulable lane among {stuck} pending "
+                "entries (inconsistent group_of sequencing?)"
+            )
+
+
 #: step identities already dispatched at least once — the compile
 #: ledger's first-dispatch detector. `_sharded_resize_step` is
 #: functools.cached, so each compiled step lives for the process and its

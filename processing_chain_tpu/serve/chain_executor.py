@@ -224,7 +224,10 @@ class ChainExecutor:
         PRIORS.md), memoized per path. The first request against a new
         SRC pays one extraction; the sidecar is store-committed, so
         every later request (and every replica sharing the store) is
-        warm. None on any failure — the cost model stays total."""
+        warm. The size/framerate facts underneath ride the shared
+        post-encode packet scan (io/sharedscan.py), so a SRC the chain
+        already scanned costs this executor no extra demux pass. None
+        on any failure — the cost model stays total."""
         with self._cache_lock:
             if src_path in self._complexity:
                 return self._complexity[src_path]

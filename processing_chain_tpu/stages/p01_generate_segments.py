@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 from .. import telemetry as tm
@@ -37,10 +38,16 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     )
     downloader = None
     infeasible: list[tuple[str, str]] = []  # (segment filename, reason)
+    shard_srcs: dict[str, None] = {}  # ordered distinct SRC paths
     # multi-host: each process takes a deterministic shard of the
     # segment set (keyed by filename; distinct outputs per key)
     all_segments = {s.filename: s for s in sorted(test_config.get_required_segments())}
     for _, segment in local_shard(all_segments):
+        # priming is an accelerator, never a gate: a segment without a
+        # source handle simply contributes nothing to the prime set
+        src = getattr(segment, "src", None)
+        if src is not None and getattr(src, "file_path", None):
+            shard_srcs.setdefault(src.file_path)
         if getattr(segment.video_coding, "is_online", False):
             if cli_args.skip_online_services:
                 log.warning("Skipping online segment %s", segment.filename)
@@ -87,4 +94,34 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
     # encodes `-p`-wide like the reference's Pool(4) (cmd_utils.py:93-101);
     # each encode stays -threads 1, so parallelism comes from the pool
     runner.run()
+    _prime_src_priors(list(shard_srcs), dry_run=cli_args.dry_run)
     return test_config
+
+
+def _prime_src_priors(src_paths: list, *, dry_run: bool = False) -> None:
+    """Encode-time priors capture (docs/PRIORS.md): extract each SRC's
+    MV/QP/frame-type sidecar while p01 owns the SRC bitstreams, committed
+    under the UNCHANGED priors plan hash — later complexity / serve
+    cost-feature calls are then pure warm hits with zero extra bitstream
+    passes. Gated to store-backed runs by default (the sidecar outlives
+    the process there); PC_PRIORS_PRIME=1 forces storeless priming onto
+    the mtime-freshness sidecar path, =0 disables. Failures are logged,
+    never fatal — priming is an accelerator, not a stage output."""
+    mode = os.environ.get("PC_PRIORS_PRIME", "auto")
+    if mode == "0" or dry_run or not src_paths:
+        return
+    from ..store import runtime as store_runtime
+
+    if mode != "1" and store_runtime.active() is None:
+        return
+    from .. import priors
+
+    log = get_logger()
+    for src in src_paths:
+        try:
+            _, hit = priors.ensure_priors(src)
+        except Exception as exc:  # noqa: BLE001 - accelerator, not a gate
+            log.warning("priors prime failed for %s: %s", src, exc)
+        else:
+            log.info("p01: priors %s for %s",
+                     "warm" if hit else "primed", os.path.basename(src))

@@ -117,12 +117,14 @@ def _run(cli_args, test_config: Optional[TestConfig]) -> TestConfig:
                 if job.should_run(cli_args.force, runner="p03")
             ]
             if fuse:
-                # short lanes fan out in the wave driver; long tests
-                # keep the staged passes (their per-segment lanes cross
-                # waves out of stream order)
+                # short AND long lanes fan out in the wave driver: the
+                # wave schedule pins a long PVS's per-segment lanes to
+                # sequential waves in stream order
+                # (parallel/p03_batch.plan_waves + models/fused
+                # SegmentOrderedTap), so the staged fallback that used
+                # to guard out-of-order delivery is gone
                 for pvs in todo:
-                    if pvs.test_config.is_short():
-                        _fanout(pvs)
+                    _fanout(pvs)
             runner.add(
                 av.create_avpvs_wo_buffer_batch(
                     todo, avpvs_src_fps=avpvs_src_fps,

@@ -44,6 +44,14 @@ METRICS: dict[str, str] = {
     "chain_io_batch_calls_total": "counter",
     # io — decoder opens: the fused chain's one-decode-per-SRC invariant
     "chain_io_decoder_opens_total": "counter",
+    # io — the decode-once invariant's second axis: demux/parse passes
+    # that are NOT decoder opens (io/medialib), plus the shared
+    # post-encode scan cache (io/sharedscan) and the get_framesizes
+    # memo (io/framesizes) that keep them at one per written file
+    "chain_io_scan_passes_total": "counter",
+    "chain_io_sharedscan_hits_total": "counter",
+    "chain_io_sharedscan_misses_total": "counter",
+    "chain_io_framesizes_cache_hits_total": "counter",
     "chain_bufpool_hits_total": "counter",
     "chain_bufpool_misses_total": "counter",
     "chain_bufpool_recycled_bytes_total": "counter",

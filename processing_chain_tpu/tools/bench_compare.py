@@ -95,6 +95,30 @@ def measure(timeout_s: float = 600.0) -> dict[str, object]:
     fb = last_json_line(proc.stdout)
     if proc.returncode == 0 and fb is not None and "fused_vs_unfused" in fb:
         out["e2e.fused_vs_unfused"] = fb["fused_vs_unfused"]
+    # shared packet scan vs the separate demux passes it replaced
+    # (docs/PERF.md "one shared packet scan"): floor ≈ 1 — sharing must
+    # at least match paying each consumer's own pass
+    proc = shell(
+        [sys.executable, bench, "--sharedscan-bench"],
+        check=False, timeout=timeout_s, env=env, cwd=_REPO,
+    )
+    sb = last_json_line(proc.stdout)
+    if (proc.returncode == 0 and sb is not None
+            and "sharedscan_vs_separate" in sb):
+        out["e2e.sharedscan_vs_separate"] = sb["sharedscan_vs_separate"]
+    # full-chain e2e vs the pinned single-core reference model
+    # (`bench.py --e2e`): a real p03 run, minutes not seconds, so it
+    # folds in only when the caller asks (PC_BENCH_COMPARE_E2E=1 — the
+    # CI fused-smoke job does); the band stays optional for plain runs
+    if os.environ.get("PC_BENCH_COMPARE_E2E"):
+        proc = shell(
+            [sys.executable, bench, "--e2e"],
+            check=False, timeout=timeout_s, env=env, cwd=_REPO,
+        )
+        eb = last_json_line(proc.stdout)
+        if (proc.returncode == 0 and eb is not None
+                and "e2e_vs_baseline_1core" in eb):
+            out["e2e.vs_baseline_1core"] = eb["e2e_vs_baseline_1core"]
     live_path = os.environ.get(
         "PC_BENCH_LIVE_FILE", os.path.join(_REPO, "BENCH_LIVE.json")
     )

@@ -140,7 +140,9 @@ def get_priors_difficulty(src_file: str, force: bool = False) -> dict:
         size = float(data.pkt_size.sum())
     else:
         try:
-            size = float(medialib.scan_packets(src_file, "video")["size"].sum())
+            from ..io import sharedscan
+
+            size = float(sharedscan.video(src_file)["size"].sum())
         except medialib.MediaError:
             size = 0.0
         if size <= 0:

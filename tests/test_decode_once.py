@@ -1,0 +1,322 @@
+"""Decode-once everything (ISSUE 19): lane-ordered fused mesh waves and
+the shared post-encode packet scan.
+
+Three altitudes. Unit: the `plan_waves` ordering contract (reduction to
+historical bucket slicing, ≤1 pinned entry per wave, ascending seq,
+cross-bucket groups) and `SegmentOrderedTap` enforcement (out-of-order
+delivery raises instead of interleaving segments inside artifacts).
+Driver: grouped waves through the real `run_bucket` on the 8-device
+virtual mesh keep the meshobs slot accounting truthful (Σ valid+pads ==
+dispatched, sub-full waves burn pad_mesh) while frames reach the fan-out
+tap in stream order. Integration: `scan_packets_all` is field-for-field
+what two `scan_packets` passes produce, the sharedscan/framesizes caches
+serve repeats without re-demuxing, and a cold p01→p02→priors run opens a
+pixel decoder exactly once per SRC — metadata and priors add ZERO opens.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from processing_chain_tpu import priors, telemetry as tm
+from processing_chain_tpu.cli import main as cli_main
+from processing_chain_tpu.io import framesizes, medialib, sharedscan
+from processing_chain_tpu.models import fused as fused_mod
+from processing_chain_tpu.parallel import make_mesh, meshobs, p03_batch
+from processing_chain_tpu.store import runtime as store_runtime
+from processing_chain_tpu.utils.runner import ChainError
+
+from test_pipeline_e2e import make_src, minimal_short_yaml, write_db
+
+PACKET_FIELDS = ("size", "pts_time", "dts_time", "duration_time", "key")
+
+
+@pytest.fixture(autouse=True)
+def _clean_runtime():
+    tm.reset()
+    sharedscan.clear()
+    yield
+    meshobs.detach_journal()
+    store_runtime.configure(None)
+    sharedscan.clear()
+    tm.disable()
+    tm.reset()
+
+
+# ------------------------------------------------ plan_waves contract
+
+
+def test_plan_waves_reduces_to_bucket_slicing_without_groups():
+    """No pinned groups: the schedule is exactly the historical per-bucket
+    slicing — same waves, same order, nothing deferred."""
+    buckets = {"A": list(range(10)), "B": [100, 101, 102]}
+    out = p03_batch.plan_waves(buckets, 4)
+    assert out == [
+        ("A", [0, 1, 2, 3]), ("A", [4, 5, 6, 7]), ("A", [8, 9]),
+        ("B", [100, 101, 102]),
+    ]
+
+
+def _by_tuple(e):
+    return e if isinstance(e, tuple) else None
+
+
+def test_plan_waves_pins_group_lanes_to_sequential_waves_in_seq_order():
+    entries = [("g", 2), ("g", 0), ("g", 1), "f0", "f1", "f2", "f3", "f4"]
+    waves = p03_batch.plan_waves({"A": entries}, 4, group_of=_by_tuple)
+    pinned = [e for _, w in waves for e in w if isinstance(e, tuple)]
+    assert pinned == [("g", 0), ("g", 1), ("g", 2)]  # strictly ascending
+    for _, wave in waves:
+        assert len(wave) <= 4
+        assert sum(isinstance(e, tuple) for e in wave) <= 1
+    flat = [e for _, w in waves for e in w]
+    assert sorted(map(repr, flat)) == sorted(map(repr, entries))
+
+
+def test_plan_waves_orders_a_group_across_buckets():
+    """Long tests ladder quality levels, so a PVS's segments land in
+    different geometry buckets — seq order must hold across them."""
+    buckets = {
+        "A": [("g", 0), ("g", 2), "a0"],
+        "B": [("g", 1), "b0"],
+    }
+    waves = p03_batch.plan_waves(buckets, 4, group_of=_by_tuple)
+    pinned = [e for _, w in waves for e in w if isinstance(e, tuple)]
+    assert pinned == [("g", 0), ("g", 1), ("g", 2)]
+    # seg1's bucket-B wave runs between the two bucket-A waves
+    keys = [k for k, w in waves for e in w if isinstance(e, tuple)]
+    assert keys == ["A", "B", "A"]
+
+
+# ------------------------------------------- SegmentOrderedTap contract
+
+
+class _FakeFanout:
+    def __init__(self):
+        self.finished = 0
+
+    def finish_streams(self):
+        self.finished += 1
+
+
+def test_segment_ordered_tap_forwards_in_order_and_finishes_once():
+    fan, fed = _FakeFanout(), []
+    tap = fused_mod.SegmentOrderedTap(fan, fed.append, 2)
+    tap.lane(0)("seg0.chunk0")
+    tap.lane(0)("seg0.chunk1")
+    tap.lane_done(0)()
+    assert fan.finished == 0  # not until the LAST segment drains
+    tap.lane(1)("seg1.chunk0")
+    tap.lane_done(1)()
+    assert fed == ["seg0.chunk0", "seg0.chunk1", "seg1.chunk0"]
+    assert fan.finished == 1
+
+
+def test_segment_ordered_tap_raises_on_out_of_order_delivery():
+    """Enforcement, not buffering: frames from a lane that isn't the
+    current segment mean the plan_waves contract broke upstream."""
+    fan = _FakeFanout()
+    tap = fused_mod.SegmentOrderedTap(fan, lambda planes: None, 3)
+    with pytest.raises(ChainError, match="lane ordering violated"):
+        tap.lane(1)("early")
+    tap.lane(0)("ok")
+    tap.lane_done(0)()
+    with pytest.raises(ChainError, match="on_done"):
+        tap.lane_done(2)()
+    assert fan.finished == 0
+
+
+# ------------------------- grouped waves through the real wave driver
+
+
+def test_grouped_waves_keep_meshobs_truthful_and_deliver_in_order(
+        devices8, tmp_path):
+    """The avpvs batch shape end to end, minus codecs: one fan-out PVS
+    with 3 pinned segment lanes plus free lanes, planned by plan_waves
+    and executed wave-by-wave through run_bucket. The tap receives every
+    segment frame in stream order, and the meshobs journal stays
+    truthful — the deferred segments run sub-full waves whose burned
+    slots are pad_mesh, and Σ valid+pads == dispatched throughout."""
+    mesh = make_mesh(devices8, time_parallel=2)
+    n_pvs = mesh.shape["pvs"]
+    rng = np.random.default_rng(19)
+
+    def yuv(n):
+        return [
+            rng.integers(0, 255, size=(n, 36, 64), dtype=np.uint8),
+            rng.integers(0, 255, size=(n, 18, 32), dtype=np.uint8),
+            rng.integers(0, 255, size=(n, 18, 32), dtype=np.uint8),
+        ]
+
+    seg_lens = [6, 3, 5]
+    entries = [
+        dict(group=("pvs0", i), frames=yuv(n))
+        for i, n in enumerate(seg_lens)
+    ]
+    entries += [dict(group=None, frames=yuv(4)) for _ in range(3)]
+    waves = p03_batch.plan_waves(
+        {"bkt": entries}, n_pvs, group_of=lambda e: e["group"]
+    )
+
+    fan, fed = _FakeFanout(), []
+    tap = fused_mod.SegmentOrderedTap(fan, fed.append, len(seg_lens))
+    bucket = p03_batch.bucket_label(72, 128, False, 36, 64)
+    meshobs.attach_journal(str(tmp_path), replica="t0")
+    total = 0
+    for _key, wave in waves:
+        lanes = []
+        for j, e in enumerate(wave):
+            n = e["frames"][0].shape[0]
+            total += n
+            if e["group"] is None:
+                emit, on_done, name = (lambda planes: None), None, f"free{j}"
+            else:
+                idx = e["group"][1]
+                emit = tap.lane(idx)
+                on_done = tap.lane_done(idx)
+                name = f"pvs0.seg{idx:04d}"
+            lanes.append(p03_batch.Lane(
+                chunks=iter([e["frames"]]), emit=emit, n_frames_hint=n,
+                on_done=on_done, name=name,
+            ))
+        p03_batch.run_bucket(
+            lanes, mesh, 72, 128, "bicubic", (2, 2), False,
+            chunk=4, bucket=bucket,
+        )
+    meshobs.detach_journal()
+
+    # stream-order delivery: the tap saw every segment frame, in order
+    # (any out-of-order emit would have raised ChainError above)
+    assert fan.finished == 1
+    assert sum(p[0].shape[0] for p in fed) == sum(seg_lens)
+
+    agg = meshobs.aggregate(str(tmp_path))
+    assert agg["invariant_violations"] == 0
+    tot = agg["totals"]
+    assert tot["valid"] == total
+    padded = tot["pad_tail"] + tot["pad_exhausted"] + tot["pad_mesh"]
+    assert tot["valid"] + padded == tot["dispatched"]
+    assert tot["pad_mesh"] > 0  # seg1/seg2 waves ran below n_pvs
+
+
+# -------------------------------------------- the shared packet scan
+
+
+def test_scan_packets_all_matches_two_scan_packets(tmp_path):
+    path = str(tmp_path / "av.avi")
+    make_src(path, n=12, audio=True)
+    both = medialib.scan_packets_all(path)
+    video = medialib.scan_packets(path, "video")
+    audio = medialib.scan_packets(path, "audio")
+    assert both["audio"] is not None
+    for field in PACKET_FIELDS:
+        np.testing.assert_array_equal(both["video"][field], video[field])
+        np.testing.assert_array_equal(both["audio"][field], audio[field])
+
+
+def test_scan_packets_all_audio_is_none_without_an_audio_stream(tmp_path):
+    path = str(tmp_path / "v.avi")
+    make_src(path, n=8)
+    assert medialib.scan_packets_all(path)["audio"] is None
+    with pytest.raises(medialib.MediaError, match="no such stream"):
+        sharedscan.audio(path)
+
+
+def test_sharedscan_serves_repeats_from_one_native_pass(
+        tmp_path, monkeypatch):
+    path = str(tmp_path / "av.avi")
+    make_src(path, n=8, audio=True)
+    calls = []
+    real = medialib.scan_packets_all
+    monkeypatch.setattr(
+        medialib, "scan_packets_all",
+        lambda p: calls.append(p) or real(p),
+    )
+    tm.enable()
+    first = sharedscan.get_scan(path)
+    again = sharedscan.video(path)
+    assert len(calls) == 1  # the repeat never touched the bitstream
+    np.testing.assert_array_equal(first["video"]["size"], again["size"])
+    assert tm.REGISTRY.sum_series(
+        "chain_io_sharedscan_hits_total", None) == 1.0
+    # stat-signature trust model: a rewrite with new size/mtime misses
+    sharedscan.invalidate(path)
+    make_src(path, n=9, audio=True)
+    sharedscan.get_scan(path)
+    assert len(calls) == 2
+
+
+def test_sharedscan_missing_file_raises_media_error_like_scan_packets(
+        tmp_path):
+    with pytest.raises(medialib.MediaError):
+        sharedscan.get_scan(str(tmp_path / "nope.mp4"))
+
+
+def test_get_framesizes_memo_hits_and_force_bypasses(tmp_path, monkeypatch):
+    path = str(tmp_path / "v.avi")
+    make_src(path, n=8)
+    calls = []
+    monkeypatch.setattr(
+        framesizes, "get_framesize_av1",
+        lambda f, force=False: calls.append(f) or [10, 20, 30],
+    )
+    tm.enable()
+    framesizes._cache.clear()
+    a = framesizes.get_framesizes(path, "av1")
+    b = framesizes.get_framesizes(path, "av1")
+    assert a == b == [10, 20, 30]
+    assert len(calls) == 1
+    assert tm.REGISTRY.sum_series(
+        "chain_io_framesizes_cache_hits_total", None) == 1.0
+    # the memo hands out copies, never its own list
+    a.append(99)
+    assert framesizes.get_framesizes(path, "av1") == [10, 20, 30]
+    # force re-parses AND refreshes the memo
+    framesizes.get_framesizes(path, "av1", force=True)
+    assert len(calls) == 2
+    framesizes.get_framesizes(path, "av1")
+    assert len(calls) == 2
+
+
+# ------------------------------------- the cold-run decode-once proof
+
+
+def test_cold_run_opens_one_decoder_per_src_metadata_and_priors_add_zero(
+        tmp_path, monkeypatch):
+    """The PR's CI invariant at pytest altitude: after p01 encodes the
+    segments, chain_io_decoder_opens_total == SRC count, and a full p02
+    metadata pass plus priors access adds ZERO pixel decodes — both ride
+    the shared post-encode packet scan p01 primed. Storeless with
+    PC_PRIORS_PRIME=1: an active store's commit read-back verification
+    (store/store._probe_readback) deliberately opens a one-frame decoder
+    per committed artifact, which is integrity checking, not a chain
+    decode — the invariant is cleanest where only the chain decodes."""
+    db_id = "P2SXM93"
+    yaml_text = minimal_short_yaml(db_id).replace(
+        "srcList:\n  SRC000: SRC000.avi",
+        "srcList:\n  SRC000: SRC000.avi\n  SRC001: SRC001.avi",
+    ).replace(
+        f"pvsList:\n  - {db_id}_SRC000_HRC000",
+        f"pvsList:\n  - {db_id}_SRC000_HRC000\n  - {db_id}_SRC001_HRC000",
+    )
+    yaml_path = write_db(tmp_path, db_id, yaml_text, {
+        "SRC000.avi": dict(n=48), "SRC001.avi": dict(n=48),
+    })
+    monkeypatch.setenv("PC_PRIORS_PRIME", "1")
+    tm.enable()
+
+    assert cli_main(["p01", "-c", yaml_path, "--skip-requirements"]) == 0
+    opens_p01 = tm.REGISTRY.sum_series("chain_io_decoder_opens_total", None)
+    assert opens_p01 == 2.0  # one pixel decode per SRC, nothing else
+
+    assert cli_main(["p02", "-c", yaml_path, "--skip-requirements"]) == 0
+    for name in ("SRC000.avi", "SRC001.avi"):
+        _, hit = priors.ensure_priors(
+            os.path.join(os.path.dirname(yaml_path), "srcVid", name))
+        assert hit  # p01's encode-time capture already committed it
+    opens_after = tm.REGISTRY.sum_series("chain_io_decoder_opens_total", None)
+    assert opens_after == opens_p01
+    # and the metadata pass was cache-fed, not scan-fed
+    assert tm.REGISTRY.sum_series(
+        "chain_io_sharedscan_hits_total", None) > 0

@@ -387,6 +387,88 @@ def test_fused_long_single_device_parity_with_audio_resample_preview(
                 audio[0], ref_audio[0], err_msg=key)
 
 
+def test_fused_long_batch_mesh_parity_lane_ordered(tmp_path, monkeypatch):
+    """The batch (multi-device mesh) fused path on a LONG test — the
+    staged fallback is gone. The two quality levels land in DIFFERENT
+    geometry buckets, so plan_waves pins the PVS's per-segment lanes to
+    sequential waves ACROSS buckets and the SegmentOrderedTap feeds the
+    fan-out the same continuous stream the single-device path would.
+    Stalled AVPVS and CPVS come out of the p03 stage alone (p04 never
+    runs), decoded-identical to the staged render, with exactly one
+    pixel decode per segment lane."""
+    yaml_text = textwrap.dedent("""\
+        databaseId: P2LTR02
+        syntaxVersion: 6
+        type: long
+        segmentDuration: 1
+        qualityLevelList:
+          Q0: {index: 0, videoCodec: h264, videoBitrate: 200, width: 160, height: 90, fps: 24, audioCodec: aac, audioBitrate: 96}
+          Q1: {index: 1, videoCodec: h264, videoBitrate: 500, width: 320, height: 180, fps: 24, audioCodec: aac, audioBitrate: 96}
+        codingList:
+          VC01: {type: video, encoder: libx264, passes: 1, iFrameInterval: 1, preset: ultrafast}
+          AC01: {type: audio, encoder: aac}
+        srcList:
+          SRC001: SRC001.avi
+        hrcList:
+          HRC000:
+            videoCodingId: VC01
+            audioCodingId: AC01
+            eventList: [[Q0, 1], [stall, 0.5], [Q1, 1]]
+        pvsList:
+          - P2LTR02_SRC001_HRC000
+        postProcessingList:
+          - {type: pc, displayWidth: 320, displayHeight: 180, codingWidth: 320, codingHeight: 180, displayFrameRate: 30}
+        """)
+    yaml_path = write_db(tmp_path, "P2LTR02", yaml_text,
+                         {"SRC001.avi": dict(n=48, audio=True)})
+    db = os.path.dirname(yaml_path)
+    assert cli_main(
+        ["p00", "-c", yaml_path, "-str", "1234", "--skip-requirements"]
+    ) == 0
+
+    from processing_chain_tpu.config import TestConfig
+
+    tc = TestConfig(yaml_path)
+    pvs = next(iter(tc.pvses.values()))
+    artifacts = {
+        "stalled": pvs.get_avpvs_file_path(),
+        "cpvs": pvs.get_cpvs_file_path(context="pc"),
+    }
+    staged = {}
+    for key, path in artifacts.items():
+        with VideoReader(path) as r:
+            video, _ = r.read_all()
+        staged[key] = (video, medialib.decode_audio_s16(path))
+    for d in ("avpvs", "cpvs"):
+        for f in glob.glob(os.path.join(db, d, "*")):
+            os.unlink(f)
+
+    monkeypatch.setenv("PC_FUSE_P04", "1")
+    tm.enable()
+    before = tm.REGISTRY.sum_series(
+        "chain_io_decoder_opens_total", None) or 0.0
+    assert cli_main(["p03", "-c", yaml_path, "--skip-requirements"]) == 0
+    after = tm.REGISTRY.sum_series(
+        "chain_io_decoder_opens_total", None) or 0.0
+    # one decode per segment lane; the stalling pass and the CPVS render
+    # rode the fan-out (a staged fallback would re-decode the AVPVS)
+    assert after - before == len(pvs.segments) == 2
+
+    for key, path in artifacts.items():
+        # the CPVS exists although p04 never ran: the fan-out wrote it
+        assert os.path.isfile(path), key
+        with VideoReader(path) as r:
+            video, _ = r.read_all()
+        audio = medialib.decode_audio_s16(path)
+        ref_video, ref_audio = staged[key]
+        assert len(video) == len(ref_video), key
+        for g, f in zip(video, ref_video):
+            np.testing.assert_array_equal(g, f, err_msg=key)
+        assert audio[0].shape == ref_audio[0].shape, key
+        assert audio[1] == ref_audio[1]
+        np.testing.assert_array_equal(audio[0], ref_audio[0], err_msg=key)
+
+
 # ------------------------------------------------------- store contract
 
 
