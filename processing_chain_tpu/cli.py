@@ -267,7 +267,8 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
         "bench-compare", "chain-lint", "chain-serve", "serve-soak",
         "queue-crashcheck", "serve-chaos", "media-crashcheck",
         "serve-admin", "fleet-top", "trace", "store-heat",
-        "store-tiers", "mesh-top", "mesh-report",
+        "store-tiers", "mesh-top", "mesh-report", "fleet-doctor",
+        "bench-history",
     )
     if not argv or argv[0] not in tools:
         sys.stderr.write(f"usage: tools {{{','.join(tools)}}} …\n")
@@ -307,6 +308,14 @@ def _dispatch_tool(argv: Sequence[str]) -> int:
             from .tools import fleet_top
 
             return fleet_top.main(rest)
+        if name == "fleet-doctor":
+            from .tools import fleet_doctor
+
+            return fleet_doctor.main(rest)
+        if name == "bench-history":
+            from .tools import bench_history
+
+            return bench_history.main(rest)
         if name == "trace":
             from .tools import trace_tool
 

@@ -1559,3 +1559,19 @@ class DurableQueue:
                 for record in source.values()
                 if tenant is None or record.tenant == tenant
             )
+
+    def backlog(self) -> dict:
+        """Queued (not yet claimed) work per priority class — record
+        counts plus predicted seconds — the autoscale advisor's
+        per-class input (serve/autoscale.py): which class is waiting
+        picks how fast the fleet must drain."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for record in self._queued.values():
+                cls = out.setdefault(record.priority,
+                                     {"count": 0, "cost_s": 0.0})
+                cls["count"] += 1
+                cls["cost_s"] += record.cost_s
+            for cls in out.values():
+                cls["cost_s"] = round(cls["cost_s"], 3)
+            return out

@@ -49,7 +49,9 @@ import time
 from typing import NamedTuple, Optional
 
 from .. import telemetry as tm
+from ..telemetry import alerts as alerts_mod
 from ..telemetry import catalog as tm_catalog
+from ..telemetry import watchdog as tm_watchdog
 from ..store import heat as store_heat
 from ..store import runtime as store_runtime
 from ..store.store import StoreCorruption
@@ -57,7 +59,7 @@ from ..telemetry import live
 from ..utils import lockdebug
 from ..utils.fsio import atomic_write_json
 from ..utils.log import get_logger
-from . import api, cost
+from . import api, autoscale, cost
 from .executors import make_executor
 from .pressure import StorePressure
 from .queue import DurableQueue, owner_process_dead, owner_stamp
@@ -147,6 +149,8 @@ class ChainServeService:
         admission_budget_s: Optional[float] = None,
         tenant_budget_s: Optional[float] = None,
         cost_calibrate: bool = False,
+        control_interval_s: float = 10.0,
+        alert_window_scale: float = 1.0,
     ) -> None:
         self.root = os.path.abspath(root)
         self.artifacts_root = os.path.join(self.root, "artifacts")
@@ -217,6 +221,28 @@ class ChainServeService:
         #: the ledger's observed/predicted ratio ring (maintenance
         #: tick; docs/SERVE.md "Cost-aware scheduling & admission")
         self.cost_calibrate = bool(cost_calibrate)
+        # ------ the SLO control loop (docs/TELEMETRY.md "Alerting &
+        # the scale signal"): one shared per-replica alert journal
+        # carries both alert lifecycle records and scale-signal
+        # records, so fleet-doctor reads one plane and scale decisions
+        # sit next to the alerts that motivated them. The engine and
+        # the advisor are re-graded by the maintenance tick, throttled
+        # to control_interval_s; window_scale compresses every burn
+        # window/hold uniformly (the soak harness squeezes hours into
+        # seconds without forking the rule declarations).
+        self.control_interval_s = max(0.05, float(control_interval_s))
+        self.alert_journal = alerts_mod.AlertJournal(
+            alerts_mod.alerts_dir(self.root), self.replica
+        )
+        self.alert_engine = alerts_mod.AlertEngine(
+            self.root, self.replica, journal=self.alert_journal,
+            window_scale=alert_window_scale,
+        )
+        self.autoscale = autoscale.AutoscaleAdvisor(
+            self.alert_journal, self.replica, workers=workers,
+            window_scale=alert_window_scale,
+        )
+        self._next_control = 0.0  # monotonic deadline; maintenance thread
         self.scheduler = Scheduler(
             self.queue, self.executor, self.artifacts_root,
             workers=workers, wave_width=wave_width,
@@ -239,6 +265,8 @@ class ChainServeService:
         # (200), it is just not claiming work
         routes.add("/healthz", self._h_healthz)
         routes.add("/fleet", self._h_fleet)
+        routes.add("/fleet/alerts", self._h_fleet_alerts)
+        routes.add("/fleet/scale-signal", self._h_scale_signal)
         self.server = live.LiveServer(port, host=host, routes=routes)
         self._recover_requests()
 
@@ -320,6 +348,9 @@ class ChainServeService:
         # releases this replica's leases/liveness so a successor (or a
         # peer) can reclaim any still-running work immediately
         self.queue.close()
+        # resolve-on-shutdown is wrong (the condition may persist);
+        # close() just seals the journal handle
+        self.alert_engine.close()
         self.heat.close()
         if self.store is not None:
             self.store.digests.save()
@@ -346,6 +377,36 @@ class ChainServeService:
             except Exception:  # noqa: BLE001 - the tick must survive disk hiccups
                 get_logger().exception(
                     "chain-serve: maintenance tick failed")
+            try:
+                # the SLO control loop rides the same tick but in its
+                # own try: an alert-grading failure must not starve
+                # lease stealing (and vice versa)
+                self._control_tick()
+            except Exception:  # noqa: BLE001 - grading must never kill the tick
+                get_logger().exception(
+                    "chain-serve: control tick failed")
+
+    def _control_tick(self, force: bool = False) -> Optional[dict]:
+        """Grade the alert rules and the scale signal against the
+        current fleet view. Throttled to `control_interval_s` (the
+        fleet scrape stats every replica's journals); `force=True`
+        (the /fleet/scale-signal cold path) grades immediately."""
+        now = time.monotonic()
+        if not force and now < self._next_control:
+            return None
+        self._next_control = now + self.control_interval_s
+        from ..telemetry import fleet as fleet_mod
+
+        view = fleet_mod.fleet_view(self.root, timeout_s=2.0)
+        result = self.alert_engine.evaluate(view)
+        calibrated = int(cost.calibration().get("n", 0)) > 0
+        return self.autoscale.evaluate(
+            current_replicas=max(1, int(view.get("alive") or 0)),
+            backlog=self.queue.backlog(),
+            outstanding_s=self.queue.outstanding_cost(),
+            active_alerts=result["active"],
+            calibrated=calibrated,
+        )
 
     def _sweep_remote_settlements(self) -> None:
         with self._lock:
@@ -990,6 +1051,11 @@ class ChainServeService:
                     "enabled": self.cost_calibrate,
                 },
             },
+            # live stall/hard-timeout episodes from the heartbeat
+            # registry — the fleet view re-labels these per replica so
+            # a stalled replica is visible fleet-wide (fleet-top's
+            # active-stalls line)
+            "stalls": tm_watchdog.active_stalls(),
         }
         with self._lock:
             for doc in self._requests.values():
@@ -1063,6 +1129,28 @@ class ChainServeService:
         from ..telemetry import fleet
 
         return self._json(200, fleet.fleet_view(self.root))
+
+    def _h_fleet_alerts(self, req: live.WebRequest):
+        """GET /fleet/alerts: the fleet-merged alert plane — active
+        alerts, recently-resolved ones, and journal stats — folded from
+        every replica's alert journal (telemetry/alerts.py)."""
+        return self._json(200, alerts_mod.alerts_report(self.root))
+
+    def _h_scale_signal(self, req: live.WebRequest):
+        """GET /fleet/scale-signal: the autoscale recommendation
+        (serve/autoscale.py) — current vs desired replicas, confidence,
+        reason codes. Served from the last maintenance-tick grading;
+        a cold replica grades synchronously once."""
+        signal = self.autoscale.latest()
+        if signal is None:
+            try:
+                signal = self._control_tick(force=True)
+            except Exception:  # noqa: BLE001 - degrade to 503, not a 500
+                get_logger().exception(
+                    "chain-serve: cold scale-signal grading failed")
+        if signal is None:  # grading itself failed; say so, don't 500
+            return self._json(503, {"error": "scale signal unavailable"})
+        return self._json(200, signal)
 
     def _h_request(self, req: live.WebRequest):
         req_id = req.path[len("/v1/requests/"):]

@@ -148,6 +148,15 @@ METRICS: dict[str, str] = {
     "chain_media_deadline_expired_total": "counter",
     "chain_isolated_decodes_total": "counter",
     "chain_fused_members_degraded_total": "counter",
+    # telemetry/alerts.py — the burn-rate engine (docs/TELEMETRY.md
+    # "Alerting & the scale signal"): fire/resolve lifecycle counts per
+    # rule and the live active-alert gauge
+    "chain_alerts_fired_total": "counter",
+    "chain_alerts_resolved_total": "counter",
+    "chain_alerts_active": "gauge",
+    # serve/autoscale.py — the machine-readable scale signal
+    "chain_scale_desired_replicas": "gauge",
+    "chain_scale_backlog_seconds": "gauge",
 }
 
 #: structured event-log record names (docs/TELEMETRY.md "Event schema")
@@ -198,6 +207,11 @@ EVENTS: frozenset = frozenset({
     "dist_init",       # parallel/distributed.py — jax.distributed joined
     "dist_collective", # parallel/distributed.py — one cross-process
                        # collective with its payload bytes
+    "alert_fired",     # telemetry/alerts.py — a burn-rate rule tripped
+    "alert_resolved",  # telemetry/alerts.py — a firing rule's condition
+                       # cleared
+    "scale_signal",    # serve/autoscale.py — a desired-replica
+                       # recommendation was (re)graded
 
     "log",             # WARNING+ console records bridged into the log
 })
@@ -281,3 +295,63 @@ READ_LATENCY_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
 )
+
+# ---------------------------------------------------------- alert rules
+#
+# The burn-rate engine (telemetry/alerts.py) evaluates every rule below
+# against the fleet-merged view; firing/resolved transitions are durable
+# journal records and surface at /fleet/alerts. Declared HERE — next to
+# the SLO bands they grade — so the alerting contract is the same
+# auditable artifact as the bands themselves; chainlint's
+# telemetry-name rule drift-checks every rule name against
+# docs/TELEMETRY.md both ways (doc tokens spell them `alert:<name>`).
+
+#: multi-window multi-burn-rate pairs (SRE shape): a pair trips only
+#: when BOTH its short and long windows burn error budget faster than
+#: `burn_rate` × the steady rate that would exactly exhaust the budget.
+#: The fast pair pages on sudden total breaches within minutes; the
+#: slow pair catches sustained low-grade burns the fast pair's short
+#: memory forgives. Window seconds scale uniformly via the engine's
+#: `window_scale` (soak harnesses compress hours into seconds).
+BURN_RATE_WINDOWS: dict[str, dict[str, float]] = {
+    "fast": {"short_s": 300.0, "long_s": 3600.0, "burn_rate": 14.4},
+    "slow": {"short_s": 1800.0, "long_s": 21600.0, "burn_rate": 6.0},
+}
+
+#: alert rule name -> declaration. `source` picks the fleet-view plane
+#: the rule reads; burn rules grade one SLO phase per (tenant × class)
+#: flow, cross-plane rules watch the other flight recorders. Severity
+#: is advisory routing ("page" vs "ticket"), not engine behaviour.
+ALERT_RULES: dict[str, dict] = {
+    # SLO burn over the fleet-merged request-phase histograms
+    "slo_burn_queue_wait": {"source": "slo", "phase": "queue_wait_s",
+                            "severity": "page"},
+    "slo_burn_execution": {"source": "slo", "phase": "execution_s",
+                           "severity": "page"},
+    "slo_burn_e2e": {"source": "slo", "phase": "e2e_s",
+                     "severity": "page"},
+    # SLO burn over the artifact read path (TTFB / full stream)
+    "slo_burn_read_ttfb": {"source": "read_slo", "phase": "read_ttfb_s",
+                           "severity": "page"},
+    "slo_burn_read_stream": {"source": "read_slo", "phase": "read_s",
+                             "severity": "ticket"},
+    # cross-plane: watchdog stall episodes (telemetry/watchdog.py)
+    "watchdog_task_stalled": {"source": "stalls", "incident": "stalled",
+                              "severity": "ticket"},
+    "watchdog_hard_timeout": {"source": "stalls",
+                              "incident": "hard_timeout",
+                              "severity": "page"},
+    # cross-plane: eviction-regret records (store/heat.py) — the cache
+    # is undersized while regrets accrue inside the fast short window
+    "store_eviction_regret": {"source": "heat", "severity": "ticket",
+                              "min_regrets": 1},
+    # cross-plane: device-plane fragmentation (parallel/meshobs.py) —
+    # any geometry bucket wasting more than the fragmentation threshold
+    # over at least `min_waves` waves
+    "mesh_waste_high": {"source": "mesh", "severity": "ticket",
+                        "min_waves": 3},
+    # cross-plane: a replica whose serve-info exists but whose process
+    # stopped answering — "gone", as opposed to merely quiet
+    "fleet_replica_stale": {"source": "replicas", "severity": "page",
+                            "stale_after_s": 30.0},
+}

@@ -45,6 +45,7 @@ from typing import Iterable, Optional
 
 from ..serve import spans as serve_spans
 from ..store import heat as store_heat
+from . import alerts as alerts_mod
 from . import catalog
 
 #: SLO phase -> the metric whose histogram measures it
@@ -542,6 +543,7 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
                 status = None
             if status is not None:
                 entry["alive"] = True
+                entry["status"] = "ok"
                 serve = status.get("serve", {})
                 entry["replica_epoch"] = serve.get(
                     "replica_epoch", entry["replica_epoch"])
@@ -550,6 +552,7 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
                 entry["requests"] = serve.get("requests", {})
                 entry["executor"] = serve.get("executor")
                 entry["uptime_s"] = status.get("uptime_s")
+                entry["stalls"] = serve.get("stalls") or []
                 entry["cost_calibration"] = (
                     serve.get("cost") or {}
                 ).get("calibration")
@@ -575,7 +578,17 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
                     parse_counters(rendered, MESH_METRICS)
                 )
         else:
+            # a journal directory that exists while its process stopped
+            # answering is not "silently absent" — it is STALE, graded
+            # with its last-seen age (the serve-info's mtime) so
+            # alerting can tell "quiet" from "gone"
+            # (catalog.ALERT_RULES fleet_replica_stale)
             entry["error"] = "unreachable"
+            entry["status"] = "stale"
+            mtime = info.get("info_mtime")
+            if mtime:
+                entry["last_seen_s"] = round(
+                    max(0.0, time.time() - float(mtime)), 1)
         replicas.append(entry)
     merged_hists = merge_histograms(parsed)
     # the store root each replica declared in its serve-info (the serve
@@ -627,6 +640,25 @@ def fleet_view(root: str, timeout_s: float = 2.0) -> dict:
         # append-only history and /fleet refreshes every few seconds
         "spans": serve_spans.journal_stats(
             os.path.join(root, "queue", "spans")),
+        # active watchdog stall episodes, labelled with the replica
+        # that reported each (telemetry/watchdog.py active_stalls —
+        # satellite of the alerting plane: a stalled task is visible
+        # fleet-wide, not just in its own process)
+        "stalls": [
+            {**stall, "replica": r["replica"]}
+            for r in replicas for stall in r.get("stalls") or []
+        ],
+        # burn-rate alerts still firing (telemetry/alerts.py) — the
+        # full lifecycle lives at /fleet/alerts; this is the summary
+        # fleet-top renders and the control loop's own engines read
+        "alerts": {
+            "active": alerts_mod.active_alerts(root),
+            "journal": alerts_mod.journal_stats(
+                alerts_mod.alerts_dir(root)),
+        },
+        # the newest journaled autoscale recommendation
+        # (serve/autoscale.py; live signal at /fleet/scale-signal)
+        "scale": alerts_mod.latest_scale(root),
     }
 
 

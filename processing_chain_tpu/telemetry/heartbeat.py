@@ -104,7 +104,10 @@ class Heartbeat:
             was_flagged = self.stall_flagged
             self.stall_flagged = False
         if was_flagged:
-            emit("task_recovered", task=self.label, kind=self.kind)
+            # stage rides along (like task_stalled's) so fleet-wide
+            # consumers can pair recoveries with the stalls they end
+            emit("task_recovered", task=self.label, kind=self.kind,
+                 stage=self.stage)
 
     def set_planned(self, planned: Optional[float]) -> None:
         if not self._registry.enabled:

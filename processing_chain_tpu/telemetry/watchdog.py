@@ -179,6 +179,34 @@ class Watchdog:
         return incidents
 
 
+def active_stalls(registry: HeartbeatRegistry = HEARTBEATS) -> list[dict]:
+    """The live stall/hard-timeout episodes, with stage/task labels —
+    what the serve /status section and the fleet view surface so a
+    stalled replica is visible beyond its own process (the stack-dump
+    events stay replica-local; this list travels). Hard-timeout
+    episodes of cancellable kinds finish their heartbeat and leave the
+    list; uninterruptible ones stay until the work really ends."""
+    out: list[dict] = []
+    with registry._lock:
+        now = registry._clock()
+        for hb in registry._live.values():
+            if hb.kind == "stage":
+                continue
+            if not (hb.stall_flagged or hb.cancelled):
+                continue
+            out.append({
+                "task": hb.label,
+                "kind": hb.kind,
+                "stage": hb.stage,
+                "beat_age_s": round(now - hb.t_beat, 1),
+                "units_done": hb.units_done,
+                "incident": "hard_timeout" if hb.cancelled
+                else "stalled",
+            })
+    out.sort(key=lambda s: -s["beat_age_s"])
+    return out
+
+
 _ACTIVE: Optional[Watchdog] = None  # guarded-by: _ACTIVE_LOCK
 _ACTIVE_LOCK = lockdebug.make_lock("watchdog_slot")
 

@@ -1,0 +1,196 @@
+"""bench-history: the perf trend across committed bench artifacts.
+
+Every PR commits its bench evidence as `BENCH_r<NN>.json` (the raw
+`bench.py` capture: command, exit code, last parsed JSON line).
+bench-compare gates ONE fresh measurement against the committed bands;
+this tool reads the whole committed series and renders metric ×
+revision, so a slow slide that never trips a single gate is still
+visible in one table — and flags every cell against the same
+`BENCH_BASELINE.json` bands bench-compare enforces.
+
+    python -m processing_chain_tpu tools bench-history
+    python -m processing_chain_tpu tools bench-history --dir REPO --json
+
+Cells render as the measured value, suffixed `!` when the value sits
+outside its baseline band (tools/bench_compare.py `compare_one`); `-`
+marks a revision that did not measure that metric (a capture from a
+host without the TPU cache, or a metric that did not exist yet).
+Exit is 0 unless `--gate-latest` is given and the NEWEST revision of
+any banded metric is out of band.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+from typing import Optional, Sequence
+
+from .bench_compare import DEFAULT_BASELINE, _REPO, compare_one
+
+_BENCH_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+#: parsed-payload field -> flat bench-compare metric name. Only fields
+#: with a committed band are mapped; everything else stays visible via
+#: --json but never renders a misleading `!`.
+_FIELD_METRICS = (
+    ("fused_vs_unfused", "e2e.fused_vs_unfused"),
+    ("sharedscan_vs_separate", "e2e.sharedscan_vs_separate"),
+    ("e2e_vs_baseline_1core", "e2e.vs_baseline_1core"),
+    ("priors_vs_proxy", "complexity.priors_vs_proxy"),
+)
+
+
+def extract(doc: dict) -> dict:
+    """The flat {metric: value} set one BENCH_r capture carries."""
+    parsed = doc.get("parsed") or {}
+    if not isinstance(parsed, dict):
+        return {}
+    out: dict = {}
+    # the kernel line reports per-chip fps only when it really ran on
+    # a TPU — a cpu/none capture's 0.34 is not a kernel regression
+    if parsed.get("platform") == "tpu" and parsed.get("value"):
+        out["kernel.fps_per_chip"] = parsed["value"]
+        if parsed.get("vs_baseline"):
+            out["kernel.vs_baseline"] = parsed["vs_baseline"]
+    for field, metric in _FIELD_METRICS:
+        if parsed.get(field) is not None:
+            out[metric] = parsed[field]
+    return out
+
+
+def load_history(repo_dir: str) -> list[dict]:
+    """Every committed BENCH_r capture, ordered by revision number:
+    [{revision, path, rc, metrics}]."""
+    rows = []
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        m = _BENCH_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rows.append({
+            "revision": int(m.group(1)),
+            "path": os.path.basename(path),
+            "rc": doc.get("rc"),
+            "metrics": extract(doc),
+        })
+    rows.sort(key=lambda r: r["revision"])
+    return rows
+
+
+def history_table(rows: list, baseline: dict) -> dict:
+    """The metric × revision table plus band verdicts: {metrics:
+    {name: {r<NN>: {value, in_band}}}, latest_out_of_band: [...]}."""
+    bands = (baseline or {}).get("metrics", {})
+    table: dict = {}
+    for row in rows:
+        for name, value in row["metrics"].items():
+            cell: dict = {"value": value}
+            spec = bands.get(name)
+            if spec is not None:
+                try:
+                    ok, band = compare_one(spec, value)
+                except (TypeError, ValueError):
+                    ok, band = None, "?"
+                cell["in_band"] = ok
+                cell["band"] = band
+            table.setdefault(name, {})[f"r{row['revision']:02d}"] = cell
+    latest_out = []
+    for name, cells in sorted(table.items()):
+        last = cells[max(cells)]
+        if last.get("in_band") is False:
+            latest_out.append(name)
+    return {"metrics": table, "latest_out_of_band": latest_out,
+            "revisions": [f"r{r['revision']:02d}" for r in rows]}
+
+
+def render(result: dict) -> str:
+    revisions = result["revisions"]
+    header = ("metric",) + tuple(revisions)
+    rows = []
+    for name, cells in sorted(result["metrics"].items()):
+        line = [name]
+        for rev in revisions:
+            cell = cells.get(rev)
+            if cell is None:
+                line.append("-")
+                continue
+            value = cell["value"]
+            txt = f"{value:g}" if isinstance(value, (int, float)) \
+                else str(value)
+            if cell.get("in_band") is False:
+                txt += "!"
+            line.append(txt)
+        rows.append(tuple(line))
+    widths = [len(h) for h in header]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+
+    def fmt(cells):
+        return "  ".join(c.ljust(w)
+                         for c, w in zip(cells, widths)).rstrip()
+
+    out = [fmt(header), fmt(tuple("-" * w for w in widths))]
+    out.extend(fmt(r) for r in rows)
+    if result["latest_out_of_band"]:
+        out.append(
+            "bench-history: latest revision OUT OF BAND for "
+            + ", ".join(result["latest_out_of_band"]))
+    else:
+        out.append(f"bench-history: {len(result['metrics'])} metrics "
+                   f"over {len(revisions)} revisions, latest in band")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools bench-history",
+        description="metric × revision table over the committed "
+                    "BENCH_r*.json series (docs/PERF.md)",
+    )
+    parser.add_argument("--dir", default=_REPO,
+                        help="directory holding BENCH_r*.json "
+                             "(default: the repo root)")
+    parser.add_argument("--baseline", default=None,
+                        help="band file (default: DIR/BENCH_BASELINE"
+                             ".json, falling back to the repo's)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable result instead of the "
+                             "table")
+    parser.add_argument("--gate-latest", action="store_true",
+                        help="exit 1 when the newest revision of any "
+                             "banded metric is out of band")
+    args = parser.parse_args(list(argv) if argv is not None else None)
+
+    baseline_path = args.baseline or os.path.join(
+        args.dir, "BENCH_BASELINE.json")
+    if not os.path.exists(baseline_path):
+        baseline_path = DEFAULT_BASELINE
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError):
+        baseline = {}
+    rows = load_history(args.dir)
+    if not rows:
+        print(f"bench-history: no BENCH_r*.json under {args.dir}")
+        return 2
+    result = history_table(rows, baseline)
+    if args.as_json:
+        print(json.dumps(result, indent=1, sort_keys=True))
+    else:
+        print(render(result), end="")
+    if args.gate_latest and result["latest_out_of_band"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

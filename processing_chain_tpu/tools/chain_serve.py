@@ -103,6 +103,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "prediction scale from the observed/"
                              "predicted ratio ring (serve/cost.py; "
                              "reported in /status and /fleet)")
+    parser.add_argument("--control-interval-s", type=float, default=10.0,
+                        help="SLO control loop cadence: alert-rule "
+                             "grading + scale-signal re-grade on the "
+                             "maintenance tick (docs/TELEMETRY.md "
+                             "\"Alerting & the scale signal\")")
+    parser.add_argument("--alert-window-scale", type=float, default=1.0,
+                        help="uniformly compress every burn-rate window "
+                             "and alert hold by this factor (soak "
+                             "harnesses squeeze hours into seconds; "
+                             "production leaves it at 1.0)")
     args = parser.parse_args(list(argv) if argv is not None else None)
 
     from .store_admin import _parse_bytes
@@ -132,6 +142,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         admission_budget_s=args.admission_budget_s,
         tenant_budget_s=args.tenant_budget_s,
         cost_calibrate=args.cost_calibrate,
+        control_interval_s=args.control_interval_s,
+        alert_window_scale=args.alert_window_scale,
     )
     stop = threading.Event()
 

@@ -11,7 +11,9 @@ obligations, all under the one ``telemetry-name`` rule:
      see is a name the doc drift check can't protect;
   3. the catalog and docs/TELEMETRY.md agree both ways: every catalog
      name appears in the doc, every ``chain_[a-z_]*`` token in the doc
-     appears in the catalog.
+     appears in the catalog — and the same for alert rules: every
+     ``ALERT_RULES`` key is documented as an ``alert:<name>`` token,
+     every ``alert:<name>`` token resolves to a declared rule.
 
 The registry plumbing itself (telemetry/metrics.py, events.py, the
 ``telemetry/__init__`` re-exports) is allowlisted: its parameters ARE
@@ -40,14 +42,17 @@ _ALLOW_FILES = (
 _EMIT_RECEIVERS = ("telemetry", "tm", "events", "EVENTS")
 
 _DOC_NAME_RE = re.compile(r"`(chain_[a-z0-9_]+)`")
+_DOC_ALERT_RE = re.compile(r"`alert:([a-z0-9_]+)`")
 
 
-def load_catalog(path: str) -> tuple[dict, set]:
-    """(METRICS dict, EVENTS set) parsed from the catalog module's AST."""
+def load_catalog(path: str) -> tuple[dict, set, set]:
+    """(METRICS dict, EVENTS set, ALERT_RULES names) parsed from the
+    catalog module's AST."""
     metrics: dict = {}
     events: set = set()
+    rules: set = set()
     if not os.path.isfile(path):
-        return metrics, events
+        return metrics, events, rules
     with open(path, encoding="utf-8") as f:
         tree = ast.parse(f.read())
     for node in tree.body:
@@ -68,7 +73,11 @@ def load_catalog(path: str) -> tuple[dict, set]:
             for sub in ast.walk(value):
                 if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
                     events.add(sub.value)
-    return metrics, events
+        if "ALERT_RULES" in targets and isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    rules.add(k.value)
+    return metrics, events, rules
 
 
 class TelemetryNameChecker(Checker):
@@ -77,7 +86,7 @@ class TelemetryNameChecker(Checker):
     def __init__(self, catalog_path: str, doc_path: str) -> None:
         self.catalog_path = catalog_path
         self.doc_path = doc_path
-        self.metrics, self.events = load_catalog(catalog_path)
+        self.metrics, self.events, self.rules = load_catalog(catalog_path)
 
     def visit_module(self, mod: ModuleSource) -> list[Finding]:
         if mod.rel in _ALLOW_FILES or not (self.metrics or self.events):
@@ -142,7 +151,7 @@ class TelemetryNameChecker(Checker):
 
     def finalize(self) -> list[Finding]:
         findings: list[Finding] = []
-        if not (self.metrics or self.events):
+        if not (self.metrics or self.events or self.rules):
             return findings
         try:
             with open(self.doc_path, encoding="utf-8") as f:
@@ -182,6 +191,28 @@ class TelemetryNameChecker(Checker):
                             "documented in docs/TELEMETRY.md",
                     symbol="doc-drift")
                 f_.snippet = name
+                findings.append(f_)
+        for name in sorted(self.rules):
+            if f"alert:{name}" not in doc:
+                f_ = Finding(
+                    rule=self.rule, path=rel_cat, line=1,
+                    message=f"alert rule {name!r} is in the catalog but "
+                            "not documented in docs/TELEMETRY.md (name "
+                            "it as `alert:" + name + "`)",
+                    symbol="doc-drift")
+                f_.snippet = name
+                findings.append(f_)
+        for tok in sorted(set(_DOC_ALERT_RE.findall(doc))):
+            if tok not in self.rules:
+                f_ = Finding(
+                    rule=self.rule, path=rel_doc,
+                    line=_doc_line(f"alert:{tok}"),
+                    message=f"docs/TELEMETRY.md names alert rule {tok!r} "
+                            "but telemetry/catalog.py ALERT_RULES does "
+                            "not declare it — stale doc or missing "
+                            "declaration",
+                    symbol="doc-drift")
+                f_.snippet = tok
                 findings.append(f_)
         for tok in sorted(set(_DOC_NAME_RE.findall(doc))):
             base = tok

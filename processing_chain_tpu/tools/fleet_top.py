@@ -88,6 +88,8 @@ def render(view: dict, note: str = "") -> str:
         else:
             extra = f"DEAD ({rep.get('error', '?')}, " \
                     f"info {rep.get('info_file')})"
+            if rep.get("last_seen_s") is not None:
+                extra += f"  last seen {_fmt_age(rep['last_seen_s'])} ago"
         lines.append(f" {mark} {ident:<44} {extra}")
     lines.append("")
     queue = view.get("queue", {})
@@ -138,6 +140,38 @@ def render(view: dict, note: str = "") -> str:
         lines.append(
             "tiers: " + "  ".join(parts)
             + f"  promotions={moves} demotions={demotes}"
+        )
+    stalls = view.get("stalls") or []
+    if stalls:
+        parts = []
+        for s in stalls[:6]:
+            stage = f"/{s['stage']}" if s.get("stage") else ""
+            parts.append(
+                f"{s.get('replica', '?')}:{s.get('task', '?')}{stage} "
+                f"{s.get('incident', 'stalled')} "
+                f"{_fmt_age(s.get('beat_age_s', 0.0))}"
+            )
+        more = f" (+{len(stalls) - 6})" if len(stalls) > 6 else ""
+        lines.append("active stalls: " + "  ".join(parts) + more)
+    alerts = (view.get("alerts") or {}).get("active") or []
+    if alerts:
+        parts = []
+        for a in alerts[:6]:
+            parts.append(
+                f"{a.get('rule', '?')}[{a.get('severity', '?')}] "
+                f"x{a.get('episodes', 1)}"
+            )
+        more = f" (+{len(alerts) - 6})" if len(alerts) > 6 else ""
+        lines.append(f"ALERTS firing: {len(alerts)}  "
+                     + "  ".join(parts) + more)
+    scale = view.get("scale")
+    if scale:
+        reasons = ",".join(scale.get("reasons") or []) or "-"
+        lines.append(
+            f"scale signal: {scale.get('current', '?')}"
+            f"→{scale.get('desired', '?')} replicas  "
+            f"confidence {scale.get('confidence', 0.0):.2f}  "
+            f"[{reasons}]"
         )
     mesh = view.get("mesh", {})
     if mesh.get("buckets"):

@@ -495,3 +495,148 @@ def test_events_carry_trace_fields(serve_factory):
                   and r.get("trace_id")]
     assert job_starts and job_starts[-1]["trace_id"] == acc["trace"]
     assert acc["request"] in job_starts[-1]["request_ids"]
+
+
+# ------------------------------------- fleet-merge edge cases (PR 20)
+
+
+def test_fleet_view_of_an_empty_root(tmp_path):
+    """No serve-info files at all: the view still builds (zero
+    replicas, empty SLO report, no alerts, no scale signal) and
+    fleet-top renders it instead of crashing."""
+    root = str(tmp_path / "empty")
+    os.makedirs(root)
+    view = fleet.fleet_view(root, timeout_s=0.5)
+    assert view["replicas"] == [] and view["alive"] == 0
+    assert view["slo"] == {} and view["read_slo"] == {}
+    assert view["stalls"] == []
+    assert view["alerts"]["active"] == []
+    assert view["alerts"]["journal"] == {"files": 0, "bytes": 0}
+    assert view["scale"] is None
+    frame = fleet_top.render(view)
+    assert "none discovered" in frame
+    assert "no phase observations yet" in frame
+
+
+def test_merge_histograms_across_catalog_versions():
+    """A fleet mid-upgrade: an old replica exposes only the execution
+    histograms, a new one also exposes the read-path family. The merge
+    must take the union — missing metrics on one replica must neither
+    crash nor zero the other's cells."""
+    from processing_chain_tpu.telemetry.metrics import MetricsRegistry
+
+    def render(with_read):
+        reg = MetricsRegistry()
+        reg.enabled = True
+        h = reg.histogram("chain_serve_queue_wait_seconds", "t",
+                          ("tenant", "priority"))
+        h.labels(tenant="acme", priority="interactive").observe(0.01)
+        if with_read:
+            r = reg.histogram("chain_serve_read_ttfb_seconds", "t",
+                              ("tenant", "size_class"))
+            r.labels(tenant="acme", size_class="small").observe(0.005)
+        return reg.render_prometheus()
+
+    names = [*fleet.PHASE_METRICS.values(),
+             *fleet.READ_PHASE_METRICS.values()]
+    old = fleet.parse_histograms(render(False), names)
+    new = fleet.parse_histograms(render(True), names)
+    merged = fleet.merge_histograms([old, new])
+    wait = [k for k in merged
+            if k[0] == "chain_serve_queue_wait_seconds"]
+    ttfb = [k for k in merged
+            if k[0] == "chain_serve_read_ttfb_seconds"]
+    assert merged[wait[0]]["count"] == 2     # both replicas merged
+    assert merged[ttfb[0]]["count"] == 1     # the new replica alone
+    assert fleet.slo_report(merged)["acme"]["interactive"][
+        "queue_wait_s"]["count"] == 2
+    assert fleet.read_slo_report(merged)["acme"]["small"][
+        "read_ttfb_s"]["count"] == 1
+    # an empty replica set merges to an empty report, not a crash
+    assert fleet.merge_histograms([]) == {}
+    assert fleet.slo_report({}) == {}
+
+
+def test_fleet_view_tolerates_torn_journal_tails(tmp_path):
+    """A scrape racing a SIGKILLed writer sees half-written final
+    lines in the span, heat, and alert journals — every complete
+    record must still count."""
+    from processing_chain_tpu.store import heat as store_heat
+    from processing_chain_tpu.telemetry import alerts
+
+    root = str(tmp_path / "torn")
+    spans_dir = os.path.join(root, "queue", "spans")
+    j = serve_spans.SpanJournal(spans_dir, "rep-a")
+    j.append("enqueue", job="j1", plan="p", state="queued", epoch=0)
+    j.close()
+    with open(os.path.join(spans_dir, "rep-a.jsonl"), "a") as f:
+        f.write('{"phase": "claim", "jo')
+    heat_dir = store_heat.heat_dir(os.path.join(root, "store"))
+    os.makedirs(heat_dir)
+    with open(os.path.join(heat_dir, "rep-a.jsonl"), "w") as f:
+        f.write(json.dumps({"kind": "read", "plan": "p", "mode": "full",
+                            "bytes": 10, "ts": 1.0}) + "\n")
+        f.write('{"kind": "evict", "pl')
+    aj = alerts.AlertJournal(alerts.alerts_dir(root), "rep-a")
+    aj.append({"kind": "fired", "id": "al-1", "alert": "k", "rule": "r",
+               "severity": "page", "labels": {}})
+    aj.close()
+    with open(os.path.join(alerts.alerts_dir(root),
+                           "rep-a.jsonl"), "a") as f:
+        f.write('{"kind": "resolved", "id"')
+    view = fleet.fleet_view(root, timeout_s=0.5)
+    assert view["spans"]["total"] == 1
+    assert view["heat"]["reads"] == 1
+    assert [a["id"] for a in view["alerts"]["active"]] == ["al-1"]
+    frame = fleet_top.render(view)
+    assert "ALERTS firing: 1" in frame
+
+
+def test_fleet_view_grades_dead_replicas_stale(tmp_path):
+    """A serve-info registration whose process stopped answering is
+    STALE with a last-seen age (the fleet_replica_stale rule's input),
+    and fleet-top says when it was last seen."""
+    from processing_chain_tpu.telemetry import alerts
+
+    root = str(tmp_path / "stale")
+    os.makedirs(root)
+    info = os.path.join(root, "serve-info-gone.json")
+    with open(info, "w") as f:
+        json.dump({"url": "http://127.0.0.1:9", "replica": "gone",
+                   "pid": 999999, "replica_epoch": 3}, f)
+    past = time.time() - 120.0
+    os.utime(info, (past, past))
+    view = fleet.fleet_view(root, timeout_s=0.5)
+    (entry,) = view["replicas"]
+    assert entry["alive"] is False and entry["status"] == "stale"
+    assert entry["error"] == "unreachable"
+    assert entry["last_seen_s"] == pytest.approx(120.0, abs=30.0)
+    frame = fleet_top.render(view)
+    assert "DEAD" in frame and "last seen" in frame
+    # the stale grade is exactly what the alert rule trips on
+    eng = alerts.AlertEngine(root, "grader")
+    fired = eng.evaluate(view)["fired"]
+    assert [s["rule"] for s in fired] == ["fleet_replica_stale"]
+    assert fired[0]["labels"]["replica"] == "gone"
+    eng.close()
+
+
+def test_fleet_view_carries_stalls_and_fleet_top_renders(serve_factory):
+    """An alive replica's /status stall episodes surface in the fleet
+    doc labelled with the replica, and fleet-top renders the active-
+    stalls line."""
+    from processing_chain_tpu.telemetry import watchdog
+
+    svc = serve_factory(workers=1)
+    stall = {"task": "wave", "stage": "p03", "kind": "task",
+             "incident": "stalled", "beat_age_s": 42.0}
+    real_active = watchdog.active_stalls
+    try:
+        watchdog.active_stalls = lambda registry=None: [dict(stall)]
+        view = fleet.fleet_view(svc.root, timeout_s=5.0)
+    finally:
+        watchdog.active_stalls = real_active
+    assert view["stalls"] == [{**stall, "replica": svc.replica}]
+    frame = fleet_top.render(view)
+    assert "active stalls:" in frame
+    assert f"{svc.replica}:wave/p03" in frame

@@ -628,7 +628,14 @@ def test_service_serves_through_tiers_end_to_end(serve_factory, tmp_path):
     status, body, _ = _get_h(url)
     assert status == 200 and len(body) == 4096
     assert svc.store.locate_tier(sha) == "hot"  # promoted read-through
-    reads = [r for r in store_heat.read_journals(
-        store_heat.heat_dir(svc.store.root))
-        if r.get("kind") == "read" and r.get("plan") == plan]
+    # the read lands in the journal from the post-stream completion
+    # callback, which the client's last byte can race — poll briefly
+    reads = []
+    deadline = time.time() + 5.0
+    while not reads and time.time() < deadline:
+        reads = [r for r in store_heat.read_journals(
+            store_heat.heat_dir(svc.store.root))
+            if r.get("kind") == "read" and r.get("plan") == plan]
+        if not reads:
+            time.sleep(0.05)
     assert reads and reads[-1]["tier"] == "warm"
